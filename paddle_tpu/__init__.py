@@ -1,0 +1,126 @@
+"""paddle_tpu: a TPU-native deep learning framework with PaddlePaddle's
+capabilities, built from scratch on JAX/XLA/Pallas.
+
+Structural blueprint: SURVEY.md at the repo root. The public API mirrors
+`paddle.*` (so a Paddle user can switch), while the implementation is
+TPU-first: XLA compilation instead of PHI CUDA kernels, GSPMD sharding
+instead of NCCL process groups, Pallas instead of hand-written CUDA.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# dtypes at top level (paddle.float32 ...)
+from .framework.dtype import (  # noqa: F401
+    bool, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128,
+    set_default_dtype, get_default_dtype,
+)
+from .framework import dtype as dtype  # noqa: F401
+from .framework.core import Tensor, to_tensor  # noqa: F401
+from .framework.core import EagerParamBase, Parameter  # noqa: F401
+from .framework.device import (  # noqa: F401
+    set_device, get_device, device_count, is_compiled_with_tpu,
+)
+from .framework.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .autograd.tape import no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from .autograd.tape import grad  # noqa: F401
+
+from . import tensor  # noqa: F401
+from . import autograd  # noqa: F401
+from . import ops  # noqa: F401
+
+# hoist every tensor op to the top level: paddle_tpu.add, paddle_tpu.matmul...
+for _name in dir(tensor):
+    if _name.startswith("_"):
+        continue
+    _fn = getattr(tensor, _name)
+    if callable(_fn) and getattr(_fn, "__module__", "").startswith("paddle_tpu.tensor"):
+        globals().setdefault(_name, _fn)
+globals()["einsum"] = tensor.einsum
+
+rand = tensor.random.rand
+randn = tensor.random.randn
+randint = tensor.random.randint
+randperm = tensor.random.randperm
+uniform = tensor.random.uniform
+normal = tensor.random.normal
+bernoulli = tensor.random.bernoulli
+multinomial = tensor.random.multinomial
+is_tensor = tensor.logic.is_tensor
+
+# subpackages that land in later milestones are imported lazily so the core
+# works standalone during bring-up
+import importlib as _importlib
+
+_LAZY = {
+    "nn": ".nn",
+    "optimizer": ".optimizer",
+    "io": ".io",
+    "amp": ".amp",
+    "jit": ".jit",
+    "metric": ".metric",
+    "distributed": ".distributed",
+    "vision": ".vision",
+    "hapi": ".hapi",
+    "profiler": ".profiler",
+    "linalg": ".tensor.linalg",
+    "incubate": ".incubate",
+    "distribution": ".distribution",
+    "sparse": ".sparse",
+    "static": ".static",
+    "device": ".framework.device",
+    "framework": ".framework",
+    "utils": ".utils",
+    "text": ".text",
+    "audio": ".audio",
+    "onnx": ".onnx",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        mod = _importlib.import_module(_LAZY[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def save(obj, path, protocol=4, **kwargs):
+    from .framework.io import save as _save
+
+    return _save(obj, path, protocol=protocol, **kwargs)
+
+
+def load(path, **kwargs):
+    from .framework.io import load as _load
+
+    return _load(path, **kwargs)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    from .hapi.summary import summary as _summary
+
+    return _summary(net, input_size, dtypes=dtypes, input=input)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from .hapi.summary import flops as _flops
+
+    return _flops(net, input_size, custom_ops=custom_ops, print_detail=print_detail)
+
+
+def set_flags(flags):
+    from .framework.flags import set_flags as _set
+
+    return _set(flags)
+
+
+def get_flags(flags=None):
+    from .framework.flags import get_flags as _get
+
+    return _get(flags)
+
+
+def set_grad_enabled_ctx(mode):  # paddle.set_grad_enabled is a context manager
+    return set_grad_enabled(mode)

@@ -1,0 +1,3 @@
+from .tape import (
+    no_grad, enable_grad, is_grad_enabled, set_grad_enabled, grad, run_backward,
+)

@@ -1,0 +1,349 @@
+"""Eager autograd: a define-by-run tape over `jax.vjp`.
+
+Reference parity: the eager autograd engine of the reference —
+`GradNodeBase` (`paddle/fluid/eager/grad_node_info.h:168`),
+`egr::Backward`/`RunBackward` (`paddle/fluid/eager/backward.cc:421,104`,
+reverse-topological ready-queue), `GradTensorHolder` accumulation, and
+`AutogradMeta` wiring.
+
+TPU-first design: the reference generates a C++ ``GradNode`` class per op from
+YAML, each re-implementing the backward kernel call. Here a single generic
+:class:`GradNode` holds the `jax.vjp` pullback of the forward computation —
+XLA already knows every op's VJP, residuals are saved on-device, and the
+pullback is itself traceable (so a whole jit'd subgraph can be one node, the
+way the reference runs a `RunProgramGradNode` for @to_static blocks).
+Topological order falls out of monotonically increasing node ids (a Wengert
+list), replacing the reference's in-degree bookkeeping.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_local = threading.local()
+_node_counter = itertools.count()
+
+
+def _tracing_flag():
+    if not hasattr(_local, "grad_enabled"):
+        _local.grad_enabled = True
+    return _local.grad_enabled
+
+
+def is_grad_enabled() -> bool:
+    return _tracing_flag()
+
+
+def set_grad_enabled(mode: bool):
+    _tracing_flag()
+    _local.grad_enabled = bool(mode)
+
+
+class no_grad(contextlib.ContextDecorator):
+    """Context manager / decorator that disables autograd recording.
+
+    Mirrors ``paddle.no_grad`` (reference `python/paddle/fluid/dygraph/base.py`).
+    """
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+class InputRef:
+    """Snapshot of an input tensor's autograd state at record time.
+
+    Recording the producing node *by value* (instead of re-reading
+    ``tensor._grad_node`` during backward) makes in-place mutation safe: if
+    the tensor is later rebound by ``__setitem__``/``increment``, nodes
+    recorded before the mutation still route cotangents into the graph that
+    actually produced the value they consumed — the tape equivalent of the
+    reference's TensorWrapper capture (`paddle/fluid/eager/tensor_wrapper.h`).
+    """
+
+    __slots__ = ("tensor", "node", "out_index", "requires")
+
+    def __init__(self, tensor, requires):
+        self.tensor = tensor
+        self.node = tensor._grad_node if requires else None
+        self.out_index = tensor._out_index if requires else 0
+        self.requires = requires
+
+
+class GradNode:
+    """One recorded op: holds the vjp pullback and links to input snapshots.
+
+    ``vjp_fn(out_cotangents) -> tuple(in_cotangents)`` — exactly `jax.vjp`'s
+    pullback contract. Strong refs to input tensors keep the upstream graph
+    alive while any consumer output lives (the reference's shared_ptr graph
+    ownership).
+    """
+
+    __slots__ = (
+        "id", "op_name", "vjp_fn", "inputs", "out_avals", "n_outputs",
+        "out_tensor_refs",
+    )
+
+    def __init__(self, op_name, vjp_fn, input_tensors, requires, out_avals):
+        self.id = next(_node_counter)
+        self.op_name = op_name
+        self.vjp_fn = vjp_fn
+        self.inputs = [InputRef(t, r) for t, r in zip(input_tensors, requires)]
+        self.out_avals = out_avals  # list[(shape, dtype)] per output
+        self.n_outputs = len(out_avals)
+        # weakrefs to output tensors; used to fire user hooks once per
+        # backward on the fully-accumulated cotangent
+        self.out_tensor_refs = [None] * len(out_avals)
+
+    def __repr__(self):
+        return f"<GradNode {self.op_name}#{self.id} nout={self.n_outputs}>"
+
+
+def _accumulate(existing, new):
+    if existing is None:
+        return new
+    return existing + new
+
+
+def _zeros_for(aval):
+    shape, dtype = aval
+    if not jnp.issubdtype(dtype, jnp.inexact):
+        # jax represents cotangents of integer/bool outputs as float0
+        return np.zeros(shape, jax.dtypes.float0)
+    return jnp.zeros(shape, dtype)
+
+
+def _apply_hooks(tensor, ct):
+    from ..framework.core import Tensor
+
+    for hook in tensor._grad_hooks:
+        out = hook(ct)
+        if out is not None:
+            ct = out._data if isinstance(out, Tensor) else out
+    return ct
+
+
+def _topo_nodes(roots):
+    """All GradNodes reachable from the root tensors, sorted by id desc.
+
+    Creation order is a valid topological order (a Wengert list), so id-desc
+    processing guarantees every consumer runs before its producer."""
+    seen = {}
+    stack = [t._grad_node for t in roots if t._grad_node is not None]
+    while stack:
+        node = stack.pop()
+        if node.id in seen:
+            continue
+        seen[node.id] = node
+        for ref in node.inputs:
+            if ref.requires and ref.node is not None and ref.node.id not in seen:
+                stack.append(ref.node)
+    return sorted(seen.values(), key=lambda n: n.id, reverse=True)
+
+
+def _sweep(root_tensors, root_cts, retain_graph, on_leaf, on_retained=None):
+    """Shared reverse-topological engine for `backward()` and `paddle.grad`.
+
+    Accumulates output cotangents per node, fires output-tensor hooks once on
+    the fully-accumulated cotangent, calls each node's vjp pullback once —
+    the eager equivalent of `egr::RunBackward` (reference
+    `eager/backward.cc:104` ready-queue + GradTensorHolder accumulation).
+
+    ``on_leaf(tensor, ct)`` receives each contribution destined for a leaf
+    (no producing node at record time). ``on_retained(tensor, ct)`` fires for
+    non-leaf tensors with ``retain_grads()`` set.
+    """
+    pending: dict[int, list] = {}
+
+    def route_ref(ref, ct):
+        if ref.node is None:
+            on_leaf(ref.tensor, ct)
+            return
+        if ref.tensor._retain_grad and on_retained is not None:
+            on_retained(ref.tensor, ct)
+        bucket = pending.setdefault(ref.node.id, [None] * ref.node.n_outputs)
+        bucket[ref.out_index] = _accumulate(bucket[ref.out_index], ct)
+
+    for t, ct in zip(root_tensors, root_cts):
+        route_ref(InputRef(t, True), ct)
+
+    nodes = _topo_nodes(root_tensors)
+    with no_grad():
+        for node in nodes:
+            bucket = pending.pop(node.id, None)
+            if bucket is None:
+                continue
+            out_cts = []
+            for i, (ct, aval) in enumerate(zip(bucket, node.out_avals)):
+                ct = ct if ct is not None else _zeros_for(aval)
+                ref = node.out_tensor_refs[i]
+                out_t = ref() if ref is not None else None
+                if out_t is not None and out_t._grad_hooks:
+                    ct = _apply_hooks(out_t, ct)
+                out_cts.append(ct)
+            if node.n_outputs == 1:
+                in_cts = node.vjp_fn(out_cts[0])
+            else:
+                in_cts = node.vjp_fn(tuple(out_cts))
+            for ref, ct in zip(node.inputs, in_cts):
+                if not ref.requires:
+                    continue
+                if hasattr(ct, "dtype") and ct.dtype == jax.dtypes.float0:
+                    continue
+                route_ref(ref, ct)
+            if not retain_graph:
+                node.vjp_fn = _used_vjp_error
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False):
+    """`tensor.backward()` engine: deposits into leaf ``.grad`` attributes.
+
+    Like the reference Tensor.backward, a missing grad_tensor seeds ones of
+    the output's shape (any shape, not just scalars).
+    """
+    from ..framework.core import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    root_cts = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            root_cts.append(jnp.ones(t._data.shape, t._data.dtype))
+        else:
+            root_cts.append(g._data if isinstance(g, Tensor) else jnp.asarray(g))
+
+    # accumulate each target's full gradient first so user hooks fire once
+    # per backward with the final value (reference: the grad-accumulation
+    # node runs hooks after fan-in completes)
+    acc: dict[int, list] = {}
+
+    def on_leaf(tensor, ct):
+        if tensor.stop_gradient:
+            return
+        rec = acc.setdefault(id(tensor), [tensor, None])
+        rec[1] = _accumulate(rec[1], ct)
+
+    def on_retained(tensor, ct):
+        rec = acc.setdefault(id(tensor), [tensor, None])
+        rec[1] = _accumulate(rec[1], ct)
+
+    _sweep(tensors, root_cts, retain_graph, on_leaf, on_retained)
+
+    for tensor, ct in acc.values():
+        ct = _apply_hooks(tensor, ct)
+        if tensor.grad is None:
+            tensor.grad = Tensor(ct, stop_gradient=True)
+        else:
+            tensor.grad = Tensor(tensor.grad._data + ct, stop_gradient=True)
+
+
+def _used_vjp_error(*_):
+    raise RuntimeError(
+        "Trying to run backward through a graph a second time. "
+        "Pass retain_graph=True to backward() to allow this."
+    )
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    allow_unused=False,
+):
+    """Functional gradient query: `paddle.grad` parity
+    (reference `fluid/eager/general_grad.h`).
+
+    Computes d(outputs)/d(inputs) without touching any ``.grad`` attribute.
+    ``create_graph`` is not supported on the eager tape — use
+    :mod:`paddle_tpu.autograd.functional` (jax-native transforms) for
+    higher-order derivatives.
+    """
+    from ..framework.core import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported on the eager tape; use "
+            "paddle_tpu.autograd.functional (vjp/jvp/hessian) for "
+            "higher-order gradients."
+        )
+    single_in = isinstance(inputs, Tensor)
+    if single_in:
+        inputs = [inputs]
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    retain_graph = bool(retain_graph)
+
+    wanted = {id(t) for t in inputs}
+    results = {id(t): None for t in inputs}
+
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+
+    root_cts = []
+    for t, g in zip(outputs, grad_outputs):
+        if g is None:
+            root_cts.append(jnp.ones(t._data.shape, t._data.dtype))
+        else:
+            root_cts.append(g._data if isinstance(g, Tensor) else jnp.asarray(g))
+
+    def collect(tensor, ct):
+        if id(tensor) in wanted:
+            results[id(tensor)] = (
+                ct if results[id(tensor)] is None else results[id(tensor)] + ct
+            )
+
+    # deliver cotangents of wanted non-leaf tensors via the retain channel
+    saved_retain = [(t, t._retain_grad) for t in inputs]
+    for t in inputs:
+        t._retain_grad = True
+    try:
+        _sweep(outputs, root_cts, retain_graph, collect, collect)
+    finally:
+        for t, r in saved_retain:
+            t._retain_grad = r
+
+    out = []
+    for t in inputs:
+        r = results[id(t)]
+        if r is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears to not have "
+                    "been used in the graph. Set allow_unused=True if this "
+                    "is the desired behavior."
+                )
+            out.append(None)
+        else:
+            out.append(Tensor(r, stop_gradient=True))
+    return out[0] if single_in else out

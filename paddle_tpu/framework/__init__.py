@@ -1,0 +1,7 @@
+from . import core, device, dtype, random
+from .core import Tensor, Parameter, EagerParamBase, to_tensor
+from .device import set_device, get_device, device_count, is_compiled_with_tpu
+from .dtype import (
+    set_default_dtype, get_default_dtype, convert_dtype,
+)
+from .random import seed, get_rng_state, set_rng_state

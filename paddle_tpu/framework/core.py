@@ -1,0 +1,324 @@
+"""The Tensor type.
+
+Reference parity: `phi::DenseTensor` (`paddle/phi/core/dense_tensor.h:41`) +
+the eager pybind Tensor object (`paddle/fluid/pybind/eager.cc`,
+`eager_method.cc`, `eager_properties.cc`) and its `AutogradMeta`
+(`paddle/fluid/eager/autograd_meta.h`).
+
+TPU-first design: a Tensor is a thin shell around a `jax.Array` (a PJRT
+buffer on TPU, or a tracer under jit). There is no LoD, no layout enum, no
+holder/allocator plumbing — XLA owns layout and memory. Autograd metadata
+(``stop_gradient``, ``grad``, producing :class:`~paddle_tpu.autograd.tape.GradNode`)
+lives directly on the shell. All ops route through
+:func:`paddle_tpu.ops.dispatch.apply`, which is where AMP, Pallas-kernel
+overrides, and tape recording happen.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtype_mod
+from .device import current_device
+
+
+def _is_jax_value(x):
+    return isinstance(x, jax.Array) or isinstance(x, jax.core.Tracer)
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_out_index",
+        "_grad_hooks",
+        "_retain_grad",
+        "name",
+        "persistable",
+        "trainable",
+        "is_parameter",
+        "_sharding_spec",
+        "__weakref__",
+    )
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None, place=None):
+        dtype = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+        if isinstance(data, Tensor):
+            arr = data._data
+            if dtype is not None and arr.dtype != np.dtype(dtype):
+                arr = arr.astype(dtype)
+        elif _is_jax_value(data):
+            arr = data if dtype is None else data.astype(dtype)
+        else:
+            np_arr = np.asarray(data, dtype=dtype)
+            # 32-bit-first: jax runs in 32-bit mode (TPU-native); python ints
+            # and int64 numpy inputs land as int32, float64 as float32.
+            arr = jax.device_put(np_arr, place or current_device())
+        self._data = arr
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self._grad_hooks = []
+        self._retain_grad = False
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self.is_parameter = False
+        self._sharding_spec = None
+
+    # ---- metadata ----
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return dtype_mod.convert_dtype(self._data.dtype)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return math.prod(self._data.shape)
+
+    @property
+    def place(self):
+        devs = getattr(self._data, "devices", None)
+        if devs is None:
+            return None
+        try:
+            return next(iter(self._data.devices()))
+        except Exception:
+            return None
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def dim(self):
+        return self._data.ndim
+
+    def numel(self):
+        return self.size
+
+    # ---- host interop ----
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, *a, **k):
+        return self._data.__dlpack__(*a, **k)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
+    # ---- autograd ----
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from ..autograd.tape import run_backward
+
+        run_backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._data))
+        else:
+            self.grad = None
+
+    clear_grad = clear_gradient
+
+    def register_hook(self, hook):
+        """Hook fires on the gradient as it is deposited into ``.grad``
+        (parity: `Tensor.register_hook`, used by EagerReducer-style overlap)."""
+        self._grad_hooks.append(hook)
+
+        class _Removable:
+            def remove(_self):
+                try:
+                    self._grad_hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Removable()
+
+    def retain_grads(self):
+        self._retain_grad = True
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from ..ops.dispatch import apply
+
+        return apply("clone", lambda x: x + jnp.zeros((), x.dtype), (self,))
+
+    # ---- mutation (functional under the hood) ----
+    def _replace_(self, array):
+        """In-place value replacement: rebinds the underlying buffer.
+
+        Used by optimizers (`param -= lr*grad`) and ``__setitem__``. Under
+        autograd this severs no history by itself; callers decide whether the
+        new value carries a grad node.
+        """
+        self._data = array
+        return self
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            arr = value._data
+        else:
+            arr = jax.device_put(
+                np.asarray(value, dtype=np.dtype(self.dtype)), current_device()
+            )
+        if tuple(arr.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {arr.shape} vs {self._data.shape}"
+            )
+        self._data = arr.astype(self._data.dtype)
+        self._grad_node = None
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    # ---- conversions ----
+    def astype(self, dtype):
+        from ..ops.dispatch import apply
+
+        d = dtype_mod.convert_dtype(dtype)
+        return apply("cast", lambda x: x.astype(d), (self,))
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def to(self, *args, **kwargs):
+        # accepts dtype or device string, paddle-style
+        t = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a.split(":")[0] in ("cpu", "gpu", "tpu"):
+                from .device import _PLATFORM_ALIASES, _available_platforms
+
+                plat = a.split(":")[0]
+                idx = int(a.split(":")[1]) if ":" in a else 0
+                plats = _available_platforms()
+                for cand in _PLATFORM_ALIASES.get(plat, (plat,)):
+                    if cand in plats:
+                        t = Tensor(
+                            jax.device_put(t._data, plats[cand][idx]),
+                            stop_gradient=t.stop_gradient,
+                        )
+                        break
+            else:
+                t = t.astype(a)
+        return t
+
+    def cpu(self):
+        return self.to("cpu")
+
+    # ---- misc dunder ----
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_s = "" if self.stop_gradient else ", stop_gradient=False"
+        try:
+            data_s = np.array2string(
+                np.asarray(self._data), precision=6, separator=", "
+            )
+        except Exception:
+            data_s = f"<{type(self._data).__name__}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}"
+            f"{grad_s},\n       {data_s})"
+        )
+
+    def __bool__(self):
+        return builtins_bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __index__(self):
+        return int(self._data)
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    # Arithmetic/comparison/indexing dunders are attached by
+    # paddle_tpu.tensor modules via attach_tensor_methods().
+
+
+builtins_bool = bool
+
+
+def attach_tensor_methods(mapping: dict):
+    """Attach functions as Tensor methods (the way the reference binds
+    generated pybind methods onto the Tensor pyobject —
+    `paddle/fluid/pybind/eager_method.cc`)."""
+    for name, fn in mapping.items():
+        setattr(Tensor, name, fn)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """`paddle.to_tensor` parity (reference `python/paddle/tensor/creation.py`)."""
+    if isinstance(data, Tensor):
+        t = Tensor(data, dtype=dtype, place=place)
+        t.stop_gradient = stop_gradient
+        return t
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient, place=place)
+
+
+class EagerParamBase(Tensor):
+    """Parameter: a trainable, persistable Tensor
+    (parity: `EagerParamBase` in reference `python/paddle/fluid/framework.py`)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.is_parameter = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+
+Parameter = EagerParamBase

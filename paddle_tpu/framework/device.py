@@ -1,0 +1,114 @@
+"""Device management.
+
+Reference parity: `paddle.set_device` / `paddle.get_device`
+(reference `python/paddle/device/__init__.py:244`) and the DeviceManager
+plugin registry (`paddle/phi/backends/device_manager.h:128`).
+
+TPU-first design: a "device" is a JAX device (PJRT). There are no streams to
+manage — XLA owns ordering — so the reference's DeviceContext/stream machinery
+collapses to "which jax.Device do creation ops place onto". Sharded (multi-
+device) placement is handled by the distributed layer via `jax.sharding`.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _platform_of(name: str) -> str:
+    # normalize paddle-style device strings: "tpu", "tpu:0", "cpu", "gpu:1"
+    return name.split(":")[0].lower()
+
+
+def _index_of(name: str) -> int:
+    parts = name.split(":")
+    return int(parts[1]) if len(parts) > 1 else 0
+
+
+_PLATFORM_ALIASES = {
+    # the axon tunnel exposes the real TPU chip under an experimental platform
+    # name; treat it as "tpu" for user-facing purposes.
+    "tpu": ("tpu", "axon"),
+    "cpu": ("cpu",),
+    "gpu": ("gpu", "cuda", "rocm"),
+}
+
+
+def _available_platforms():
+    plats = {}
+    for d in jax.devices():
+        plats.setdefault(d.platform.lower(), []).append(d)
+    return plats
+
+
+def set_device(device: str):
+    """Select the device that subsequent tensor-creation ops place data on.
+
+    Accepts ``"tpu"``, ``"tpu:0"``, ``"cpu"``, ``"gpu:1"``.
+    """
+    platform = _platform_of(device)
+    index = _index_of(device)
+    plats = _available_platforms()
+    candidates = _PLATFORM_ALIASES.get(platform, (platform,))
+    for cand in candidates:
+        if cand in plats:
+            devs = plats[cand]
+            if index >= len(devs):
+                raise ValueError(
+                    f"device index {index} out of range for platform {cand!r} "
+                    f"({len(devs)} devices)"
+                )
+            _state.device = devs[index]
+            _state.name = f"{platform}:{index}"
+            return _state.device
+    # fall back to jax.devices('cpu') which always exists even when the
+    # default platform is tpu
+    if platform == "cpu":
+        devs = jax.devices("cpu")
+        _state.device = devs[index]
+        _state.name = f"cpu:{index}"
+        return _state.device
+    raise ValueError(
+        f"device {device!r} not available; present platforms: {sorted(plats)}"
+    )
+
+
+def get_device() -> str:
+    """Paddle-style device string for the current device."""
+    if not hasattr(_state, "name"):
+        _init_default()
+    return _state.name
+
+
+def current_device() -> jax.Device:
+    """The jax.Device creation ops place onto."""
+    if not hasattr(_state, "device"):
+        _init_default()
+    return _state.device
+
+
+def _init_default():
+    d = jax.devices()[0]
+    platform = d.platform.lower()
+    for public, aliases in _PLATFORM_ALIASES.items():
+        if platform in aliases:
+            platform = public
+            break
+    _state.device = d
+    _state.name = f"{platform}:0"
+
+
+def is_compiled_with_tpu() -> bool:
+    plats = _available_platforms()
+    return bool(plats.get("tpu") or plats.get("axon"))
+
+
+def device_count(platform: str | None = None) -> int:
+    if platform is None:
+        return len(jax.devices())
+    candidates = _PLATFORM_ALIASES.get(platform.lower(), (platform.lower(),))
+    plats = _available_platforms()
+    return sum(len(plats.get(c, ())) for c in candidates)

@@ -1,0 +1,110 @@
+"""Dtype system.
+
+TPU-first: bfloat16 is a first-class dtype (the MXU's native 16-bit type);
+float64 is supported but discouraged (TPU emulates it slowly).
+
+Reference parity: mirrors the dtype surface of ``paddle.dtype``
+(`python/paddle/framework/dtype.py` in the reference) — same public names
+(`paddle.float32`, `paddle.bfloat16`, ...), but represented directly as numpy
+dtypes so they interoperate with jax/numpy with zero conversion cost.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype objects. These are numpy dtype classes, which jax accepts
+# natively everywhere a dtype is expected.
+bool = np.bool_  # noqa: A001 - matching paddle's public name
+uint8 = np.uint8
+int8 = np.int8
+int16 = np.int16
+int32 = np.int32
+int64 = np.int64
+float16 = np.float16
+bfloat16 = jnp.bfloat16
+float32 = np.float32
+float64 = np.float64
+complex64 = np.complex64
+complex128 = np.complex128
+
+_ALIASES = {
+    "bool": bool,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    # paddle legacy VarDesc-style names
+    "FP16": float16,
+    "FP32": float32,
+    "FP64": float64,
+    "BF16": bfloat16,
+    "INT8": int8,
+    "INT16": int16,
+    "INT32": int32,
+    "INT64": int64,
+    "BOOL": bool,
+    "UINT8": uint8,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGER = {uint8, int8, int16, int32, int64}
+_COMPLEX = {complex64, complex128}
+
+_default_dtype = [float32]
+
+
+def convert_dtype(dtype):
+    """Normalize a user-provided dtype (str, numpy dtype, jnp dtype) to a
+    canonical numpy dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype in _ALIASES:
+            return _ALIASES[dtype]
+        raise ValueError(f"unknown dtype string: {dtype!r}")
+    if dtype in _ALIASES.values():
+        return dtype
+    # numpy dtype instance or jax type
+    npdtype = np.dtype(dtype)
+    name = npdtype.name
+    if name in _ALIASES:
+        return _ALIASES[name]
+    raise ValueError(f"unsupported dtype: {dtype!r}")
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    if d is bfloat16:
+        return "bfloat16"
+    return np.dtype(d).name
+
+
+def is_floating_point(dtype):
+    return convert_dtype(dtype) in _FLOATING
+
+
+def is_integer(dtype):
+    return convert_dtype(dtype) in _INTEGER
+
+
+def is_complex(dtype):
+    return convert_dtype(dtype) in _COMPLEX
+
+
+def set_default_dtype(dtype):
+    d = convert_dtype(dtype)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"default dtype must be floating point, got {dtype}")
+    _default_dtype[0] = d
+
+
+def get_default_dtype():
+    return _default_dtype[0]

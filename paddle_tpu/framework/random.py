@@ -1,0 +1,94 @@
+"""Global RNG state.
+
+Reference parity: `paddle.seed` and `phi::Generator`
+(reference `paddle/phi/core/generator.h`) — a per-device stateful generator.
+
+TPU-first design: JAX PRNG is functional (splittable keys, no hidden state),
+which is what makes dropout reproducible under tracing and sharding. We keep a
+*thin* stateful wrapper for the Paddle-shaped API (`paddle.seed`,
+`get_rng_state`/`set_rng_state`) but every consumer takes an explicit key via
+:func:`next_key`, and traced code (jit / shard_map) can override the key
+source with :func:`rng_scope` so randomness flows through traced arguments
+instead of being baked into the compiled program as a constant.
+
+The distributed layer builds `RNGStatesTracker` (TP/PP-deterministic dropout,
+reference `fleet/layers/mpu/random.py`) on top of :func:`rng_scope`.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+class _KeySource:
+    """Stateful splittable key source."""
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self._key = jax.random.key(seed)
+
+    def next(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key):
+        self._key = key
+
+
+def _default_source() -> _KeySource:
+    if not hasattr(_state, "source"):
+        _state.source = _KeySource(0)
+    return _state.source
+
+
+def _scopes():
+    if not hasattr(_state, "scopes"):
+        _state.scopes = []
+    return _state.scopes
+
+
+def seed(value: int):
+    """Reset the global generator. Returns the new key source."""
+    _state.source = _KeySource(int(value))
+    return _state.source
+
+
+def next_key():
+    """Produce a fresh PRNG key.
+
+    Inside an :func:`rng_scope`, keys are split from the scope's (possibly
+    traced) key — this is how jit'd programs thread randomness through traced
+    arguments. Outside any scope, keys come from the global generator.
+    """
+    scopes = _scopes()
+    if scopes:
+        key, sub = jax.random.split(scopes[-1][0])
+        scopes[-1][0] = key
+        return sub
+    return _default_source().next()
+
+
+@contextlib.contextmanager
+def rng_scope(key):
+    """Route :func:`next_key` to split from ``key`` (which may be a tracer)."""
+    cell = [key]
+    _scopes().append(cell)
+    try:
+        yield cell
+    finally:
+        _scopes().pop()
+
+
+def get_rng_state():
+    return _default_source().get_state()
+
+
+def set_rng_state(key):
+    _default_source().set_state(key)

@@ -1,0 +1,3 @@
+from . import dispatch, registry
+from .dispatch import apply, apply_nondiff
+from .registry import register_kernel, list_ops, op_stats

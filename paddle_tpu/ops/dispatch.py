@@ -1,0 +1,128 @@
+"""The single op-dispatch path.
+
+Reference parity: this is the TPU equivalent of the generated
+``<op>_ad_func`` eager functions (reference
+`paddle/fluid/eager/auto_code_generator/generator/eager_gen.py` output into
+`eager/api/generated/eager_generated/forwards/dygraph_functions.cc`) plus the
+PHI API dispatch (`paddle/phi/api/lib/kernel_dispatch.h:48`). Every eager op
+call flows through :func:`apply`:
+
+    AMP autocast  ->  kernel selection (XLA default / Pallas override)
+                  ->  execute (jax, async dispatch to TPU)
+                  ->  tape recording (GradNode with jax.vjp pullback)
+
+TPU-first design: instead of per-op generated C++ (forward fn + GradNode
+class + Python binding), one generic path suffices because jax provides the
+kernel *and* its VJP for every op, and XLA's async dispatch plays the role of
+the CUDA stream. The hot path cost is a few Python frames + jax dispatch.
+"""
+from __future__ import annotations
+
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import registry
+from ..autograd import tape
+from ..framework.core import Tensor
+
+# AMP hook: set by paddle_tpu.amp. Signature: (op_name, arrays) -> arrays
+_amp_hook = None
+# Watchdog hook: set by paddle_tpu.framework.flags nan/inf checking.
+_check_hook = None
+
+
+def set_amp_hook(fn):
+    global _amp_hook
+    _amp_hook = fn
+
+
+def set_check_hook(fn):
+    global _check_hook
+    _check_hook = fn
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_inexact(dtype):
+    return jnp.issubdtype(dtype, jnp.inexact)
+
+
+def apply(op_name, fn, operands, n_outputs=None, **static):
+    """Execute ``fn(*arrays, **static)`` with autograd recording.
+
+    ``operands`` is the positional tensor-like inputs (Tensor, jax array,
+    numpy array, or python scalar). ``static`` kwargs are compile-time
+    attributes (axes, shapes, flags) — never differentiated.
+
+    Returns Tensor or tuple[Tensor] mirroring fn's output structure.
+    """
+    registry.count_call(op_name)
+    kernel = registry.lookup_kernel(op_name)
+    if kernel is not None:
+        fn = kernel
+
+    arrays = [_unwrap(x) for x in operands]
+    if _amp_hook is not None:
+        arrays = _amp_hook(op_name, arrays)
+
+    requires = [
+        isinstance(x, Tensor) and not x.stop_gradient for x in operands
+    ]
+    record = tape.is_grad_enabled() and any(requires)
+
+    if record:
+        def pure(*arrs):
+            out = fn(*arrs, **static)
+            return tuple(out) if isinstance(out, (tuple, list)) else out
+
+        out, vjp_fn = jax.vjp(pure, *arrays)
+        multi = isinstance(out, tuple)
+        outs = out if multi else (out,)
+        # ops whose outputs are all non-inexact (argmax, comparisons, int
+        # casts) produce no gradient flow; drop the node.
+        if not any(_is_inexact(o.dtype) for o in outs):
+            record = False
+    else:
+        out = fn(*arrays, **static)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+
+    if _check_hook is not None:
+        _check_hook(op_name, outs)
+
+    node = None
+    if record:
+        in_tensors = [
+            x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+            for x in operands
+        ]
+        out_avals = [(o.shape, o.dtype) for o in outs]
+        node = tape.GradNode(op_name, vjp_fn, in_tensors, requires, out_avals)
+
+    results = []
+    for i, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=True)
+        if node is not None and _is_inexact(o.dtype):
+            t.stop_gradient = False
+            t._grad_node = node
+            t._out_index = i
+            node.out_tensor_refs[i] = weakref.ref(t)
+        results.append(t)
+
+    return tuple(results) if multi else results[0]
+
+
+def apply_nondiff(op_name, fn, operands, **static):
+    """Dispatch with recording unconditionally off (comparisons, argsort
+    indices, random masks...)."""
+    registry.count_call(op_name)
+    arrays = [_unwrap(x) for x in operands]
+    out = fn(*arrays, **static)
+    if isinstance(out, (tuple, list)):
+        return tuple(Tensor(o) for o in out)
+    return Tensor(out)

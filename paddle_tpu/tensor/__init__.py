@@ -1,0 +1,27 @@
+"""Tensor function namespace (parity: `python/paddle/tensor/__init__.py`).
+
+Every public op defined in the submodules is re-exported here (and bound as a
+Tensor method by `attach`)."""
+from ..framework.core import Tensor, to_tensor
+from . import creation, linalg, logic, manipulation, math, random, search, stat
+from .einsum import einsum
+
+
+def _reexport(mod, into):
+    for name in dir(mod):
+        if name.startswith("_"):
+            continue
+        fn = getattr(mod, name)
+        if callable(fn) and getattr(fn, "__module__", "").startswith(
+            "paddle_tpu.tensor"
+        ):
+            into.setdefault(name, fn)
+
+
+_ns: dict = {}
+for _mod in (math, manipulation, creation, logic, search, stat, linalg, random):
+    _reexport(_mod, _ns)
+_ns.pop("Tensor", None)
+globals().update(_ns)
+
+from . import attach  # noqa: F401,E402  (binds Tensor methods; import for effect)

@@ -1,0 +1,140 @@
+"""Bind tensor functions as Tensor methods and operator dunders.
+
+Reference parity: the generated pybind method table
+(`paddle/fluid/pybind/eager_method.cc` + generated `eager_op_function.cc`) —
+here a plain attribute attachment, no codegen needed.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, attach_tensor_methods
+from ..ops.dispatch import apply, apply_nondiff
+from . import creation, linalg, logic, manipulation, math, random, search, stat
+
+
+def _swap(fn):
+    def g(self, other):
+        return fn(other if isinstance(other, Tensor) else Tensor(jnp.asarray(other)), self)
+    return g
+
+
+def _getitem(self, idx):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._data
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(np.asarray(i))
+        return i
+    if isinstance(idx, tuple):
+        jidx = tuple(conv(i) for i in idx)
+    else:
+        jidx = conv(idx)
+    return apply("getitem", lambda a: a[jidx], (self,))
+
+
+def _setitem(self, idx, value):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._data
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(np.asarray(i))
+        return i
+    jidx = tuple(conv(i) for i in idx) if isinstance(idx, tuple) else conv(idx)
+    if isinstance(value, Tensor):
+        out = apply(
+            "setitem", lambda a, v: a.at[jidx].set(v.astype(a.dtype)), (self, value)
+        )
+    else:
+        out = apply(
+            "setitem",
+            lambda a: a.at[jidx].set(jnp.asarray(value, a.dtype)),
+            (self,),
+        )
+    manipulation._adopt_inplace(self, out)
+
+
+def _inplace(fn):
+    def g(self, *args, **kwargs):
+        out = fn(self, *args, **kwargs)
+        return manipulation._adopt_inplace(self, out)
+    return g
+
+
+_METHODS = {
+    # arithmetic dunders
+    "__add__": math.add,
+    "__radd__": _swap(math.add),
+    "__sub__": math.subtract,
+    "__rsub__": _swap(math.subtract),
+    "__mul__": math.multiply,
+    "__rmul__": _swap(math.multiply),
+    "__truediv__": math.divide,
+    "__rtruediv__": _swap(math.divide),
+    "__floordiv__": math.floor_divide,
+    "__rfloordiv__": _swap(math.floor_divide),
+    "__mod__": math.mod,
+    "__rmod__": _swap(math.mod),
+    "__pow__": math.pow,
+    "__rpow__": _swap(math.pow),
+    "__matmul__": math.matmul,
+    "__neg__": lambda self: math.neg(self),
+    "__abs__": lambda self: math.abs(self),
+    "__invert__": lambda self: logic.logical_not(self),
+    # comparisons
+    "__eq__": logic.equal,
+    "__ne__": logic.not_equal,
+    "__lt__": logic.less_than,
+    "__le__": logic.less_equal,
+    "__gt__": logic.greater_than,
+    "__ge__": logic.greater_equal,
+    "__and__": math.bitwise_and,
+    "__or__": math.bitwise_or,
+    "__xor__": math.bitwise_xor,
+    # indexing
+    "__getitem__": _getitem,
+    "__setitem__": _setitem,
+}
+
+# plain named methods: every tensor function is also a method
+_NAMED_SOURCES = [math, manipulation, logic, search, stat, linalg, creation]
+_SKIP = {
+    "apply", "apply_nondiff", "Tensor", "attach_tensor_methods", "to_tensor",
+}
+
+for mod in _NAMED_SOURCES:
+    for name in dir(mod):
+        if name.startswith("_") or name in _SKIP:
+            continue
+        # never clobber methods the Tensor core already defines
+        # (clone, numel, astype, detach, ...)
+        if hasattr(Tensor, name):
+            continue
+        fn = getattr(mod, name)
+        if callable(fn) and getattr(fn, "__module__", "").startswith("paddle_tpu"):
+            _METHODS.setdefault(name, fn)
+
+# inplace method variants (paddle's trailing-underscore convention)
+for base_name in [
+    "add", "subtract", "multiply", "divide", "clip", "scale", "exp", "sqrt",
+    "rsqrt", "abs", "ceil", "floor", "round", "reciprocal", "tanh", "sigmoid",
+]:
+    fn = getattr(math, base_name)
+    _METHODS.setdefault(base_name + "_", _inplace(fn))
+
+_METHODS.setdefault("fill_", _inplace(lambda self, v: creation.full_like(self, v)))
+_METHODS.setdefault("zero_", _inplace(lambda self: creation.zeros_like(self)))
+_METHODS.setdefault(
+    "mean_all", lambda self: math.mean(self)
+)
+_METHODS["uniform_"] = random.uniform_
+_METHODS["normal_"] = random.normal_
+_METHODS["exponential_"] = random.exponential_
+_METHODS["bernoulli_"] = random.bernoulli_
+
+attach_tensor_methods(_METHODS)
+
+# property-style: Tensor.T
+Tensor.T = property(lambda self: manipulation.t(self) if self.ndim <= 2 else manipulation.transpose(self, list(range(self.ndim))[::-1]))
+Tensor.mT = property(lambda self: apply("mT", lambda a: jnp.swapaxes(a, -1, -2), (self,)))

@@ -1,0 +1,180 @@
+"""Tensor creation ops.
+
+Reference parity: `python/paddle/tensor/creation.py` (to_tensor, zeros, ones,
+full, arange, linspace, eye, *_like, tril/triu, diag, meshgrid, assign) with
+kernels from `paddle/phi/kernels/cpu|gpu/full_kernel.cc` etc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.core import Tensor, to_tensor  # re-export to_tensor
+from ..ops.dispatch import apply, apply_nondiff
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "tril", "triu", "diag", "diagflat", "meshgrid", "assign", "clone",
+    "tril_indices", "triu_indices", "complex", "polar",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in np.asarray(shape._data)]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s) if not isinstance(s, Tensor) else int(s._data) for s in shape]
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else dtype_mod.get_default_dtype()
+    return dtype_mod.convert_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_list(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_list(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = (
+            "bool" if isinstance(fill_value, bool)
+            else "int32" if isinstance(fill_value, (int, np.integer))
+            else dtype_mod.get_default_dtype()
+        )
+    return Tensor(jnp.full(_shape_list(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    # XLA has no uninitialized buffers; zeros is the honest TPU equivalent.
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply_nondiff("zeros_like", lambda a: jnp.zeros_like(a, dtype=_dt(dtype, x.dtype)), (x,))
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply_nondiff("ones_like", lambda a: jnp.ones_like(a, dtype=_dt(dtype, x.dtype)), (x,))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply_nondiff(
+        "full_like", lambda a: jnp.full_like(a, fill_value, dtype=_dt(dtype, x.dtype)), (x,)
+    )
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            "float32"
+            if any(isinstance(v, float) for v in (start, end, step))
+            else "int64"
+        )
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(
+        jnp.logspace(_v(start), _v(stop), int(_v(num)), base=_v(base), dtype=_dt(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def tril(x, diagonal=0, name=None):
+    return apply("tril", lambda a: jnp.tril(a, k=diagonal), (x,))
+
+
+def triu(x, diagonal=0, name=None):
+    return apply("triu", lambda a: jnp.triu(a, k=diagonal), (x,))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                n = a.shape[0] + abs(offset)
+                mask = jnp.eye(n, k=offset, dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, a.dtype))
+            return out
+        return jnp.diagonal(a, offset=offset)
+    return apply("diag", f, (x,))
+
+
+def diagflat(x, offset=0, name=None):
+    return apply("diagflat", lambda a: jnp.diagflat(a, k=offset), (x,))
+
+
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = apply("meshgrid", lambda *arrs: tuple(jnp.meshgrid(*arrs, indexing="ij")), args)
+    return list(outs)
+
+
+def assign(x, output=None):
+    val = x._data if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is None:
+        return apply("assign", lambda a: a + jnp.zeros((), a.dtype), (x if isinstance(x, Tensor) else Tensor(val),))
+    output.set_value(Tensor(val))
+    return output
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(np.stack([r, c]).astype(np.dtype(_dt(dtype, np.int32))))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(np.stack([r, c]).astype(np.dtype(_dt(dtype, np.int32))))
+
+
+def complex(real, imag, name=None):  # noqa: A001
+    return apply("complex", lambda r, i: jax.lax.complex(r, i), (real, imag))
+
+
+def polar(abs_, angle, name=None):
+    return apply(
+        "polar",
+        lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)),
+        (abs_, angle),
+    )

@@ -1,0 +1,14 @@
+"""Einsum (parity: `python/paddle/tensor/einsum.py` — the reference
+implements its own parser + planner; here XLA's native einsum lowering does
+the contraction planning onto the MXU)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.dispatch import apply
+
+
+def einsum(equation, *operands, name=None):
+    return apply(
+        "einsum", lambda *arrs: jnp.einsum(equation, *arrs), tuple(operands)
+    )

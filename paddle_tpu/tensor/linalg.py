@@ -1,0 +1,217 @@
+"""Linear algebra ops.
+
+Reference parity: `python/paddle/tensor/linalg.py` + `paddle.linalg.*`
+namespace. Decompositions lower to XLA's native QR/SVD/Cholesky/Eigh; on TPU
+some (eig, lstsq) fall back to CPU via jax — same split as the reference
+where some linalg kernels are CPU-only.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..ops.dispatch import apply, apply_nondiff
+from .math import matmul, dot, mv, bmm, outer, inner, cross  # noqa: F401
+from .manipulation import t  # noqa: F401
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(a):
+        if axis is None and p is None:
+            return jnp.linalg.norm(a.reshape(-1), ord=2, keepdims=False)
+        if axis is None:
+            return jnp.linalg.norm(
+                a.reshape(-1), ord=p if p != "fro" else 2, keepdims=False
+            )
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        ord_ = p
+        if p == "fro":
+            ord_ = "fro" if isinstance(ax, tuple) else 2
+        elif p is None:
+            ord_ = None
+        return jnp.linalg.norm(a, ord=ord_, axis=ax, keepdims=keepdim)
+    return apply("norm", f, (x,))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(
+        "vector_norm",
+        lambda a: jnp.linalg.vector_norm(a, ord=p, axis=ax, keepdims=keepdim),
+        (x,),
+    )
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply(
+        "matrix_norm",
+        lambda a: jnp.linalg.matrix_norm(a, ord=p, keepdims=keepdim),
+        (x,),
+    )
+
+
+def dist(x, y, p=2, name=None):
+    return apply(
+        "dist", lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), (x, y)
+    )
+
+
+def cond(x, p=None, name=None):
+    return apply("cond", lambda a: jnp.linalg.cond(a, p=p), (x,))
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return apply("cholesky", f, (x,))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        Lm = jnp.swapaxes(L, -1, -2).conj() if upper else L
+        z = jax.scipy.linalg.solve_triangular(Lm, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(Lm, -1, -2).conj(), z, lower=False
+        )
+    return apply("cholesky_solve", f, (x, y))
+
+
+def qr(x, mode="reduced", name=None):
+    if mode == "r":
+        return apply("qr", lambda a: jnp.linalg.qr(a, mode="r"), (x,))
+    outs = apply("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), (x,))
+    return outs
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply(
+        "svd",
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        (x,),
+    )
+
+
+def svdvals(x, name=None):
+    return apply("svdvals", lambda a: jnp.linalg.svd(a, compute_uv=False), (x,))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(
+        "eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), (x,)
+    )
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), (x,))
+
+
+def eig(x, name=None):
+    """CPU-backed (XLA:TPU has no nonsymmetric eig — same as reference's
+    CPU-only `eig` kernel, `phi/kernels/cpu/eig_kernel.cc`)."""
+    a = np.asarray(x._data)
+    w, v = np.linalg.eig(a)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    a = np.asarray(x._data)
+    return Tensor(np.linalg.eigvals(a))
+
+
+def inv(x, name=None):
+    return apply("inv", jnp.linalg.inv, (x,))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(
+        "pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), (x,)
+    )
+
+
+def solve(x, y, name=None):
+    return apply("solve", jnp.linalg.solve, (x, y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        aa = jnp.swapaxes(a, -1, -2) if transpose else a
+        return jax.scipy.linalg.solve_triangular(
+            aa, b, lower=not upper if not transpose else upper,
+            unit_diagonal=unitriangular,
+        )
+    return apply("triangular_solve", f, (x, y))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    a = np.asarray(x._data)
+    b = np.asarray(y._data)
+    sol, res, rank, sv = np.linalg.lstsq(a, b, rcond=rcond)
+    return (
+        Tensor(sol), Tensor(res if res.size else np.zeros(0, a.dtype)),
+        Tensor(np.asarray(rank, np.int64)), Tensor(sv),
+    )
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    out = apply(
+        "lu", lambda a: tuple(jax.scipy.linalg.lu_factor(a)), (x,)
+    )
+    lu_mat, piv = out
+    if get_infos:
+        info = Tensor(np.zeros((), np.int32))
+        return lu_mat, piv, info
+    return lu_mat, piv
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), (x,))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_nondiff(
+        "matrix_rank",
+        lambda a: jnp.linalg.matrix_rank(a, rtol=tol).astype(jnp.int64),
+        (x,),
+    )
+
+
+def det(x, name=None):
+    return apply("det", jnp.linalg.det, (x,))
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return apply("slogdet", f, (x,))
+
+
+def multi_dot(x, name=None):
+    return apply("multi_dot", lambda *arrs: jnp.linalg.multi_dot(arrs), tuple(x))
+
+
+def matmul_transpose(x, y, name=None):
+    return apply("matmul_transpose", lambda a, b: a @ jnp.swapaxes(b, -1, -2), (x, y))
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), (x, y))
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = eye
+        for i in range(n):
+            v = a[..., :, i]
+            v = jnp.where(jnp.arange(m) == i, 1.0, jnp.where(jnp.arange(m) < i, 0.0, v))
+            h = eye - t_[..., i] * jnp.outer(v, v)
+            q = q @ h
+        return q[..., :, :n]
+    return apply("householder_product", f, (x, tau))

@@ -1,0 +1,71 @@
+"""Comparison / logical ops.
+
+Reference parity: `python/paddle/tensor/logic.py`.
+All non-differentiable: dispatched via apply_nondiff (no tape nodes).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..ops.dispatch import apply_nondiff
+
+
+def _cmp(name, jfn):
+    def f(x, y, name=None):
+        return apply_nondiff(f.__op_name__, jfn, (x, y))
+    f.__name__ = f.__qualname__ = name
+    f.__op_name__ = name
+    return f
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+
+
+def logical_not(x, name=None):
+    return apply_nondiff("logical_not", jnp.logical_not, (x,))
+
+
+def equal_all(x, y, name=None):
+    return apply_nondiff(
+        "equal_all", lambda a, b: jnp.array_equal(a, b), (x, y)
+    )
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_nondiff(
+        "allclose",
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        (x, y),
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_nondiff(
+        "isclose",
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        (x, y),
+    )
+
+
+def is_empty(x, name=None):
+    return Tensor(np.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply_nondiff(
+        "isin", lambda a, b: jnp.isin(a, b, invert=invert), (x, test_x)
+    )

@@ -1,0 +1,473 @@
+"""Shape/layout manipulation ops.
+
+Reference parity: `python/paddle/tensor/manipulation.py` (reshape, concat,
+split, gather/scatter, tile/expand, pad, flip/roll...) over PHI kernels.
+All of these are free or cheap on TPU — XLA fuses reshapes/transposes into
+consumers; gathers/scatters lower to native HLO.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.core import Tensor, attach_tensor_methods
+from ..ops.dispatch import apply, apply_nondiff
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(
+        int(s._data) if isinstance(s, Tensor) else int(s) for s in shape
+    )
+
+
+def reshape(x, shape, name=None):
+    s = _shape_arg(shape)
+    return apply("reshape", lambda a: jnp.reshape(a, s), (x,))
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    return _adopt_inplace(x, out)
+
+
+def _adopt_inplace(x, out):
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    if not out.stop_gradient:
+        x.stop_gradient = False
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply("flatten", f, (x,))
+
+
+def transpose(x, perm, name=None):
+    return apply("transpose", lambda a: jnp.transpose(a, tuple(perm)), (x,))
+
+
+def t(x, name=None):
+    return apply("t", lambda a: a.T if a.ndim >= 2 else a, (x,))
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis", lambda a: jnp.moveaxis(a, source, destination), (x,))
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply("squeeze", f, (x,))
+
+
+def squeeze_(x, axis=None, name=None):
+    return _adopt_inplace(x, squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a._data) if isinstance(a, Tensor) else int(a) for a in axes]
+    def f(a):
+        out = a
+        for ax in sorted(ax if ax >= 0 else ax + out.ndim + 1 for ax in axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply("unsqueeze", f, (x,))
+
+
+def unsqueeze_(x, axis, name=None):
+    return _adopt_inplace(x, unsqueeze(x, axis))
+
+
+def concat(x, axis=0, name=None):
+    ax = int(axis._data) if isinstance(axis, Tensor) else int(axis)
+    return apply("concat", lambda *arrs: jnp.concatenate(arrs, axis=ax), tuple(x))
+
+
+def stack(x, axis=0, name=None):
+    return apply("stack", lambda *arrs: jnp.stack(arrs, axis=axis), tuple(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis._data) if isinstance(axis, Tensor) else int(axis)
+    def f(a):
+        dim = a.shape[ax]
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=ax))
+        secs = [
+            int(s._data) if isinstance(s, Tensor) else int(s)
+            for s in num_or_sections
+        ]
+        # paddle allows one -1 section
+        if any(s == -1 for s in secs):
+            known = sum(s for s in secs if s != -1)
+            secs = [dim - known if s == -1 else s for s in secs]
+        offsets = np.cumsum(secs)[:-1].tolist()
+        return tuple(jnp.split(a, offsets, axis=ax))
+    return list(apply("split", f, (x,)))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    def f(a):
+        n = a.shape[axis]
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis))
+    return list(apply("unbind", f, (x,)))
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_arg(repeat_times)
+    return apply("tile", lambda a: jnp.tile(a, reps), (x,))
+
+
+def expand(x, shape, name=None):
+    s = _shape_arg(shape)
+    def f(a):
+        # paddle allows -1 = keep dim, but only for dims that exist in x
+        target = list(s)
+        offset = len(target) - a.ndim
+        for i in range(len(target)):
+            if target[i] == -1:
+                if i < offset:
+                    raise ValueError(
+                        f"expand: -1 at position {i} refers to a new "
+                        f"dimension (input has {a.ndim} dims, target has "
+                        f"{len(target)}); -1 is only valid for existing dims"
+                    )
+                target[i] = a.shape[i - offset]
+        return jnp.broadcast_to(a, tuple(target))
+    return apply("expand", f, (x,))
+
+
+def expand_as(x, y, name=None):
+    return apply("expand_as", lambda a, b: jnp.broadcast_to(a, b.shape), (x, y))
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    outs = apply(
+        "broadcast_tensors", lambda *arrs: tuple(jnp.broadcast_arrays(*arrs)), tuple(inputs)
+    )
+    return list(outs)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def cast(x, dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    return apply("cast", lambda a: a.astype(d), (x,))
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis._data) if isinstance(axis, Tensor) else int(axis)
+    def f(a, idx):
+        return jnp.take(a, idx.reshape(-1) if idx.ndim > 1 else idx, axis=ax)
+    return apply("gather", f, (x, index))
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        # index [..., k] indexes the first k dims of a
+        k = idx.shape[-1]
+        idx_tuple = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[idx_tuple] if k > 0 else a
+    return apply("gather_nd", f, (x, index))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def f(a, idx):
+        return jnp.take_along_axis(a, idx, axis=axis)
+    return apply("take_along_axis", f, (arr, indices))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):  # noqa: A002
+    def f(a, idx, v):
+        v = jnp.broadcast_to(jnp.asarray(v, a.dtype), idx.shape)
+        # build full index grid
+        it = jnp.indices(idx.shape)
+        full_idx = list(it)
+        full_idx[axis % a.ndim] = idx
+        full_idx = tuple(full_idx)
+        if reduce == "assign":
+            return a.at[full_idx].set(v)
+        if reduce in ("add", "sum"):
+            return a.at[full_idx].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[full_idx].multiply(v)
+        raise ValueError(f"unsupported reduce: {reduce}")
+    return apply("put_along_axis", f, (arr, indices, values))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """Row scatter (parity: paddle.scatter / `phi/kernels/.../scatter_kernel`)."""
+    def f(a, idx, upd):
+        idx = idx.reshape(-1)
+        if overwrite:
+            return a.at[idx].set(upd)
+        # paddle overwrite=False: zero the rows then accumulate
+        zeroed = a.at[idx].set(jnp.zeros_like(upd))
+        return zeroed.at[idx].add(upd)
+    return apply("scatter", f, (x, index, updates))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return _adopt_inplace(x, scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, upd):
+        idx_tuple = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[idx_tuple].add(upd)
+    return apply("scatter_nd_add", f, (x, index, updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    s = _shape_arg(shape)
+    def f(idx, upd):
+        zeros = jnp.zeros(s, upd.dtype)
+        idx_tuple = tuple(jnp.moveaxis(idx, -1, 0))
+        return zeros.at[idx_tuple].add(upd)
+    return apply("scatter_nd", f, (index, updates))
+
+
+def index_select(x, index, axis=0, name=None):
+    def f(a, idx):
+        return jnp.take(a, idx.reshape(-1), axis=axis)
+    return apply("index_select", f, (x, index))
+
+
+def index_sample(x, index, name=None):
+    def f(a, idx):
+        return jnp.take_along_axis(a, idx, axis=1)
+    return apply("index_sample", f, (x, index))
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, idx, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        out = moved.at[idx.reshape(-1)].add(jnp.moveaxis(v, axis, 0))
+        return jnp.moveaxis(out, 0, axis)
+    return apply("index_add", f, (x, index, value))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx_arrays = tuple(
+        i._data if isinstance(i, Tensor) else jnp.asarray(i) for i in indices
+    )
+    def f(a, v):
+        if accumulate:
+            return a.at[idx_arrays].add(v)
+        return a.at[idx_arrays].set(v)
+    return apply("index_put", f, (x, value))
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply("flip", lambda a: jnp.flip(a, axis=tuple(axes)), (x,))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply("roll", lambda a: jnp.roll(a, shifts, axis=axis), (x,))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), (x,))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats._data if isinstance(repeats, Tensor) else repeats
+    def f(a):
+        return jnp.repeat(a, r, axis=axis)
+    return apply("repeat_interleave", f, (x,))
+
+
+def masked_select(x, mask, name=None):
+    """Data-dependent output shape: eager-only, no gradient (use
+    masked_fill/where for differentiable masking under jit)."""
+    a = np.asarray(x._data)
+    m = np.asarray(mask._data if isinstance(mask, Tensor) else mask).astype(bool)
+    return Tensor(a[np.broadcast_to(m, a.shape)])
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value
+    if isinstance(v, Tensor):
+        def f(a, m, val):
+            return jnp.where(m.astype(bool), val.astype(a.dtype), a)
+        return apply("masked_fill", f, (x, mask, v))
+    def f(a, m):
+        return jnp.where(m.astype(bool), jnp.asarray(v, a.dtype), a)
+    return apply("masked_fill", f, (x, mask))
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    def f(a):
+        idx = [jnp.s_[:]] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            st = int(st._data) if isinstance(st, Tensor) else int(st)
+            en = int(en._data) if isinstance(en, Tensor) else int(en)
+            idx[ax] = jnp.s_[st:en]
+        return a[tuple(idx)]
+    return apply("slice", f, (x,))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        idx = [jnp.s_[:]] * a.ndim
+        for ax, st, en, sr in zip(axes, starts, ends, strides):
+            idx[ax] = jnp.s_[st:en:sr]
+        return a[tuple(idx)]
+    return apply("strided_slice", f, (x,))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    s = _shape_arg(shape)
+    offs = [0] * len(s) if offsets is None else [
+        int(o._data) if isinstance(o, Tensor) else int(o) for o in offsets
+    ]
+    def f(a):
+        idx = tuple(
+            jnp.s_[o: o + (d if d != -1 else a.shape[i] - o)]
+            for i, (o, d) in enumerate(zip(offs, s))
+        )
+        return a[idx]
+    return apply("crop", f, (x,))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    """Eager-only (data-dependent shape)."""
+    a = np.asarray(x._data)
+    res = np.unique(
+        a, return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts, axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r.astype(np.int32) if i > 0 else r) for i, r in enumerate(res))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    a = np.asarray(x._data)
+    flat = a.reshape(-1) if axis is None else a
+    if axis is None:
+        change = np.concatenate([[True], flat[1:] != flat[:-1]])
+        out = flat[change]
+        outs = [Tensor(out)]
+        if return_inverse:
+            inv = np.cumsum(change) - 1
+            outs.append(Tensor(inv.astype(np.int32)))
+        if return_counts:
+            idx = np.flatnonzero(change)
+            counts = np.diff(np.append(idx, flat.size))
+            outs.append(Tensor(counts.astype(np.int32)))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis is not supported yet")
+
+
+def as_complex(x, name=None):
+    return apply("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), (x,))
+
+
+def as_real(x, name=None):
+    return apply(
+        "as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), (x,)
+    )
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    d = dtype_mod.convert_dtype(shape_or_dtype)
+    return apply("view_dtype", lambda a: jax.lax.bitcast_convert_type(a, d), (x,))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def numel(x, name=None):
+    return Tensor(np.asarray(x.size, np.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    def f(idx):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        in_shard = (idx // shard_size) == shard_id
+        return jnp.where(in_shard, idx - lo, ignore_value)
+    return apply_nondiff("shard_index", f, (input,))
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def f(a):
+        return tuple(jnp.array_split(a, num_or_indices, axis=axis))
+    return list(apply("tensor_split", f, (x,)))
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def hstack(x, name=None):
+    return apply("hstack", lambda *arrs: jnp.hstack(arrs), tuple(x))
+
+
+def vstack(x, name=None):
+    return apply("vstack", lambda *arrs: jnp.vstack(arrs), tuple(x))
+
+
+def dstack(x, name=None):
+    return apply("dstack", lambda *arrs: jnp.dstack(arrs), tuple(x))
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply("atleast_1d", jnp.atleast_1d, (x,)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply("atleast_2d", jnp.atleast_2d, (x,)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply("atleast_3d", jnp.atleast_3d, (x,)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs

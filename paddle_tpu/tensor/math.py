@@ -1,0 +1,400 @@
+"""Elementwise + reduction math ops.
+
+Reference parity: `python/paddle/tensor/math.py` (~300 functions) backed by
+PHI kernels (`paddle/phi/kernels/cpu|gpu/*_kernel.cc`, elementwise machinery
+in `phi/kernels/funcs/broadcast_function.h`). Broadcasting, dtype promotion
+and VJPs all come from jax/XLA here instead of hand-written functors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.core import Tensor
+from ..ops.dispatch import apply, apply_nondiff
+
+
+def _unary(name, jfn):
+    def f(x, name=None):
+        return apply(f.__op_name__, jfn, (x,))
+    f.__name__ = f.__qualname__ = name
+    f.__op_name__ = name
+    f.__doc__ = f"Elementwise {name} (parity: paddle.{name})."
+    return f
+
+
+def _binary(name, jfn):
+    def f(x, y, name=None):
+        return apply(f.__op_name__, jfn, (x, y))
+    f.__name__ = f.__qualname__ = name
+    f.__op_name__ = name
+    f.__doc__ = f"Elementwise {name} with broadcasting (parity: paddle.{name})."
+    return f
+
+
+# ---- elementwise unary ----
+abs = _unary("abs", jnp.abs)  # noqa: A001
+acos = _unary("acos", jnp.arccos)
+acosh = _unary("acosh", jnp.arccosh)
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+ceil = _unary("ceil", jnp.ceil)
+cos = _unary("cos", jnp.cos)
+cosh = _unary("cosh", jnp.cosh)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+floor = _unary("floor", jnp.floor)
+frac = _unary("frac", lambda a: a - jnp.trunc(a))
+i0 = _unary("i0", lambda a: jax.scipy.special.i0(a))
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+log = _unary("log", jnp.log)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+log2 = _unary("log2", jnp.log2)
+neg = _unary("neg", jnp.negative)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+round = _unary("round", jnp.round)  # noqa: A001
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+sign = _unary("sign", jnp.sign)
+sin = _unary("sin", jnp.sin)
+sinh = _unary("sinh", jnp.sinh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+tan = _unary("tan", jnp.tan)
+tanh = _unary("tanh", jnp.tanh)
+trunc = _unary("trunc", jnp.trunc)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+
+# ---- elementwise binary ----
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+mod = _binary("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = _binary("pow", jnp.power)  # noqa: A001
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+heaviside = _binary("heaviside", jnp.heaviside)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+hypot = _binary("hypot", jnp.hypot)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+copysign = _binary("copysign", jnp.copysign)
+nextafter = _binary("nextafter", jnp.nextafter)
+ldexp = _binary("ldexp", lambda a, b: a * (2.0 ** b.astype(jnp.float32)))
+
+# bitwise
+bitwise_and = _binary("bitwise_and", jnp.bitwise_and)
+bitwise_or = _binary("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _binary("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = _unary("bitwise_not", jnp.bitwise_not)
+bitwise_left_shift = _binary("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _binary("bitwise_right_shift", jnp.right_shift)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def f(a):
+        if bias_after_scale:
+            return a * scale + jnp.asarray(bias, a.dtype)
+        return (a + jnp.asarray(bias, a.dtype)) * scale
+    out = apply("scale", f, (x,))
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply("clip", lambda a: jnp.clip(a, lo, hi), (x,))
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply("lerp", lambda a, b, w: a + w * (b - a), (x, y, weight))
+    return apply("lerp", lambda a, b: a + weight * (b - a), (x, y))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), (x,))
+
+
+def multiplex(inputs, index, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    def f(*arrs):
+        stacked = jnp.stack(arrs, axis=0)  # [n, batch, ...]
+        sel = idx.reshape(-1)
+        return jnp.take_along_axis(
+            stacked, sel.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0
+        )[0]
+    return apply("multiplex", f, tuple(inputs))
+
+
+# ---- matmul family ----
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """Batched matmul on the MXU (parity: paddle.matmul,
+    `phi/kernels/gpu|cpu/matmul_kernel`). transpose flags avoid materialized
+    transposes — XLA folds them into the dot dimension numbers."""
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply("matmul", f, (x, y))
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return apply("dot", f, (x, y))
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return apply("bmm", jnp.matmul, (x, y))
+
+
+def mv(x, vec, name=None):
+    return apply("mv", jnp.matmul, (x, vec))
+
+
+def inner(x, y, name=None):
+    return apply("inner", lambda a, b: jnp.tensordot(a, b, axes=([-1], [-1])), (x, y))
+
+
+def outer(x, y, name=None):
+    return apply("outer", lambda a, b: jnp.outer(a, b), (x, y))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return apply(
+        "addmm", lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), (input, x, y)
+    )
+
+
+def kron(x, y, name=None):
+    return apply("kron", jnp.kron, (x, y))
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, d in enumerate(a.shape) if d == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply("cross", f, (x, y))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("trace", lambda a: jnp.trace(a, offset, axis1, axis2, dtype=a.dtype), (x,))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("diagonal", lambda a: jnp.diagonal(a, offset, axis1, axis2), (x,))
+
+
+# ---- reductions ----
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    d = dtype_mod.convert_dtype(dtype) if dtype else None
+    return apply(
+        "sum", lambda a: jnp.sum(a, axis=_norm_axis(axis), dtype=d, keepdims=keepdim), (x,)
+    )
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(
+        "mean", lambda a: jnp.mean(a, axis=_norm_axis(axis), keepdims=keepdim), (x,)
+    )
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(
+        "max", lambda a: jnp.max(a, axis=_norm_axis(axis), keepdims=keepdim), (x,)
+    )
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(
+        "min", lambda a: jnp.min(a, axis=_norm_axis(axis), keepdims=keepdim), (x,)
+    )
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) if dtype else None
+    return apply(
+        "prod", lambda a: jnp.prod(a, axis=_norm_axis(axis), dtype=d, keepdims=keepdim), (x,)
+    )
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = dtype_mod.convert_dtype(dtype) if dtype else None
+    return apply(
+        "nansum", lambda a: jnp.nansum(a, axis=_norm_axis(axis), dtype=d, keepdims=keepdim), (x,)
+    )
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply(
+        "nanmean", lambda a: jnp.nanmean(a, axis=_norm_axis(axis), keepdims=keepdim), (x,)
+    )
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(
+        "logsumexp",
+        lambda a: jax.scipy.special.logsumexp(a, axis=_norm_axis(axis), keepdims=keepdim),
+        (x,),
+    )
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) if dtype else None
+    def f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=d)
+        return jnp.cumsum(a, axis=int(axis), dtype=d)
+    return apply("cumsum", f, (x,))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) if dtype else None
+    return apply("cumprod", lambda a: jnp.cumprod(a, axis=dim, dtype=d), (x,))
+
+
+def _cum_extreme(x, axis, op_name, better):
+    """Running max/min with indices via an associative scan over (value,
+    index) pairs — one fused XLA scan instead of the reference's dedicated
+    CUDA kernel (`phi/kernels/gpu/cum_maxmin_kernel.cu`)."""
+    def f(a):
+        ax = 0 if axis is None else int(axis)
+        arr = a.reshape(-1) if axis is None else a
+        idx0 = jax.lax.broadcasted_iota(jnp.int32, arr.shape, ax)
+        def combine(lhs, rhs):
+            (va, ia), (vb, ib) = lhs, rhs
+            keep_b = better(vb, va)
+            return jnp.where(keep_b, vb, va), jnp.where(keep_b, ib, ia)
+        vals, idx = jax.lax.associative_scan(combine, (arr, idx0), axis=ax)
+        return vals, idx
+    return apply(op_name, f, (x,))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, "cummax", lambda b, a: b >= a)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, "cummin", lambda b, a: b <= a)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(a):
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        return jax.lax.associative_scan(jnp.logaddexp, arr, axis=ax)
+    return apply("logcumsumexp", f, (x,))
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    def f(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+    return apply("add_n", f, tuple(inputs))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_nondiff(
+        "count_nonzero",
+        lambda a: jnp.count_nonzero(a, axis=_norm_axis(axis), keepdims=keepdim).astype(jnp.int32),
+        (x,),
+    )
+
+
+# ---- float status ----
+def isnan(x, name=None):
+    return apply_nondiff("isnan", jnp.isnan, (x,))
+
+
+def isinf(x, name=None):
+    return apply_nondiff("isinf", jnp.isinf, (x,))
+
+
+def isfinite(x, name=None):
+    return apply_nondiff("isfinite", jnp.isfinite, (x,))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(
+        "nan_to_num",
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        (x,),
+    )
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    operands = [x]
+    has_prepend = prepend is not None
+    has_append = append is not None
+    if has_prepend:
+        operands.append(prepend)
+    if has_append:
+        operands.append(append)
+    def f(a, *rest):
+        pre = rest[0] if has_prepend else None
+        app = rest[1 if has_prepend else 0] if has_append else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+    return apply("diff", f, tuple(operands))
+
+
+def increment(x, value=1.0, name=None):
+    out = apply("increment", lambda a: a + jnp.asarray(value, a.dtype), (x,))
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient and x.stop_gradient
+    return x

@@ -1,0 +1,181 @@
+"""Random sampling ops.
+
+Reference parity: `python/paddle/tensor/random.py` backed by `phi::Generator`
+(`paddle/phi/core/generator.h`) stateful RNG kernels.
+
+TPU-first design: every sample consumes a fresh split of the global
+functional PRNG key (`framework.random.next_key`), so results are
+reproducible under `paddle_tpu.seed`, and traced code can thread keys
+explicitly via `rng_scope` (this is what makes dropout correct under jit and
+deterministic per TP/PP rank — see parallel RNGStatesTracker).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework import random as rng
+from ..framework.core import Tensor
+from ..ops.dispatch import apply_nondiff
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else dtype_mod.get_default_dtype()
+    return dtype_mod.convert_dtype(dtype)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(
+        int(s._data) if isinstance(s, Tensor) else int(s) for s in shape
+    )
+
+
+def seed(value):
+    rng.seed(value)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    key = rng.next_key()
+    d = _dt(dtype)
+    out = jax.random.uniform(
+        key, _shape_list(shape), dtype=jnp.float32, minval=min, maxval=max
+    ).astype(d)
+    return Tensor(out)
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    key = rng.next_key()
+    return Tensor(jax.random.normal(key, _shape_list(shape), dtype=_dt(dtype)))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            jnp.shape(m), jnp.shape(s)
+        ) if shape is None else _shape_list(shape)
+        key = rng.next_key()
+        return Tensor(jax.random.normal(key, shp) * s + m)
+    key = rng.next_key()
+    shp = _shape_list(shape) if shape is not None else ()
+    return Tensor(jax.random.normal(key, shp) * std + mean)
+
+
+gaussian = normal
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = rng.next_key()
+    out = jax.random.randint(key, _shape_list(shape), low, high, dtype=jnp.int32)
+    return Tensor(out.astype(np.dtype(_dt(dtype, np.int64))))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, tuple(x.shape), dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = rng.next_key()
+    return Tensor(jax.random.permutation(key, n).astype(np.dtype(_dt(dtype, np.int64))))
+
+
+def shuffle(x, axis=0, name=None):
+    key = rng.next_key()
+    return apply_nondiff(
+        "shuffle", lambda a: jax.random.permutation(key, a, axis=axis), (x,)
+    )
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = rng.next_key()
+    def f(a):
+        logits = jnp.log(jnp.maximum(a, 1e-30))
+        if replacement:
+            return jax.random.categorical(
+                key, logits, axis=-1, shape=(*a.shape[:-1], num_samples)
+            ).astype(jnp.int64)
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(key, a.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype(jnp.int64)
+    return apply_nondiff("multinomial", f, (x,))
+
+
+def bernoulli(x, name=None):
+    key = rng.next_key()
+    return apply_nondiff(
+        "bernoulli",
+        lambda a: jax.random.bernoulli(key, a).astype(a.dtype),
+        (x,),
+    )
+
+
+def bernoulli_(x, p=0.5, name=None):
+    key = rng.next_key()
+    out = jax.random.bernoulli(key, p, tuple(x.shape)).astype(np.dtype(x.dtype))
+    x._data = jnp.asarray(out)
+    return x
+
+
+def poisson(x, name=None):
+    key = rng.next_key()
+    return apply_nondiff(
+        "poisson", lambda a: jax.random.poisson(key, a).astype(a.dtype), (x,)
+    )
+
+
+def binomial(count, prob, name=None):
+    key = rng.next_key()
+    return apply_nondiff(
+        "binomial",
+        lambda n, p: jax.random.binomial(key, n, p).astype(jnp.int64),
+        (count, prob),
+    )
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = rng.next_key()
+    out = jax.random.exponential(key, tuple(x.shape)) / lam
+    x._data = out.astype(x._data.dtype)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    key = rng.next_key()
+    x._data = jax.random.uniform(
+        key, tuple(x.shape), dtype=jnp.float32, minval=min, maxval=max
+    ).astype(x._data.dtype)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    key = rng.next_key()
+    x._data = (
+        jax.random.normal(key, tuple(x.shape), dtype=jnp.float32) * std + mean
+    ).astype(x._data.dtype)
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    return uniform(tuple(x.shape), dtype or x.dtype, 0.0, 1.0)
+
+
+def randn_like(x, dtype=None, name=None):
+    return randn(tuple(x.shape), dtype or x.dtype)

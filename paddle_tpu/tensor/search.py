@@ -1,0 +1,147 @@
+"""Search/sort ops.
+
+Reference parity: `python/paddle/tensor/search.py` (argmax, argsort, topk,
+where, nonzero, masked ops) over PHI kernels
+(`phi/kernels/gpu/top_k_kernel.cu`, `arg_min_max_kernel`, ...).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.core import Tensor
+from ..ops.dispatch import apply, apply_nondiff
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = np.dtype(dtype_mod.convert_dtype(dtype))
+    return apply_nondiff(
+        "argmax",
+        lambda a: jnp.argmax(a, axis=axis, keepdims=keepdim).astype(d),
+        (x,),
+    )
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = np.dtype(dtype_mod.convert_dtype(dtype))
+    return apply_nondiff(
+        "argmin",
+        lambda a: jnp.argmin(a, axis=axis, keepdims=keepdim).astype(d),
+        (x,),
+    )
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    return apply_nondiff(
+        "argsort",
+        lambda a: jnp.argsort(a, axis=axis, stable=stable, descending=descending),
+        (x,),
+    )
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return apply(
+        "sort",
+        lambda a: jnp.sort(a, axis=axis, stable=stable, descending=descending),
+        (x,),
+    )
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    import jax as _jax
+    kk = int(k._data) if isinstance(k, Tensor) else int(k)
+    def f(a):
+        ax = axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = _jax.lax.top_k(src, kk)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+    return apply("topk", f, (x,))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(
+        "where",
+        lambda c, a, b: jnp.where(c.astype(bool), a, b),
+        (condition, x, y),
+    )
+
+
+def where_(condition, x, y, name=None):
+    from .manipulation import _adopt_inplace
+    return _adopt_inplace(x, where(condition, x, y))
+
+
+def nonzero(x, as_tuple=False):
+    """Eager-only (data-dependent output shape)."""
+    a = np.asarray(x._data)
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(i.astype(np.int64).reshape(-1, 1)) for i in nz)
+    return Tensor(np.stack(nz, axis=1).astype(np.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+    def f(seq, v):
+        if seq.ndim == 1:
+            return jnp.searchsorted(seq, v, side=side).astype(dt)
+        import jax as _jax
+        flat_seq = seq.reshape(-1, seq.shape[-1])
+        flat_v = v.reshape(-1, v.shape[-1])
+        out = _jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(flat_seq, flat_v)
+        return out.reshape(v.shape).astype(dt)
+    return apply_nondiff("searchsorted", f, (sorted_sequence, values))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        s = jnp.sort(a, axis=ax)
+        idx = jnp.argsort(a, axis=ax)
+        vals = jnp.take(s, k - 1, axis=ax)
+        ids = jnp.take(idx, k - 1, axis=ax).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            ids = jnp.expand_dims(ids, ax)
+        return vals, ids
+    return apply("kthvalue", f, (x,))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = np.asarray(x._data)
+    ax = axis % a.ndim
+    moved = np.moveaxis(a, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], a.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        most = uniq[counts == counts.max()].max()
+        vals[i] = most
+        idxs[i] = np.where(row == most)[0][-1]
+    out_shape = moved.shape[:-1]
+    vals = vals.reshape(out_shape)
+    idxs = idxs.reshape(out_shape)
+    if keepdim:
+        vals = np.expand_dims(vals, ax)
+        idxs = np.expand_dims(idxs, ax)
+    return Tensor(vals), Tensor(idxs)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def f(a, idx):
+        moved = jnp.moveaxis(a, axis, 0)
+        out = moved.at[idx.reshape(-1)].set(jnp.asarray(value, a.dtype))
+        return jnp.moveaxis(out, 0, axis)
+    return apply("index_fill", f, (x, index))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)

@@ -1,0 +1,114 @@
+"""Statistics ops.
+
+Reference parity: `python/paddle/tensor/stat.py` (mean/std/var/median/
+quantile/histogram/bincount...).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..ops.dispatch import apply, apply_nondiff
+from .math import _norm_axis, mean  # noqa: F401  (mean lives in math, re-exported)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return apply(
+        "std",
+        lambda a: jnp.std(a, axis=_norm_axis(axis), ddof=ddof, keepdims=keepdim),
+        (x,),
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return apply(
+        "var",
+        lambda a: jnp.var(a, axis=_norm_axis(axis), ddof=ddof, keepdims=keepdim),
+        (x,),
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def f(a):
+        if mode == "avg":
+            return jnp.median(a, axis=axis, keepdims=keepdim)
+        # mode == 'min': lower median
+        ax = axis if axis is not None else None
+        if ax is None:
+            flat = a.reshape(-1)
+            s = jnp.sort(flat)
+            out = s[(flat.shape[0] - 1) // 2]
+            return out.reshape((1,) * a.ndim) if keepdim else out
+        s = jnp.sort(a, axis=ax)
+        n = a.shape[ax]
+        out = jnp.take(s, (n - 1) // 2, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+    return apply("median", f, (x,))
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply(
+        "nanmedian",
+        lambda a: jnp.nanmedian(a, axis=_norm_axis(axis), keepdims=keepdim),
+        (x,),
+    )
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qq = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply(
+        "quantile",
+        lambda a: jnp.quantile(
+            a, qq, axis=_norm_axis(axis), keepdims=keepdim, method=interpolation
+        ),
+        (x,),
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qq = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply(
+        "nanquantile",
+        lambda a: jnp.nanquantile(
+            a, qq, axis=_norm_axis(axis), keepdims=keepdim, method=interpolation
+        ),
+        (x,),
+    )
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):  # noqa: A002
+    a = np.asarray(input._data)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+    w = np.asarray(weight._data) if weight is not None else None
+    hist, _ = np.histogram(a, bins=bins, range=(lo, hi), weights=w, density=density)
+    return Tensor(hist if density or w is not None else hist.astype(np.int64))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    a = np.asarray(x._data)
+    w = np.asarray(weights._data) if weights is not None else None
+    hist, edges = np.histogramdd(a, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(hist), [Tensor(e) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    a = np.asarray(x._data)
+    w = np.asarray(weights._data) if weights is not None else None
+    return Tensor(np.bincount(a, weights=w, minlength=minlength))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), (x,))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = np.asarray(fweights._data) if fweights is not None else None
+    aw = np.asarray(aweights._data) if aweights is not None else None
+    return apply(
+        "cov",
+        lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw),
+        (x,),
+    )

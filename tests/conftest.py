@@ -1,0 +1,41 @@
+"""Test configuration.
+
+Mirrors the reference's test strategy (SURVEY.md §4): single-host, no real
+multi-chip hardware — distributed logic is exercised on a *virtual 8-device
+CPU mesh* (`xla_force_host_platform_device_count`), the same trick as the
+reference's fake `custom_cpu` plugin device (`test/custom_runtime/`).
+
+IMPORTANT: these env vars must be set before jax initializes its backends,
+hence this file must not import jax before setting them.
+"""
+import os
+
+# force-override: the session env pins JAX_PLATFORMS=axon (the tunneled TPU);
+# unit tests must run on the virtual CPU mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# the axon sitecustomize pins jax_platforms="axon,cpu" at interpreter start
+# (overriding env); force CPU-only here so tests never touch the TPU tunnel.
+jax.config.update("jax_platforms", "cpu")
+
+# numpy-parity tests need true fp32 contractions; production keeps the fast
+# MXU default (bf16 inputs / fp32 accumulate), tunable via paddle flags.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu
+
+    paddle_tpu.seed(2024)
+    np.random.seed(2024)
+    yield

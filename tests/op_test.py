@@ -1,0 +1,64 @@
+"""OpTest-style base utilities.
+
+Reference parity: `test/legacy_test/eager_op_test.py:378` (`OpTest`) — ops
+declare numpy inputs and expected outputs; outputs are checked against numpy
+and analytic grads are checked against numeric finite differences
+(`get_numeric_gradient`, reference `eager_op_test.py:134`).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(fn, np_fn, inputs, rtol=1e-4, atol=1e-5, **kwargs):
+    """Run `fn` on Tensors and `np_fn` on numpy arrays; compare."""
+    tensors = [paddle.to_tensor(x) for x in inputs]
+    out = fn(*tensors, **kwargs)
+    expected = np_fn(*inputs, **kwargs)
+    if not isinstance(out, (tuple, list)):
+        out, expected = [out], [expected]
+    for o, e in zip(out, expected):
+        np.testing.assert_allclose(
+            o.numpy().astype(np.float64) if np.issubdtype(np.asarray(e).dtype, np.floating) else o.numpy(),
+            np.asarray(e),
+            rtol=rtol, atol=atol,
+        )
+    return out
+
+
+def numeric_grad(fn, inputs, idx=0, eps=1e-3, **kwargs):
+    """Central finite differences of sum(fn(*inputs)) w.r.t. inputs[idx]."""
+    inputs = [np.asarray(x, np.float64) for x in inputs]
+    base = inputs[idx]
+    grad = np.zeros_like(base)
+    it = np.nditer(base, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        orig = base[i]
+        base[i] = orig + eps
+        hi = float(np.sum(np.asarray(fn(*inputs, **kwargs), np.float64)))
+        base[i] = orig - eps
+        lo = float(np.sum(np.asarray(fn(*inputs, **kwargs), np.float64)))
+        base[i] = orig
+        grad[i] = (hi - lo) / (2 * eps)
+        it.iternext()
+    return grad
+
+
+def check_grad(fn, np_fn, inputs, grad_idx=0, rtol=1e-3, atol=1e-3, **kwargs):
+    """Analytic grad via the tape vs numeric finite differences."""
+    tensors = [
+        paddle.to_tensor(np.asarray(x, np.float32), stop_gradient=False)
+        for x in inputs
+    ]
+    out = fn(*tensors, **kwargs)
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    loss = out.sum()
+    loss.backward()
+    analytic = tensors[grad_idx].grad.numpy()
+    numeric = numeric_grad(np_fn, inputs, idx=grad_idx, **kwargs)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+    return analytic

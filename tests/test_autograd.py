@@ -1,0 +1,256 @@
+"""Autograd tape tests (reference model: test/legacy_test grad checks +
+`check_grad` finite differences, eager_op_test.py:2463)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad
+
+
+class TestBasicBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_stop_gradient_default(self):
+        x = paddle.to_tensor([1.0])
+        y = x * 2
+        assert y.stop_gradient
+        assert y._grad_node is None
+
+    def test_branching_accumulation(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        a = x * 2
+        b = x * 3
+        (a + b).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_repeated_operand(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        (x * x).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_grad_accumulates_across_backwards(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_clear_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_non_scalar_seeds_ones(self):
+        # paddle seeds ones for any output shape when grad_tensor is None
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        (x * 2).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+        x.clear_grad()
+        y = x * 2
+        y.backward(paddle.to_tensor([1.0, 3.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 6.0])
+
+    def test_inplace_after_use_keeps_history(self):
+        # mutation after a tensor was consumed must not drop the recorded
+        # gradient path (InputRef snapshot semantics)
+        x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+        y = (x * 2).sum()
+        x[0] = 0.0
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0, 2.0])
+
+    def test_intermediate_hook_modifies_cotangent(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        h = x * 2
+        h.register_hook(lambda g: g * 0)
+        h.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 0.0])
+
+    def test_clone_not_recursive(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        c = x.clone()
+        np.testing.assert_allclose(c.numpy(), [1.0, 2.0])
+        c.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+
+    def test_argsort_descending_bool(self):
+        out = paddle.argsort(
+            paddle.to_tensor([True, False, True]), descending=True
+        )
+        assert out.numpy()[2] == 1  # False sorts last
+
+    def test_double_backward_raises(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+class TestOpGradients:
+    def test_matmul_grad(self):
+        check_grad(
+            paddle.matmul, np.matmul,
+            [np.random.rand(3, 4).astype(np.float32),
+             np.random.rand(4, 2).astype(np.float32)],
+            grad_idx=0,
+        )
+        check_grad(
+            paddle.matmul, np.matmul,
+            [np.random.rand(3, 4).astype(np.float32),
+             np.random.rand(4, 2).astype(np.float32)],
+            grad_idx=1,
+        )
+
+    @pytest.mark.parametrize(
+        "op,np_op",
+        [
+            ("exp", np.exp), ("tanh", np.tanh), ("sqrt", np.sqrt),
+            ("sigmoid", lambda a: 1 / (1 + np.exp(-a))),
+            ("log", np.log),
+        ],
+    )
+    def test_unary_grads(self, op, np_op):
+        x = np.random.rand(3, 4).astype(np.float32) + 0.5
+        check_grad(getattr(paddle, op), np_op, [x])
+
+    def test_broadcast_grad(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(4).astype(np.float32)
+        check_grad(paddle.add, np.add, [x, y], grad_idx=1)
+        check_grad(paddle.multiply, np.multiply, [x, y], grad_idx=1)
+
+    def test_reduction_grads(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        check_grad(lambda t: paddle.mean(t), lambda a: np.mean(a), [x])
+        check_grad(
+            lambda t: paddle.sum(t, axis=1), lambda a: np.sum(a, 1), [x]
+        )
+        check_grad(lambda t: paddle.max(t, axis=0), lambda a: np.max(a, 0), [x])
+
+    def test_reshape_transpose_grads(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        check_grad(
+            lambda t: paddle.reshape(t, [4, 3]), lambda a: a.reshape(4, 3), [x]
+        )
+        check_grad(
+            lambda t: paddle.transpose(t, [1, 0]), lambda a: a.T, [x]
+        )
+
+    def test_concat_grad(self):
+        x = np.random.rand(2, 3).astype(np.float32)
+        y = np.random.rand(2, 3).astype(np.float32)
+        tx = paddle.to_tensor(x, stop_gradient=False)
+        ty = paddle.to_tensor(y, stop_gradient=False)
+        out = paddle.concat([tx, ty], axis=0)
+        (out * out).sum().backward()
+        np.testing.assert_allclose(tx.grad.numpy(), 2 * x, rtol=1e-5)
+        np.testing.assert_allclose(ty.grad.numpy(), 2 * y, rtol=1e-5)
+
+    def test_getitem_grad(self):
+        x = np.random.rand(4, 3).astype(np.float32)
+        t = paddle.to_tensor(x, stop_gradient=False)
+        t[1:3].sum().backward()
+        expected = np.zeros_like(x)
+        expected[1:3] = 1.0
+        np.testing.assert_allclose(t.grad.numpy(), expected)
+
+    def test_gather_grad(self):
+        x = np.random.rand(5, 2).astype(np.float32)
+        t = paddle.to_tensor(x, stop_gradient=False)
+        idx = paddle.to_tensor(np.array([0, 0, 3]))
+        paddle.gather(t, idx).sum().backward()
+        expected = np.zeros_like(x)
+        expected[0] = 2.0
+        expected[3] = 1.0
+        np.testing.assert_allclose(t.grad.numpy(), expected)
+
+    def test_multi_output_op_grad(self):
+        x = np.random.rand(4, 5).astype(np.float32)
+        t = paddle.to_tensor(x, stop_gradient=False)
+        vals, idx = paddle.topk(t, 2, axis=1)
+        vals.sum().backward()
+        g = t.grad.numpy()
+        assert g.sum() == pytest.approx(8.0)  # two 1s per row
+
+
+class TestNoGrad:
+    def test_no_grad_context(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_no_grad_decorator(self):
+        @paddle.no_grad()
+        def f(t):
+            return t * 2
+
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        assert f(x).stop_gradient
+
+    def test_enable_grad_nested(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            with paddle.enable_grad():
+                y = x * 2
+        assert not y.stop_gradient
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = paddle.to_tensor([3.0], stop_gradient=False)
+        z = (x * x * y).sum()
+        gx, gy = paddle.grad(z, [x, y])
+        np.testing.assert_allclose(gx.numpy(), [12.0])
+        np.testing.assert_allclose(gy.numpy(), [4.0])
+        assert x.grad is None  # .grad untouched
+
+    def test_grad_unused(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = paddle.to_tensor([3.0], stop_gradient=False)
+        z = (x * x).sum()
+        with pytest.raises(RuntimeError):
+            paddle.grad(z, [x, y])
+        gx, gy = paddle.grad((x * x).sum(), [x, y], allow_unused=True)
+        assert gy is None
+
+    def test_register_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+        x.register_hook(lambda g: seen.append(np.asarray(g)))
+        (x * 2).backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [2.0])
+
+    def test_hook_modifies_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        x.register_hook(lambda g: g * 10)
+        (x * 2).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+    def test_retain_grads_intermediate(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        y.retain_grads()
+        (y * 3).sum().backward()
+        np.testing.assert_allclose(y.grad.numpy(), [3.0])
+
+    def test_detach(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).detach()
+        assert y.stop_gradient
+        z = y * 3
+        assert z._grad_node is None

@@ -1,0 +1,363 @@
+"""Op parity tests vs numpy (OpTest model, reference eager_op_test.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        assert t.dtype == paddle.float32
+        np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3], "int32").numpy().sum() == 6
+        np.testing.assert_array_equal(paddle.full([2], 7).numpy(), [7, 7])
+
+    def test_arange_linspace(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(
+            paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6
+        )
+
+    def test_eye_tril_triu(self):
+        x = np.random.rand(4, 4).astype(np.float32)
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3))
+        np.testing.assert_array_equal(
+            paddle.tril(paddle.to_tensor(x)).numpy(), np.tril(x)
+        )
+        np.testing.assert_array_equal(
+            paddle.triu(paddle.to_tensor(x), 1).numpy(), np.triu(x, 1)
+        )
+
+    def test_like_family(self):
+        x = paddle.to_tensor(np.random.rand(3, 2).astype(np.float32))
+        assert paddle.zeros_like(x).shape == [3, 2]
+        assert paddle.ones_like(x).numpy().sum() == 6
+        assert paddle.full_like(x, 3.0).numpy()[0, 0] == 3.0
+
+
+class TestElementwise:
+    @pytest.mark.parametrize(
+        "op,np_op",
+        [
+            ("add", np.add), ("subtract", np.subtract),
+            ("multiply", np.multiply), ("divide", np.divide),
+            ("maximum", np.maximum), ("minimum", np.minimum),
+        ],
+    )
+    def test_binary(self, op, np_op):
+        x = np.random.rand(3, 4).astype(np.float32) + 0.5
+        y = np.random.rand(3, 4).astype(np.float32) + 0.5
+        check_output(getattr(paddle, op), np_op, [x, y])
+
+    def test_broadcasting(self):
+        x = np.random.rand(3, 1, 4).astype(np.float32)
+        y = np.random.rand(2, 1).astype(np.float32)
+        check_output(paddle.add, np.add, [x, y])
+
+    def test_scalar_operands(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        assert (x + 1).numpy().tolist() == [2.0, 3.0]
+        assert (2 * x).numpy().tolist() == [2.0, 4.0]
+        assert (1 - x).numpy().tolist() == [0.0, -1.0]
+        assert (x / 2).dtype == paddle.float32
+
+    @pytest.mark.parametrize(
+        "op,np_op",
+        [
+            ("exp", np.exp), ("log", lambda a: np.log(a)),
+            ("sqrt", np.sqrt), ("abs", np.abs), ("tanh", np.tanh),
+            ("sin", np.sin), ("cos", np.cos), ("floor", np.floor),
+            ("ceil", np.ceil), ("square", np.square),
+        ],
+    )
+    def test_unary(self, op, np_op):
+        x = np.random.rand(3, 4).astype(np.float32) + 0.5
+        # XLA:CPU uses polynomial approximations for transcendentals; allow
+        # a few ulp more than strict float32
+        check_output(getattr(paddle, op), np_op, [x], rtol=1e-3, atol=1e-5)
+
+    def test_clip_scale(self):
+        x = np.linspace(-2, 2, 10).astype(np.float32)
+        check_output(paddle.clip, lambda a, **k: np.clip(a, -1, 1), [x], min=-1, max=1)
+        t = paddle.scale(paddle.to_tensor(x), scale=2.0, bias=1.0)
+        np.testing.assert_allclose(t.numpy(), x * 2 + 1, rtol=1e-6)
+
+    def test_pow_mod(self):
+        x = np.random.rand(4).astype(np.float32) + 1
+        y = np.random.rand(4).astype(np.float32) + 1
+        check_output(paddle.pow, np.power, [x, y])
+        check_output(paddle.mod, np.mod, [x, y])
+
+
+class TestMatmul:
+    def test_matmul_2d(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(4, 5).astype(np.float32)
+        check_output(paddle.matmul, np.matmul, [x, y], rtol=1e-4)
+
+    def test_matmul_transpose_flags(self):
+        x = np.random.rand(4, 3).astype(np.float32)
+        y = np.random.rand(5, 4).astype(np.float32)
+        out = paddle.matmul(
+            paddle.to_tensor(x), paddle.to_tensor(y),
+            transpose_x=True, transpose_y=True,
+        )
+        np.testing.assert_allclose(out.numpy(), x.T @ y.T, rtol=1e-4)
+
+    def test_batched(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        y = np.random.rand(2, 4, 5).astype(np.float32)
+        check_output(paddle.bmm, np.matmul, [x, y], rtol=1e-4)
+
+    def test_einsum(self):
+        x = np.random.rand(2, 3).astype(np.float32)
+        y = np.random.rand(3, 4).astype(np.float32)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), x @ y, rtol=1e-4)
+
+
+class TestReductions:
+    @pytest.mark.parametrize(
+        "op,np_op",
+        [("sum", np.sum), ("mean", np.mean), ("max", np.max), ("min", np.min)],
+    )
+    def test_full_reduce(self, op, np_op):
+        x = np.random.rand(3, 4).astype(np.float32)
+        check_output(getattr(paddle, op), np_op, [x], rtol=1e-5)
+
+    def test_axis_keepdim(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(
+            paddle.sum(t, axis=1).numpy(), x.sum(1), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            paddle.mean(t, axis=[0, 2], keepdim=True).numpy(),
+            x.mean((0, 2), keepdims=True), rtol=1e-5,
+        )
+
+    def test_cumsum_logsumexp(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(
+            paddle.cumsum(t, axis=1).numpy(), np.cumsum(x, 1), rtol=1e-5
+        )
+        from scipy.special import logsumexp as np_lse  # noqa
+        np.testing.assert_allclose(
+            paddle.logsumexp(t).numpy(), np_lse(x), rtol=1e-5
+        )
+
+    def test_prod_std_var(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.prod(t).numpy(), x.prod(), rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle.tensor.std(t).numpy(), x.std(ddof=1), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            paddle.tensor.var(t, axis=0).numpy(), x.var(0, ddof=1), rtol=1e-4
+        )
+
+
+class TestManipulation:
+    def test_reshape_flatten(self):
+        x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+        t = paddle.to_tensor(x)
+        assert paddle.reshape(t, [4, 6]).shape == [4, 6]
+        assert paddle.reshape(t, [-1, 4]).shape == [6, 4]
+        assert paddle.flatten(t, 1, 2).shape == [2, 12]
+
+    def test_transpose_squeeze(self):
+        x = np.random.rand(2, 1, 3).astype(np.float32)
+        t = paddle.to_tensor(x)
+        assert paddle.transpose(t, [2, 0, 1]).shape == [3, 2, 1]
+        assert paddle.squeeze(t, 1).shape == [2, 3]
+        assert paddle.unsqueeze(t, 0).shape == [1, 2, 1, 3]
+
+    def test_concat_stack_split(self):
+        x = np.random.rand(2, 3).astype(np.float32)
+        y = np.random.rand(2, 3).astype(np.float32)
+        tx, ty = paddle.to_tensor(x), paddle.to_tensor(y)
+        np.testing.assert_array_equal(
+            paddle.concat([tx, ty], 0).numpy(), np.concatenate([x, y], 0)
+        )
+        np.testing.assert_array_equal(
+            paddle.stack([tx, ty], 1).numpy(), np.stack([x, y], 1)
+        )
+        parts = paddle.split(paddle.to_tensor(np.arange(10)), [3, 3, 4])
+        assert [p.shape[0] for p in parts] == [3, 3, 4]
+        parts = paddle.split(paddle.to_tensor(np.arange(12).reshape(2, 6)), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 2]
+
+    def test_gather_scatter(self):
+        x = np.random.rand(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        t = paddle.to_tensor(x)
+        np.testing.assert_array_equal(
+            paddle.gather(t, paddle.to_tensor(idx)).numpy(), x[idx]
+        )
+        upd = np.ones((3, 3), np.float32)
+        out = paddle.tensor.scatter(t, paddle.to_tensor(idx), paddle.to_tensor(upd))
+        expected = x.copy()
+        expected[idx] = 1.0
+        np.testing.assert_array_equal(out.numpy(), expected)
+
+    def test_tile_expand(self):
+        x = np.random.rand(1, 3).astype(np.float32)
+        t = paddle.to_tensor(x)
+        assert paddle.tile(t, [2, 2]).shape == [2, 6]
+        assert paddle.expand(t, [4, 3]).shape == [4, 3]
+        assert paddle.tensor.broadcast_to(t, [4, 3]).shape == [4, 3]
+
+    def test_indexing(self):
+        x = np.arange(24).reshape(4, 6).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_array_equal(t[1].numpy(), x[1])
+        np.testing.assert_array_equal(t[1:3, ::2].numpy(), x[1:3, ::2])
+        np.testing.assert_array_equal(t[:, -1].numpy(), x[:, -1])
+        idx = paddle.to_tensor(np.array([0, 2]))
+        np.testing.assert_array_equal(t[idx].numpy(), x[[0, 2]])
+
+    def test_setitem(self):
+        x = np.zeros((3, 3), np.float32)
+        t = paddle.to_tensor(x.copy())
+        t[1] = 5.0
+        assert t.numpy()[1].tolist() == [5.0, 5.0, 5.0]
+        t[0, 0] = 1.0
+        assert t.numpy()[0, 0] == 1.0
+
+    def test_flip_roll(self):
+        x = np.arange(6).reshape(2, 3).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_array_equal(paddle.flip(t, [0]).numpy(), x[::-1])
+        np.testing.assert_array_equal(
+            paddle.roll(t, 1, axis=1).numpy(), np.roll(x, 1, 1)
+        )
+
+    def test_cast(self):
+        t = paddle.to_tensor([1.7, 2.3])
+        assert t.astype("int32").numpy().tolist() == [1, 2]
+        assert t.astype(paddle.float16).dtype == paddle.float16
+
+
+class TestLogicSearch:
+    def test_comparisons(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        y = paddle.to_tensor([2.0, 2.0, 2.0])
+        assert (x < y).numpy().tolist() == [True, False, False]
+        assert (x == y).numpy().tolist() == [False, True, False]
+        assert paddle.tensor.allclose(x, x).item() is True
+
+    def test_where(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(3, 4).astype(np.float32)
+        cond = x > 0.5
+        out = paddle.where(
+            paddle.to_tensor(cond), paddle.to_tensor(x), paddle.to_tensor(y)
+        )
+        np.testing.assert_array_equal(out.numpy(), np.where(cond, x, y))
+
+    def test_argmax_sort_topk(self):
+        x = np.random.rand(4, 5).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_array_equal(
+            paddle.argmax(t, axis=1).numpy(), x.argmax(1)
+        )
+        np.testing.assert_allclose(
+            paddle.tensor.sort(t, axis=1).numpy(), np.sort(x, 1), rtol=1e-6
+        )
+        vals, idx = paddle.topk(t, 2, axis=1)
+        np.testing.assert_allclose(
+            vals.numpy(), np.sort(x, 1)[:, ::-1][:, :2], rtol=1e-6
+        )
+
+    def test_nonzero_masked(self):
+        x = np.array([[0, 1], [2, 0]], np.float32)
+        t = paddle.to_tensor(x)
+        nz = paddle.tensor.nonzero(t)
+        np.testing.assert_array_equal(nz.numpy(), np.stack(np.nonzero(x), 1))
+        sel = paddle.tensor.masked_select(t, t > 0)
+        np.testing.assert_array_equal(np.sort(sel.numpy()), [1, 2])
+
+    def test_unique(self):
+        x = np.array([3, 1, 2, 1, 3], np.int32)
+        out = paddle.tensor.unique(paddle.to_tensor(x))
+        np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+
+
+class TestLinalg:
+    def test_solve_inv(self):
+        a = np.random.rand(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        b = np.random.rand(3, 2).astype(np.float32)
+        out = paddle.tensor.solve(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), np.linalg.solve(a, b), rtol=1e-3, atol=1e-4)
+        inv = paddle.tensor.inv(paddle.to_tensor(a))
+        np.testing.assert_allclose(inv.numpy(), np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+
+    def test_norm_det(self):
+        a = np.random.rand(3, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.tensor.norm(paddle.to_tensor(a)).numpy(),
+            np.linalg.norm(a), rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            paddle.tensor.det(paddle.to_tensor(a)).numpy(),
+            np.linalg.det(a), rtol=1e-4, atol=1e-5,
+        )
+
+    def test_cholesky_qr_svd(self):
+        a = np.random.rand(4, 3).astype(np.float32)
+        spd = a.T @ a + 3 * np.eye(3, dtype=np.float32)
+        L = paddle.tensor.cholesky(paddle.to_tensor(spd))
+        np.testing.assert_allclose(
+            L.numpy() @ L.numpy().T, spd, rtol=1e-4, atol=1e-4
+        )
+        q, r = paddle.tensor.qr(paddle.to_tensor(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-4, atol=1e-4)
+        u, s, vt = paddle.tensor.svd(paddle.to_tensor(a))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ vt.numpy(), a, rtol=1e-3, atol=1e-4
+        )
+
+
+class TestRandom:
+    def test_reproducible(self):
+        paddle.seed(7)
+        a = paddle.randn([3, 3]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([3, 3]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_uniform_range(self):
+        x = paddle.uniform([1000], min=2.0, max=3.0).numpy()
+        assert x.min() >= 2.0 and x.max() < 3.0
+
+    def test_randint_randperm(self):
+        x = paddle.randint(0, 10, [100]).numpy()
+        assert x.min() >= 0 and x.max() < 10
+        p = paddle.randperm(16).numpy()
+        np.testing.assert_array_equal(np.sort(p), np.arange(16))
+
+    def test_bernoulli_multinomial(self):
+        probs = paddle.full([1000], 0.3)
+        b = paddle.bernoulli(probs).numpy()
+        assert 0.1 < b.mean() < 0.5
+        m = paddle.multinomial(paddle.to_tensor([0.1, 0.0, 0.9]), 50, replacement=True)
+        assert set(np.unique(m.numpy())).issubset({0, 2})
+
+
+class TestDtypePromotion:
+    def test_defaults(self):
+        assert paddle.to_tensor(1.5).dtype == paddle.float32
+        assert paddle.to_tensor([1, 2]).dtype in (paddle.int32, paddle.int64)
+
+    def test_mixed(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        y = paddle.to_tensor([1, 2], dtype="int32")
+        assert (x + y).dtype == paddle.float32
