@@ -272,6 +272,19 @@ class Tensor:
     def __hash__(self):
         return id(self)
 
+    def __deepcopy__(self, memo):
+        # jax arrays are immutable; share the buffer, copy the shell
+        if isinstance(self, EagerParamBase):
+            t = EagerParamBase(self._data, name=self.name, trainable=self.trainable)
+            t.optimize_attr = dict(self.optimize_attr)
+            t.regularizer = self.regularizer
+            t.need_clip = self.need_clip
+        else:
+            t = Tensor(self._data, stop_gradient=self.stop_gradient, name=self.name)
+        t.persistable = self.persistable
+        memo[id(self)] = t
+        return t
+
     def __iter__(self):
         for i in range(len(self)):
             yield self[i]

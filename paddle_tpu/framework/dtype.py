@@ -70,11 +70,13 @@ def convert_dtype(dtype):
         if dtype in _ALIASES:
             return _ALIASES[dtype]
         raise ValueError(f"unknown dtype string: {dtype!r}")
-    if dtype in _ALIASES.values():
-        return dtype
-    # numpy dtype instance or jax type
-    npdtype = np.dtype(dtype)
-    name = npdtype.name
+    # normalize np.dtype instances and jax scalar types to the canonical
+    # class (instances compare == to the class but hash differently, which
+    # would break set/dict membership downstream)
+    try:
+        name = np.dtype(dtype).name
+    except TypeError:
+        raise ValueError(f"unsupported dtype: {dtype!r}") from None
     if name in _ALIASES:
         return _ALIASES[name]
     raise ValueError(f"unsupported dtype: {dtype!r}")

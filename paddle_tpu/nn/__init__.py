@@ -1,0 +1,50 @@
+"""`paddle.nn` namespace (parity: `python/paddle/nn/__init__.py`)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+)
+from .layer.layers import (  # noqa: F401
+    Layer, Sequential, LayerList, ParameterList, LayerDict, Identity,
+    ParamAttr,
+)
+from .layer.common import (  # noqa: F401
+    Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
+    Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, Pad1D, Pad2D, Pad3D,
+    ZeroPad2D, PixelShuffle, PixelUnshuffle, ChannelShuffle, Bilinear,
+    CosineSimilarity, PairwiseDistance, Unfold, Fold,
+)
+from .layer.conv import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
+)
+from .layer.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
+    LayerNorm, RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D,
+    InstanceNorm3D, LocalResponseNorm, SpectralNorm,
+)
+from .layer.pooling import (  # noqa: F401
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, LPPool2D,
+)
+from .layer.activation import (  # noqa: F401
+    ReLU, ReLU6, Sigmoid, LogSigmoid, Tanh, Tanhshrink, GELU, SiLU, Swish,
+    Mish, LeakyReLU, ELU, SELU, CELU, Hardtanh, Hardshrink, Softshrink,
+    Hardsigmoid, Hardswish, Softplus, Softsign, Softmax, LogSoftmax, Maxout,
+    GLU, RReLU, PReLU,
+)
+from .layer.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    SmoothL1Loss, KLDivLoss, MarginRankingLoss, HingeEmbeddingLoss,
+    CosineEmbeddingLoss, TripletMarginLoss, MultiLabelSoftMarginLoss,
+    SoftMarginLoss, CTCLoss,
+)
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .layer.rnn import (  # noqa: F401
+    SimpleRNNCell, LSTMCell, GRUCell, SimpleRNN, LSTM, GRU, RNN, BiRNN,
+)
+
+from . import utils  # noqa: F401

@@ -1,0 +1,226 @@
+"""Activation functions.
+
+Reference parity: `python/paddle/nn/functional/activation.py` over PHI
+activation kernels (`paddle/phi/kernels/funcs/activation_functor.h`).
+All are single fused XLA elementwise ops — no custom functors needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import apply
+from ...framework import random as rng
+
+
+def relu(x, name=None):
+    return apply("relu", jax.nn.relu, (x,))
+
+
+def relu_(x, name=None):
+    from ...tensor.manipulation import _adopt_inplace
+    return _adopt_inplace(x, relu(x))
+
+
+def relu6(x, name=None):
+    return apply("relu6", jax.nn.relu6, (x,))
+
+
+def sigmoid(x, name=None):
+    return apply("sigmoid", jax.nn.sigmoid, (x,))
+
+
+def log_sigmoid(x, name=None):
+    return apply("log_sigmoid", jax.nn.log_sigmoid, (x,))
+
+
+def tanh(x, name=None):
+    return apply("tanh", jnp.tanh, (x,))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(
+        "gelu", lambda a: jax.nn.gelu(a, approximate=approximate), (x,)
+    )
+
+
+def silu(x, name=None):
+    return apply("silu", jax.nn.silu, (x,))
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def mish(x, name=None):
+    return apply("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), (x,))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(
+        "leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), (x,)
+    )
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a >= 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a >= 0, a, w.reshape(shape) * a)
+    return apply("prelu", f, (x, weight))
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    if not training:
+        return apply(
+            "rrelu", lambda a: jnp.where(a >= 0, a, (lower + upper) / 2 * a), (x,)
+        )
+    key = rng.next_key()
+    def f(a):
+        slope = jax.random.uniform(key, a.shape, jnp.float32, lower, upper).astype(a.dtype)
+        return jnp.where(a >= 0, a, slope * a)
+    return apply("rrelu", f, (x,))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", lambda a: jax.nn.elu(a, alpha), (x,))
+
+
+def elu_(x, alpha=1.0, name=None):
+    from ...tensor.manipulation import _adopt_inplace
+    return _adopt_inplace(x, elu(x, alpha))
+
+
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    return apply(
+        "selu",
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+        (x,),
+    )
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", lambda a: jax.nn.celu(a, alpha), (x,))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return apply("hardtanh", lambda a: jnp.clip(a, min, max), (x,))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(
+        "hardshrink",
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, jnp.zeros((), a.dtype)),
+        (x,),
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(
+        "softshrink",
+        lambda a: jnp.where(
+            a > threshold, a - threshold,
+            jnp.where(a < -threshold, a + threshold, jnp.zeros((), a.dtype)),
+        ),
+        (x,),
+    )
+
+
+def tanhshrink(x, name=None):
+    return apply("tanhshrink", lambda a: a - jnp.tanh(a), (x,))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(
+        "hardsigmoid", lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), (x,)
+    )
+
+
+def hardswish(x, name=None):
+    return apply(
+        "hardswish", lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, (x,)
+    )
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(
+        "softplus",
+        lambda a: jnp.where(
+            beta * a > threshold, a, jax.nn.softplus(beta * a) / beta
+        ),
+        (x,),
+    )
+
+
+def softsign(x, name=None):
+    return apply("softsign", jax.nn.soft_sign, (x,))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from ...framework.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return apply("softmax", f, (x,))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...tensor.manipulation import _adopt_inplace
+    return _adopt_inplace(x, softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from ...framework.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply("log_softmax", f, (x,))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    key = rng.next_key()
+    def f(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(
+                onehot, idx, jnp.ones((), y.dtype), axis=axis, inplace=False
+            ) if hasattr(jnp, "put_along_axis") else onehot.at[
+                tuple(
+                    idx if i == (axis % y.ndim) else ind
+                    for i, ind in enumerate(jnp.indices(idx.shape))
+                )
+            ].set(1.0)
+            y = jax.lax.stop_gradient(onehot - y) + y
+        return y
+    return apply("gumbel_softmax", f, (x,))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return apply("maxout", f, (x,))
+
+
+def glu(x, axis=-1, name=None):
+    return apply("glu", lambda a: jax.nn.glu(a, axis=axis), (x,))
+
+
+def tanh_(x, name=None):
+    from ...tensor.manipulation import _adopt_inplace
+    return _adopt_inplace(x, tanh(x))

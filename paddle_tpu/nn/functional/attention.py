@@ -1,0 +1,81 @@
+"""Attention functional ops.
+
+Reference parity: `paddle.nn.functional.scaled_dot_product_attention` and the
+flash-attention PHI kernel (`paddle/phi/kernels/gpu/flash_attn_kernel.cu`,
+external `cmake/external/flashattn.cmake`).
+
+TPU-first design: the default implementation is plain jnp (XLA fuses it
+well at short seq-len); the op name "flash_attention" is a Pallas override
+point — `paddle_tpu.ops.pallas.flash_attention` registers a fused
+tiled-softmax kernel for TPU via the kernel registry, exactly how the
+reference swaps in the flashattn CUDA library.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...ops.dispatch import apply
+
+
+def _sdpa_reference(q, k, v, *rest, causal=False, dropout=0.0, scale=None,
+                    dropout_key=None):
+    """q,k,v: [batch, seq, heads, head_dim] (paddle flash-attn layout)."""
+    hd = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(hd)
+    # [b, h, sq, sk]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * s
+    if rest:
+        mask = rest[0]
+        logits = logits + mask.astype(logits.dtype)
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm, logits, jnp.asarray(-1e30, logits.dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), jnp.zeros((), probs.dtype))
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0,
+    is_causal=False, training=True, name=None,
+):
+    """Inputs [batch, seq, num_heads, head_dim] — same layout as the
+    reference's flash_attn op. Routed through op name "flash_attention" so a
+    Pallas kernel can take over on TPU."""
+    from ...framework import random as rng
+
+    operands = (query, key, value) if attn_mask is None else (
+        query, key, value, attn_mask
+    )
+    p = dropout_p if training else 0.0
+    dk = rng.next_key() if p > 0.0 else None
+
+    def default(*arrs, causal=False, dropout=0.0):
+        return _sdpa_reference(*arrs, causal=causal, dropout=dropout,
+                               dropout_key=dk)
+
+    return apply(
+        "flash_attention",
+        default,
+        operands,
+        causal=is_causal,
+        dropout=p,
+    )
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, name=None):
+    """Parity: paddle.nn.functional.flash_attention.flash_attention."""
+    out = scaled_dot_product_attention(
+        query, key, value, None, dropout, causal
+    )
+    if return_softmax:
+        return out, None
+    return out, None

@@ -1,0 +1,388 @@
+"""Common NN functional ops: linear, dropout, embedding, pad, one_hot,
+interpolate, normalize, cosine_similarity...
+
+Reference parity: `python/paddle/nn/functional/common.py` + `input.py`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import random as rng
+from ...framework.core import Tensor
+from ...framework.dtype import convert_dtype
+from ...ops.dispatch import apply
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, W is [in, out] (parity: paddle.nn.functional.linear,
+    PHI kernel `phi/kernels/.../matmul_kernel` + fused bias; XLA fuses the
+    bias add into the MXU matmul epilogue)."""
+    if bias is None:
+        return apply("linear", lambda a, w: a @ w, (x, weight))
+    return apply("linear", lambda a, w, b: a @ w + b, (x, weight, bias))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    """Parity: paddle.nn.functional.dropout (`phi/kernels/gpu/dropout_kernel`).
+
+    Keys come from the functional RNG (`framework.random.next_key`) so the
+    mask is reproducible and trace-safe."""
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    if p == 1.0:
+        return apply("dropout", lambda a: jnp.zeros_like(a), (x,))
+    key = rng.next_key()
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [ax % a.ndim for ax in axes] else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype))
+        return jnp.where(keep, a, jnp.zeros((), a.dtype))
+    return apply("dropout", f, (x,))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = rng.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        coef_a = (1.0 / np.sqrt((1 - p) * (1 + p * alpha_p ** 2))).astype(np.float32)
+        coef_b = -coef_a * p * alpha_p
+        return coef_a * jnp.where(keep, a, jnp.asarray(alpha_p, a.dtype)) + coef_b
+    return apply("alpha_dropout", f, (x,))
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Parity: paddle.nn.functional.embedding
+    (`phi/kernels/.../embedding_kernel`). On TPU a gather from the table;
+    padding_idx rows contribute zero gradient via mask."""
+    def f(idx, w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            pad = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            mask = (idx != pad)[..., None]
+            out = out * mask.astype(out.dtype)
+        return out
+    return apply("embedding", f, (x, weight))
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(
+        "one_hot",
+        lambda idx: jax.nn.one_hot(idx, num_classes, dtype=jnp.float32),
+        (x,),
+    )
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l, *rest):
+        k = l.shape[-1]
+        if rest:
+            return (1 - epsilon) * l + epsilon * rest[0]
+        return (1 - epsilon) * l + epsilon / k
+    ops = (label,) if prior_dist is None else (label, prior_dist)
+    return apply("label_smooth", f, ops)
+
+
+_PAD_MODES = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    """Parity: paddle.nn.functional.pad (`phi/kernels/.../pad3d_kernel`).
+    `pad` is paddle-style [left, right, top, bottom, ...] over the last dims
+    (or per-dim pairs when len == 2*ndim)."""
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = list(int(p) for p in pad)
+    jmode = _PAD_MODES[mode]
+    def f(a):
+        nd = a.ndim
+        cfg = [(0, 0)] * nd
+        if len(pad) == 2 * nd:
+            # full per-dim spec, paddle order = numpy order
+            for i in range(nd):
+                cfg[i] = (pad[2 * i], pad[2 * i + 1])
+        else:
+            # spatial-only spec over trailing dims; paddle lists (left,right)
+            # starting from the LAST spatial dim backwards
+            n_spatial = len(pad) // 2
+            if data_format.startswith("NC"):
+                spatial = list(range(2, nd))
+            else:
+                spatial = list(range(1, nd - 1))
+            assert n_spatial <= len(spatial), "pad spec longer than spatial dims"
+            for i in range(n_spatial):
+                dim = spatial[-(i + 1)]
+                cfg[dim] = (pad[2 * i], pad[2 * i + 1])
+        if jmode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        return jnp.pad(a, cfg, mode=jmode)
+    return apply("pad", f, (x,))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        n = jnp.linalg.norm(a, ord=p, axis=axis, keepdims=True)
+        return a / jnp.maximum(n, epsilon)
+    return apply("normalize", f, (x,))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+    return apply("cosine_similarity", f, (x1, x2))
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+    return apply("pairwise_distance", f, (x, y))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return apply("pixel_shuffle", f, (x,))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+    return apply("pixel_unshuffle", f, (x,))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            return a.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        return a.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return apply("channel_shuffle", f, (x,))
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    align_mode=0,
+    data_format="NCHW",
+    name=None,
+):
+    """Parity: paddle.nn.functional.interpolate (`phi/kernels/.../interpolate_kernel`).
+    Uses jax.image.resize; nearest/bilinear/bicubic/trilinear/area supported."""
+    if isinstance(size, Tensor):
+        size = [int(s) for s in size.tolist()]
+    method = {
+        "nearest": "nearest",
+        "bilinear": "bilinear",
+        "bicubic": "bicubic",
+        "trilinear": "trilinear",
+        "linear": "linear",
+        "area": "linear",
+    }[mode]
+    def f(a):
+        nd = a.ndim
+        channel_last = not data_format.startswith("NC")
+        spatial = list(range(1, nd - 1)) if channel_last else list(range(2, nd))
+        if size is not None:
+            tgt = list(size) if isinstance(size, (list, tuple)) else [size]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+            tgt = [int(a.shape[d] * s) for d, s in zip(spatial, sf)]
+        out_shape = list(a.shape)
+        for d, s in zip(spatial, tgt):
+            out_shape[d] = s
+        if method == "trilinear":
+            m = "trilinear" if nd == 5 else "bilinear"
+        else:
+            m = method
+        if m == "trilinear":
+            m = "linear"
+        return jax.image.resize(a, tuple(out_shape), method=m)
+    return apply("interpolate", f, (x,))
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (parity: paddle.nn.functional.unfold,
+    `phi/kernels/.../unfold_kernel`)."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings) if not (isinstance(paddings, (list, tuple)) and len(paddings) == 4) else (None, None)
+    dh, dw = _pair(dilations)
+    def f(a):
+        n, c, h, w = a.shape
+        if ph is not None:
+            a = jnp.pad(a, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        else:
+            pt, pl, pb, pr = paddings
+            a = jnp.pad(a, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+        hh, ww = a.shape[2], a.shape[3]
+        out_h = (hh - (dh * (kh - 1) + 1)) // sh + 1
+        out_w = (ww - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            a, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )  # [n, c*kh*kw, out_h, out_w]
+        return patches.reshape(n, c * kh * kw, out_h * out_w)
+    return apply("unfold", f, (x,))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """col2im — the adjoint of unfold; expressed via the VJP of unfold so
+    behavior matches exactly (overlaps sum)."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    def f(cols):
+        n = cols.shape[0]
+        c = cols.shape[1] // (kh * kw)
+        def unfold_arr(img):
+            sh, sw = _pair(strides)
+            dh, dw = _pair(dilations)
+            ph, pw = _pair(paddings)
+            img = jnp.pad(img, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+            patches = jax.lax.conv_general_dilated_patches(
+                img, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            )
+            return patches.reshape(n, c * kh * kw, -1)
+        zeros = jnp.zeros((n, c, oh, ow), cols.dtype)
+        _, vjp = jax.vjp(unfold_arr, zeros)
+        (img,) = vjp(cols)
+        return img
+    return apply("fold", f, (x,))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    ops = (x1, x2, weight) if bias is None else (x1, x2, weight, bias)
+    return apply("bilinear", f, ops)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    """Parity: paddle.nn.functional.grid_sample (bilinear only)."""
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx = (g[..., 0] + 1) * (w - 1) / 2 if align_corners else ((g[..., 0] + 1) * w - 1) / 2
+        gy = (g[..., 1] + 1) * (h - 1) / 2 if align_corners else ((g[..., 1] + 1) * h - 1) / 2
+        x0 = jnp.floor(gx); x1 = x0 + 1
+        y0 = jnp.floor(gy); y1 = y0 + 1
+        wx1 = gx - x0; wx0 = 1 - wx1
+        wy1 = gy - y0; wy0 = 1 - wy1
+        def sample(yy, xx):
+            valid = (xx >= 0) & (xx < w) & (yy >= 0) & (yy < h)
+            xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            vals = a[jnp.arange(n)[:, None, None], :, yi, xi]  # [n,gh,gw,c]
+            return vals * valid[..., None].astype(a.dtype)
+        out = (
+            sample(y0, x0) * (wy0 * wx0)[..., None]
+            + sample(y0, x1) * (wy0 * wx1)[..., None]
+            + sample(y1, x0) * (wy1 * wx0)[..., None]
+            + sample(y1, x1) * (wy1 * wx1)[..., None]
+        )
+        return jnp.moveaxis(out, -1, 1)
+    return apply("grid_sample", f, (x, grid))
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in out_shape.tolist()]
+    n, c, h, w = out_shape
+    def f(th):
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [h, w, 3]
+        return jnp.einsum("hwk,nok->nhwo", base, th)
+    return apply("affine_grid", f, (theta,))
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+    def f(l):
+        m = maxlen if maxlen is not None else int(jnp.max(l))
+        return (jnp.arange(m)[None, :] < l[..., None]).astype(np.dtype(d) if d != jnp.bfloat16 else d)
+    lens = lengths if isinstance(lengths, Tensor) else Tensor(jnp.asarray(lengths))
+    if maxlen is None:
+        m = int(np.asarray(lens._data).max())
+        return apply(
+            "sequence_mask",
+            lambda l: (jnp.arange(m)[None, :] < l[..., None]).astype(np.dtype(d) if d != jnp.bfloat16 else d),
+            (lens,),
+        )
+    return apply("sequence_mask", f, (lens,))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError(
+        "class_center_sample requires dynamic shapes; planned for the "
+        "distributed margin-loss module"
+    )

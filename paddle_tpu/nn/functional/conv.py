@@ -1,0 +1,180 @@
+"""Convolutions via `lax.conv_general_dilated` (the XLA conv that tiles onto
+the MXU), replacing the reference's cuDNN dispatch
+(`paddle/phi/kernels/gpu/conv_kernel.cu`, `python/paddle/nn/functional/conv.py`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...ops.dispatch import apply
+
+
+def _ntuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _resolve_padding(padding, nd, strides, dilations, ksizes):
+    """paddle padding: int, list of ints, list of pairs, 'SAME', 'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if all(isinstance(p, int) for p in padding):
+        if len(padding) == nd:
+            return [(p, p) for p in padding]
+        if len(padding) == 2 * nd:
+            return [
+                (padding[2 * i], padding[2 * i + 1]) for i in range(nd)
+            ]
+    return [tuple(p) for p in padding]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, nd, op_name):
+    strides = _ntuple(stride, nd)
+    dilations = _ntuple(dilation, nd)
+    channel_last = not data_format.startswith("NC")
+    if nd == 1:
+        dn_in = "NWC" if channel_last else "NCW"
+        dn_out = dn_in
+        dn_k = "WIO" if channel_last else "OIW"
+    elif nd == 2:
+        dn_in = "NHWC" if channel_last else "NCHW"
+        dn_out = dn_in
+        dn_k = "HWIO" if channel_last else "OIHW"
+    else:
+        dn_in = "NDHWC" if channel_last else "NCDHW"
+        dn_out = dn_in
+        dn_k = "DHWIO" if channel_last else "OIDHW"
+
+    def f(a, w, *rest):
+        # paddle weights are always [out_c, in_c/groups, *k]
+        if channel_last:
+            if nd == 1:
+                wk = jnp.transpose(w, (2, 1, 0))
+            elif nd == 2:
+                wk = jnp.transpose(w, (2, 3, 1, 0))
+            else:
+                wk = jnp.transpose(w, (2, 3, 4, 1, 0))
+        else:
+            wk = w
+        ksz = w.shape[2:]
+        pad = _resolve_padding(padding, nd, strides, dilations, ksz)
+        out = jax.lax.conv_general_dilated(
+            a, wk,
+            window_strides=strides,
+            padding=pad,
+            rhs_dilation=dilations,
+            dimension_numbers=(dn_in, dn_k, dn_out),
+            feature_group_count=groups,
+        )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    ops = (x, weight) if bias is None else (x, weight, bias)
+    return apply(op_name, f, ops)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, fmt, 1, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 2, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 3, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, data_format, nd, op_name, output_size=None):
+    strides = _ntuple(stride, nd)
+    dilations = _ntuple(dilation, nd)
+    out_pad = _ntuple(output_padding, nd)
+    channel_last = not data_format.startswith("NC")
+
+    def f(a, w, *rest):
+        # paddle transpose-conv weights are [in_c, out_c/groups, *k]
+        if channel_last:
+            a_ncx = jnp.moveaxis(a, -1, 1)
+        else:
+            a_ncx = a
+        # implement via gradient of forward conv: conv_transpose(x, w) is
+        # the VJP of conv(y, w) wrt y — XLA lowers this as a dilated conv
+        ksz = w.shape[2:]
+        pad = padding if isinstance(padding, str) else _ntuple(padding, nd)
+        if isinstance(pad, str):
+            raise NotImplementedError("SAME/VALID transpose padding: use ints")
+        n, cin = a_ncx.shape[0], a_ncx.shape[1]
+        cout = w.shape[1] * groups
+        in_spatial = a_ncx.shape[2:]
+        out_spatial = tuple(
+            (in_spatial[i] - 1) * strides[i]
+            - 2 * pad[i]
+            + dilations[i] * (ksz[i] - 1)
+            + 1 + out_pad[i]
+            for i in range(nd)
+        )
+        if output_size is not None:
+            osz = tuple(int(v) for v in _ntuple(output_size, nd))
+            out_spatial = osz
+        dn = ("NCHW", "OIHW", "NCHW") if nd == 2 else (
+            ("NCW", "OIW", "NCW") if nd == 1 else ("NCDHW", "OIDHW", "NCDHW")
+        )
+        # forward conv maps [n, cout, *out_spatial] -> [n, cin, *in_spatial]
+        # with weights [cin, cout/groups, *k]; paddle stores exactly that.
+        def fwd_conv(y):
+            return jax.lax.conv_general_dilated(
+                y, w,
+                window_strides=strides,
+                padding=[(pad[i], pad[i]) for i in range(nd)],
+                rhs_dilation=dilations,
+                dimension_numbers=dn,
+                feature_group_count=groups,
+            )
+        zeros = jnp.zeros((n, cout) + out_spatial, a_ncx.dtype)
+        _, vjp = jax.vjp(fwd_conv, zeros)
+        (out,) = vjp(a_ncx)
+        if rest:
+            out = out + rest[0].reshape((1, -1) + (1,) * nd)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    ops = (x, weight) if bias is None else (x, weight, bias)
+    return apply(op_name, f, ops)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, fmt, 1, "conv1d_transpose", output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 2, "conv2d_transpose", output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 3, "conv3d_transpose", output_size)

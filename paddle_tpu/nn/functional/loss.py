@@ -1,0 +1,322 @@
+"""Loss functions.
+
+Reference parity: `python/paddle/nn/functional/loss.py` over PHI
+cross_entropy / bce / smooth_l1 / kldiv kernels
+(`phi/kernels/gpu/cross_entropy_kernel.cu` etc.).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor
+from ...ops.dispatch import apply
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(
+    input,  # noqa: A002
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    """Parity: paddle.nn.functional.cross_entropy — fused
+    softmax+cross-entropy (the reference's `softmax_with_cross_entropy`
+    kernel); computed via log_softmax + gather so XLA emits one fused
+    kernel with a numerically-stable logsumexp."""
+    has_w = weight is not None
+    operands = [input, label] + ([weight] if has_w else [])
+
+    def f(logits, lab, *rest):
+        ax = axis % logits.ndim
+        logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax else jnp.log(
+            jnp.maximum(logits, 1e-30)
+        )
+        if soft_label or (lab.ndim == logits.ndim and lab.shape == logits.shape
+                          and jnp.issubdtype(lab.dtype, jnp.floating)):
+            tgt = lab
+            if label_smoothing > 0:
+                k = logits.shape[ax]
+                tgt = tgt * (1 - label_smoothing) + label_smoothing / k
+            loss = -jnp.sum(tgt * logp, axis=ax)
+            return _reduce(loss, reduction)
+        lab_idx = lab
+        if lab_idx.ndim == logits.ndim:  # trailing 1 dim
+            lab_idx = jnp.squeeze(lab_idx, axis=ax)
+        lab_idx = lab_idx.astype(jnp.int32)
+        valid = lab_idx != ignore_index
+        safe = jnp.where(valid, lab_idx, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, ax), axis=ax
+        ).squeeze(ax)
+        if label_smoothing > 0:
+            k = logits.shape[ax]
+            smooth = -jnp.mean(logp, axis=ax)
+            loss = (1 - label_smoothing) * (-picked) + label_smoothing * smooth
+        else:
+            loss = -picked
+        if has_w:
+            w = rest[0]
+            loss = loss * jnp.take(w, safe)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            if has_w:
+                denom = jnp.sum(jnp.take(rest[0], safe) * valid)
+            else:
+                denom = jnp.maximum(jnp.sum(valid), 1)
+            return jnp.sum(loss) / denom
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply("cross_entropy", f, tuple(operands))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        reduction="none", axis=axis,
+    )
+    from .activation import softmax as _softmax
+    from ...tensor.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):  # noqa: A002
+    has_w = weight is not None
+    operands = [input, label] + ([weight] if has_w else [])
+    def f(logp, lab, *rest):
+        lab = lab.astype(jnp.int32)
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        picked = jnp.take_along_axis(logp, safe[:, None], axis=1).squeeze(1)
+        loss = -picked
+        if has_w:
+            loss = loss * jnp.take(rest[0], safe)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = (
+                jnp.sum(jnp.take(rest[0], safe) * valid) if has_w
+                else jnp.maximum(jnp.sum(valid), 1)
+            )
+            return jnp.sum(loss) / denom
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply("nll_loss", f, tuple(operands))
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply(
+        "mse_loss", lambda a, b: _reduce((a - b) ** 2, reduction), (input, label)
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply(
+        "l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction), (input, label)
+    )
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply("smooth_l1_loss", f, (input, label))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    has_w = weight is not None
+    operands = [input, label] + ([weight] if has_w else [])
+    def f(p, t, *rest):
+        p = jnp.clip(p, 1e-12, 1 - 1e-7)
+        loss = -(t * jnp.log(p) + (1 - t) * jnp.log1p(-p))
+        if has_w:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+    return apply("binary_cross_entropy", f, tuple(operands))
+
+
+def binary_cross_entropy_with_logits(
+    logit, label, weight=None, reduction="mean", pos_weight=None, name=None
+):
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    operands = [logit, label]
+    if has_w:
+        operands.append(weight)
+    if has_pw:
+        operands.append(pos_weight)
+    def f(z, t, *rest):
+        # numerically stable: max(z,0) - z*t + log(1+exp(-|z|))
+        base = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        i = 0
+        if has_pw:
+            pw = rest[-1]
+            logsig = jax.nn.log_sigmoid(z)
+            logsig_neg = jax.nn.log_sigmoid(-z)
+            base = -(pw * t * logsig + (1 - t) * logsig_neg)
+        if has_w:
+            base = base * rest[0]
+        return _reduce(base, reduction)
+    return apply("bce_with_logits", f, tuple(operands))
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):  # noqa: A002
+    def f(logp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - logp)
+        else:
+            safe_t = jnp.maximum(t, 1e-12)
+            loss = t * (jnp.log(safe_t) - logp)
+            loss = jnp.where(t > 0, loss, 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply("kl_div", f, (input, label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):  # noqa: A002
+    def f(a, b, t):
+        return _reduce(jnp.maximum(0.0, -t * (a - b) + margin), reduction)
+    return apply("margin_ranking_loss", f, (input, other, label))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    def f(a, t):
+        loss = jnp.where(t == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply("hinge_embedding_loss", f, (input, label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, t):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        loss = jnp.where(t == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply("cosine_embedding_loss", f, (input1, input2, label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,  # noqa: A002
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return apply("triplet_margin_loss", f, (input, positive, negative))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    has_w = weight is not None
+    operands = [input, label] + ([weight] if has_w else [])
+    def f(z, t, *rest):
+        loss = -(t * jax.nn.log_sigmoid(z) + (1 - t) * jax.nn.log_sigmoid(-z))
+        if has_w:
+            loss = loss * rest[0]
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+    return apply("multi_label_soft_margin_loss", f, tuple(operands))
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    def f(z, t):
+        return _reduce(jnp.log1p(jnp.exp(-t * z)), reduction)
+    return apply("soft_margin_loss", f, (input, label))
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return apply("square_error_cost", lambda a, b: (a - b) ** 2, (input, label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    def f(p, t):
+        return -t * jnp.log(p + epsilon) - (1 - t) * jnp.log(1 - p + epsilon)
+    return apply("log_loss", f, (input, label))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard alpha recursion in log space with lax.scan
+    (the reference links warpctc; here it's a pure XLA scan).
+    log_probs: [T, B, C] (paddle layout), labels: [B, L]."""
+    def f(lp, lab, in_len, lab_len):
+        T, B, C = lp.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        # extended label sequence: blank l1 blank l2 ... blank
+        ext = jnp.full((B, S), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+        # transition mask: allow skip from s-2 when ext[s] != blank and
+        # ext[s] != ext[s-2]
+        ext_prev2 = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)), constant_values=-1)
+        can_skip = (ext != blank) & (ext != ext_prev2)
+        init = jnp.full((B, S), neg_inf)
+        init = init.at[:, 0].set(lp[0, jnp.arange(B), ext[:, 0]])
+        init = init.at[:, 1].set(
+            jnp.where(L > 0, lp[0, jnp.arange(B), ext[:, 1]], neg_inf)
+        )
+        def step(alpha, lp_t):
+            a_prev = alpha
+            a_shift1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=-1e30)
+            a_shift2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=-1e30)
+            a_shift2 = jnp.where(can_skip, a_shift2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, merged + emit
+        _, alphas = jax.lax.scan(step, init, lp[1:])
+        alphas = jnp.concatenate([init[None], alphas], axis=0)  # [T, B, S]
+        t_idx = (in_len.astype(jnp.int32) - 1)
+        last = alphas[t_idx, jnp.arange(B)]  # [B, S]
+        send = 2 * lab_len.astype(jnp.int32)
+        p_blank = jnp.take_along_axis(last, send[:, None], axis=1)[:, 0]
+        p_label = jnp.take_along_axis(
+            last, jnp.maximum(send - 1, 0)[:, None], axis=1
+        )[:, 0]
+        ll = jnp.logaddexp(p_blank, jnp.where(lab_len > 0, p_label, neg_inf))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(lp.dtype), 1))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply("ctc_loss", f, (log_probs, labels, input_lengths, label_lengths))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    has_n = normalizer is not None
+    operands = [logit, label] + ([normalizer] if has_n else [])
+    def f(z, t, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if has_n:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+    return apply("sigmoid_focal_loss", f, tuple(operands))

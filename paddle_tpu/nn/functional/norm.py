@@ -1,0 +1,236 @@
+"""Normalization functional ops.
+
+Reference parity: `python/paddle/nn/functional/norm.py` over PHI
+batch_norm/layer_norm/group_norm/instance_norm kernels. On TPU these are
+plain jnp expressions that XLA fuses into one kernel; running-stat updates
+happen outside the traced computation (the layer owns the buffers).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...ops.dispatch import apply
+from ...autograd.tape import no_grad
+
+
+def batch_norm(
+    x, running_mean, running_var, weight=None, bias=None, training=False,
+    momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None, name=None,
+):
+    """Parity: paddle.nn.functional.batch_norm. In training mode computes
+    batch statistics and (eagerly, outside the graph) updates the running
+    buffers in place with paddle's convention:
+    running = momentum * running + (1 - momentum) * batch."""
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    use_batch_stats = training and not use_global_stats
+
+    def stats_axes(a):
+        if channel_last:
+            return tuple(range(a.ndim - 1))
+        return (0,) + tuple(range(2, a.ndim))
+
+    def ch_shape(a):
+        s = [1] * a.ndim
+        s[-1 if channel_last else (1 if a.ndim > 1 else 0)] = -1
+        return s
+
+    if use_batch_stats:
+        # eager running-stat update (buffer mutation, no grad)
+        with no_grad():
+            axes = stats_axes(x._data)
+            bm = jnp.mean(x._data, axis=axes)
+            bv = jnp.var(x._data, axis=axes)
+            if running_mean is not None:
+                running_mean._data = (
+                    momentum * running_mean._data + (1 - momentum) * bm
+                ).astype(running_mean._data.dtype)
+            if running_var is not None:
+                n = x._data.size // bm.size
+                unbiased = bv * n / max(n - 1, 1)
+                running_var._data = (
+                    momentum * running_var._data + (1 - momentum) * unbiased
+                ).astype(running_var._data.dtype)
+
+    operands = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if not use_batch_stats:
+        operands += [running_mean, running_var]
+    if has_w:
+        operands.append(weight)
+    if has_b:
+        operands.append(bias)
+
+    def f(a, *rest):
+        i = 0
+        if not use_batch_stats:
+            mean, var = rest[0], rest[1]
+            i = 2
+        else:
+            axes = stats_axes(a)
+            mean = jnp.mean(a, axis=axes)
+            var = jnp.var(a, axis=axes)
+        shape = ch_shape(a)
+        out = (a - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+        if has_w:
+            out = out * rest[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + rest[i].reshape(shape)
+        return out
+
+    return apply("batch_norm", f, tuple(operands))
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    """Parity: paddle.nn.functional.layer_norm
+    (`phi/kernels/gpu/layer_norm_kernel.cu`). Normalizes over the trailing
+    `normalized_shape` dims; XLA fuses mean/var/scale into one pass."""
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    nd = len(tuple(normalized_shape))
+
+    operands = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        operands.append(weight)
+    if has_b:
+        operands.append(bias)
+
+    def f(a, *rest):
+        axes = tuple(range(a.ndim - nd, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * rest[i].reshape(a.shape[a.ndim - nd:])
+            i += 1
+        if has_b:
+            out = out + rest[i].reshape(a.shape[a.ndim - nd:])
+        return out
+
+    return apply("layer_norm", f, tuple(operands))
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (no reference equivalent op — used by the Llama family;
+    reference models implement it ad hoc). Normalizes the last dim."""
+    operands = [x] if weight is None else [x, weight]
+    has_w = weight is not None
+
+    def f(a, *rest):
+        # compute in fp32 for stability, cast back (matches common practice)
+        a32 = a.astype(jnp.float32)
+        ms = jnp.mean(a32 * a32, axis=-1, keepdims=True)
+        out = (a32 * jnp.reciprocal(jnp.sqrt(ms + epsilon))).astype(a.dtype)
+        if has_w:
+            out = out * rest[0]
+        return out
+
+    return apply("rms_norm", f, tuple(operands))
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW", name=None):
+    channel_last = not data_format.startswith("NC")
+    operands = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        operands.append(weight)
+    if has_b:
+        operands.append(bias)
+
+    def f(a, *rest):
+        if channel_last:
+            a_ncx = jnp.moveaxis(a, -1, 1)
+        else:
+            a_ncx = a
+        n, c = a_ncx.shape[0], a_ncx.shape[1]
+        spatial = a_ncx.shape[2:]
+        g = a_ncx.reshape(n, num_groups, c // num_groups, *spatial)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) / jnp.sqrt(var + epsilon)).reshape(a_ncx.shape)
+        shape = (1, c) + (1,) * len(spatial)
+        i = 0
+        if has_w:
+            out = out * rest[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + rest[i].reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply("group_norm", f, tuple(operands))
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    channel_last = not data_format.startswith("NC")
+    operands = [x]
+    has_stats = not use_input_stats and running_mean is not None
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_stats:
+        operands += [running_mean, running_var]
+    if has_w:
+        operands.append(weight)
+    if has_b:
+        operands.append(bias)
+
+    def f(a, *rest):
+        a_ncx = jnp.moveaxis(a, -1, 1) if channel_last else a
+        i = 0
+        if has_stats:
+            c = a_ncx.shape[1]
+            sh = (1, c) + (1,) * (a_ncx.ndim - 2)
+            mean = rest[0].reshape(sh)
+            var = rest[1].reshape(sh)
+            i = 2
+        else:
+            axes = tuple(range(2, a_ncx.ndim))
+            mean = jnp.mean(a_ncx, axis=axes, keepdims=True)
+            var = jnp.var(a_ncx, axis=axes, keepdims=True)
+        out = (a_ncx - mean) / jnp.sqrt(var + eps)
+        shape = (1, a_ncx.shape[1]) + (1,) * (a_ncx.ndim - 2)
+        if has_w:
+            out = out * rest[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + rest[i].reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply("instance_norm", f, tuple(operands))
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(a):
+        channel_last = not data_format.startswith("NC")
+        ax = a.ndim - 1 if channel_last else 1
+        sq = a * a
+        # sum over a window of `size` channels centered at each channel
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        pads = [(0, 0)] * a.ndim
+        pads[ax] = (pad_lo, pad_hi)
+        padded = jnp.pad(sq, pads)
+        import jax as _jax
+        dims = [1] * a.ndim
+        dims[ax] = size
+        strides = [1] * a.ndim
+        window_sum = _jax.lax.reduce_window(
+            padded, jnp.asarray(0, a.dtype), _jax.lax.add,
+            tuple(dims), tuple(strides), "VALID",
+        )
+        return a / (k + alpha * window_sum) ** beta
+    return apply("local_response_norm", f, (x,))

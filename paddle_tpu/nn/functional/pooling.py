@@ -1,0 +1,191 @@
+"""Pooling via `lax.reduce_window` (parity:
+`python/paddle/nn/functional/pooling.py`, PHI `pool_kernel`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor
+from ...ops.dispatch import apply
+from .conv import _ntuple
+
+
+def _window(nd, ksize, stride, channel_last):
+    k = _ntuple(ksize, nd)
+    s = _ntuple(stride if stride is not None else ksize, nd)
+    if channel_last:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+    else:
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+    return dims, strides, k, s
+
+
+def _pool_padding(padding, nd, channel_last):
+    if isinstance(padding, str):
+        return padding.upper()
+    p = _ntuple(padding, nd)
+    spatial = [(pp, pp) for pp in p]
+    if channel_last:
+        return [(0, 0)] + spatial + [(0, 0)]
+    return [(0, 0), (0, 0)] + spatial
+
+
+def _ceil_adjust(pad, a_shape, dims, strides, ceil_mode):
+    """Extend high-side padding so output size ceils instead of floors
+    (paddle's ceil_mode; reference pool kernels compute this in
+    `phi/kernels/funcs/pooling.h`)."""
+    if not ceil_mode or isinstance(pad, str):
+        return pad
+    new_pad = []
+    for ax, (lo, hi) in enumerate(pad):
+        k, s = dims[ax], strides[ax]
+        if k == 1 and s == 1:
+            new_pad.append((lo, hi))
+            continue
+        eff = a_shape[ax] + lo + hi
+        rem = (eff - k) % s
+        extra = (s - rem) % s if rem else 0
+        new_pad.append((lo, hi + extra))
+    return new_pad
+
+
+def _max_pool(x, nd, kernel_size, stride, padding, ceil_mode, data_format, op_name):
+    channel_last = not data_format.startswith("NC")
+    dims, strides, _, _ = _window(nd, kernel_size, stride, channel_last)
+    pad = _pool_padding(padding, nd, channel_last)
+    def f(a):
+        p = _ceil_adjust(pad, a.shape, dims, strides, ceil_mode)
+        init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+        return jax.lax.reduce_window(
+            a, jnp.asarray(init, a.dtype), jax.lax.max, dims, strides, p
+        )
+    return apply(op_name, f, (x,))
+
+
+def _avg_pool(x, nd, kernel_size, stride, padding, exclusive, ceil_mode, data_format, op_name):
+    channel_last = not data_format.startswith("NC")
+    dims, strides, _, _ = _window(nd, kernel_size, stride, channel_last)
+    pad = _pool_padding(padding, nd, channel_last)
+    def f(a):
+        p = _ceil_adjust(pad, a.shape, dims, strides, ceil_mode)
+        summed = jax.lax.reduce_window(
+            a, jnp.asarray(0, a.dtype), jax.lax.add, dims, strides, p
+        )
+        if exclusive and p not in ("VALID",):
+            ones = jnp.ones_like(a)
+            counts = jax.lax.reduce_window(
+                ones, jnp.asarray(0, a.dtype), jax.lax.add, dims, strides, p
+            )
+            return summed / counts
+        return summed / np.prod([d for d in dims if d > 1])
+    return apply(op_name, f, (x,))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _max_pool(x, 1, kernel_size, stride, padding, ceil_mode, fmt, "max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _max_pool(x, 2, kernel_size, stride, padding, ceil_mode, data_format, "max_pool2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _max_pool(x, 3, kernel_size, stride, padding, ceil_mode, data_format, "max_pool3d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _avg_pool(x, 1, kernel_size, stride, padding, exclusive, ceil_mode, fmt, "avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _avg_pool(x, 2, kernel_size, stride, padding, exclusive, ceil_mode, data_format, "avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _avg_pool(x, 3, kernel_size, stride, padding, exclusive, ceil_mode, data_format, "avg_pool3d")
+
+
+def _adaptive_windows(in_size, out_size):
+    """Start/end boundaries identical to paddle's adaptive pooling."""
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = ((np.arange(out_size) + 1) * in_size + out_size - 1) // out_size
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, nd, reduce_fn, data_format, op_name):
+    channel_last = not data_format.startswith("NC")
+    out = _ntuple(output_size, nd)
+    def f(a):
+        spatial_axes = list(range(1, a.ndim - 1)) if channel_last else list(range(2, a.ndim))
+        res = a
+        for i, ax in enumerate(spatial_axes):
+            if out[i] is None:
+                continue
+            in_size = res.shape[ax]
+            o = out[i]
+            if in_size % o == 0:
+                # uniform windows: reshape + reduce (fast path)
+                k = in_size // o
+                new_shape = res.shape[:ax] + (o, k) + res.shape[ax + 1:]
+                res = reduce_fn(res.reshape(new_shape), ax + 1)
+            else:
+                starts, ends = _adaptive_windows(in_size, o)
+                slices = [
+                    reduce_fn(
+                        jax.lax.slice_in_dim(res, int(s), int(e), axis=ax), ax
+                    )
+                    for s, e in zip(starts, ends)
+                ]
+                res = jnp.stack(slices, axis=ax)
+        return res
+    return apply(op_name, f, (x,))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, jnp.mean, "NCW", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, jnp.mean, data_format, "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, jnp.mean, data_format, "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, jnp.max, "NCW", "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, jnp.max, "NCHW", "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, jnp.max, "NCDHW", "adaptive_max_pool3d")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    channel_last = not data_format.startswith("NC")
+    dims, strides, _, _ = _window(2, kernel_size, stride, channel_last)
+    pad = _pool_padding(padding, 2, channel_last)
+    p = float(norm_type)
+    def f(a):
+        powered = jnp.abs(a) ** p
+        summed = jax.lax.reduce_window(
+            powered, jnp.asarray(0, a.dtype), jax.lax.add, dims, strides, pad
+        )
+        return summed ** (1.0 / p)
+    return apply("lp_pool2d", f, (x,))

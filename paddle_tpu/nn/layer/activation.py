@@ -1,0 +1,79 @@
+"""Activation layers (parity: `python/paddle/nn/layer/activation.py`)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+
+def _act_layer(name, fn, **defaults):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            merged = dict(defaults)
+            # positional args map onto the declared default keys in order
+            for k, v in zip(defaults.keys(), args):
+                merged[k] = v
+            for k, v in kwargs.items():
+                if k in merged:
+                    merged[k] = v
+            self._kwargs = merged
+
+        def forward(self, x):
+            return fn(x, **self._kwargs)
+
+    _Act.__name__ = _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _act_layer("ReLU", F.relu)
+ReLU6 = _act_layer("ReLU6", F.relu6)
+Sigmoid = _act_layer("Sigmoid", F.sigmoid)
+LogSigmoid = _act_layer("LogSigmoid", F.log_sigmoid)
+Tanh = _act_layer("Tanh", F.tanh)
+Tanhshrink = _act_layer("Tanhshrink", F.tanhshrink)
+GELU = _act_layer("GELU", F.gelu, approximate=False)
+SiLU = _act_layer("SiLU", F.silu)
+Swish = _act_layer("Swish", F.swish)
+Mish = _act_layer("Mish", F.mish)
+LeakyReLU = _act_layer("LeakyReLU", F.leaky_relu, negative_slope=0.01)
+ELU = _act_layer("ELU", F.elu, alpha=1.0)
+SELU = _act_layer("SELU", F.selu)
+CELU = _act_layer("CELU", F.celu, alpha=1.0)
+Hardtanh = _act_layer("Hardtanh", F.hardtanh, min=-1.0, max=1.0)
+Hardshrink = _act_layer("Hardshrink", F.hardshrink, threshold=0.5)
+Softshrink = _act_layer("Softshrink", F.softshrink, threshold=0.5)
+Hardsigmoid = _act_layer("Hardsigmoid", F.hardsigmoid)
+Hardswish = _act_layer("Hardswish", F.hardswish)
+Softplus = _act_layer("Softplus", F.softplus, beta=1.0, threshold=20.0)
+Softsign = _act_layer("Softsign", F.softsign)
+Softmax = _act_layer("Softmax", F.softmax, axis=-1)
+LogSoftmax = _act_layer("LogSoftmax", F.log_softmax, axis=-1)
+Maxout = _act_layer("Maxout", F.maxout, groups=2, axis=1)
+GLU = _act_layer("GLU", F.glu, axis=-1)
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU: random slope in training, mean slope in eval
+    (parity: paddle.nn.RReLU)."""
+
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init),
+        )
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

@@ -112,6 +112,7 @@ class Layer:
 
     def register_buffer(self, name, tensor, persistable=True):
         self._buffers[name] = tensor
+        object.__setattr__(self, name, tensor)
         if not persistable:
             self._non_persistable_buffer_names.add(name)
         return tensor

@@ -1,0 +1,320 @@
+"""Recurrent layers via `lax.scan` (compiler-friendly TPU control flow),
+replacing the reference's cuDNN RNN kernels
+(`python/paddle/nn/layer/rnn.py`, `phi/kernels/gpu/rnn_kernel.cu`).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...ops.dispatch import apply
+from .. import initializer as I
+from .layers import Layer
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [gates * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [gates * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [gates * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [gates * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kwargs):
+        super().__init__(input_size, hidden_size, 1, **kwargs)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        from ...tensor import creation
+        if states is None:
+            states = creation.zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        def f(x, h, wih, whh, bih, bhh):
+            out = act(x @ wih.T + bih + h @ whh.T + bhh)
+            return out
+        h = apply("simple_rnn_cell", f, (inputs, states, self.weight_ih,
+                                         self.weight_hh, self.bias_ih, self.bias_hh))
+        return h, h
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__(input_size, hidden_size, 4, **kwargs)
+
+    def forward(self, inputs, states=None):
+        from ...tensor import creation
+        if states is None:
+            z = creation.zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+            states = (z, z)
+        h_prev, c_prev = states
+        def f(x, h, c, wih, whh, bih, bhh):
+            gates = x @ wih.T + bih + h @ whh.T + bhh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fg = jax.nn.sigmoid(fg)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = fg * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        h, c = apply("lstm_cell", f, (inputs, h_prev, c_prev, self.weight_ih,
+                                      self.weight_hh, self.bias_ih, self.bias_hh))
+        return h, (h, c)
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__(input_size, hidden_size, 3, **kwargs)
+
+    def forward(self, inputs, states=None):
+        from ...tensor import creation
+        if states is None:
+            states = creation.zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+        def f(x, h, wih, whh, bih, bhh):
+            gi = x @ wih.T + bih
+            gh = h @ whh.T + bhh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+        h = apply("gru_cell", f, (inputs, states, self.weight_ih,
+                                  self.weight_hh, self.bias_ih, self.bias_hh))
+        return h, h
+
+
+def _scan_layer(cell_kind, x, h0, c0, wih, whh, bih, bhh, reverse=False):
+    """One directional RNN layer as a lax.scan over time. x: [T, B, I]."""
+    def step(carry, x_t):
+        if cell_kind == "lstm":
+            h, c = carry
+            gates = x_t @ wih.T + bih + h @ whh.T + bhh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(fg) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+        if cell_kind == "gru":
+            h = carry
+            gi = x_t @ wih.T + bih
+            gh = h @ whh.T + bhh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            h_new = (1 - z) * c + z * h
+            return h_new, h_new
+        h = carry
+        h_new = jnp.tanh(x_t @ wih.T + bih + h @ whh.T + bhh)
+        return h_new, h_new
+
+    init = (h0, c0) if cell_kind == "lstm" else h0
+    carry, outs = jax.lax.scan(step, init, x, reverse=reverse)
+    return carry, outs
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) RNN
+    (parity: paddle.nn.{SimpleRNN,LSTM,GRU})."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirectional else 1
+        gates = {"lstm": 4, "gru": 3, "rnn": 1}[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._weights = []
+        for layer in range(num_layers):
+            for direction in range(self.num_directions):
+                in_sz = input_size if layer == 0 else hidden_size * self.num_directions
+                suffix = f"l{layer}" + ("_reverse" if direction else "")
+                wih = self.create_parameter(
+                    [gates * hidden_size, in_sz], default_initializer=u,
+                    attr=weight_ih_attr)
+                whh = self.create_parameter(
+                    [gates * hidden_size, hidden_size], default_initializer=u,
+                    attr=weight_hh_attr)
+                bih = self.create_parameter(
+                    [gates * hidden_size], is_bias=True, default_initializer=u,
+                    attr=bias_ih_attr)
+                bhh = self.create_parameter(
+                    [gates * hidden_size], is_bias=True, default_initializer=u,
+                    attr=bias_hh_attr)
+                self.add_parameter(f"weight_ih_{suffix}", wih)
+                self.add_parameter(f"weight_hh_{suffix}", whh)
+                self.add_parameter(f"bias_ih_{suffix}", bih)
+                self.add_parameter(f"bias_hh_{suffix}", bhh)
+                self._weights.append((wih, whh, bih, bhh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...framework import random as rng
+        mode = self.mode
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+        time_major = self.time_major
+        # inter-layer dropout (paddle applies it to the outputs of every
+        # layer except the last, training mode only)
+        drop_p = self.dropout if (self.training and self.dropout > 0) else 0.0
+        drop_keys = [rng.next_key() for _ in range(nl - 1)] if drop_p else []
+        operands = [inputs]
+        has_init = initial_states is not None
+        if has_init:
+            if mode == "lstm":
+                operands += [initial_states[0], initial_states[1]]
+            else:
+                operands.append(initial_states)
+        flat_weights = [w for ws in self._weights for w in ws]
+        operands += flat_weights
+
+        def f(x, *rest):
+            i = 0
+            if has_init:
+                if mode == "lstm":
+                    h0_all, c0_all = rest[0], rest[1]
+                    i = 2
+                else:
+                    h0_all = rest[0]
+                    i = 1
+            else:
+                h0_all = None
+            weights = rest[i:]
+            xt = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, I]
+            B = xt.shape[1]
+            final_h, final_c = [], []
+            out = xt
+            for layer in range(nl):
+                dir_outs = []
+                for d in range(nd):
+                    wi = (layer * nd + d) * 4
+                    wih, whh, bih, bhh = weights[wi: wi + 4]
+                    idx = layer * nd + d
+                    h0 = (
+                        h0_all[idx] if h0_all is not None
+                        else jnp.zeros((B, hs), xt.dtype)
+                    )
+                    c0 = (
+                        c0_all[idx] if (mode == "lstm" and has_init)
+                        else jnp.zeros((B, hs), xt.dtype)
+                    )
+                    carry, outs = _scan_layer(
+                        mode, out, h0, c0, wih, whh, bih, bhh, reverse=(d == 1)
+                    )
+                    if mode == "lstm":
+                        final_h.append(carry[0])
+                        final_c.append(carry[1])
+                    else:
+                        final_h.append(carry)
+                    dir_outs.append(outs)
+                out = (
+                    jnp.concatenate(dir_outs, axis=-1) if nd == 2 else dir_outs[0]
+                )
+                if drop_p and layer < nl - 1:
+                    keep = jax.random.bernoulli(
+                        drop_keys[layer], 1.0 - drop_p, out.shape
+                    )
+                    out = jnp.where(keep, out / (1.0 - drop_p),
+                                    jnp.zeros((), out.dtype))
+            result = out if time_major else jnp.swapaxes(out, 0, 1)
+            h_stack = jnp.stack(final_h)
+            if mode == "lstm":
+                return result, h_stack, jnp.stack(final_c)
+            return result, h_stack
+
+        outs = apply(f"{mode}_forward", f, tuple(operands))
+        if mode == "lstm":
+            out, h, c = outs
+            return out, (h, c)
+        out, h = outs
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("rnn", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("lstm", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("gru", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (parity: paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor import manipulation as M
+        xs = inputs if self.time_major else M.transpose(
+            inputs, [1, 0] + list(range(2, inputs.ndim)))
+        T = xs.shape[0]
+        order = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = []
+        for ti in order:
+            out, states = self.cell(xs[ti], states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        stacked = M.stack(outs, axis=0)
+        if not self.time_major:
+            stacked = M.transpose(stacked, [1, 0] + list(range(2, stacked.ndim)))
+        return stacked, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor import manipulation as M
+        fw_states, bw_states = (None, None) if initial_states is None else initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, fw_states)
+        out_bw, st_bw = self.rnn_bw(inputs, bw_states)
+        return M.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

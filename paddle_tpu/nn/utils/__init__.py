@@ -1,0 +1,109 @@
+"""`paddle.nn.utils` (parity: `python/paddle/nn/utils/`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor
+from ..clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
+
+
+def parameters_to_vector(parameters, name=None):
+    arrays = [p._data.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(arrays))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    v = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in parameters:
+        n = p.size
+        p._data = v[offset: offset + n].reshape(p._data.shape).astype(p._data.dtype)
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v / ||v|| (parity:
+    `python/paddle/nn/utils/weight_norm_hook.py`). Implemented with a
+    forward-pre-hook that recomputes the weight from (g, v) each call."""
+    import math
+
+    weight = getattr(layer, name)
+    w = weight._data
+    if dim is None:
+        norm = jnp.linalg.norm(w.reshape(-1))
+        v = w
+    else:
+        moved = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        norm = jnp.linalg.norm(moved, axis=1)
+        v = w
+    from ...framework.core import EagerParamBase
+
+    g = EagerParamBase(norm)
+    v_p = EagerParamBase(v)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v_p)
+    # remove original param from registry; keep plain attr for forward use
+    del layer._parameters[name]
+
+    def compute(layer_, _inputs):
+        vv = v_p._data if not hasattr(v_p, "_tape_val") else v_p._data
+        from ...ops.dispatch import apply
+
+        def f(g_a, v_a):
+            if dim is None:
+                vn = jnp.linalg.norm(v_a.reshape(-1))
+                return g_a * v_a / vn
+            moved_ = jnp.moveaxis(v_a, dim, 0)
+            flat = moved_.reshape(moved_.shape[0], -1)
+            vn = jnp.linalg.norm(flat, axis=1)
+            shape = (-1,) + (1,) * (moved_.ndim - 1)
+            out = moved_ * (g_a.reshape(shape) / vn.reshape(shape))
+            return jnp.moveaxis(out, 0, dim)
+
+        new_w = apply("weight_norm", f, (g, v_p))
+        object.__setattr__(layer_, name, new_w)
+        return None
+
+    hook = layer.register_forward_pre_hook(compute)
+    layer._weight_norm_hook = hook
+    layer._weight_norm_name = name
+    compute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    hook = getattr(layer, "_weight_norm_hook", None)
+    if hook is not None:
+        hook.remove()
+    g = layer._parameters.pop(name + "_g", None)
+    v = layer._parameters.pop(name + "_v", None)
+    if g is not None and v is not None:
+        import jax.numpy as jnp
+
+        w = getattr(layer, name)
+        from ...framework.core import EagerParamBase
+
+        layer.add_parameter(name, EagerParamBase(w._data))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    """Parity: `python/paddle/nn/utils/spectral_norm_hook.py`."""
+    weight = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    from ..layer.norm import SpectralNorm as _SN
+
+    sn = _SN(weight.shape, dim=dim, power_iters=n_power_iterations, eps=eps)
+    layer.add_sublayer(name + "_sn", sn)
+    orig = layer._parameters.pop(name)
+    layer.add_parameter(name + "_orig", orig)
+
+    def compute(layer_, _inputs):
+        object.__setattr__(layer_, name, sn(orig))
+        return None
+
+    layer.register_forward_pre_hook(compute)
+    compute(layer, None)
+    return layer

@@ -1,0 +1,404 @@
+"""Optimizers.
+
+Reference parity: `python/paddle/optimizer/` (Optimizer base, SGD, Momentum,
+Adagrad, Adam, AdamW, Adamax, RMSProp, Lamb) over PHI optimizer kernels
+(`phi/kernels/gpu/adam_kernel.cu` etc.).
+
+TPU-first design: every optimizer is a *pure functional update rule*
+(`_init_state` / `_update`) wrapped in a thin stateful shell. The eager path
+(`opt.step()`) loops the pure rule over parameters; the compiled path (jit
+train step, hapi Engine, distributed sharded states) calls the same rule
+inside the traced computation — one implementation, bit-identical both ways.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import no_grad
+from ..framework.core import Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if weight_decay is None:
+            self._weight_decay = 0.0
+        elif isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+        else:  # L2Decay-like object with _coeff
+            self._weight_decay = float(getattr(weight_decay, "_coeff", 0.0))
+        self._accumulators: dict[int, dict] = {}
+        self._global_step = 0
+        # master weights for low-precision params (multi_precision)
+        self._master_weights: dict[int, jax.Array] = {}
+
+    # ---- lr ----
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "optimizer's learning rate is an LRScheduler; call "
+                "scheduler.step()/set state instead"
+            )
+        self._learning_rate = float(value)
+
+    # ---- functional rule (override in subclasses) ----
+    def _init_state(self, param):
+        """Pure: param array -> dict of state arrays."""
+        return {}
+
+    def _update(self, param, grad, state, lr, step):
+        """Pure: (param, grad, state, lr, step) -> (new_param, new_state).
+        `step` is the 1-based update count."""
+        raise NotImplementedError
+
+    # ---- weight decay helpers ----
+    def _apply_decoupled_decay(self, work, lr, param):
+        """Hook for decoupled (AdamW-style) decay; default no-op."""
+        return work
+
+    def _coupled_decay(self, grad, param):
+        """L2 regularization folded into the gradient (reference: regularizer
+        appended before the optimizer op)."""
+        if self._weight_decay:
+            return grad + self._weight_decay * param
+        return grad
+
+    # ---- eager step ----
+    @no_grad()
+    def step(self):
+        params_grads = [
+            (p, p.grad) for p in self._parameter_list
+            if not p.stop_gradient and p.grad is not None
+        ]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._global_step += 1
+        lr = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            key = id(p)
+            self._current_param = p  # per-param context for subclass rules
+            param_arr = p._data
+            # multi-precision: keep an fp32 master copy for bf16/fp16 params
+            if self._multi_precision and param_arr.dtype.name in ("bfloat16", "float16"):
+                master = self._master_weights.get(key)
+                if master is None:
+                    master = param_arr.astype(jnp.float32)
+                work = master
+                g_arr = g._data.astype(jnp.float32)
+            else:
+                work = param_arr
+                g_arr = g._data.astype(param_arr.dtype)
+            state = self._accumulators.get(key)
+            if state is None:
+                state = self._init_state(work)
+                self._accumulators[key] = state
+            work = self._apply_decoupled_decay(work, lr, p)
+            new_p, new_state = self._update(work, g_arr, state, lr, self._global_step)
+            self._accumulators[key] = new_state
+            if self._multi_precision and param_arr.dtype.name in ("bfloat16", "float16"):
+                self._master_weights[key] = new_p
+                p._data = new_p.astype(param_arr.dtype)
+            else:
+                p._data = new_p
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # ---- checkpoint ----
+    def state_dict(self):
+        sd = {}
+        for i, p in enumerate(self._parameter_list):
+            name = p.name or f"param_{i}"
+            st = self._accumulators.get(id(p))
+            if st:
+                for k, v in st.items():
+                    sd[f"{name}.{k}"] = Tensor(v)
+            mw = self._master_weights.get(id(p))
+            if mw is not None:
+                sd[f"{name}.master_weight"] = Tensor(mw)
+        sd["global_step"] = self._global_step
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._global_step = int(state_dict.get("global_step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for i, p in enumerate(self._parameter_list):
+            name = p.name or f"param_{i}"
+            st = self._init_state(p._data)
+            found = False
+            for k in st:
+                key = f"{name}.{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    st[k] = jnp.asarray(
+                        v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+                    )
+                    found = True
+            if found:
+                self._accumulators[id(p)] = st
+            mk = f"{name}.master_weight"
+            if mk in state_dict:
+                v = state_dict[mk]
+                self._master_weights[id(p)] = jnp.asarray(
+                    v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+                )
+
+
+class SGD(Optimizer):
+    """Parity: paddle.optimizer.SGD (`phi/kernels/.../sgd_kernel`)."""
+
+    def _update(self, param, grad, state, lr, step):
+        grad = self._coupled_decay(grad, param)
+        return param - lr * grad, state
+
+
+class Momentum(Optimizer):
+    """Parity: paddle.optimizer.Momentum (supports Nesterov)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, param):
+        return {"velocity": jnp.zeros_like(param)}
+
+    def _update(self, param, grad, state, lr, step):
+        grad = self._coupled_decay(grad, param)
+        v = self._momentum * state["velocity"] + grad
+        if self._nesterov:
+            new_p = param - lr * (grad + self._momentum * v)
+        else:
+            new_p = param - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, param):
+        return {"moment": jnp.full_like(param, self._init_acc)}
+
+    def _update(self, param, grad, state, lr, step):
+        grad = self._coupled_decay(grad, param)
+        m = state["moment"] + grad * grad
+        new_p = param - lr * grad / (jnp.sqrt(m) + self._epsilon)
+        return new_p, {"moment": m}
+
+
+class Adam(Optimizer):
+    """Parity: paddle.optimizer.Adam (`phi/kernels/gpu/adam_kernel.cu`)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None, amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def _init_state(self, param):
+        s = {
+            "moment1": jnp.zeros_like(param),
+            "moment2": jnp.zeros_like(param),
+        }
+        if self._amsgrad:
+            s["moment2_max"] = jnp.zeros_like(param)
+        return s
+
+    def _update(self, param, grad, state, lr, step):
+        grad = self._coupled_decay(grad, param)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * grad * grad
+        m_hat = m / (1 - b1 ** step)
+        if self._amsgrad:
+            v_max = jnp.maximum(state["moment2_max"], v)
+            v_hat = v_max / (1 - b2 ** step)
+            new_state = {"moment1": m, "moment2": v, "moment2_max": v_max}
+        else:
+            v_hat = v / (1 - b2 ** step)
+            new_state = {"moment1": m, "moment2": v}
+        new_p = param - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        return new_p, new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (parity: paddle.optimizer.AdamW)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None,
+                 amsgrad=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name,
+                         amsgrad)
+        self._decoupled_wd = float(weight_decay) if not hasattr(weight_decay, "_coeff") else float(weight_decay._coeff)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply_decoupled_decay(self, work, lr, param):
+        if not self._decoupled_wd:
+            return work
+        if self._apply_decay_param_fun is not None:
+            if not self._apply_decay_param_fun(param.name or ""):
+                return work
+        return work * (1.0 - lr * self._decoupled_wd)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, param):
+        return {"moment": jnp.zeros_like(param), "inf_norm": jnp.zeros_like(param)}
+
+    def _update(self, param, grad, state, lr, step):
+        grad = self._coupled_decay(grad, param)
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * grad
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(grad))
+        new_p = param - (lr / (1 - self._beta1 ** step)) * m / (u + self._epsilon)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, param):
+        s = {"mean_square": jnp.zeros_like(param), "momentum": jnp.zeros_like(param)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(param)
+        return s
+
+    def _update(self, param, grad, state, lr, step):
+        grad = self._coupled_decay(grad, param)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * grad * grad
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * grad
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+            new_state = {"mean_square": ms, "mean_grad": mg}
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+            new_state = {"mean_square": ms}
+        mom = self._momentum * state["momentum"] + lr * grad / denom
+        new_state["momentum"] = mom
+        return param - mom, new_state
+
+
+class Lamb(Optimizer):
+    """Parity: paddle.optimizer.Lamb (layerwise adaptive large-batch)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, param):
+        return {"moment1": jnp.zeros_like(param), "moment2": jnp.zeros_like(param)}
+
+    def _update(self, param, grad, state, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * grad * grad
+        m_hat = m / (1 - b1 ** step)
+        v_hat = v / (1 - b2 ** step)
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        wd = self._lamb_wd
+        if self._exclude_fn is not None:
+            cur = getattr(self, "_current_param", None)
+            if cur is not None and self._exclude_fn(cur.name or ""):
+                wd = 0.0
+        update = r + wd * param
+        w_norm = jnp.linalg.norm(param.astype(jnp.float32).reshape(-1))
+        u_norm = jnp.linalg.norm(update.astype(jnp.float32).reshape(-1))
+        trust = jnp.where(
+            (w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0
+        ).astype(param.dtype)
+        new_p = param - lr * trust * update
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _init_state(self, param):
+        return {
+            "avg_squared_grad": jnp.zeros_like(param),
+            "avg_squared_update": jnp.zeros_like(param),
+        }
+
+    def _update(self, param, grad, state, lr, step):
+        grad = self._coupled_decay(grad, param)
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * grad * grad
+        upd = (
+            jnp.sqrt(state["avg_squared_update"] + self._epsilon)
+            / jnp.sqrt(asg + self._epsilon)
+        ) * grad
+        asu = self._rho * state["avg_squared_update"] + (1 - self._rho) * upd * upd
+        return param - lr * upd, {
+            "avg_squared_grad": asg, "avg_squared_update": asu,
+        }
+
+
+class L2Decay:
+    """Parity: paddle.regularizer.L2Decay."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff

@@ -1,0 +1,395 @@
+"""nn.Layer / layers / functional tests (reference model:
+test/legacy_test layer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLayerBase:
+    def test_parameters_and_naming(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+        assert len(net.parameters()) == 4
+        assert all(not p.stop_gradient for p in net.parameters())
+
+    def test_state_dict_roundtrip(self):
+        net1 = nn.Linear(3, 3)
+        net2 = nn.Linear(3, 3)
+        assert not np.allclose(net1.weight.numpy(), net2.weight.numpy())
+        missing, unexpected = net2.set_state_dict(net1.state_dict())
+        assert not missing and not unexpected
+        np.testing.assert_array_equal(net1.weight.numpy(), net2.weight.numpy())
+
+    def test_train_eval_modes(self):
+        net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        assert net.training
+        net.eval()
+        assert not net.training and not net[1].training
+        x = paddle.randn([8, 4])
+        np.testing.assert_array_equal(net(x).numpy(), net(x).numpy())
+
+    def test_sequential_and_layerlist(self):
+        seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        out = seq(paddle.randn([3, 4]))
+        assert out.shape == [3, 2]
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3 and len(list(ll.parameters())) == 6
+
+    def test_forward_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        h = net.register_forward_post_hook(lambda l, i, o: calls.append(1))
+        net(paddle.randn([1, 2]))
+        assert calls == [1]
+        h.remove()
+        net(paddle.randn([1, 2]))
+        assert calls == [1]
+
+    def test_to_dtype(self):
+        net = nn.Linear(2, 2)
+        net.to(dtype="bfloat16")
+        assert net.weight.dtype == paddle.bfloat16
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        buf_names = [n for n, _ in bn.named_buffers()]
+        assert "_mean" in buf_names and "_variance" in buf_names
+        sd = bn.state_dict()
+        assert "_mean" in sd
+
+
+class TestLayers:
+    def test_linear_matches_numpy(self):
+        lin = nn.Linear(3, 5)
+        x = np.random.rand(2, 3).astype(np.float32)
+        out = lin(paddle.to_tensor(x))
+        expected = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), expected, rtol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        idx = paddle.to_tensor(np.array([[1, 0, 3]]))
+        out = emb(idx)
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_array_equal(out.numpy()[0, 1], np.zeros(4))
+
+    def test_conv2d_shapes(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        out = conv(paddle.randn([2, 3, 16, 16]))
+        assert out.shape == [2, 8, 8, 8]
+
+    def test_conv2d_vs_manual(self):
+        conv = nn.Conv2D(1, 1, 2, bias_attr=False)
+        x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+        out = conv(paddle.to_tensor(x))
+        w = conv.weight.numpy()[0, 0]
+        expected = np.zeros((1, 1, 2, 2), np.float32)
+        for i in range(2):
+            for j in range(2):
+                expected[0, 0, i, j] = (x[0, 0, i:i+2, j:j+2] * w).sum()
+        np.testing.assert_allclose(out.numpy(), expected, rtol=1e-4)
+
+    def test_conv_transpose(self):
+        convt = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1)
+        out = convt(paddle.randn([1, 4, 8, 8]))
+        assert out.shape == [1, 2, 15, 15]
+
+    def test_batchnorm_train_and_eval(self):
+        bn = nn.BatchNorm1D(4)
+        x = paddle.to_tensor(np.random.rand(16, 4).astype(np.float32) * 5 + 3)
+        out = bn(x)
+        # normalized output: ~zero mean, ~unit var
+        assert abs(out.numpy().mean()) < 1e-4
+        assert abs(out.numpy().std() - 1) < 0.1
+        # running stats moved toward batch stats
+        assert bn._mean.numpy().mean() > 0
+        bn.eval()
+        out2 = bn(x)
+        assert not np.allclose(out.numpy(), out2.numpy())
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = paddle.randn([4, 8])
+        out = ln(x).numpy()
+        np.testing.assert_allclose(out.mean(-1), np.zeros(4), atol=1e-5)
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = paddle.randn([4, 8])
+        out = rn(x).numpy()
+        rms = np.sqrt((out ** 2).mean(-1))
+        np.testing.assert_allclose(rms, np.ones(4), rtol=1e-2)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        out = gn(paddle.randn([2, 4, 5, 5]))
+        assert out.shape == [2, 4, 5, 5]
+
+    def test_pools(self):
+        x = paddle.randn([2, 3, 8, 8])
+        assert nn.MaxPool2D(2)(x).shape == [2, 3, 4, 4]
+        assert nn.AvgPool2D(2)(x).shape == [2, 3, 4, 4]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [2, 3, 1, 1]
+        out = nn.AdaptiveAvgPool2D(1)(x)
+        np.testing.assert_allclose(
+            out.numpy()[..., 0, 0], x.numpy().mean((-1, -2)), rtol=1e-5
+        )
+
+    def test_maxpool_matches_numpy(self):
+        x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+        out = nn.MaxPool2D(2)(paddle.to_tensor(x)).numpy()
+        expected = x.reshape(1, 1, 2, 2, 2, 2).max((3, 5))
+        np.testing.assert_array_equal(out, expected)
+
+    def test_dropout_train_eval(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([100, 100])
+        y = d(x)
+        frac_zero = (y.numpy() == 0).mean()
+        assert 0.3 < frac_zero < 0.7
+        # upscale keeps expectation
+        assert abs(y.numpy().mean() - 1.0) < 0.1
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+    def test_multihead_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 5, 16])
+        out = mha(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        out = enc(paddle.randn([2, 6, 16]))
+        assert out.shape == [2, 6, 16]
+
+    def test_lstm(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        out, (h, c) = lstm(paddle.randn([2, 5, 4]))
+        assert out.shape == [2, 5, 8]
+        assert h.shape == [2, 2, 8] and c.shape == [2, 2, 8]
+
+    def test_bidirectional_gru(self):
+        gru = nn.GRU(4, 8, direction="bidirect")
+        out, h = gru(paddle.randn([2, 5, 4]))
+        assert out.shape == [2, 5, 16]
+
+    def test_lstm_gradients_flow(self):
+        lstm = nn.LSTM(3, 4)
+        x = paddle.randn([2, 5, 3])
+        out, _ = lstm(x)
+        out.sum().backward()
+        for p in lstm.parameters():
+            assert p.grad is not None
+
+
+class TestFunctional:
+    def test_softmax_cross_entropy_parity(self):
+        logits = np.random.rand(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 4, 1])
+        loss = F.cross_entropy(
+            paddle.to_tensor(logits), paddle.to_tensor(labels)
+        )
+        # numpy reference
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        p = e / e.sum(1, keepdims=True)
+        expected = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(loss.numpy(), expected, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = paddle.randn([4, 5])
+        labels = paddle.to_tensor(np.array([0, -100, 2, -100]))
+        loss = F.cross_entropy(logits, labels, ignore_index=-100)
+        l_all = F.cross_entropy(
+            logits[paddle.to_tensor(np.array([0, 2]))],
+            paddle.to_tensor(np.array([0, 2])),
+        )
+        np.testing.assert_allclose(loss.numpy(), l_all.numpy(), rtol=1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        logits = paddle.randn([3, 4])
+        soft = paddle.to_tensor(np.full((3, 4), 0.25, np.float32))
+        loss = F.cross_entropy(logits, soft, soft_label=True)
+        assert loss.numpy().shape == ()
+
+    def test_bce_variants(self):
+        p = paddle.to_tensor(np.array([0.2, 0.8], np.float32))
+        t = paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+        bce = F.binary_cross_entropy(p, t).numpy()
+        expected = -(np.log(0.8) + np.log(0.8)) / 2
+        np.testing.assert_allclose(bce, expected, rtol=1e-4)
+        z = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+        bcel = F.binary_cross_entropy_with_logits(z, t).numpy()
+        sig = 1 / (1 + np.exp(-np.array([-1.0, 2.0])))
+        exp2 = -(np.log(1 - sig[0]) + np.log(sig[1])) / 2
+        np.testing.assert_allclose(bcel, exp2, rtol=1e-4)
+
+    def test_losses_reduce_modes(self):
+        a = paddle.randn([4, 3])
+        b = paddle.randn([4, 3])
+        assert F.mse_loss(a, b, "none").shape == [4, 3]
+        assert F.mse_loss(a, b, "sum").shape == []
+        np.testing.assert_allclose(
+            F.mse_loss(a, b).numpy(),
+            ((a.numpy() - b.numpy()) ** 2).mean(), rtol=1e-5,
+        )
+
+    def test_one_hot_pad(self):
+        oh = F.one_hot(paddle.to_tensor(np.array([0, 2])), 3)
+        np.testing.assert_array_equal(oh.numpy(), [[1, 0, 0], [0, 0, 1]])
+        x = paddle.ones([1, 1, 2, 2])
+        padded = F.pad(x, [1, 1, 1, 1])
+        assert padded.shape == [1, 1, 4, 4]
+        assert padded.numpy().sum() == 4
+
+    def test_interpolate(self):
+        x = paddle.randn([1, 2, 4, 4])
+        up = F.interpolate(x, scale_factor=2, mode="nearest")
+        assert up.shape == [1, 2, 8, 8]
+        down = F.interpolate(x, size=[2, 2], mode="bilinear")
+        assert down.shape == [1, 2, 2, 2]
+
+    def test_sdpa_matches_reference(self):
+        np.random.seed(0)
+        q = np.random.rand(2, 4, 2, 8).astype(np.float32)
+        k = np.random.rand(2, 4, 2, 8).astype(np.float32)
+        v = np.random.rand(2, 4, 2, 8).astype(np.float32)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v)
+        )
+        # numpy reference
+        scale = 1 / np.sqrt(8)
+        logits = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        probs = e / e.sum(-1, keepdims=True)
+        expected = np.einsum("bhqk,bkhd->bqhd", probs, v)
+        np.testing.assert_allclose(out.numpy(), expected, rtol=1e-4, atol=1e-5)
+
+    def test_sdpa_causal(self):
+        q = paddle.randn([1, 4, 1, 8])
+        out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        assert out.shape == [1, 4, 1, 8]
+
+    def test_activations_smoke(self):
+        x = paddle.randn([4, 4])
+        for name in ["relu", "gelu", "silu", "tanh", "sigmoid", "softplus",
+                     "hardswish", "mish", "selu", "leaky_relu", "elu"]:
+            out = getattr(F, name)(x)
+            assert out.shape == [4, 4]
+
+    def test_gradients_through_layers(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.Linear(8, 1))
+        x = paddle.randn([3, 4])
+        net(x).sum().backward()
+        for p in net.parameters():
+            assert p.grad is not None and np.isfinite(p.grad.numpy()).all()
+
+
+class TestReviewRegressions:
+    def test_ceil_mode_pooling(self):
+        x = paddle.randn([1, 1, 5, 5])
+        assert F.max_pool2d(x, 2, stride=2, ceil_mode=True).shape == [1, 1, 3, 3]
+        assert F.max_pool2d(x, 2, stride=2, ceil_mode=False).shape == [1, 1, 2, 2]
+        assert F.avg_pool2d(x, 2, stride=2, ceil_mode=True).shape == [1, 1, 3, 3]
+
+    def test_attention_dropout_applied(self):
+        paddle.seed(3)
+        q = paddle.randn([1, 8, 2, 4])
+        out_nodrop = F.scaled_dot_product_attention(q, q, q, dropout_p=0.0)
+        out_drop = F.scaled_dot_product_attention(q, q, q, dropout_p=0.9)
+        assert not np.allclose(out_nodrop.numpy(), out_drop.numpy())
+        out_eval = F.scaled_dot_product_attention(
+            q, q, q, dropout_p=0.9, training=False
+        )
+        np.testing.assert_allclose(out_eval.numpy(), out_nodrop.numpy(), rtol=1e-6)
+
+    def test_sync_bn_conversion_keeps_stats(self):
+        model = nn.Sequential(nn.Conv2D(1, 2, 3, padding=1), nn.BatchNorm2D(2))
+        model(paddle.randn([4, 1, 8, 8]))  # moves running stats
+        trained_mean = model[1]._mean.numpy().copy()
+        converted = nn.SyncBatchNorm.convert_sync_batchnorm(model)
+        assert isinstance(converted[1], nn.SyncBatchNorm)
+        np.testing.assert_array_equal(converted[1]._mean.numpy(), trained_mean)
+
+    def test_lamb_exclude_weight_decay(self):
+        w1 = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        w1.name = "linear.weight"
+        w2 = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        w2.name = "norm.weight"
+        from paddle_tpu import optimizer as optim
+        opt = optim.Lamb(
+            learning_rate=0.1, lamb_weight_decay=0.5, parameters=[w1, w2],
+            exclude_from_weight_decay_fn=lambda n: "norm" in n,
+        )
+        (w1.sum() * 0 + w2.sum() * 0 + (w1 * w1).sum() * 0).backward()
+        # zero grads but decay still applies via update term
+        opt.step()
+        # decayed param moved more than excluded param
+        assert abs(w1.numpy()[0] - 1.0) > abs(w2.numpy()[0] - 1.0)
+
+    def test_rnn_interlayer_dropout(self):
+        lstm = nn.LSTM(4, 8, num_layers=2, dropout=0.9)
+        x = paddle.randn([2, 5, 4])
+        paddle.seed(11)
+        a, _ = lstm(x)
+        lstm.eval()
+        b, _ = lstm(x)
+        assert not np.allclose(a.numpy(), b.numpy())
+
+    def test_rrelu_layer_random_in_train(self):
+        r = nn.RReLU(0.1, 0.9)
+        x = paddle.to_tensor(np.full((64,), -1.0, np.float32))
+        out = r(x).numpy()
+        assert out.std() > 0.01  # random slopes
+        r.eval()
+        out_eval = r(x).numpy()
+        np.testing.assert_allclose(out_eval, -0.5, rtol=1e-5)
+
+    def test_instance_norm_nhwc(self):
+        x = np.random.rand(2, 4, 4, 3).astype(np.float32)
+        out = F.instance_norm(
+            paddle.to_tensor(x), data_format="NHWC"
+        ).numpy()
+        # per-sample, per-channel normalized over spatial dims
+        np.testing.assert_allclose(
+            out.mean(axis=(1, 2)), np.zeros((2, 3)), atol=1e-5
+        )
+
+    def test_deepcopy_preserves_param_attrs(self):
+        import copy
+        lin = nn.Linear(2, 2, weight_attr=nn.ParamAttr(learning_rate=0.5))
+        assert lin.weight.optimize_attr["learning_rate"] == 0.5
+        lin2 = copy.deepcopy(lin)
+        assert lin2.weight.optimize_attr["learning_rate"] == 0.5
+
+
+class TestClip:
+    def test_clip_by_global_norm(self):
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        p1 = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+        g1 = paddle.to_tensor(np.array([3.0, 4.0, 0.0], np.float32))
+        out = clip([(p1, g1)])
+        np.testing.assert_allclose(
+            np.linalg.norm(out[0][1].numpy()), 1.0, rtol=1e-5
+        )
+
+    def test_clip_by_value(self):
+        clip = nn.ClipGradByValue(0.5)
+        p = paddle.to_tensor(np.zeros(2, np.float32), stop_gradient=False)
+        g = paddle.to_tensor(np.array([2.0, -2.0], np.float32))
+        out = clip([(p, g)])
+        np.testing.assert_array_equal(out[0][1].numpy(), [0.5, -0.5])

@@ -1,0 +1,147 @@
+"""Optimizer + LR scheduler tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _quadratic_problem():
+    # minimize ||w - target||^2
+    w = paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+    target = paddle.to_tensor(np.array([1.0, -2.0, 3.0, 0.5], np.float32))
+    return w, target
+
+
+def _run(opt_cls, steps=200, **kwargs):
+    w, target = _quadratic_problem()
+    opt = opt_cls(parameters=[w], **kwargs)
+    for _ in range(steps):
+        loss = ((w - target) * (w - target)).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w, target, opt
+
+
+class TestOptimizers:
+    def test_sgd_converges(self):
+        w, t, _ = _run(optimizer.SGD, learning_rate=0.1)
+        np.testing.assert_allclose(w.numpy(), t.numpy(), atol=1e-3)
+
+    def test_momentum_converges(self):
+        w, t, _ = _run(optimizer.Momentum, learning_rate=0.05, momentum=0.9)
+        np.testing.assert_allclose(w.numpy(), t.numpy(), atol=1e-3)
+
+    def test_adam_converges(self):
+        w, t, _ = _run(optimizer.Adam, learning_rate=0.1, steps=300)
+        np.testing.assert_allclose(w.numpy(), t.numpy(), atol=1e-2)
+
+    def test_adamw_converges_and_decays(self):
+        w, t, _ = _run(optimizer.AdamW, learning_rate=0.1, weight_decay=0.0, steps=300)
+        np.testing.assert_allclose(w.numpy(), t.numpy(), atol=1e-2)
+        # decay pulls weights below target
+        w2, t2, _ = _run(optimizer.AdamW, learning_rate=0.1, weight_decay=0.5, steps=300)
+        assert np.abs(w2.numpy()).sum() < np.abs(t2.numpy()).sum()
+
+    @pytest.mark.parametrize("cls,kw", [
+        (optimizer.Adagrad, {"learning_rate": 0.5}),
+        (optimizer.Adamax, {"learning_rate": 0.1}),
+        (optimizer.RMSProp, {"learning_rate": 0.05}),
+        (optimizer.Lamb, {"learning_rate": 0.05, "lamb_weight_decay": 0.0}),
+        (optimizer.Adadelta, {"learning_rate": 5.0}),
+    ])
+    def test_other_optimizers_descend(self, cls, kw):
+        w, t, _ = _run(cls, steps=300, **kw)
+        final_loss = ((w.numpy() - t.numpy()) ** 2).sum()
+        assert final_loss < 2.0  # started at 14.25
+
+    def test_grad_clip_in_step(self):
+        w, t, _ = _run(
+            optimizer.SGD, learning_rate=0.1, steps=300,
+            grad_clip=nn.ClipGradByGlobalNorm(0.5),
+        )
+        np.testing.assert_allclose(w.numpy(), t.numpy(), atol=1e-2)
+
+    def test_weight_decay_l2(self):
+        w, t, _ = _run(
+            optimizer.SGD, learning_rate=0.1, weight_decay=10.0, steps=100
+        )
+        # fixed point of grad 2(w-t) + 10w = 0  =>  w = t/6
+        np.testing.assert_allclose(w.numpy(), t.numpy() / 6, atol=1e-3)
+
+    def test_state_dict_roundtrip(self):
+        w, t, opt = _run(optimizer.Adam, learning_rate=0.1, steps=5)
+        sd = opt.state_dict()
+        w2, _ = _quadratic_problem()
+        opt2 = optimizer.Adam(learning_rate=0.1, parameters=[w2])
+        opt2.set_state_dict(sd)
+        assert opt2._global_step == 5
+        key = next(iter(opt._accumulators))
+        key2 = next(iter(opt2._accumulators))
+        np.testing.assert_allclose(
+            np.asarray(opt._accumulators[key]["moment1"]),
+            np.asarray(opt2._accumulators[key2]["moment1"]),
+        )
+
+    def test_multi_precision_bf16(self):
+        w = paddle.to_tensor(
+            np.ones(4, np.float32), dtype="bfloat16", stop_gradient=False
+        )
+        opt = optimizer.AdamW(
+            learning_rate=0.01, parameters=[w], multi_precision=True
+        )
+        (w * w).sum().backward()
+        opt.step()
+        assert w.dtype == paddle.bfloat16
+        assert id(w) in opt._master_weights
+
+
+class TestLRSchedulers:
+    def test_scheduler_drives_optimizer(self):
+        sched = optimizer.lr.StepDecay(0.1, step_size=10, gamma=0.1)
+        w, _ = _quadratic_problem()
+        opt = optimizer.SGD(learning_rate=sched, parameters=[w])
+        assert opt.get_lr() == pytest.approx(0.1)
+        for _ in range(10):
+            sched.step()
+        assert opt.get_lr() == pytest.approx(0.01)
+
+    def test_cosine(self):
+        s = optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert s() == pytest.approx(1.0)
+        for _ in range(10):
+            s.step()
+        assert s() == pytest.approx(0.0, abs=1e-6)
+
+    def test_warmup(self):
+        s = optimizer.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+        vals = []
+        for _ in range(12):
+            vals.append(s())
+            s.step()
+        assert vals[0] == pytest.approx(0.0)
+        assert vals[5] == pytest.approx(0.05)
+        assert vals[11] == pytest.approx(0.1)
+
+    def test_piecewise(self):
+        s = optimizer.lr.PiecewiseDecay([3, 6], [1.0, 0.5, 0.1])
+        vals = []
+        for _ in range(8):
+            vals.append(s())
+            s.step()
+        assert vals[0] == 1.0 and vals[4] == 0.5 and vals[7] == 0.1
+
+    def test_noam(self):
+        s = optimizer.lr.NoamDecay(d_model=512, warmup_steps=100, learning_rate=1.0)
+        peak_region = []
+        for _ in range(200):
+            s.step()
+            peak_region.append(s())
+        assert np.argmax(peak_region) == pytest.approx(99, abs=2)
+
+    def test_reduce_on_plateau(self):
+        s = optimizer.lr.ReduceOnPlateau(1.0, patience=2, factor=0.5)
+        for _ in range(5):
+            s.step(metrics=1.0)  # no improvement
+        assert s() == pytest.approx(0.5)
