@@ -1,0 +1,140 @@
+"""Runtime flag registry.
+
+Reference parity: the exported-flag registry of `paddle/phi/core/flags.cc`
+(`PHI_DEFINE_EXPORTED_*`, registry map `phi/core/flags.h:141-171`) and
+`paddle.set_flags` / `paddle.get_flags`
+(`python/paddle/fluid/framework.py:7493`). Flags are settable via the
+``FLAGS_<name>`` environment variable at import time or via
+:func:`set_flags` at runtime (SURVEY.md §5.6).
+
+TPU-first design: most of the reference's ~91 flags govern CUDA allocator /
+cuDNN autotune behavior that XLA owns here; we register the subset with
+TPU-meaningful semantics plus hooks (nan/inf check, matmul precision) that
+other subsystems observe.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_registry: dict[str, dict] = {}
+_observers: dict[str, list] = {}
+
+
+def _coerce(value, default):
+    if isinstance(default, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if isinstance(default, int):
+        return int(value)
+    if isinstance(default, float):
+        return float(value)
+    return value
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    """Register a flag (the `PHI_DEFINE_EXPORTED_*` equivalent). The env var
+    ``FLAGS_<name>`` overrides the default at definition time."""
+    env = os.environ.get(f"FLAGS_{name}")
+    value = _coerce(env, default) if env is not None else default
+    with _lock:
+        _registry[name] = {"value": value, "default": default, "help": help_str}
+    return value
+
+
+def observe_flag(name: str, callback):
+    """Subscribe to changes of a flag; fired from set_flags."""
+    _observers.setdefault(name, []).append(callback)
+
+
+def get_flags(flags=None):
+    with _lock:
+        if flags is None:
+            return {k: v["value"] for k, v in _registry.items()}
+        if isinstance(flags, str):
+            flags = [flags]
+        out = {}
+        for name in flags:
+            key = name[6:] if name.startswith("FLAGS_") else name
+            if key not in _registry:
+                raise ValueError(f"unknown flag {name!r}")
+            out[name] = _registry[key]["value"]
+        return out
+
+
+def set_flags(flags: dict):
+    fired = []
+    with _lock:
+        for name, value in flags.items():
+            key = name[6:] if name.startswith("FLAGS_") else name
+            if key not in _registry:
+                raise ValueError(f"unknown flag {name!r}")
+            rec = _registry[key]
+            rec["value"] = _coerce(value, rec["default"])
+            fired.append((key, rec["value"]))
+    for key, value in fired:
+        for cb in _observers.get(key, []):
+            cb(value)
+
+
+def flag_value(name: str):
+    with _lock:
+        return _registry[name]["value"]
+
+
+# ---- the flag set (TPU-meaningful subset of phi/core/flags.cc) ----
+define_flag("check_nan_inf", False,
+            "Check every op output for NaN/Inf (reference "
+            "`fluid/eager/nan_inf_utils.cc`); raises on first hit.")
+define_flag("check_nan_inf_level", 0,
+            "0: raise on nan/inf; 1: warn only.")
+define_flag("matmul_precision", "default",
+            "XLA matmul precision: default|high|highest (MXU bf16 passes vs "
+            "fp32). The TPU analogue of FLAGS_gemm_use_half_precision_compute_type.")
+define_flag("benchmark", False, "Sync after every op (latency attribution).")
+define_flag("eager_delete_tensor_gb", 0.0,
+            "Accepted for API parity; XLA/PJRT owns buffer lifetime on TPU.")
+define_flag("use_autotune", True,
+            "Let XLA autotune (kept for parity with phi autotune cache).")
+define_flag("log_level", 0, "VLOG-equivalent verbosity for paddle_tpu.utils.log.")
+define_flag("fraction_of_gpu_memory_to_use", 0.92,
+            "Accepted for parity; TPU HBM is managed by PJRT.")
+define_flag("init_allocated_mem", False, "Parity no-op on TPU.")
+define_flag("cudnn_deterministic", False,
+            "Deterministic mode: fixes sampling order and disables autotune.")
+define_flag("flash_attn", True,
+            "Use the Pallas flash-attention kernel for "
+            "scaled_dot_product_attention on TPU when shapes allow.")
+
+
+def _install_check_hook(enabled):
+    from ..ops import dispatch
+
+    if not enabled:
+        dispatch.set_check_hook(None)
+        return
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    def _hook(op_name, outs):
+        for o in outs:
+            if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.inexact):
+                bad = bool(jnp.any(~jnp.isfinite(o)))
+                if bad:
+                    msg = f"NaN/Inf detected in output of op '{op_name}'"
+                    if flag_value("check_nan_inf_level") >= 1:
+                        import warnings
+
+                        warnings.warn(msg)
+                    else:
+                        raise FloatingPointError(msg)
+
+    dispatch.set_check_hook(_hook)
+
+
+observe_flag("check_nan_inf", _install_check_hook)
+if flag_value("check_nan_inf"):
+    _install_check_hook(True)

@@ -1,0 +1,107 @@
+"""Checkpoint I/O: ``paddle.save`` / ``paddle.load``.
+
+Reference parity: `python/paddle/framework/io.py:646,888` — pickled nested
+state dicts with tensors materialised to numpy; `Layer.state_dict` /
+`Optimizer.state_dict` round-trip is the contract (SURVEY.md §5.4).
+
+TPU-first design: tensors are serialised as numpy arrays (host pull from the
+PJRT buffer); on load they are placed back on the current device. Sharded
+(multi-host) checkpointing lives in `paddle_tpu.distributed.checkpoint`,
+which layers reshard-on-load on top of this same format.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+
+import numpy as np
+
+from .core import EagerParamBase, Tensor
+
+
+class _TensorPayload:
+    """Pickle surrogate for a Tensor: numpy value + the shell metadata."""
+
+    def __init__(self, t: Tensor):
+        self.value = np.asarray(t._data)
+        self.name = t.name
+        self.stop_gradient = t.stop_gradient
+        self.persistable = t.persistable
+        self.is_parameter = isinstance(t, EagerParamBase) or t.is_parameter
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.value
+        if obj.is_parameter:
+            t = EagerParamBase(obj.value, name=obj.name,
+                              trainable=not obj.stop_gradient)
+        else:
+            t = Tensor(obj.value, stop_gradient=obj.stop_gradient,
+                       name=obj.name)
+        t.persistable = obj.persistable
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """Save a nested object (state dicts, tensors, python values) to ``path``.
+
+    Parity: `paddle.save` (reference `python/paddle/framework/io.py:646`).
+    Large tensors are fine with protocol>=4 (64-bit lengths).
+    """
+    if isinstance(path, (str, os.PathLike)):
+        path = os.fspath(path)
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "wb") as f:
+            pickle.dump(_pack(obj), f, protocol=protocol)
+    elif hasattr(path, "write"):
+        pickle.dump(_pack(obj), path, protocol=protocol)
+    else:
+        raise TypeError(f"unsupported path type {type(path)}")
+
+
+def load(path, return_numpy=False, **configs):
+    """Load an object saved by :func:`save`.
+
+    Parity: `paddle.load` (reference `python/paddle/framework/io.py:888`).
+    """
+    if isinstance(path, (str, os.PathLike)):
+        with open(os.fspath(path), "rb") as f:
+            raw = pickle.load(f)
+    elif hasattr(path, "read"):
+        raw = pickle.load(path)
+    else:
+        raise TypeError(f"unsupported path type {type(path)}")
+    return _unpack(raw, return_numpy=return_numpy)
+
+
+def save_to_bytes(obj, protocol=4) -> bytes:
+    buf = _io.BytesIO()
+    save(obj, buf, protocol=protocol)
+    return buf.getvalue()
+
+
+def load_from_bytes(data: bytes, return_numpy=False):
+    return load(_io.BytesIO(data), return_numpy=return_numpy)

@@ -1,0 +1,41 @@
+"""`paddle.io` parity: Dataset / DataLoader / samplers.
+
+Reference parity: `python/paddle/io/reader.py:218` (DataLoader),
+`io/dataloader/dataloader_iter.py` (worker loop + prefetch),
+`io/dataloader/batch_sampler.py`, `dataset.py` (SURVEY.md §2.8).
+
+TPU-first design: the reference forks multiprocess workers that feed a
+blocking queue, then a separate thread moves batches onto the GPU. On TPU
+the input pipeline is host-side numpy; we use a thread pool (numpy releases
+the GIL) + bounded prefetch queue, and the final device_put is async under
+PJRT so compute overlaps transfer naturally. `num_workers` maps to pool
+threads. A C++ batching core (paddle_tpu/native) accelerates hot collate
+paths when built.
+"""
+from .dataset import (  # noqa: F401
+    Dataset,
+    IterableDataset,
+    TensorDataset,
+    ComposeDataset,
+    ChainDataset,
+    Subset,
+    ConcatDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    Sampler,
+    SequenceSampler,
+    RandomSampler,
+    WeightedRandomSampler,
+    BatchSampler,
+    DistributedBatchSampler,
+)
+from .reader import DataLoader, default_collate_fn  # noqa: F401
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "Subset", "ConcatDataset", "random_split",
+    "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+    "BatchSampler", "DistributedBatchSampler",
+    "DataLoader", "default_collate_fn",
+]

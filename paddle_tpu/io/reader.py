@@ -1,0 +1,219 @@
+"""DataLoader.
+
+Reference parity: `python/paddle/io/reader.py:218` (DataLoader),
+`io/dataloader/dataloader_iter.py` (_DataLoaderIterSingleProcess /
+MultiProcess: worker loop, blocking queue, device transfer thread),
+`worker.py` (SURVEY.md §2.8).
+
+TPU-first design: numpy-producing workers run in a thread pool (numpy
+releases the GIL, so threads scale for decode/augment work and sidestep the
+reference's shared-memory queue machinery); a bounded prefetch queue keeps
+`prefetch_factor × num_workers` batches in flight; batches are converted to
+device Tensors on consume — PJRT device_put is async, so host→HBM copy of
+batch k+1 overlaps step k's compute.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ..framework.core import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    """Stack a list of samples into batched arrays (reference
+    `python/paddle/io/dataloader/collate.py`)."""
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return np.stack(batch, axis=0)
+    if isinstance(sample, Tensor):
+        return np.stack([s.numpy() for s in batch], axis=0)
+    if isinstance(sample, (int, float)):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return tuple(default_collate_fn(list(fields)) for fields in zip(*batch))
+    try:
+        return np.asarray(batch)
+    except Exception:
+        return list(batch)
+
+
+def _to_device(item, to_tensor=True):
+    if not to_tensor:
+        return item
+    if isinstance(item, np.ndarray):
+        return Tensor(item)
+    if isinstance(item, dict):
+        return {k: _to_device(v) for k, v in item.items()}
+    if isinstance(item, (tuple, list)):
+        return tuple(_to_device(v) for v in item)
+    return item
+
+
+class _SingleProcessIter:
+    def __init__(self, loader):
+        self._loader = loader
+        self._index_iter = iter(loader.batch_sampler)
+
+    def __next__(self):
+        indices = next(self._index_iter)
+        batch = [self._loader.dataset[i] for i in indices]
+        out = self._loader.collate_fn(batch)
+        return _to_device(out, self._loader.return_list is not False)
+
+
+class _PrefetchIter:
+    """Thread-pool iterator with ordered, bounded prefetch."""
+
+    _SENTINEL = object()
+
+    def __init__(self, loader):
+        self._loader = loader
+        self._depth = max(2, loader.num_workers * loader.prefetch_factor)
+        self._out_q: queue.Queue = queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._batches = list(iter(loader.batch_sampler))
+        self._next_submit = 0
+        self._next_yield = 0
+        self._results = {}
+        self._results_lock = threading.Condition()
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True)
+            for _ in range(loader.num_workers)
+        ]
+        self._task_q: queue.Queue = queue.Queue()
+        for i, idxs in enumerate(self._batches):
+            self._task_q.put((i, idxs))
+        for _ in self._threads:
+            self._task_q.put(None)
+        for t in self._threads:
+            t.start()
+
+    def _worker(self):
+        while not self._stop.is_set():
+            task = self._task_q.get()
+            if task is None:
+                return
+            i, indices = task
+            try:
+                batch = [self._loader.dataset[j] for j in indices]
+                out = self._loader.collate_fn(batch)
+                err = None
+            except Exception as e:  # propagate to consumer
+                out, err = None, e
+            with self._results_lock:
+                # bound memory: wait until the consumer is within `depth`
+                while (
+                    i - self._next_yield >= self._depth
+                    and not self._stop.is_set()
+                ):
+                    self._results_lock.wait(timeout=0.1)
+                self._results[i] = (out, err)
+                self._results_lock.notify_all()
+
+    def __next__(self):
+        if self._next_yield >= len(self._batches):
+            self._stop.set()
+            raise StopIteration
+        with self._results_lock:
+            while self._next_yield not in self._results:
+                self._results_lock.wait(timeout=0.1)
+            out, err = self._results.pop(self._next_yield)
+            self._next_yield += 1
+            self._results_lock.notify_all()
+        if err is not None:
+            self._stop.set()
+            raise err
+        return _to_device(out, self._loader.return_list is not False)
+
+    def __del__(self):
+        self._stop.set()
+
+
+class _IterableDatasetIter:
+    def __init__(self, loader):
+        self._loader = loader
+        self._it = iter(loader.dataset)
+        self._drop_last = loader.drop_last
+        self._batch_size = loader.batch_size
+
+    def __next__(self):
+        if self._batch_size is None:
+            return _to_device(self._loader.collate_fn([next(self._it)]))
+        batch = []
+        for _ in range(self._batch_size):
+            try:
+                batch.append(next(self._it))
+            except StopIteration:
+                break
+        if not batch or (self._drop_last and len(batch) < self._batch_size):
+            raise StopIteration
+        out = self._loader.collate_fn(batch)
+        return _to_device(out, self._loader.return_list is not False)
+
+
+class DataLoader:
+    """Parity: `paddle.io.DataLoader` (reference `reader.py:218`)."""
+
+    def __init__(
+        self,
+        dataset,
+        feed_list=None,
+        places=None,
+        return_list=True,
+        batch_sampler=None,
+        batch_size=1,
+        shuffle=False,
+        drop_last=False,
+        collate_fn=None,
+        num_workers=0,
+        use_buffer_reader=True,
+        prefetch_factor=2,
+        use_shared_memory=True,
+        timeout=0,
+        worker_init_fn=None,
+        persistent_workers=False,
+    ):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = int(prefetch_factor)
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        else:
+            if batch_size is None:
+                raise ValueError("batch_size=None requires a batch_sampler")
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last,
+            )
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return _IterableDatasetIter(self)
+        if self.num_workers > 0:
+            return _PrefetchIter(self)
+        return _SingleProcessIter(self)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("DataLoader over IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def __call__(self):
+        return self.__iter__()

@@ -30,6 +30,10 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
     Keys come from the functional RNG (`framework.random.next_key`) so the
     mask is reproducible and trace-safe."""
     if not training or p == 0.0:
+        # downscale_in_infer trains unscaled and scales at inference
+        # (reference common.py eval branch: scale(x, keep_prob))
+        if mode == "downscale_in_infer" and not training and p > 0.0:
+            return apply("dropout", lambda a: a * (1.0 - p), (x,))
         return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
     if p == 1.0:
         return apply("dropout", lambda a: jnp.zeros_like(a), (x,))
@@ -218,18 +222,74 @@ def interpolate(
     data_format="NCHW",
     name=None,
 ):
-    """Parity: paddle.nn.functional.interpolate (`phi/kernels/.../interpolate_kernel`).
-    Uses jax.image.resize; nearest/bilinear/bicubic/trilinear/area supported."""
+    """Parity: paddle.nn.functional.interpolate (`phi/kernels/.../interpolate_kernel`),
+    including align_corners / align_mode and 'area' (adaptive-average) modes.
+
+    TPU-first design: resampling is separable, so each spatial axis is
+    resized by a static [out, in] weight matrix (computed host-side at trace
+    time) applied as a tensordot — a dense matmul XLA tiles onto the MXU,
+    instead of per-pixel gathers."""
     if isinstance(size, Tensor):
         size = [int(s) for s in size.tolist()]
-    method = {
-        "nearest": "nearest",
-        "bilinear": "bilinear",
-        "bicubic": "bicubic",
-        "trilinear": "trilinear",
-        "linear": "linear",
-        "area": "linear",
+    mode = mode.lower()
+    if mode not in ("nearest", "linear", "bilinear", "bicubic", "trilinear", "area"):
+        raise ValueError(f"unsupported interpolate mode {mode!r}")
+
+    def _axis_weights(n_in, n_out, kind):
+        """[n_out, n_in] resampling matrix for one axis (float32 numpy)."""
+        j = np.arange(n_out, dtype=np.float64)
+        W = np.zeros((n_out, n_in), dtype=np.float64)
+        rows = np.arange(n_out)
+        if kind == "nearest":
+            if align_corners:
+                src = np.rint(j * (n_in - 1) / max(n_out - 1, 1)).astype(int)
+            else:
+                src = np.floor(j * n_in / n_out).astype(int)
+            W[rows, np.clip(src, 0, n_in - 1)] = 1.0
+            return W
+        if kind == "area":
+            for jj in range(n_out):
+                start = int(np.floor(jj * n_in / n_out))
+                end = max(int(np.ceil((jj + 1) * n_in / n_out)), start + 1)
+                W[jj, start:end] = 1.0 / (end - start)
+            return W
+        # source coordinate per output index (reference interpolate_kernel:
+        # align_corners -> corner-aligned; align_mode 0 -> half-pixel,
+        # align_mode 1 -> asymmetric)
+        if align_corners:
+            src = j * (n_in - 1) / max(n_out - 1, 1)
+        elif kind == "linear" and align_mode == 1:
+            src = j * (n_in / n_out)
+        else:
+            src = (j + 0.5) * (n_in / n_out) - 0.5
+        if kind == "linear":
+            src = np.clip(src, 0, n_in - 1)
+            lo = np.floor(src).astype(int)
+            hi = np.minimum(lo + 1, n_in - 1)
+            frac = src - lo
+            np.add.at(W, (rows, lo), 1.0 - frac)
+            np.add.at(W, (rows, hi), frac)
+            return W
+        # bicubic: Keys kernel, A=-0.75 (reference cubic_interp)
+        A = -0.75
+        def cubic(t):
+            t = np.abs(t)
+            return np.where(
+                t <= 1, (A + 2) * t**3 - (A + 3) * t**2 + 1,
+                np.where(t < 2, A * t**3 - 5 * A * t**2 + 8 * A * t - 4 * A, 0.0),
+            )
+        base = np.floor(src).astype(int)
+        for tap in (-1, 0, 1, 2):
+            idx = base + tap
+            w = cubic(src - idx)
+            np.add.at(W, (rows, np.clip(idx, 0, n_in - 1)), w)
+        return W
+
+    kind_per_axis = {
+        "nearest": "nearest", "area": "area", "linear": "linear",
+        "bilinear": "linear", "trilinear": "linear", "bicubic": "cubic",
     }[mode]
+
     def f(a):
         nd = a.ndim
         channel_last = not data_format.startswith("NC")
@@ -239,16 +299,23 @@ def interpolate(
         else:
             sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
             tgt = [int(a.shape[d] * s) for d, s in zip(spatial, sf)]
-        out_shape = list(a.shape)
+        out = a
+        compute_dtype = a.dtype if jnp.issubdtype(a.dtype, jnp.floating) else jnp.float32
         for d, s in zip(spatial, tgt):
-            out_shape[d] = s
-        if method == "trilinear":
-            m = "trilinear" if nd == 5 else "bilinear"
-        else:
-            m = method
-        if m == "trilinear":
-            m = "linear"
-        return jax.image.resize(a, tuple(out_shape), method=m)
+            n_in = out.shape[d]
+            if n_in == s:
+                continue
+            W = jnp.asarray(
+                _axis_weights(n_in, s, kind_per_axis), dtype=compute_dtype
+            )
+            moved = jnp.tensordot(out.astype(compute_dtype), W, axes=[[d], [1]])
+            out = jnp.moveaxis(moved, -1, d)
+        if out.dtype != a.dtype:
+            if kind_per_axis == "nearest":
+                out = jnp.rint(out).astype(a.dtype)
+            else:
+                out = out.astype(a.dtype)
+        return out
     return apply("interpolate", f, (x,))
 
 

@@ -183,9 +183,10 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
     pad = _pool_padding(padding, 2, channel_last)
     p = float(norm_type)
     def f(a):
+        pp = _ceil_adjust(pad, a.shape, dims, strides, ceil_mode)
         powered = jnp.abs(a) ** p
         summed = jax.lax.reduce_window(
-            powered, jnp.asarray(0, a.dtype), jax.lax.add, dims, strides, pad
+            powered, jnp.asarray(0, a.dtype), jax.lax.add, dims, strides, pp
         )
         return summed ** (1.0 / p)
     return apply("lp_pool2d", f, (x,))

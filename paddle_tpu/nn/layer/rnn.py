@@ -102,8 +102,11 @@ class GRUCell(_RNNCellBase):
         return h, h
 
 
-def _scan_layer(cell_kind, x, h0, c0, wih, whh, bih, bhh, reverse=False):
+def _scan_layer(cell_kind, x, h0, c0, wih, whh, bih, bhh, reverse=False,
+                activation="tanh"):
     """One directional RNN layer as a lax.scan over time. x: [T, B, I]."""
+    act = jax.nn.relu if activation == "relu" else jnp.tanh
+
     def step(carry, x_t):
         if cell_kind == "lstm":
             h, c = carry
@@ -124,7 +127,7 @@ def _scan_layer(cell_kind, x, h0, c0, wih, whh, bih, bhh, reverse=False):
             h_new = (1 - z) * c + z * h
             return h_new, h_new
         h = carry
-        h_new = jnp.tanh(x_t @ wih.T + bih + h @ whh.T + bhh)
+        h_new = act(x_t @ wih.T + bih + h @ whh.T + bhh)
         return h_new, h_new
 
     init = (h0, c0) if cell_kind == "lstm" else h0
@@ -147,6 +150,7 @@ class _RNNBase(Layer):
         self.num_layers = num_layers
         self.time_major = time_major
         self.dropout = dropout
+        self.activation = activation
         self.bidirectional = direction in ("bidirect", "bidirectional")
         self.num_directions = 2 if self.bidirectional else 1
         gates = {"lstm": 4, "gru": 3, "rnn": 1}[mode]
@@ -225,7 +229,8 @@ class _RNNBase(Layer):
                         else jnp.zeros((B, hs), xt.dtype)
                     )
                     carry, outs = _scan_layer(
-                        mode, out, h0, c0, wih, whh, bih, bhh, reverse=(d == 1)
+                        mode, out, h0, c0, wih, whh, bih, bhh,
+                        reverse=(d == 1), activation=self.activation,
                     )
                     if mode == "lstm":
                         final_h.append(carry[0])

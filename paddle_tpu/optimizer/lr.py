@@ -130,9 +130,24 @@ class LinearWarmup(LRScheduler):
                 * self.last_epoch / self.warmup_steps + self.start_lr
             )
         if self.lr_sched is not None:
-            self.lr_sched.step()
+            # sync, don't advance: reference lr.py:905 steps the wrapped
+            # scheduler to last_epoch - warmup_steps so get_lr() is pure and
+            # epoch jumps / checkpoint resume stay consistent
+            self.lr_sched.step(self.last_epoch - self.warmup_steps)
             return self.lr_sched()
         return self.final_lr
+
+    def state_dict(self):
+        d = super().state_dict()
+        if self.lr_sched is not None:
+            d["LinearWarmup_LR"] = self.lr_sched.state_dict()
+        return d
+
+    def set_state_dict(self, state_dict):
+        inner = state_dict.pop("LinearWarmup_LR", None)
+        super().set_state_dict(state_dict)
+        if inner is not None and self.lr_sched is not None:
+            self.lr_sched.set_state_dict(inner)
 
 
 class ExponentialDecay(LRScheduler):

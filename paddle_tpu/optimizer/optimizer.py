@@ -36,8 +36,14 @@ class Optimizer:
             self._weight_decay = float(getattr(weight_decay, "_coeff", 0.0))
         self._accumulators: dict[int, dict] = {}
         self._global_step = 0
+        # per-param update counts: bias correction must use the number of
+        # updates *this* param received (reference keeps per-param
+        # beta1_pow/beta2_pow accumulators), not the global step — params
+        # unfrozen mid-training otherwise get ~10x-undersized first updates
+        self._step_counts: dict[int, int] = {}
         # master weights for low-precision params (multi_precision)
         self._master_weights: dict[int, jax.Array] = {}
+        self._current_reg = None
 
     # ---- lr ----
     def get_lr(self):
@@ -69,8 +75,15 @@ class Optimizer:
         return work
 
     def _coupled_decay(self, grad, param):
-        """L2 regularization folded into the gradient (reference: regularizer
-        appended before the optimizer op)."""
+        """Regularization folded into the gradient (reference: regularizer
+        ops appended before the optimizer op). A per-param regularizer
+        (ParamAttr(regularizer=...)) overrides the optimizer-level decay."""
+        reg = self._current_reg
+        if reg is not None:
+            coeff = float(getattr(reg, "_coeff", 0.0))
+            if type(reg).__name__ == "L1Decay":
+                return grad + coeff * jnp.sign(param)
+            return grad + coeff * param
         if self._weight_decay:
             return grad + self._weight_decay * param
         return grad
@@ -91,6 +104,12 @@ class Optimizer:
                 continue
             key = id(p)
             self._current_param = p  # per-param context for subclass rules
+            self._current_reg = getattr(p, "regularizer", None)
+            step = self._step_counts.get(key, 0) + 1
+            self._step_counts[key] = step
+            # ParamAttr(learning_rate=...) per-param multiplier
+            attrs = getattr(p, "optimize_attr", None)
+            lr_p = lr * float(attrs.get("learning_rate", 1.0)) if attrs else lr
             param_arr = p._data
             # multi-precision: keep an fp32 master copy for bf16/fp16 params
             if self._multi_precision and param_arr.dtype.name in ("bfloat16", "float16"):
@@ -106,8 +125,8 @@ class Optimizer:
             if state is None:
                 state = self._init_state(work)
                 self._accumulators[key] = state
-            work = self._apply_decoupled_decay(work, lr, p)
-            new_p, new_state = self._update(work, g_arr, state, lr, self._global_step)
+            work = self._apply_decoupled_decay(work, lr_p, p)
+            new_p, new_state = self._update(work, g_arr, state, lr_p, step)
             self._accumulators[key] = new_state
             if self._multi_precision and param_arr.dtype.name in ("bfloat16", "float16"):
                 self._master_weights[key] = new_p
@@ -138,6 +157,9 @@ class Optimizer:
             mw = self._master_weights.get(id(p))
             if mw is not None:
                 sd[f"{name}.master_weight"] = Tensor(mw)
+            sc = self._step_counts.get(id(p))
+            if sc is not None:
+                sd[f"{name}.step_count"] = sc
         sd["global_step"] = self._global_step
         if isinstance(self._learning_rate, LRScheduler):
             sd["LR_Scheduler"] = self._learning_rate.state_dict()
@@ -161,6 +183,13 @@ class Optimizer:
                     found = True
             if found:
                 self._accumulators[id(p)] = st
+            sk = f"{name}.step_count"
+            if sk in state_dict:
+                self._step_counts[id(p)] = int(state_dict[sk])
+            elif found:
+                # legacy checkpoints without per-param counts: fall back to
+                # the global step so bias correction stays monotonic
+                self._step_counts[id(p)] = self._global_step
             mk = f"{name}.master_weight"
             if mk in state_dict:
                 v = state_dict[mk]

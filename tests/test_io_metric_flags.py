@@ -1,0 +1,192 @@
+"""Tests: paddle.save/load, flags registry, metric, io.DataLoader.
+
+Mirrors reference tests `test/legacy_test/test_paddle_save_load.py`,
+`test_dataloader_*`, `python/paddle/tests/test_metrics.py`.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import io as pio
+from paddle_tpu import metric as pmetric
+from paddle_tpu import nn
+
+
+class TestSaveLoad:
+    def test_roundtrip_state_dict(self, tmp_path):
+        layer = nn.Linear(4, 3)
+        path = tmp_path / "model.pdparams"
+        paddle.save(layer.state_dict(), path)
+        loaded = paddle.load(path)
+        for k, v in layer.state_dict().items():
+            np.testing.assert_allclose(loaded[k].numpy(), v.numpy())
+            assert loaded[k].is_parameter == v.is_parameter
+
+    def test_nested_python_objects(self, tmp_path):
+        obj = {"step": 7, "lr": 0.1, "t": paddle.to_tensor([1.0, 2.0]),
+               "nested": [paddle.to_tensor(3), {"x": "y"}]}
+        p = tmp_path / "ckpt"
+        paddle.save(obj, p)
+        back = paddle.load(p)
+        assert back["step"] == 7
+        np.testing.assert_allclose(back["t"].numpy(), [1.0, 2.0])
+        assert back["nested"][1]["x"] == "y"
+
+    def test_return_numpy(self, tmp_path):
+        p = tmp_path / "t"
+        paddle.save({"w": paddle.to_tensor([1.0])}, p)
+        back = paddle.load(p, return_numpy=True)
+        assert isinstance(back["w"], np.ndarray)
+
+    def test_set_state_dict_after_load(self, tmp_path):
+        l1 = nn.Linear(5, 5)
+        l2 = nn.Linear(5, 5)
+        p = tmp_path / "m"
+        paddle.save(l1.state_dict(), p)
+        missing, unexpected = l2.set_state_dict(paddle.load(p))
+        assert not missing and not unexpected
+        x = paddle.randn([2, 5])
+        np.testing.assert_allclose(l1(x).numpy(), l2(x).numpy(), rtol=1e-6)
+
+
+class TestFlags:
+    def test_get_set(self):
+        flags = paddle.get_flags()
+        assert "check_nan_inf" in flags
+        paddle.set_flags({"FLAGS_check_nan_inf_level": 1})
+        assert paddle.get_flags("FLAGS_check_nan_inf_level")[
+            "FLAGS_check_nan_inf_level"] == 1
+        paddle.set_flags({"FLAGS_check_nan_inf_level": 0})
+
+    def test_unknown_flag_raises(self):
+        with pytest.raises(ValueError):
+            paddle.set_flags({"FLAGS_not_a_flag": 1})
+
+    def test_nan_check_hook(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor([1.0, 0.0])
+            with pytest.raises(FloatingPointError):
+                _ = x / paddle.to_tensor([1.0, 0.0])
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+        # after disabling, no raise
+        _ = paddle.to_tensor([1.0]) / paddle.to_tensor([0.0])
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = pmetric.Accuracy(topk=(1, 2))
+        pred = paddle.to_tensor(
+            [[0.1, 0.7, 0.2], [0.5, 0.3, 0.2], [0.1, 0.2, 0.7]])
+        label = paddle.to_tensor([1, 1, 2])
+        correct = m.compute(pred, label)
+        m.update(correct)
+        acc1, acc2 = m.accumulate()
+        assert abs(acc1 - 2 / 3) < 1e-6
+        assert abs(acc2 - 1.0) < 1e-6
+
+    def test_precision_recall(self):
+        p = pmetric.Precision()
+        r = pmetric.Recall()
+        preds = [0.9, 0.8, 0.1, 0.4]
+        labels = [1, 0, 1, 0]
+        p.update(np.array(preds), np.array(labels))
+        r.update(np.array(preds), np.array(labels))
+        assert abs(p.accumulate() - 0.5) < 1e-6   # tp=1 fp=1
+        assert abs(r.accumulate() - 0.5) < 1e-6   # tp=1 fn=1
+
+    def test_auc_perfect(self):
+        m = pmetric.Auc()
+        m.update(np.array([[0.2, 0.8], [0.9, 0.1]]), np.array([1, 0]))
+        assert m.accumulate() == 1.0
+
+    def test_functional_accuracy(self):
+        pred = paddle.to_tensor([[0.1, 0.9], [0.8, 0.2]])
+        label = paddle.to_tensor([1, 0])
+        acc = pmetric.accuracy(pred, label)
+        assert abs(float(acc.numpy()) - 1.0) < 1e-6
+
+
+class _SquareDataset(pio.Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i]), np.float32([i * i])
+
+    def __len__(self):
+        return self.n
+
+
+class TestDataLoader:
+    def test_basic_batching(self):
+        ds = _SquareDataset(10)
+        dl = pio.DataLoader(ds, batch_size=4, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 1]
+        np.testing.assert_allclose(y.numpy().flatten(), [0, 1, 4, 9])
+
+    def test_shuffle_covers_all(self):
+        ds = _SquareDataset(16)
+        dl = pio.DataLoader(ds, batch_size=4, shuffle=True)
+        seen = sorted(
+            int(v) for x, _ in dl for v in x.numpy().flatten())
+        assert seen == list(range(16))
+
+    def test_workers_prefetch_ordered(self):
+        ds = _SquareDataset(32)
+        dl = pio.DataLoader(ds, batch_size=4, num_workers=2)
+        flat = [int(v) for x, _ in dl for v in x.numpy().flatten()]
+        assert flat == list(range(32))
+
+    def test_worker_exception_propagates(self):
+        class Bad(pio.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                if i == 5:
+                    raise ValueError("boom")
+                return np.float32([i])
+
+        dl = pio.DataLoader(Bad(), batch_size=2, num_workers=2)
+        with pytest.raises(ValueError):
+            list(dl)
+
+    def test_tensor_dataset_and_random_split(self):
+        xs = paddle.randn([10, 3])
+        ys = paddle.randn([10])
+        ds = pio.TensorDataset([xs, ys])
+        a, b = pio.random_split(ds, [7, 3])
+        assert len(a) == 7 and len(b) == 3
+
+    def test_iterable_dataset(self):
+        class Stream(pio.IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.float32([i])
+
+        dl = pio.DataLoader(Stream(), batch_size=3, drop_last=True)
+        batches = list(dl)
+        assert len(batches) == 2
+
+    def test_distributed_batch_sampler_shards(self):
+        ds = _SquareDataset(10)
+        s0 = pio.DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                         rank=0)
+        s1 = pio.DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                         rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert len(i0) == len(i1) == 5
+        assert not (set(i0) & set(i1)) or len(set(i0 + i1)) == 10
+
+    def test_concat_and_subset(self):
+        d = pio.ConcatDataset([_SquareDataset(3), _SquareDataset(2)])
+        assert len(d) == 5
+        np.testing.assert_allclose(d[3][0], [0.0])
+        sub = pio.Subset(_SquareDataset(5), [4, 2])
+        np.testing.assert_allclose(sub[0][1], [16.0])

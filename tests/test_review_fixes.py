@@ -1,0 +1,131 @@
+"""Regression tests for review findings (metric labels, dropout infer mode,
+LinearWarmup sync, RNN activation, interpolate alignment, per-param optimizer
+state, lp_pool ceil_mode)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import metric as pmetric
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+
+
+def test_accuracy_column_labels():
+    m = pmetric.Accuracy()
+    pred = paddle.to_tensor([[0.1, 0.2, 0.7]] * 4)
+    label = paddle.to_tensor([[2], [2], [2], [2]])  # (N,1) class ids
+    m.update(m.compute(pred, label))
+    assert m.accumulate() == 1.0
+
+
+def test_dropout_downscale_in_infer():
+    x = paddle.ones([8])
+    out = F.dropout(x, p=0.5, training=False, mode="downscale_in_infer")
+    np.testing.assert_allclose(out.numpy(), np.full(8, 0.5), rtol=1e-6)
+    # train pass leaves kept values unscaled in this mode
+    kept = F.dropout(x, p=0.5, training=True, mode="downscale_in_infer").numpy()
+    assert set(np.unique(kept)).issubset({0.0, 1.0})
+
+
+def test_linear_warmup_syncs_inner_scheduler():
+    inner = paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+    sched = paddle.optimizer.lr.LinearWarmup(
+        inner, warmup_steps=2, start_lr=0.0, end_lr=0.1)
+    seen = [sched()]
+    for _ in range(3):
+        sched.step()
+        seen.append(sched())
+    np.testing.assert_allclose(seen, [0.0, 0.05, 0.1, 0.05], rtol=1e-6)
+    # epoch jump stays consistent
+    sched.step(epoch=4)
+    assert abs(sched() - 0.025) < 1e-9
+    # state round trip
+    st = sched.state_dict()
+    sched2 = paddle.optimizer.lr.LinearWarmup(
+        paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.5),
+        warmup_steps=2, start_lr=0.0, end_lr=0.1)
+    sched2.set_state_dict(st)
+    assert sched2() == sched()
+
+
+def test_simple_rnn_relu_activation():
+    rnn = nn.SimpleRNN(3, 4, activation="relu")
+    x = paddle.randn([2, 5, 3])
+    out, h = rnn(x)
+    assert float(out.numpy().min()) >= 0.0  # relu cells never go negative
+    rnn_t = nn.SimpleRNN(3, 4, activation="tanh")
+    rnn_t.set_state_dict(rnn.state_dict())
+    out_t, _ = rnn_t(x)
+    assert not np.allclose(out.numpy(), out_t.numpy())
+
+
+@pytest.mark.parametrize("mode,align", [
+    ("bilinear", True), ("bilinear", False),
+    ("nearest", False), ("bicubic", True), ("bicubic", False),
+    ("area", False),
+])
+def test_interpolate_matches_torch(mode, align):
+    x = np.random.randn(2, 3, 7, 9).astype("float32")
+    kwargs = {} if mode in ("nearest", "area") else {"align_corners": align}
+    ref = torch.nn.functional.interpolate(
+        torch.from_numpy(x), size=(13, 5), mode=mode, **kwargs).numpy()
+    out = F.interpolate(paddle.to_tensor(x), size=[13, 5], mode=mode,
+                        align_corners=align if mode not in ("nearest", "area") else False)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_interpolate_linear_align_mode1():
+    # asymmetric mapping: src = j*scale; first output row equals first input row
+    x = np.arange(4, dtype="float32").reshape(1, 1, 4)
+    out = F.interpolate(paddle.to_tensor(x), size=[8], mode="linear",
+                        align_corners=False, align_mode=1)
+    np.testing.assert_allclose(out.numpy()[0, 0, :2], [0.0, 0.5], rtol=1e-6)
+
+
+def test_adam_per_param_bias_correction():
+    # param that receives its first grad late must be corrected like step 1
+    a = paddle.nn.Linear(2, 2)
+    b = paddle.nn.Linear(2, 2)
+    opt = paddle.optimizer.Adam(
+        learning_rate=0.1, parameters=a.parameters() + b.parameters())
+    x = paddle.randn([4, 2])
+    for _ in range(5):  # only `a` gets grads
+        a(x).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    w_before = b.weight.numpy().copy()
+    (a(x).sum() + b(x).sum()).backward()
+    opt.step()
+    delta = np.abs(b.weight.numpy() - w_before)
+    # first Adam update magnitude ~= lr (bias-corrected); the broken global
+    # step version would give ~lr*(1-beta1)=0.01
+    assert delta.mean() > 0.05
+
+
+def test_param_attr_lr_and_regularizer():
+    w_attr = nn.ParamAttr(learning_rate=0.0)  # frozen via multiplier
+    l = nn.Linear(3, 3, weight_attr=w_attr)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=l.parameters())
+    w0 = l.weight.numpy().copy()
+    l(paddle.randn([2, 3])).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(l.weight.numpy(), w0)  # lr multiplier 0
+
+    reg_attr = nn.ParamAttr(regularizer=paddle.optimizer.L2Decay(0.5))
+    l2 = nn.Linear(3, 3, weight_attr=reg_attr, bias_attr=False)
+    opt2 = paddle.optimizer.SGD(learning_rate=0.1, parameters=l2.parameters())
+    w0 = l2.weight.numpy().copy()
+    # zero data -> zero grad; only the regularizer moves the weight
+    l2(paddle.zeros([2, 3])).sum().backward()
+    opt2.step()
+    np.testing.assert_allclose(l2.weight.numpy(), w0 * (1 - 0.1 * 0.5), rtol=1e-5)
+
+
+def test_lp_pool2d_ceil_mode():
+    x = paddle.randn([1, 1, 8, 8])
+    out = F.lp_pool2d(x, 2, kernel_size=3, stride=2, ceil_mode=True)
+    assert out.shape == [1, 1, 4, 4]
+    out2 = F.lp_pool2d(x, 2, kernel_size=3, stride=2, ceil_mode=False)
+    assert out2.shape == [1, 1, 3, 3]
