@@ -1,0 +1,18 @@
+"""`paddle.amp` parity (SURVEY.md §2.2 AMP row)."""
+from .auto_cast import auto_cast, amp_guard, decorate, amp_state  # noqa: F401
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+from . import amp_lists  # noqa: F401
+
+WHITE_LIST = amp_lists.WHITE_LIST
+BLACK_LIST = amp_lists.BLACK_LIST
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler",
+           "amp_lists"]
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True

@@ -1,0 +1,41 @@
+"""AMP op lists.
+
+Reference parity: `python/paddle/amp/amp_lists.py` (white/black lists) and
+the per-op autocast decision compiled into every generated ad_func
+(`paddle/fluid/eager/amp_utils.h:108`, `eager_amp_auto_cast.h`).
+
+TPU-first: the low-precision dtype of choice is bfloat16 (MXU-native, same
+exponent range as fp32 so no loss scaling needed); fp16 is supported for
+parity. White ops ride the MXU; black ops are numerically sensitive
+reductions kept in fp32.
+"""
+
+# ops that benefit from low precision (matmul-class: MXU)
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
+    "conv2d_transpose", "conv3d_transpose", "bmm", "mm", "mv", "einsum",
+    "addmm", "flash_attention", "scaled_dot_product_attention",
+}
+
+# numerically dangerous in low precision — always fp32
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "prod",
+    "cumsum", "softmax", "log_softmax", "softmax_with_cross_entropy",
+    "cross_entropy", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "nll_loss", "kl_div", "smooth_l1_loss", "mse_loss", "l1_loss",
+    "layer_norm", "batch_norm", "instance_norm", "group_norm", "rms_norm",
+    "reduce_mean", "reduce_sum", "norm", "cos_sim", "pow", "rsqrt",
+    "softplus", "logsumexp", "erfinv", "cholesky", "svd", "eig", "eigh",
+    "inverse", "det", "sigmoid_cross_entropy_with_logits", "ctc_loss",
+    "margin_cross_entropy", "dist", "renorm",
+}
+
+# everything else runs in whichever dtype its inputs already have ("gray")
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)

@@ -1,0 +1,159 @@
+"""Autocast: `paddle.amp.auto_cast` / `paddle.amp.decorate`.
+
+Reference parity: `python/paddle/amp/auto_cast.py:271` (`amp_guard`) and
+`:756` (`decorate`); the cast insertion point mirrors the generated
+ad_funcs' AMP block (`paddle/fluid/eager/amp_utils.h:108`) — here it is the
+single `_amp_hook` in `paddle_tpu.ops.dispatch.apply`, so every eager op and
+every traced op inside `jit` sees the same policy.
+
+Levels: O1 casts white-list op inputs to low precision and black-list op
+inputs to fp32; O2 additionally keeps ("pure" low precision) everything
+except black-list ops in low precision. O2 users typically `decorate` the
+model so parameters themselves are stored low-precision with fp32 master
+weights in the optimizer.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..ops import dispatch
+from . import amp_lists
+
+_state = threading.local()
+
+
+def _ctx():
+    if not hasattr(_state, "stack"):
+        _state.stack = []
+    return _state.stack
+
+
+def amp_state():
+    stack = _ctx()
+    return stack[-1] if stack else None
+
+
+_LOW = {"float16": jnp.float16, "bfloat16": jnp.bfloat16}
+
+
+class _AmpConfig:
+    __slots__ = ("enable", "level", "dtype", "white", "black")
+
+    def __init__(self, enable, level, dtype, custom_white, custom_black):
+        self.enable = enable
+        self.level = level.upper()
+        self.dtype = dtype
+        white = amp_lists.white_list()
+        black = amp_lists.black_list()
+        if custom_white:
+            white |= set(custom_white)
+            black -= set(custom_white)
+        if custom_black:
+            black |= set(custom_black)
+            white -= set(custom_black)
+        self.white = white
+        self.black = black
+
+
+def _cast_arrays(arrays, target):
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) \
+                and a.dtype != target:
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return out
+
+
+# ops that must never be blanket-cast: program containers (inner ops are
+# cast individually during tracing) and explicit dtype ops
+_NO_CAST = {"run_program", "cast", "clone"}
+
+
+def _amp_hook(op_name, arrays):
+    cfg = amp_state()
+    if cfg is None or not cfg.enable or op_name in _NO_CAST:
+        return arrays
+    low = _LOW[cfg.dtype]
+    if op_name in cfg.black:
+        return _cast_arrays(arrays, jnp.float32)
+    if op_name in cfg.white:
+        return _cast_arrays(arrays, low)
+    if cfg.level == "O2":
+        return _cast_arrays(arrays, low)
+    # O1 gray ops: promote to the widest floating dtype among inputs so
+    # mixed fp32/low inputs don't fail (reference: GetPromoteType)
+    dtypes = {a.dtype for a in arrays
+              if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)}
+    if len(dtypes) > 1:
+        return _cast_arrays(arrays, jnp.float32)
+    return arrays
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """Context manager enabling mixed precision (`paddle.amp.auto_cast`).
+
+    TPU note: default dtype is bfloat16 — fp32 exponent range, so GradScaler
+    is a no-op under bf16 (kept for API parity, enabled for fp16).
+    """
+    if dtype not in _LOW:
+        raise ValueError(f"amp dtype must be float16|bfloat16, got {dtype!r}")
+    if level.upper() not in ("O0", "O1", "O2"):
+        raise ValueError(f"amp level must be O0|O1|O2, got {level!r}")
+    cfg = _AmpConfig(enable and level.upper() != "O0", level, dtype,
+                     custom_white_list, custom_black_list)
+    stack = _ctx()
+    stack.append(cfg)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+# the hook is installed once and permanently: it reads the *thread-local*
+# config stack and no-ops when empty, so concurrent threads entering/leaving
+# auto_cast cannot disable each other's casting; the active-predicate keeps
+# the non-AMP fast path to a single boolean check
+dispatch.set_amp_hook(_amp_hook, lambda: len(_ctx()) > 0)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """Cast model params to the AMP dtype and enable optimizer master
+    weights (`paddle.amp.decorate`, reference `auto_cast.py:756`).
+
+    O2 stores parameters in low precision; optimizers created with
+    `multi_precision=True` (forced here) keep fp32 master copies.
+    """
+    from ..nn.layer.layers import Layer
+
+    if level.upper() not in ("O1", "O2"):
+        raise ValueError("decorate level must be O1 or O2")
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    if level.upper() == "O2":
+        for m in model_list:
+            # parameters go low-precision; buffers (norm running stats) are
+            # deliberately left fp32, matching the reference's O2 behavior
+            for p in m.parameters():
+                if p._data.dtype == jnp.float32:
+                    p._data = p._data.astype(_LOW[dtype])
+    out_opt = optimizers
+    if optimizers is not None:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        for opt in opt_list:
+            opt._multi_precision = True
+        out_opt = opt_list[0] if single_opt else opt_list
+    if optimizers is None:
+        return model_list[0] if single_model else model_list
+    return (model_list[0] if single_model else model_list), out_opt

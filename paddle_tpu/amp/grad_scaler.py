@@ -1,0 +1,125 @@
+"""Dynamic loss scaling: `paddle.amp.GradScaler`.
+
+Reference parity: `python/paddle/amp/grad_scaler.py:576` (GradScaler over
+AmpScaler): scale() multiplies the loss, step/update unscale grads, skip the
+step on inf/nan, and adapt the scale (x2 after `incr_every_n_steps` good
+steps, /2 on a bad step).
+
+TPU note: needed for fp16; under bfloat16 (the TPU default) overflow is as
+rare as fp32, so `enable=False` scalers (identity) are common — same as the
+reference's behavior when amp dtype is bf16.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5,
+                 incr_every_n_steps=2000, decr_every_n_nan_or_inf=1,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, var):
+        """Multiply the loss by the scale factor."""
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        """Divide accumulated grads by the scale and detect inf/nan
+        (reference `grad_scaler.py` _unscale)."""
+        if not self._enable or self._unscaled:
+            return
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is None:
+                continue
+            g = p.grad._data / self._scale
+            if not found and not bool(jnp.all(jnp.isfinite(g))):
+                found = True
+            p.grad = Tensor(g, stop_gradient=True)
+        self._found_inf = found
+        self._unscaled = True
+
+    def step(self, optimizer):
+        """unscale + optimizer.step unless overflow was found."""
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._unscaled:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled = False
+
+    def update(self):
+        """Adapt the loss scale after a step."""
+        if not self._enable or not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    # ---- checkpoint ----
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n,
+            "incr_count": self._good_steps,
+            "decr_count": self._bad_steps,
+            "use_dynamic_loss_scaling": self._dynamic,
+        }
+
+    def load_state_dict(self, state):
+        self._scale = float(state.get("scale", self._scale))
+        self._good_steps = int(state.get("incr_count", 0))
+        self._bad_steps = int(state.get("decr_count", 0))
+        self._dynamic = bool(
+            state.get("use_dynamic_loss_scaling", self._dynamic))
+
+    set_state_dict = load_state_dict
+
+
+AmpScaler = GradScaler

@@ -103,16 +103,20 @@ class GradNode:
 
     __slots__ = (
         "id", "op_name", "vjp_fn", "inputs", "out_avals", "n_outputs",
-        "out_tensor_refs",
+        "out_tensor_refs", "multi",
     )
 
-    def __init__(self, op_name, vjp_fn, input_tensors, requires, out_avals):
+    def __init__(self, op_name, vjp_fn, input_tensors, requires, out_avals,
+                 multi=None):
         self.id = next(_node_counter)
         self.op_name = op_name
         self.vjp_fn = vjp_fn
         self.inputs = [InputRef(t, r) for t, r in zip(input_tensors, requires)]
         self.out_avals = out_avals  # list[(shape, dtype)] per output
         self.n_outputs = len(out_avals)
+        # whether the recorded fn returned a tuple (a 1-tuple output still
+        # needs a 1-tuple cotangent for jax.vjp's pytree match)
+        self.multi = len(out_avals) > 1 if multi is None else multi
         # weakrefs to output tensors; used to fire user hooks once per
         # backward on the fully-accumulated cotangent
         self.out_tensor_refs = [None] * len(out_avals)
@@ -203,10 +207,10 @@ def _sweep(root_tensors, root_cts, retain_graph, on_leaf, on_retained=None):
                 if out_t is not None and out_t._grad_hooks:
                     ct = _apply_hooks(out_t, ct)
                 out_cts.append(ct)
-            if node.n_outputs == 1:
-                in_cts = node.vjp_fn(out_cts[0])
-            else:
+            if node.multi:
                 in_cts = node.vjp_fn(tuple(out_cts))
+            else:
+                in_cts = node.vjp_fn(out_cts[0])
             for ref, ct in zip(node.inputs, in_cts):
                 if not ref.requires:
                     continue

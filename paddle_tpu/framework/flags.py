@@ -119,8 +119,15 @@ def _install_check_hook(enabled):
     import jax.numpy as jnp
     import numpy as np
 
+    import jax
+
     def _hook(op_name, outs):
         for o in outs:
+            if isinstance(o, jax.core.Tracer):
+                # under tracing values are abstract; the watchdog is an
+                # eager-path tool (reference likewise checks eagerly in
+                # nan_inf_utils.cc) — traced programs use finite-loss checks
+                continue
             if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.inexact):
                 bad = bool(jnp.any(~jnp.isfinite(o)))
                 if bad:

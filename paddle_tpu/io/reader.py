@@ -147,7 +147,8 @@ class _IterableDatasetIter:
 
     def __next__(self):
         if self._batch_size is None:
-            return _to_device(self._loader.collate_fn([next(self._it)]))
+            return _to_device(self._loader.collate_fn([next(self._it)]),
+                              self._loader.return_list is not False)
         batch = []
         for _ in range(self._batch_size):
             try:

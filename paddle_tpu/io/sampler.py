@@ -41,7 +41,8 @@ class RandomSampler(Sampler):
         n = len(self.data_source)
         rng = self.generator or np.random
         if self.replacement:
-            yield from rng.randint(0, n, size=self.num_samples).tolist()
+            draw = getattr(rng, "integers", None) or rng.randint
+            yield from draw(0, n, size=self.num_samples).tolist()
         else:
             yield from rng.permutation(n)[: self.num_samples].tolist()
 

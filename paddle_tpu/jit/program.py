@@ -1,0 +1,268 @@
+"""The tracing JIT: `@to_static` without AST rewriting.
+
+Reference parity: `paddle.jit.to_static` (`python/paddle/jit/api.py:233`),
+`StaticFunction` (`jit/dy2static/program_translator.py:305`),
+`PartialProgramLayer` running the captured block as ONE dygraph op
+(`jit/dy2static/partial_program.py:151` → `run_program` op) with a
+whole-block grad node (`fluid/eager/to_static/`).
+
+TPU-first design: the reference rewrites Python AST into a static
+ProgramDesc; on TPU the natural capture mechanism is *tracing* (pjit-style):
+the layer's Python runs once per input signature under `jax.jit` tracing,
+producing a compiled XLA program. The whole traced program then enters the
+eager tape as ONE GradNode ("run_program") via the standard dispatch path,
+so `loss.backward()` works across the jit boundary exactly like the
+reference's RunProgramGradNode. Parameters and mutable buffers are threaded
+as traced inputs/outputs (functionalized state), so batch-norm stats update
+correctly and XLA can fuse the whole step.
+
+Limitations vs AST rewriting (same as pjit): Python control flow on traced
+*values* is frozen per trace; each new input signature retraces (cached by
+shape/dtype/structure).
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+import jax
+import numpy as np
+
+from ..autograd.tape import no_grad
+from ..framework import random as rng
+from ..framework.core import Tensor
+from ..ops.dispatch import apply
+
+
+class InputSpec:
+    """Parity: `paddle.static.InputSpec`. ``None`` dims are dynamic: the
+    eager call path re-traces per concrete shape (XLA-cached); `jit.save`
+    exports them as symbolic dimensions."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        from ..framework.dtype import convert_dtype
+
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+# ---- pytree-lite flatten/unflatten over Tensor leaves ----
+
+def _flatten(obj, leaves):
+    if isinstance(obj, Tensor):
+        leaves.append(obj)
+        return ("T",)
+    if isinstance(obj, (np.ndarray, np.generic)):
+        leaves.append(Tensor(obj))
+        return ("T",)
+    if isinstance(obj, (list, tuple)):
+        tag = "L" if isinstance(obj, list) else "U"
+        return (tag, tuple(_flatten(v, leaves) for v in obj))
+    if isinstance(obj, dict):
+        keys = tuple(obj.keys())
+        return ("D", keys, tuple(_flatten(obj[k], leaves) for k in keys))
+    return ("S", obj)
+
+
+def _unflatten(spec, leaves, pos):
+    tag = spec[0]
+    if tag == "T":
+        leaf = leaves[pos[0]]
+        pos[0] += 1
+        return leaf
+    if tag in ("L", "U"):
+        vals = [_unflatten(s, leaves, pos) for s in spec[1]]
+        return vals if tag == "L" else tuple(vals)
+    if tag == "D":
+        return {k: _unflatten(s, leaves, pos)
+                for k, s in zip(spec[1], spec[2])}
+    return spec[1]
+
+
+def _spec_key(spec):
+    """Hashable form of a structure spec (static leaves by value)."""
+    tag = spec[0]
+    if tag == "T":
+        return ("T",)
+    if tag in ("L", "U"):
+        return (tag, tuple(_spec_key(s) for s in spec[1]))
+    if tag == "D":
+        return ("D", spec[1], tuple(_spec_key(s) for s in spec[2]))
+    v = spec[1]
+    try:
+        hash(v)
+    except TypeError:
+        v = repr(v)
+    return ("S", v)
+
+
+class _TraceEntry:
+    __slots__ = ("fn", "out_spec", "n_user_out")
+
+    def __init__(self):
+        self.fn = None
+        self.out_spec = None
+        self.n_user_out = 0
+
+
+class StaticFunction:
+    """Callable wrapper holding the trace cache (parity:
+    `program_translator.py:305` StaticFunction + its ProgramCache)."""
+
+    def __init__(self, function, input_spec=None, layer=None,
+                 build_strategy=None, full_graph=True):
+        self._function = function
+        self._input_spec = input_spec
+        self._layer = layer
+        self._cache: dict = {}
+        self._bound: dict = {}
+        self._lock = threading.Lock()
+        try:
+            functools.update_wrapper(self, function)
+        except AttributeError:
+            pass
+
+    # -- paddle-shaped introspection --
+    @property
+    def code(self):
+        import inspect
+
+        return inspect.getsource(self._function)
+
+    @property
+    def function(self):
+        return self._function
+
+    def rollback(self):
+        if self._layer is not None:
+            self._layer.forward = self._function
+        return self._function
+
+    def concrete_cache_size(self):
+        return len(self._cache)
+
+    def __get__(self, instance, owner):
+        # class-level decoration: bind one StaticFunction per Layer
+        # instance, cached ON the instance so its lifetime (and that of the
+        # trace cache / compiled executables) matches the instance's
+        if instance is None:
+            return self
+        attr = f"__jst_bound_{self._function.__name__}"
+        bound = instance.__dict__.get(attr)
+        if bound is None:
+            bound = StaticFunction(
+                self._function.__get__(instance, owner),
+                input_spec=self._input_spec,
+                layer=instance,
+            )
+            object.__setattr__(instance, attr, bound)
+        return bound
+
+    # -- capture state --
+    def _state(self):
+        if self._layer is None:
+            return [], []
+        diff, aux = [], []
+        seen = set()
+        for _, p in self._layer.named_parameters():
+            if id(p) in seen:
+                continue
+            seen.add(id(p))
+            (aux if p.stop_gradient else diff).append(p)
+        for _, b in self._layer.named_buffers():
+            if id(b) not in seen:
+                seen.add(id(b))
+                aux.append(b)
+        return diff, aux
+
+    def __call__(self, *args, **kwargs):
+        from ..amp.auto_cast import amp_state
+
+        diff_params, aux_state = self._state()
+        leaves: list[Tensor] = []
+        in_spec = _flatten((args, kwargs), leaves)
+        training = self._layer.training if self._layer is not None else True
+
+        amp = amp_state()
+        amp_key = (
+            (amp.enable, amp.level, amp.dtype) if amp is not None else None
+        )
+        key = (
+            _spec_key(in_spec),
+            tuple((tuple(t._data.shape), str(t._data.dtype), t.stop_gradient)
+                  for t in leaves),
+            tuple((tuple(t._data.shape), str(t._data.dtype))
+                  for t in diff_params + aux_state),
+            training,
+            amp_key,
+        )
+        with self._lock:
+            entry = self._cache.get(key)
+            if entry is None:
+                entry = self._build_entry(
+                    in_spec, [t.stop_gradient for t in leaves],
+                    len(diff_params), len(aux_state))
+                self._cache[key] = entry
+
+        prng = rng.next_key()
+        operands = (
+            tuple(diff_params) + tuple(aux_state) + (prng,) + tuple(leaves)
+        )
+        saved = [(t, t._data) for t in diff_params + aux_state]
+        try:
+            outs = apply("run_program", entry.fn, operands)
+        finally:
+            # tracing rebinds the shells to tracers; restore concrete buffers
+            for t, arr in saved:
+                t._data = arr
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        user_outs = list(outs[: entry.n_user_out])
+        new_state = outs[entry.n_user_out:]
+        with no_grad():
+            for t, new in zip(aux_state, new_state):
+                t._data = new._data
+        return _unflatten(entry.out_spec, user_outs, pos=[0])
+
+    def _build_entry(self, in_spec, input_stop_grads, n_diff, n_aux):
+        function = self._function
+        entry = _TraceEntry()
+
+        def raw_program(*arrays):
+            diff_params, aux_state = self._state()
+            param_arrays = arrays[:n_diff]
+            aux_arrays = arrays[n_diff:n_diff + n_aux]
+            prng = arrays[n_diff + n_aux]
+            input_arrays = arrays[n_diff + n_aux + 1:]
+            # rebind parameter shells onto traced arrays (the TensorWrapper
+            # equivalent); restored by the caller after tracing
+            for t, arr in zip(diff_params, param_arrays):
+                t._data = arr
+            for t, arr in zip(aux_state, aux_arrays):
+                t._data = arr
+            input_tensors = [
+                Tensor(arr, stop_gradient=sg)
+                for arr, sg in zip(input_arrays, input_stop_grads)
+            ]
+            call_args, call_kwargs = _unflatten(in_spec, input_tensors, pos=[0])
+            # inner eager tape off: the whole program is ONE outer GradNode
+            with no_grad(), rng.rng_scope(prng):
+                out = function(*call_args, **call_kwargs)
+            out_leaves: list[Tensor] = []
+            entry.out_spec = _flatten(out, out_leaves)
+            entry.n_user_out = len(out_leaves)
+            flat = [t._data for t in out_leaves]
+            flat += [t._data for t in aux_state]
+            return tuple(flat)
+
+        entry.fn = jax.jit(raw_program)
+        return entry

@@ -28,15 +28,19 @@ from . import registry
 from ..autograd import tape
 from ..framework.core import Tensor
 
-# AMP hook: set by paddle_tpu.amp. Signature: (op_name, arrays) -> arrays
+# AMP hook: set by paddle_tpu.amp. Signature: (op_name, arrays) -> arrays.
+# `_amp_active` is a cheap predicate consulted per op so an idle (imported
+# but not entered) AMP costs one boolean check, not a closure per call.
 _amp_hook = None
+_amp_active = None
 # Watchdog hook: set by paddle_tpu.framework.flags nan/inf checking.
 _check_hook = None
 
 
-def set_amp_hook(fn):
-    global _amp_hook
+def set_amp_hook(fn, active_fn=None):
+    global _amp_hook, _amp_active
     _amp_hook = fn
+    _amp_active = active_fn
 
 
 def set_check_hook(fn):
@@ -67,8 +71,15 @@ def apply(op_name, fn, operands, n_outputs=None, **static):
         fn = kernel
 
     arrays = [_unwrap(x) for x in operands]
-    if _amp_hook is not None:
-        arrays = _amp_hook(op_name, arrays)
+    if _amp_hook is not None and (_amp_active is None or _amp_active()):
+        # wrap the cast INSIDE the op fn so it is part of the recorded vjp:
+        # the transpose then casts cotangents back to each input's dtype at
+        # every precision boundary (the reference emits the cast op into the
+        # graph for the same reason — eager_amp_auto_cast.h)
+        inner_fn = fn
+
+        def fn(*arrs, **st):  # noqa: F811 - deliberate shadow
+            return inner_fn(*_amp_hook(op_name, list(arrs)), **st)
 
     requires = [
         isinstance(x, Tensor) and not x.stop_gradient for x in operands
@@ -102,7 +113,8 @@ def apply(op_name, fn, operands, n_outputs=None, **static):
             for x in operands
         ]
         out_avals = [(o.shape, o.dtype) for o in outs]
-        node = tape.GradNode(op_name, vjp_fn, in_tensors, requires, out_avals)
+        node = tape.GradNode(op_name, vjp_fn, in_tensors, requires, out_avals,
+                             multi=multi)
 
     results = []
     for i, o in enumerate(outs):

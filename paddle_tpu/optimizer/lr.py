@@ -144,10 +144,12 @@ class LinearWarmup(LRScheduler):
         return d
 
     def set_state_dict(self, state_dict):
-        inner = state_dict.pop("LinearWarmup_LR", None)
-        super().set_state_dict(state_dict)
+        inner = state_dict.get("LinearWarmup_LR")
+        outer = {k: v for k, v in state_dict.items()
+                 if k != "LinearWarmup_LR"}
+        super().set_state_dict(outer)
         if inner is not None and self.lr_sched is not None:
-            self.lr_sched.set_state_dict(inner)
+            self.lr_sched.set_state_dict(dict(inner))
 
 
 class ExponentialDecay(LRScheduler):

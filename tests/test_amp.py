@@ -1,0 +1,191 @@
+"""AMP tests (reference model: `test/amp/` suite)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestAutoCast:
+    def test_o1_white_op_low_precision(self):
+        x = paddle.randn([4, 8])
+        l = nn.Linear(8, 8)
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            y = l(x)
+        assert y.dtype == paddle.bfloat16
+
+    def test_o1_black_op_fp32(self):
+        x = paddle.randn([4, 8]).astype("bfloat16")
+        with paddle.amp.auto_cast(level="O1"):
+            y = paddle.nn.functional.softmax(x)
+        assert y.dtype == paddle.float32
+
+    def test_o1_gray_op_keeps_dtype(self):
+        x = paddle.randn([4])
+        with paddle.amp.auto_cast(level="O1"):
+            y = x + x
+        assert y.dtype == paddle.float32
+
+    def test_o2_gray_op_low_precision(self):
+        x = paddle.randn([4])
+        with paddle.amp.auto_cast(level="O2"):
+            y = x + x
+        assert y.dtype == paddle.bfloat16
+
+    def test_disabled(self):
+        x = paddle.randn([4, 8])
+        l = nn.Linear(8, 8)
+        with paddle.amp.auto_cast(enable=False):
+            y = l(x)
+        assert y.dtype == paddle.float32
+
+    def test_custom_lists(self):
+        x = paddle.randn([4, 8])
+        l = nn.Linear(8, 8)
+        with paddle.amp.auto_cast(custom_black_list={"linear", "matmul"}):
+            y = l(x)
+        assert y.dtype == paddle.float32
+
+    def test_nested_restores(self):
+        x = paddle.randn([2, 2])
+        with paddle.amp.auto_cast(level="O2"):
+            with paddle.amp.auto_cast(enable=False):
+                y = x + x
+                assert y.dtype == paddle.float32
+            z = x + x
+            assert z.dtype == paddle.bfloat16
+        w = x + x
+        assert w.dtype == paddle.float32
+
+    def test_backward_through_amp(self):
+        l = nn.Linear(8, 4)
+        x = paddle.randn([2, 8])
+        with paddle.amp.auto_cast(level="O1"):
+            loss = l(x).sum()
+        loss.backward()
+        assert l.weight.grad is not None
+        assert l.weight.grad.shape == [8, 4]
+
+    def test_bad_args(self):
+        with pytest.raises(ValueError):
+            with paddle.amp.auto_cast(dtype="float8"):
+                pass
+        with pytest.raises(ValueError):
+            with paddle.amp.auto_cast(level="O9"):
+                pass
+
+
+class TestDecorate:
+    def test_o2_casts_params(self):
+        model = nn.Linear(4, 4)
+        opt = paddle.optimizer.AdamW(parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2")
+        assert model.weight.dtype == paddle.bfloat16
+        assert opt._multi_precision
+
+    def test_o2_training_keeps_master_weights(self):
+        model = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2")
+        x = paddle.randn([8, 4])
+        with paddle.amp.auto_cast(level="O2"):
+            loss = model(x).sum()
+        loss.backward()
+        opt.step()
+        # master weight exists in fp32
+        assert len(opt._master_weights) == 2
+        for mw in opt._master_weights.values():
+            assert str(mw.dtype) == "float32"
+
+
+class TestGradScaler:
+    def _loss(self, model, x):
+        return model(x).sum()
+
+    def test_scale_and_step(self):
+        model = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        x = paddle.randn([8, 4])
+        w0 = model.weight.numpy().copy()
+        scaled = scaler.scale(self._loss(model, x))
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        assert not np.allclose(model.weight.numpy(), w0)
+        # grads were unscaled before stepping: compare with plain step
+        model2 = nn.Linear(4, 4)
+        model2.set_state_dict({k: paddle.to_tensor(v) for k, v in
+                               zip(model2.state_dict(),
+                                   [w0, model.bias.numpy() * 0])})
+
+    def test_skip_on_overflow_and_scale_decrease(self):
+        model = nn.Linear(2, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                       decr_every_n_nan_or_inf=1)
+        w0 = model.weight.numpy().copy()
+        x = paddle.to_tensor([[np.inf, 1.0]], dtype="float32")
+        scaled = scaler.scale(model(x).sum())
+        scaled.backward()
+        scaler.step(opt)   # must skip
+        scaler.update()
+        np.testing.assert_allclose(model.weight.numpy(), w0)
+        assert scaler.get_loss_scaling() == 4.0
+
+    def test_scale_increase_after_good_steps(self):
+        model = nn.Linear(2, 2)
+        opt = paddle.optimizer.SGD(parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                       incr_every_n_steps=2)
+        x = paddle.randn([4, 2])
+        for _ in range(2):
+            s = scaler.scale(model(x).sum())
+            s.backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+        assert scaler.get_loss_scaling() == 16.0
+
+    def test_disabled_scaler_passthrough(self):
+        scaler = paddle.amp.GradScaler(enable=False)
+        x = paddle.to_tensor([3.0])
+        assert scaler.scale(x) is x
+
+    def test_state_dict_roundtrip(self):
+        s = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        s._good_steps = 7
+        st = s.state_dict()
+        s2 = paddle.amp.GradScaler()
+        s2.load_state_dict(st)
+        assert s2.get_loss_scaling() == 4.0
+        assert s2._good_steps == 7
+
+
+class TestAmpTraining:
+    def test_bf16_o2_converges(self):
+        # the BASELINE config-3 pattern in miniature: pure-bf16 training with
+        # fp32 master weights must converge like fp32
+        net = nn.Sequential(nn.Linear(4, 32), nn.ReLU(), nn.Linear(32, 1))
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        net, opt = paddle.amp.decorate(net, opt, level="O2")
+        rng = np.random.RandomState(0)
+        first = last = None
+        for i in range(60):
+            xb = rng.randn(16, 4).astype("float32")
+            yb = xb.sum(axis=1, keepdims=True) * 0.5
+            x, y = paddle.to_tensor(xb), paddle.to_tensor(yb)
+            with paddle.amp.auto_cast(level="O2"):
+                pred = net(x)
+                loss = ((pred.astype("float32") - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss.numpy())
+            last = float(loss.numpy())
+        assert last < first * 0.2, (first, last)
