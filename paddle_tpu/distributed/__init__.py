@@ -1,0 +1,46 @@
+"""paddle_tpu.distributed: SPMD distributed training over a TPU device mesh.
+
+Reference parity: `python/paddle/distributed/` — the collective API,
+`init_parallel_env`/`DataParallel`, fleet, meta-parallel layers, sharding,
+auto-parallel annotations, launch.
+
+TPU-first design (SURVEY.md §2.5-2.6 "TPU build"): one global
+`jax.sharding.Mesh` with axes (dp, pp, sharding, sep, mp) replaces the
+reference's per-axis NCCL communicator rings; parallelism strategies are
+sharding layouts (GSPMD) rather than communication protocols; explicit
+collectives exist for shard_map regions (pipeline schedules, MoE all-to-all)
+and lower to XLA collective HLOs riding ICI.
+"""
+from __future__ import annotations
+
+from .env import (  # noqa: F401
+    AXIS_ORDER, ParallelEnv as _EnvView, get_env, get_mesh, init_mesh,
+)
+from .collective import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
+    alltoall, barrier, broadcast, broadcast_object_list, get_group, new_group,
+    ppermute, recv, reduce, reduce_scatter, scatter, send, wait,
+)
+from .parallel import (  # noqa: F401
+    DataParallel, ParallelEnv, get_rank, get_world_size, init_parallel_env,
+    is_initialized, spawn,
+)
+from .shard import (  # noqa: F401
+    PartitionSpec, get_sharding, replicate, shard_parameter, shard_tensor,
+    sharding_constraint,
+)
+
+from . import fleet  # noqa: F401
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "is_initialized",
+    "ParallelEnv", "DataParallel", "spawn",
+    "ReduceOp", "Group", "new_group", "get_group",
+    "all_reduce", "all_gather", "all_to_all", "alltoall", "broadcast",
+    "reduce", "scatter", "reduce_scatter", "barrier", "wait", "send", "recv",
+    "ppermute", "all_gather_object", "broadcast_object_list",
+    "shard_tensor", "sharding_constraint", "shard_parameter", "replicate",
+    "get_sharding", "PartitionSpec",
+    "init_mesh", "get_mesh", "get_env", "AXIS_ORDER",
+    "fleet",
+]

@@ -1,0 +1,422 @@
+"""Process groups and collective communication.
+
+Reference parity: the `ProcessGroup` abstraction and its per-collective
+Python API — `paddle/fluid/distributed/collective/process_group.h:53`,
+`python/paddle/distributed/communication/{all_reduce,all_gather,...}.py`,
+group management `python/paddle/distributed/collective.py:178` (`new_group`).
+
+TPU-first design: a "group" is a set of mesh axes, not an NCCL ring. Eager
+collectives are tiny compiled shard_map programs over those axes (SURVEY §5.8:
+"Eager-mode collectives = tiny compiled programs"); collectives that appear
+inside a traced program (jit / shard_map) lower directly to XLA collective
+HLOs (`psum`, `all_gather`, `ppermute`, …) and ride ICI. There are no
+streams, events, or ncclUniqueId bootstrap — XLA owns ordering, and the mesh
+is the membership.
+
+Semantics note (single-controller): an eager Tensor is a *global* array. A
+collective over a group reads the tensor's per-shard view along the group's
+axes: `all_reduce` on an axis-sharded tensor sums the shards (replicating the
+result); on a replicated tensor each participant holds the same value, so the
+sum is value × group size — identical to what N identical NCCL ranks would
+produce.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+def shard_map(fn, mesh, in_specs, out_specs, check_rep=False):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=check_rep)
+
+from . import env as env_mod
+from ..framework.core import Tensor
+from ..ops.dispatch import apply
+
+
+class ReduceOp:
+    """Parity: `paddle.distributed.ReduceOp`."""
+
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communicator: one or more mesh axes.
+
+    Parity: the `Group` returned by `paddle.distributed.new_group`
+    (`collective.py:178`). `axes` is the mesh-axis tuple the collectives
+    run over; `nranks` is the product of those axis sizes.
+    """
+
+    def __init__(self, axes, name=None):
+        self.axes = tuple(axes)
+        self.name = name or "_".join(self.axes)
+
+    @property
+    def nranks(self) -> int:
+        e = env_mod.ensure_env()
+        n = 1
+        for a in self.axes:
+            n *= e.degree(a)
+        return n
+
+    world_size = nranks
+
+    @property
+    def rank(self) -> int:
+        return 0 if self.nranks > 0 else -1
+
+    def get_group_rank(self, rank):
+        return rank
+
+    def __repr__(self):
+        return f"Group(axes={self.axes}, nranks={self.nranks})"
+
+
+_WORLD: Group | None = None
+
+
+def _world_group() -> Group:
+    global _WORLD
+    if _WORLD is None:
+        env_mod.ensure_env()
+        _WORLD = Group(env_mod.AXIS_ORDER, name="world")
+    return _WORLD
+
+
+def get_group(group=None) -> Group:
+    if group is None:
+        return _world_group()
+    if isinstance(group, Group):
+        return group
+    if isinstance(group, str):
+        return Group((group,))
+    return Group(tuple(group))
+
+
+def new_group(ranks=None, backend=None, timeout=None, axes=None, name=None):
+    """Parity: `paddle.distributed.new_group`. In SPMD the membership is a
+    mesh-axis set; rank lists (a multi-controller concept) are accepted when
+    they exactly cover one axis of the current mesh, otherwise axes must be
+    given explicitly."""
+    if axes is not None:
+        return Group(axes if isinstance(axes, (tuple, list)) else (axes,), name)
+    e = env_mod.ensure_env()
+    if ranks is None or len(ranks) == e.world_size:
+        return _world_group()
+    for ax in env_mod.AXIS_ORDER:
+        if e.degree(ax) == len(ranks):
+            return Group((ax,), name)
+    raise ValueError(
+        f"cannot map ranks {ranks} onto mesh axes {e.degrees}; "
+        "pass axes=... explicitly"
+    )
+
+
+# ---------------------------------------------------------------------------
+# in-trace detection: inside shard_map the group's axes are bound axis names
+# ---------------------------------------------------------------------------
+
+def _axes_in_scope(axes) -> bool:
+    try:
+        for a in axes:
+            jax.lax.axis_index(a)  # raises NameError outside shard_map
+        return True
+    except (NameError, Exception):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# eager collectives: cached compiled shard_map programs
+# ---------------------------------------------------------------------------
+
+def _spec_on(ndim, axes, dim):
+    parts = [None] * ndim
+    parts[dim] = axes if len(axes) > 1 else axes[0]
+    return PartitionSpec(*parts)
+
+
+@functools.lru_cache(maxsize=512)
+def _reduce_program(axes, op, shape, dtype, in_spec_key):
+    e = env_mod.get_env()
+    in_spec = PartitionSpec(*in_spec_key)
+    red = {
+        "sum": jax.lax.psum, "avg": jax.lax.pmean,
+        "max": jax.lax.pmax, "min": jax.lax.pmin,
+        "prod": _prod_reduce,
+    }[op]
+    ax = axes if len(axes) > 1 else axes[0]
+
+    # result replicated over the reduced axes
+    out_parts = [p if not _mentions(p, axes) else None for p in in_spec_key]
+    out_spec = PartitionSpec(*out_parts)
+
+    def shard_fn(x):
+        return red(x, ax)
+
+    fn = shard_map(shard_fn, mesh=e.mesh, in_specs=(in_spec,),
+                   out_specs=out_spec, check_rep=False)
+    return jax.jit(fn)
+
+
+def _mentions(part, axes):
+    if part is None:
+        return False
+    if isinstance(part, (tuple, list)):
+        return any(p in axes for p in part)
+    return part in axes
+
+
+def _current_spec(arr) -> tuple:
+    s = getattr(arr, "sharding", None)
+    if isinstance(s, NamedSharding):
+        spec = tuple(s.spec)
+        spec = spec + (None,) * (arr.ndim - len(spec))
+        return spec
+    return (None,) * arr.ndim
+
+
+def _on_mesh(arr):
+    """Place an off-mesh (single-device) array onto the mesh replicated;
+    mesh-resident arrays pass through with their layout."""
+    e = env_mod.ensure_env()
+    s = getattr(arr, "sharding", None)
+    if isinstance(s, NamedSharding) and s.mesh.shape == e.mesh.shape:
+        return arr
+    return jax.device_put(arr, NamedSharding(e.mesh, PartitionSpec()))
+
+
+def _prod_reduce(x, ax):
+    return jnp.exp(jax.lax.psum(jnp.log(x), ax))
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Parity: `paddle.distributed.all_reduce`. In-place on the Tensor shell
+    (rebinds the buffer), also returns it."""
+    g = get_group(group)
+    if g.nranks == 1:
+        return tensor
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+    if _axes_in_scope(g.axes):
+        ax = g.axes if len(g.axes) > 1 else g.axes[0]
+        red = {"sum": jax.lax.psum, "avg": jax.lax.pmean,
+               "max": jax.lax.pmax, "min": jax.lax.pmin,
+               "prod": _prod_reduce}[op]
+        out = apply(f"all_reduce_{op}", lambda x: red(x, ax), (t,))
+        t._replace_(out._data)
+        t._grad_node = out._grad_node
+        t._out_index = out._out_index
+        t.stop_gradient = out.stop_gradient and t.stop_gradient
+        return t
+    arr = _on_mesh(t._data)
+    prog = _reduce_program(g.axes, op, tuple(arr.shape), str(arr.dtype),
+                           _current_spec(arr))
+    t._replace_(prog(arr))
+    return t
+
+
+@functools.lru_cache(maxsize=512)
+def _gather_program(axes, dim, shape, dtype, in_spec_key):
+    e = env_mod.get_env()
+    in_spec = PartitionSpec(*in_spec_key)
+    ax = axes if len(axes) > 1 else axes[0]
+    out_parts = [p if not _mentions(p, axes) else None for p in in_spec_key]
+    out_spec = PartitionSpec(*out_parts)
+
+    def shard_fn(x):
+        return jax.lax.all_gather(x, ax, axis=dim, tiled=True)
+
+    fn = shard_map(shard_fn, mesh=e.mesh, in_specs=(in_spec,),
+                   out_specs=out_spec, check_rep=False)
+    return jax.jit(fn)
+
+
+def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis=0):
+    """Parity: `paddle.distributed.all_gather(tensor_list, tensor)`. Also
+    callable functional-style: `all_gather(tensor)` returns the gathered
+    Tensor (concatenated along ``axis``)."""
+    g = get_group(group)
+    out_list = None
+    if isinstance(tensor_or_list, list) and tensor is not None:
+        out_list, x = tensor_or_list, tensor
+    else:
+        x = tensor_or_list
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    if g.nranks == 1:
+        gathered = t
+    elif _axes_in_scope(g.axes):
+        ax = g.axes if len(g.axes) > 1 else g.axes[0]
+        gathered = apply(
+            "all_gather",
+            lambda a: jax.lax.all_gather(a, ax, axis=axis, tiled=True),
+            (t,),
+        )
+    else:
+        arr = _on_mesh(t._data)
+        prog = _gather_program(g.axes, axis, tuple(arr.shape),
+                               str(arr.dtype), _current_spec(arr))
+        gathered = Tensor(prog(arr))
+    if out_list is not None:
+        from ..tensor.manipulation import split as _split
+
+        out_list.extend(_split(gathered, g.nranks, axis=axis))
+        return out_list
+    return gathered
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Parity: `paddle.distributed.broadcast`. SPMD: a global array is
+    already consistent across the mesh; replicate it over the group's axes."""
+    g = get_group(group)
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+    if g.nranks == 1 or _axes_in_scope(g.axes):
+        return t
+    e = env_mod.ensure_env()
+    spec = _current_spec(t._data)
+    parts = [None if _mentions(p, g.axes) else p for p in spec]
+    t._replace_(jax.device_put(
+        _on_mesh(t._data), NamedSharding(e.mesh, PartitionSpec(*parts))))
+    return t
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """SPMD reduce == all_reduce (every participant holds the result)."""
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Parity: `paddle.distributed.scatter`. SPMD: shard dim 0 over the
+    group's axes (src is irrelevant — data is global)."""
+    g = get_group(group)
+    if tensor_list is not None:
+        from ..tensor.manipulation import concat
+
+        tensor = concat([x if isinstance(x, Tensor) else Tensor(x)
+                         for x in tensor_list], axis=0)
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+    if g.nranks == 1 or _axes_in_scope(g.axes):
+        return t
+    e = env_mod.ensure_env()
+    t._replace_(jax.device_put(
+        _on_mesh(t._data), NamedSharding(e.mesh, _spec_on(t.ndim, g.axes, 0))))
+    return t
+
+
+def all_to_all(out_tensor_list, in_tensor_list=None, group=None, sync_op=True,
+               split_axis=0, concat_axis=0):
+    """Parity: `paddle.distributed.alltoall`. Functional form
+    `all_to_all(x, split_axis=, concat_axis=)` is the EP dispatch primitive
+    (reference `global_scatter`/`global_gather` ops); inside shard_map it
+    lowers to the XLA AllToAll HLO."""
+    g = get_group(group)
+    if isinstance(out_tensor_list, list) and in_tensor_list is not None:
+        from ..tensor.manipulation import concat, split as _split
+
+        x = concat([t if isinstance(t, Tensor) else Tensor(t)
+                    for t in in_tensor_list], axis=0)
+        res = all_to_all(x, group=group, split_axis=0, concat_axis=0)
+        out_tensor_list.extend(_split(res, g.nranks, axis=0))
+        return out_tensor_list
+    x = out_tensor_list
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    if g.nranks == 1:
+        return t
+    ax = g.axes if len(g.axes) > 1 else g.axes[0]
+    if _axes_in_scope(g.axes):
+        return apply(
+            "all_to_all",
+            lambda a: jax.lax.all_to_all(a, ax, split_axis=split_axis,
+                                         concat_axis=concat_axis, tiled=True),
+            (t,),
+        )
+    e = env_mod.ensure_env()
+    in_spec = _spec_on(t.ndim, g.axes, concat_axis)
+    out_spec = _spec_on(t.ndim, g.axes, split_axis)
+
+    def shard_fn(a):
+        return jax.lax.all_to_all(a, ax, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+
+    fn = jax.jit(shard_map(shard_fn, mesh=e.mesh, in_specs=(in_spec,),
+                           out_specs=out_spec, check_rep=False))
+    arr = jax.device_put(_on_mesh(t._data), NamedSharding(e.mesh, in_spec))
+    return Tensor(fn(arr))
+
+
+alltoall = all_to_all
+
+
+def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True, axis=0):
+    """Parity: `paddle.distributed.reduce_scatter` — XLA ReduceScatter HLO
+    in-trace; eager form shards the summed result along ``axis``."""
+    g = get_group(group)
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+    if g.nranks == 1:
+        return t
+    ax = g.axes if len(g.axes) > 1 else g.axes[0]
+    if _axes_in_scope(g.axes):
+        return apply(
+            "reduce_scatter",
+            lambda a: jax.lax.psum_scatter(a, ax, scatter_dimension=axis,
+                                           tiled=True),
+            (t,),
+        )
+    red = all_reduce(Tensor(t._data), op=op, group=group)
+    e = env_mod.ensure_env()
+    red._replace_(jax.device_put(
+        _on_mesh(red._data), NamedSharding(e.mesh, _spec_on(t.ndim, g.axes, axis))))
+    return red
+
+
+def ppermute(tensor, perm, group=None):
+    """`jax.lax.ppermute` exposed for pipeline schedules (reference p2p
+    send/recv, `pp_utils/p2p_communication.py`). In-trace only."""
+    g = get_group(group)
+    ax = g.axes if len(g.axes) > 1 else g.axes[0]
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+    return apply("ppermute", lambda a: jax.lax.ppermute(a, ax, perm), (t,))
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv is expressed as ppermute inside pipeline "
+        "schedules on TPU (XLA CollectivePermute); host-level p2p is not a "
+        "TPU primitive"
+    )
+
+
+recv = send
+
+
+def barrier(group=None):
+    """Parity: `paddle.distributed.barrier`. Single-controller: dispatch is
+    ordered by the runtime; block the host on a trivial device round-trip."""
+    e = env_mod.ensure_env()
+    jnp.zeros(()).block_until_ready()
+    return None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        tensor._data.block_until_ready()
+
+
+# ---- object collectives (host-side; parity communication/all_gather_object) ----
+
+def all_gather_object(object_list, obj, group=None):
+    """Single-controller: every "rank" holds the same object graph."""
+    g = get_group(group)
+    object_list.extend([obj] * g.nranks)
+    return object_list
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list

@@ -1,0 +1,187 @@
+"""Global parallel environment: the device Mesh and its axis topology.
+
+Reference parity: the process-group world bootstrap
+(`python/paddle/distributed/parallel.py:915` `init_parallel_env`, TCPStore
+rendezvous + `ProcessGroupNCCL` creation `collective.py:139`) and the 4-D
+hybrid topology (`fleet/base/topology.py:58` `CommunicateTopology`).
+
+TPU-first design: Paddle is multi-controller — N processes, one per GPU,
+rendezvous over TCPStore, NCCL rings per axis. On TPU the idiomatic model is
+single-controller SPMD: ONE Python process per host drives all local chips,
+`jax.distributed` handles multi-host bootstrap, and the "process groups" are
+axes of a `jax.sharding.Mesh`. A collective "over the mp group" is an XLA
+collective over the 'mp' mesh axis, compiled into the program and riding ICI.
+
+The mesh axes follow the reference topology order [dp, pp, sharding, sep, mp]
+(`topology.py:144-240`): outermost axes map to the slowest-varying device
+dimension so that mp (highest-bandwidth-need) neighbours are physically
+adjacent on the ICI torus, the same reason the reference puts mp innermost on
+NVLink.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# canonical axis order, outermost (slowest) first — mirrors the reference's
+# HybridCommunicateGroup order ["data", "pipe", "sharding", "sep", "model"]
+AXIS_ORDER = ("dp", "pp", "sharding", "sep", "mp")
+
+_global_env = None
+
+
+class ParallelEnv:
+    """The single-controller parallel environment.
+
+    Holds the global :class:`jax.sharding.Mesh` plus per-axis degrees. All
+    distributed layers consult this via :func:`get_env`.
+    """
+
+    def __init__(self, mesh: Mesh, degrees: dict):
+        self.mesh = mesh
+        self.degrees = dict(degrees)
+
+    # -- paddle-shaped queries (multi-controller vocabulary mapped to SPMD) --
+    @property
+    def world_size(self) -> int:
+        return self.mesh.size
+
+    @property
+    def nranks(self) -> int:
+        return self.mesh.size
+
+    @property
+    def rank(self) -> int:
+        # single-controller: the driving process is "rank 0" of its host
+        return jax.process_index()
+
+    @property
+    def local_rank(self) -> int:
+        return 0
+
+    def degree(self, axis: str) -> int:
+        return self.degrees.get(axis, 1)
+
+    def sharding_for(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def __repr__(self):
+        return f"ParallelEnv(degrees={self.degrees})"
+
+
+def _devices_for_mesh(n: int | None = None):
+    devs = jax.devices()
+    return devs if n is None else devs[:n]
+
+
+def init_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1,
+              sep: int = 1, devices=None) -> ParallelEnv:
+    """Build the global mesh from per-axis degrees.
+
+    Degrees of 1 keep their axis in the mesh (size-1 axes are free in XLA),
+    so `PartitionSpec('mp')` is always valid regardless of configuration.
+    A degree of -1 on exactly one axis absorbs the remaining devices
+    (`dp=-1` is the common "data parallel over whatever is left").
+    """
+    global _global_env
+    degrees = {"dp": dp, "pp": pp, "sharding": sharding, "sep": sep, "mp": mp}
+    devs = list(devices) if devices is not None else _devices_for_mesh()
+    known = 1
+    wild = None
+    for ax, d in degrees.items():
+        if d == -1:
+            if wild is not None:
+                raise ValueError("only one axis may be -1")
+            wild = ax
+        else:
+            known *= d
+    if wild is not None:
+        if len(devs) % known:
+            raise ValueError(
+                f"cannot infer {wild}: {len(devs)} devices not divisible by {known}"
+            )
+        degrees[wild] = len(devs) // known
+    total = int(np.prod([degrees[a] for a in AXIS_ORDER]))
+    if total > len(devs):
+        raise ValueError(
+            f"mesh of {total} devices requested but only {len(devs)} available"
+        )
+    devs = devs[:total]
+    arr = np.array(devs).reshape([degrees[a] for a in AXIS_ORDER])
+    mesh = Mesh(arr, AXIS_ORDER)
+    _global_env = ParallelEnv(mesh, degrees)
+    _install_mesh_hook(mesh)
+    return _global_env
+
+
+def _install_mesh_hook(mesh):
+    """Teach the op dispatcher to replicate off-mesh eager operands onto the
+    mesh (mixing a host-side batch with sharded params is the common case)."""
+    from ..ops import dispatch as _dispatch
+
+    if mesh.size == 1:
+        _dispatch.set_mesh_hook(None)
+        return
+    n_mesh = mesh.size
+    repl = NamedSharding(mesh, PartitionSpec())
+
+    def _concrete(a):
+        return isinstance(a, jax.Array) and not isinstance(a, jax.core.Tracer)
+
+    def harmonize(arrays):
+        on_mesh = off_mesh = False
+        for a in arrays:
+            if _concrete(a):
+                if len(a.sharding.device_set) == n_mesh:
+                    on_mesh = True
+                else:
+                    off_mesh = True
+        if not (on_mesh and off_mesh):
+            return arrays
+        return [
+            jax.device_put(a, repl)
+            if _concrete(a) and len(a.sharding.device_set) != n_mesh
+            else a
+            for a in arrays
+        ]
+
+    _dispatch.set_mesh_hook(harmonize)
+
+
+def get_env() -> ParallelEnv | None:
+    return _global_env
+
+
+def ensure_env() -> ParallelEnv:
+    """Default single-axis env over all visible devices (dp=-1)."""
+    if _global_env is None:
+        init_mesh(dp=-1)
+    return _global_env
+
+
+def reset_env():
+    global _global_env
+    _global_env = None
+
+
+def get_mesh() -> Mesh | None:
+    return _global_env.mesh if _global_env is not None else None
+
+
+def init_distributed_runtime(coordinator_address=None, num_processes=None,
+                             process_id=None):
+    """Multi-host bootstrap (reference: TCPStore + `BroadcastUniqueNCCLID`,
+    `process_group_nccl.cc:477`). On TPU: `jax.distributed.initialize` — the
+    JAX coordination service plays TCPStore, PJRT plays NCCL."""
+    if num_processes is None:
+        num_processes = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if num_processes <= 1 and coordinator_address is None:
+        return  # single host, nothing to rendezvous
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -1,0 +1,104 @@
+"""fleet: the distributed-training facade.
+
+Reference parity: `paddle.distributed.fleet` — `fleet.init`
+(`fleet/fleet.py:169`), `fleet.distributed_model` (`fleet/model.py:30`),
+`fleet.distributed_optimizer` (`fleet/fleet.py:1053`), plus the worker/server
+role queries PS mode uses.
+
+TPU-first design: `init` builds the global device mesh from the strategy's
+hybrid degrees (instead of splitting NCCL comm rings per axis) and installs
+the HybridCommunicateGroup view over it. `distributed_model` wraps by
+strategy exactly like the reference's meta-parallel dispatch
+(`fleet/model.py:126-149`): pure-DP -> DataParallel annotations, pp>1 ->
+PipelineParallel schedule wrapper, otherwise the layer already carries its
+TP shardings and passes through.
+"""
+from __future__ import annotations
+
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import (
+    CommunicateTopology, HybridCommunicateGroup, ensure_hcg, get_hcg, set_hcg,
+)
+from .. import env as env_mod
+
+__all__ = [
+    "init", "DistributedStrategy", "HybridCommunicateGroup",
+    "CommunicateTopology", "distributed_model", "distributed_optimizer",
+    "get_hybrid_communicate_group", "worker_index", "worker_num",
+    "is_first_worker", "barrier_worker",
+]
+
+_fleet_strategy: DistributedStrategy | None = None
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    """Parity: `fleet.init` (`fleet/fleet.py:169`)."""
+    global _fleet_strategy
+    strategy = strategy or DistributedStrategy()
+    _fleet_strategy = strategy
+    hc = strategy.hybrid_configs
+    env_mod.init_mesh(
+        dp=hc.get("dp_degree", 1) or 1,
+        mp=hc.get("mp_degree", 1) or 1,
+        pp=hc.get("pp_degree", 1) or 1,
+        sharding=hc.get("sharding_degree", 1) or 1,
+        sep=hc.get("sep_degree", 1) or 1,
+    )
+    set_hcg(HybridCommunicateGroup())
+    return None
+
+
+def get_strategy() -> DistributedStrategy | None:
+    return _fleet_strategy
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    return ensure_hcg()
+
+
+def distributed_model(model):
+    """Parity: `fleet.distributed_model` (`fleet/model.py:30`)."""
+    from ..parallel import DataParallel
+    from ..meta_parallel.pipeline_parallel import PipelineParallel
+    from ..meta_parallel.parallel_layers.pp_layers import PipelineLayer
+
+    hcg = ensure_hcg()
+    if hcg.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):
+        return PipelineParallel(model, hcg, _fleet_strategy)
+    if (hcg.get_data_parallel_world_size() > 1
+            and hcg.get_model_parallel_world_size() == 1
+            and hcg.get_pipe_parallel_world_size() == 1):
+        return DataParallel(model)
+    # TP / hybrid: shardings already live on the parameters (GSPMD)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Parity: `fleet.distributed_optimizer` (`fleet/fleet.py:1053`). Under
+    GSPMD the optimizer update inherits parameter shardings, so no wrapping
+    is needed; returned as-is (HybridParallelOptimizer's grad-clip-across-
+    groups behavior is automatic because grads are global arrays)."""
+    return optimizer
+
+
+# -- worker/server role queries (PS-mode parity; collective mode: trivial) --
+
+def worker_index():
+    e = env_mod.get_env()
+    return e.rank if e else 0
+
+
+def worker_num():
+    import jax
+
+    return jax.process_count()
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+def barrier_worker():
+    from ..collective import barrier
+
+    barrier()

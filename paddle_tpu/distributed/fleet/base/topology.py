@@ -1,0 +1,163 @@
+"""Hybrid-parallel topology.
+
+Reference parity: `CommunicateTopology` and `HybridCommunicateGroup`
+(`python/paddle/distributed/fleet/base/topology.py:58,144-240`) — the 4-D
+cartesian rank grid and the per-axis communicator groups every meta-parallel
+layer consults.
+
+TPU-first design: the topology IS the mesh (env.AXIS_ORDER). Groups are mesh
+axes, so "get_model_parallel_group" returns the 'mp' axis group; there is no
+rank-list arithmetic because XLA addresses devices by mesh coordinates.
+"""
+from __future__ import annotations
+
+from ... import env as env_mod
+from ...collective import Group
+
+
+class CommunicateTopology:
+    """Parity: `topology.py:58`. Maps hybrid axis names to mesh axes."""
+
+    # reference axis vocabulary -> mesh axis
+    _ALIAS = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+              "sep": "sep", "model": "mp"}
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
+                 dims=None):
+        self._names = list(hybrid_group_names)
+        e = env_mod.ensure_env()
+        self._dims = list(dims) if dims is not None else [
+            e.degree(self._ALIAS[n]) for n in self._names
+        ]
+
+    def get_hybrid_group_names(self):
+        return self._names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        n = 1
+        for d in self._dims:
+            n *= d
+        return n
+
+
+class HybridCommunicateGroup:
+    """Parity: `topology.py:144`. The object `fleet.init` hangs the per-axis
+    groups on; meta-parallel layers query world sizes/ranks/groups here."""
+
+    def __init__(self, topology: CommunicateTopology | None = None):
+        self._topo = topology or CommunicateTopology()
+        e = env_mod.ensure_env()
+        self._env = e
+        self._dp_group = Group(("dp",), "dp_group")
+        self._mp_group = Group(("mp",), "mp_group")
+        self._pp_group = Group(("pp",), "pp_group")
+        self._sharding_group = Group(("sharding",), "sharding_group")
+        self._sep_group = Group(("sep",), "sep_group")
+        # dp+sharding fused group (reference: check_group for pure-dp params)
+        self._dp_sharding_group = Group(("dp", "sharding"), "dp_sharding")
+
+    def get_hybrid_communicate_group(self):
+        return self
+
+    @property
+    def topology(self):
+        return self._topo
+
+    def topology_obj(self):
+        return self._topo
+
+    # -- global --
+    def get_global_rank(self):
+        return self._env.rank
+
+    def get_world_size(self):
+        return self._env.world_size
+
+    # -- data parallel --
+    def get_data_parallel_world_size(self):
+        return self._env.degree("dp")
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    # -- model (tensor) parallel --
+    def get_model_parallel_world_size(self):
+        return self._env.degree("mp")
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    # -- pipeline parallel --
+    def get_pipe_parallel_world_size(self):
+        return self._env.degree("pp")
+
+    def get_stage_id(self):
+        return 0
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def is_first_stage(self):
+        return True
+
+    def is_last_stage(self):
+        return True
+
+    # -- sharding --
+    def get_sharding_parallel_world_size(self):
+        return self._env.degree("sharding")
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    # -- sep --
+    def get_sep_parallel_world_size(self):
+        return self._env.degree("sep")
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_check_parallel_group(self, sharding=False):
+        return self._dp_sharding_group
+
+
+_hcg: HybridCommunicateGroup | None = None
+
+
+def set_hcg(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hcg() -> HybridCommunicateGroup | None:
+    return _hcg
+
+
+def ensure_hcg() -> HybridCommunicateGroup:
+    global _hcg
+    if _hcg is None:
+        _hcg = HybridCommunicateGroup()
+    return _hcg

@@ -1,0 +1,88 @@
+"""Per-parallel-axis RNG state tracking for deterministic dropout.
+
+Reference parity: `RNGStatesTracker` (`fleet/layers/mpu/random.py`) — under
+TP, dropout inside the parallel region must use a *different* seed per mp
+rank (masks on different weight shards must differ) while dropout outside
+must be *identical* across mp ranks.
+
+TPU-first design: JAX PRNG keys are functional, so a "state per name" is a
+dict of keys; `rng_state(name)` routes `framework.random.next_key()` through
+the named key via `rng_scope`. Under GSPMD the mask tensor is one global
+array, so mp ranks are automatically consistent — the tracker exists for API
+parity and for explicitly-partitioned (shard_map) regions where per-shard
+determinism is needed; there we fold the axis index into the key.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from .....framework import random as rng
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = jax.random.key(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        with rng.rng_scope(self.states_[name]) as cell:
+            try:
+                yield
+            finally:
+                self.states_[name] = cell[0]
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    """Parity: `fleet/layers/mpu/random.py` model_parallel_random_seed."""
+    import random as pyrandom
+
+    seed = seed if seed is not None else pyrandom.randint(0, 2**31 - 1)
+    global_seed = seed
+    local_seed = seed + 1024
+    _TRACKER.reset()
+    rng.seed(global_seed)
+    _TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+
+
+def dropout(x, p=0.5, axis=None, rng_name=MODEL_PARALLEL_RNG, training=True,
+            mode="upscale_in_train", name=None):
+    """Dropout drawing its mask key from the named tracker state (parity:
+    `paddle.distributed.fleet.meta_parallel.parallel_layers.random.dropout`)."""
+    from .....nn import functional as F
+
+    if not training or p == 0.0:
+        return x
+    with _TRACKER.rng_state(rng_name):
+        return F.dropout(x, p, axis=axis, training=training, mode=mode)

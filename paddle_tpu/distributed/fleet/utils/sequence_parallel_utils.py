@@ -1,0 +1,134 @@
+"""Megatron-style sequence parallelism (SP) utilities.
+
+Reference parity: `fleet/utils/sequence_parallel_utils.py:36-122` (the
+Scatter/Gather/AllGather/ReduceScatter PyLayers), `:228`
+(`ColumnSequenceParallelLinear`), `:340` (`RowSequenceParallelLinear`),
+`:190` (SP-param allreduce hooks).
+
+TPU-first design: SP shards the *sequence* dim of activations over the 'mp'
+axis in the regions between the TP linears (layernorm/dropout/residual), so
+the memory-heavy elementwise region holds seq/mp per device. The reference
+implements this with explicit allgather/reduce-scatter PyLayers; here each
+op is a sharding constraint and XLA emits the all-gather (entering a column
+linear) and reduce-scatter (leaving a row linear) — including their
+transposes in backward. The SP-parameter allreduce hook (`:190`) has no
+equivalent: layernorm params are global replicated arrays, their grads are
+reduced by GSPMD automatically.
+
+Layout note: paddle's SP utils assume activations [s, b, h]; ours follow the
+framework-wide [b, s, h] and shard dim 1.
+"""
+from __future__ import annotations
+
+from ... import shard
+from ...fleet.base.topology import ensure_hcg
+from ...fleet.meta_parallel.parallel_layers.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear,
+)
+
+
+def _seq_spec(ndim, axis="mp"):
+    parts = [None] * ndim
+    parts[1] = axis
+    return parts
+
+
+class ScatterOp:
+    """Split the sequence dim over 'mp' (parity `:85`)."""
+
+    @staticmethod
+    def apply(x):
+        return shard.sharding_constraint(x, *_seq_spec(x.ndim))
+
+
+class GatherOp:
+    """Re-replicate the sequence dim (parity `:99`)."""
+
+    @staticmethod
+    def apply(x):
+        return shard.sharding_constraint(x, *(None,) * x.ndim)
+
+
+class AllGatherOp:
+    """Gather seq shards before a column-parallel matmul (parity `:108`)."""
+
+    @staticmethod
+    def apply(x):
+        return shard.sharding_constraint(x, *(None,) * x.ndim)
+
+
+class ReduceScatterOp:
+    """Reduce partial sums and scatter the seq dim (parity `:122`) —
+    the exit of a row-parallel matmul in SP mode."""
+
+    @staticmethod
+    def apply(x):
+        return shard.sharding_constraint(x, *_seq_spec(x.ndim))
+
+
+def scatter(x):
+    return ScatterOp.apply(x)
+
+
+def all_gather(x):
+    return AllGatherOp.apply(x)
+
+
+def reduce_scatter(x):
+    return ReduceScatterOp.apply(x)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Parity `:170`: tag consulted by `register_sequence_parallel_allreduce_hooks`;
+    grads of global arrays are already correct under GSPMD, so the tag is
+    informational."""
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """No-op under GSPMD (see module docstring); kept for script parity."""
+    return None
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Column-parallel linear whose input arrives sequence-sharded
+    (parity `:228`): all-gather seq → matmul → output feature-sharded."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias, gather_output=gather_output,
+                         fuse_matmul_bias=fuse_matmul_bias,
+                         mp_group=mp_group, name=name)
+
+    def forward(self, x):
+        x = AllGatherOp.apply(x)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Row-parallel linear whose output leaves sequence-sharded
+    (parity `:340`): matmul partial sums → reduce-scatter over seq."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias,
+                         input_is_parallel=input_is_parallel,
+                         fuse_matmul_bias=fuse_matmul_bias,
+                         mp_group=mp_group, name=name)
+
+    def forward(self, x):
+        out = super().forward(x)
+        return ReduceScatterOp.apply(out)
+
+
+def create_fused_allreduce_gradient_hook(*a, **k):  # parity stub
+    return None

@@ -1,0 +1,137 @@
+"""Parallel environment bootstrap and DataParallel.
+
+Reference parity: `paddle.distributed.init_parallel_env`
+(`python/paddle/distributed/parallel.py:915`), `paddle.DataParallel`
+(`parallel.py:191`) and the C++ `EagerReducer` gradient-fusion machinery
+(`fluid/distributed/collective/reducer.cc`).
+
+TPU-first design: DP is a sharding, not a wrapper protocol. The batch is
+sharded over the 'dp' mesh axis and parameters are replicated; when jax
+differentiates that computation, XLA itself emits the gradient all-reduce
+(GSPMD completes shardings through the backward graph), overlapped by the
+scheduler. The EagerReducer's 1.3K lines of bucketing/overlap therefore
+have no equivalent here — `DataParallel` only annotates inputs and exposes
+the reference's API surface.
+"""
+from __future__ import annotations
+
+import os
+
+from . import env as env_mod
+from .shard import sharding_constraint
+from ..framework.core import Tensor
+
+
+def init_parallel_env(dp=-1, mp=1, pp=1, sharding=1, sep=1):
+    """Parity: `paddle.distributed.init_parallel_env`. Bootstraps multi-host
+    coordination if PADDLE_TRAINERS_NUM/PADDLE_MASTER env are set (the
+    launcher contract, `launch/controllers/collective.py:124-220`), then
+    builds the global mesh."""
+    addr = os.environ.get("PADDLE_MASTER") or None
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = os.environ.get("PADDLE_TRAINER_ID")
+    if addr and nproc > 1:
+        env_mod.init_distributed_runtime(
+            coordinator_address=addr, num_processes=nproc,
+            process_id=int(pid) if pid is not None else None,
+        )
+    return env_mod.init_mesh(dp=dp, mp=mp, pp=pp, sharding=sharding, sep=sep)
+
+
+def get_rank(group=None):
+    e = env_mod.get_env()
+    return e.rank if e is not None else int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+def get_world_size(group=None):
+    if group is not None:
+        from .collective import get_group
+
+        return get_group(group).nranks
+    e = env_mod.get_env()
+    return e.world_size if e is not None else int(
+        os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+
+def is_initialized():
+    return env_mod.get_env() is not None
+
+
+class ParallelEnv:
+    """Parity shim: `paddle.distributed.ParallelEnv` attribute surface."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def dev_id(self):
+        return 0
+
+
+class DataParallel:
+    """Parity: `paddle.DataParallel(layer)` (`parallel.py:191`).
+
+    Wraps a Layer; shards every batch input over the 'dp' mesh axis. Gradient
+    synchronization is implicit (see module docstring), so
+    `no_sync()` is a no-op context and the reducer knobs are accepted and
+    ignored.
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        self._layers = layers
+        env_mod.ensure_env()
+
+    def __getattr__(self, name):
+        return getattr(self._layers, name)
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(
+            sharding_constraint(x, "dp") if isinstance(x, Tensor) and x.ndim
+            else x
+            for x in inputs
+        )
+        return self._layers(*inputs, **kwargs)
+
+    def no_sync(self):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    # state passthrough so checkpointing sees the inner layer
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def train(self):
+        return self._layers.train()
+
+    def eval(self):
+        return self._layers.eval()
+
+
+def spawn(func, args=(), nprocs=-1, **options):
+    """Parity: `paddle.distributed.spawn`. Single-controller SPMD drives all
+    local chips from one process — run the function directly."""
+    func(*args)

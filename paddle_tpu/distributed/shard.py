@@ -1,0 +1,94 @@
+"""Sharding annotations: shard_tensor / sharding constraints (GSPMD).
+
+Reference parity: `paddle.distributed.shard_tensor`
+(`auto_parallel/interface.py:28`) and the whole static auto-parallel chain —
+`Completer` (dist-attr propagation, `static/completion.py:108`), `Partitioner`
+(`static/partitioner.py:40`) and `Resharder` (comm insertion,
+`static/reshard.py:978`).
+
+TPU-first design: those three compiler stages ARE GSPMD. We annotate tensors
+with a `PartitionSpec` over the global mesh; XLA's SPMD partitioner completes
+the propagation, splits per device, and inserts the collectives. So Paddle's
+~15K-line auto-parallel static stack collapses to: put params on the mesh with
+`jax.device_put(NamedSharding)`, and drop `with_sharding_constraint` hints at
+layer boundaries inside traced code. Both paths run through the op dispatcher
+so they are autograd-transparent (the VJP of a sharding constraint is the
+matching constraint on the cotangent — XLA handles it).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from . import env as env_mod
+from ..framework.core import Tensor
+from ..ops.dispatch import apply
+
+__all__ = [
+    "PartitionSpec", "shard_tensor", "sharding_constraint", "replicate",
+    "get_sharding", "shard_parameter",
+]
+
+
+def _named_sharding(*spec) -> NamedSharding:
+    e = env_mod.ensure_env()
+    return NamedSharding(e.mesh, PartitionSpec(*spec))
+
+
+def get_sharding(t) -> PartitionSpec | None:
+    arr = t._data if isinstance(t, Tensor) else t
+    s = getattr(arr, "sharding", None)
+    if isinstance(s, NamedSharding):
+        return s.spec
+    return getattr(t, "_sharding_spec", None)
+
+
+def shard_tensor(x, mesh=None, placements=None, *, spec=None,
+                 stop_gradient=None):
+    """Place a tensor on the mesh with the given layout.
+
+    ``spec`` is a PartitionSpec-style tuple of mesh-axis names per dim
+    (None = replicated). ``placements`` accepts the same thing for parity
+    with the reference's `shard_tensor(x, mesh, [Shard(0), Replicate()])`
+    vocabulary — strings/None only, e.g. ``["dp", None]``.
+
+    Eager: physically reshards (device_put). Traced: a sharding constraint.
+    """
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    parts = tuple(spec if spec is not None else (placements or ()))
+    e = env_mod.ensure_env()
+    mesh = mesh or e.mesh
+    sharding = NamedSharding(mesh, PartitionSpec(*parts))
+
+    # jax.device_put: eager -> physical reshard onto the mesh; traced ->
+    # equivalent to a sharding constraint. Differentiable in both (its
+    # transpose is a device_put back to the cotangent's prior sharding).
+    out = apply("shard_tensor", lambda a: jax.device_put(a, sharding), (t,))
+    out._sharding_spec = PartitionSpec(*parts)
+    if stop_gradient is not None:
+        out.stop_gradient = stop_gradient
+    elif t.stop_gradient:
+        out.stop_gradient = True
+    return out
+
+
+def sharding_constraint(x, *spec):
+    """`with_sharding_constraint` as a Paddle-shaped op: hint XLA that this
+    activation should be laid out as ``spec`` over the global mesh. The
+    primary tool of the meta-parallel layers."""
+    return shard_tensor(x, spec=spec)
+
+
+def replicate(x):
+    return shard_tensor(x, spec=())
+
+
+def shard_parameter(param, *spec):
+    """Physically shard a Parameter's buffer in place (used by the
+    meta-parallel layers at construction; parity with Megatron-style weight
+    partitioning in `fleet/layers/mpu/mp_layers.py` — but the weight stays a
+    single *global* array and XLA owns the split)."""
+    sharding = _named_sharding(*spec)
+    param._replace_(jax.device_put(param._data, sharding))
+    param._sharding_spec = PartitionSpec(*spec)
+    return param

@@ -35,6 +35,17 @@ _amp_hook = None
 _amp_active = None
 # Watchdog hook: set by paddle_tpu.framework.flags nan/inf checking.
 _check_hook = None
+# Mesh hook: set by paddle_tpu.distributed once a mesh is active. Harmonizes
+# operand placement (off-mesh operands -> replicated on the mesh) so eager
+# ops can mix host tensors with mesh-sharded parameters, the way the
+# reference's data_transform moves operands to the kernel's place
+# (`paddle/phi/api/lib/data_transform.cc`).
+_mesh_hook = None
+
+
+def set_mesh_hook(fn):
+    global _mesh_hook
+    _mesh_hook = fn
 
 
 def set_amp_hook(fn, active_fn=None):
@@ -71,6 +82,8 @@ def apply(op_name, fn, operands, n_outputs=None, **static):
         fn = kernel
 
     arrays = [_unwrap(x) for x in operands]
+    if _mesh_hook is not None:
+        arrays = _mesh_hook(arrays)
     if _amp_hook is not None and (_amp_active is None or _amp_active()):
         # wrap the cast INSIDE the op fn so it is part of the recorded vjp:
         # the transpose then casts cotangents back to each input's dtype at
@@ -134,6 +147,8 @@ def apply_nondiff(op_name, fn, operands, **static):
     indices, random masks...)."""
     registry.count_call(op_name)
     arrays = [_unwrap(x) for x in operands]
+    if _mesh_hook is not None:
+        arrays = _mesh_hook(arrays)
     out = fn(*arrays, **static)
     if isinstance(out, (tuple, list)):
         return tuple(Tensor(o) for o in out)
