@@ -1,0 +1,92 @@
+"""Benchmark: Llama causal-LM training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The metric is tokens/sec/chip for a compiled full train step (fwd+bwd+AdamW,
+bf16 params with fp32 masters) on a ~1.2B-param Llama config — the
+single-chip proxy for BASELINE config 4. "vs_baseline" is model FLOPs
+utilization (MFU) divided by the 0.45 north-star target from BASELINE.json,
+so 1.0 means the 45%-MFU goal is met on this chip.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+# bf16 peak FLOPs/s per chip by TPU generation (public spec sheets)
+_PEAK = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
+    for k, v in _PEAK.items():
+        if k in kind:
+            return v
+    return 459e12  # assume v5p-class if unknown
+
+
+def main():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:  # smoke-mode so local runs finish; real numbers need a chip
+        cfg = LlamaConfig.tiny(use_parallel_cross_entropy=False)
+        batch, seq, steps, warmup = 2, 64, 3, 1
+    else:
+        # sized for a single v5e chip (16G HBM): ~0.44B params, bf16 +
+        # fp32 masters + Adam moments ≈ 6G, activations ≈ 4G at b4×s1024
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_hidden_layers=12, num_attention_heads=12,
+            max_position_embeddings=1024, dtype="bfloat16",
+            use_parallel_cross_entropy=False)
+        batch, seq, steps, warmup = 4, 1024, 10, 2
+
+    pt.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if cfg.dtype == "bfloat16":
+        for p in model.parameters():
+            p._data = p._data.astype("bfloat16")
+    opt = pt.optimizer.AdamW(
+        learning_rate=1e-4, parameters=model.parameters(),
+        multi_precision=cfg.dtype == "bfloat16")
+    step = TrainStep(model, opt, lambda m, i, l: m(i, l))
+
+    ids = pt.to_tensor(np.random.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = pt.to_tensor(np.random.randint(0, cfg.vocab_size, (batch, seq)))
+
+    for _ in range(warmup):
+        float(step(ids, labels).numpy())  # host transfer = real sync
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    final_loss = float(loss.numpy())  # chained through params: syncs all
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
+
+    tokens_per_sec = batch * seq * steps / dt
+    flops_tok = model.flops_per_token(seq)
+    mfu = tokens_per_sec * flops_tok / _peak_flops(jax.devices()[0])
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

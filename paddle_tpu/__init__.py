@@ -69,6 +69,7 @@ _LAZY = {
     "distribution": ".distribution",
     "sparse": ".sparse",
     "static": ".static",
+    "models": ".models",
     "device": ".framework.device",
     "framework": ".framework",
     "utils": ".utils",

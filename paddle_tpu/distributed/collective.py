@@ -112,12 +112,13 @@ def new_group(ranks=None, backend=None, timeout=None, axes=None, name=None):
     e = env_mod.ensure_env()
     if ranks is None or len(ranks) == e.world_size:
         return _world_group()
-    for ax in env_mod.AXIS_ORDER:
-        if e.degree(ax) == len(ranks):
-            return Group((ax,), name)
+    matching = [ax for ax in env_mod.AXIS_ORDER
+                if e.degree(ax) == len(ranks)]
+    if len(matching) == 1:
+        return Group((matching[0],), name)
     raise ValueError(
-        f"cannot map ranks {ranks} onto mesh axes {e.degrees}; "
-        "pass axes=... explicitly"
+        f"cannot map ranks {ranks} unambiguously onto mesh axes "
+        f"{e.degrees} (matching axes: {matching}); pass axes=... explicitly"
     )
 
 
@@ -145,8 +146,7 @@ def _spec_on(ndim, axes, dim):
 
 
 @functools.lru_cache(maxsize=512)
-def _reduce_program(axes, op, shape, dtype, in_spec_key):
-    e = env_mod.get_env()
+def _reduce_program(mesh, axes, op, shape, dtype, in_spec_key):
     in_spec = PartitionSpec(*in_spec_key)
     red = {
         "sum": jax.lax.psum, "avg": jax.lax.pmean,
@@ -162,7 +162,7 @@ def _reduce_program(axes, op, shape, dtype, in_spec_key):
     def shard_fn(x):
         return red(x, ax)
 
-    fn = shard_map(shard_fn, mesh=e.mesh, in_specs=(in_spec,),
+    fn = shard_map(shard_fn, mesh=mesh, in_specs=(in_spec,),
                    out_specs=out_spec, check_rep=False)
     return jax.jit(fn)
 
@@ -195,7 +195,12 @@ def _on_mesh(arr):
 
 
 def _prod_reduce(x, ax):
-    return jnp.exp(jax.lax.psum(jnp.log(x), ax))
+    # jax.lax has no pprod: |x| in log space + sign parity + zero sweep
+    mag = jnp.exp(jax.lax.psum(jnp.log(jnp.maximum(jnp.abs(x), 1e-38)), ax))
+    n_neg = jax.lax.psum((x < 0).astype(jnp.int32), ax)
+    sign = 1.0 - 2.0 * (n_neg % 2).astype(jnp.float32)
+    any_zero = jax.lax.pmin(jnp.abs(x), ax) == 0
+    return jnp.where(any_zero, 0.0, mag * sign).astype(x.dtype)
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -217,15 +222,15 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         t.stop_gradient = out.stop_gradient and t.stop_gradient
         return t
     arr = _on_mesh(t._data)
-    prog = _reduce_program(g.axes, op, tuple(arr.shape), str(arr.dtype),
+    prog = _reduce_program(env_mod.get_env().mesh, g.axes, op,
+                           tuple(arr.shape), str(arr.dtype),
                            _current_spec(arr))
     t._replace_(prog(arr))
     return t
 
 
 @functools.lru_cache(maxsize=512)
-def _gather_program(axes, dim, shape, dtype, in_spec_key):
-    e = env_mod.get_env()
+def _gather_program(mesh, axes, dim, shape, dtype, in_spec_key):
     in_spec = PartitionSpec(*in_spec_key)
     ax = axes if len(axes) > 1 else axes[0]
     out_parts = [p if not _mentions(p, axes) else None for p in in_spec_key]
@@ -234,7 +239,7 @@ def _gather_program(axes, dim, shape, dtype, in_spec_key):
     def shard_fn(x):
         return jax.lax.all_gather(x, ax, axis=dim, tiled=True)
 
-    fn = shard_map(shard_fn, mesh=e.mesh, in_specs=(in_spec,),
+    fn = shard_map(shard_fn, mesh=mesh, in_specs=(in_spec,),
                    out_specs=out_spec, check_rep=False)
     return jax.jit(fn)
 
@@ -261,7 +266,8 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis=0):
         )
     else:
         arr = _on_mesh(t._data)
-        prog = _gather_program(g.axes, axis, tuple(arr.shape),
+        prog = _gather_program(env_mod.get_env().mesh, g.axes, axis,
+                               tuple(arr.shape),
                                str(arr.dtype), _current_spec(arr))
         gathered = Tensor(prog(arr))
     if out_list is not None:
@@ -338,17 +344,24 @@ def all_to_all(out_tensor_list, in_tensor_list=None, group=None, sync_op=True,
             (t,),
         )
     e = env_mod.ensure_env()
+    fn = _a2a_program(e.mesh, g.axes, t.ndim, split_axis, concat_axis)
     in_spec = _spec_on(t.ndim, g.axes, concat_axis)
-    out_spec = _spec_on(t.ndim, g.axes, split_axis)
+    arr = jax.device_put(_on_mesh(t._data), NamedSharding(e.mesh, in_spec))
+    return Tensor(fn(arr))
+
+
+@functools.lru_cache(maxsize=512)
+def _a2a_program(mesh, axes, ndim, split_axis, concat_axis):
+    ax = axes if len(axes) > 1 else axes[0]
+    in_spec = _spec_on(ndim, axes, concat_axis)
+    out_spec = _spec_on(ndim, axes, split_axis)
 
     def shard_fn(a):
         return jax.lax.all_to_all(a, ax, split_axis=split_axis,
                                   concat_axis=concat_axis, tiled=True)
 
-    fn = jax.jit(shard_map(shard_fn, mesh=e.mesh, in_specs=(in_spec,),
-                           out_specs=out_spec, check_rep=False))
-    arr = jax.device_put(_on_mesh(t._data), NamedSharding(e.mesh, in_spec))
-    return Tensor(fn(arr))
+    return jax.jit(shard_map(shard_fn, mesh=mesh, in_specs=(in_spec,),
+                             out_specs=out_spec))
 
 
 alltoall = all_to_all

@@ -119,14 +119,24 @@ def init_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1,
 
 def _install_mesh_hook(mesh):
     """Teach the op dispatcher to replicate off-mesh eager operands onto the
-    mesh (mixing a host-side batch with sharded params is the common case)."""
+    mesh (mixing a host-side batch with sharded params is the common case),
+    and place newly created Parameters on the mesh."""
     from ..ops import dispatch as _dispatch
+    from ..framework import core as _core
 
     if mesh.size == 1:
         _dispatch.set_mesh_hook(None)
+        _core.set_param_place_hook(None)
         return
     n_mesh = mesh.size
     repl = NamedSharding(mesh, PartitionSpec())
+
+    def place_param(arr):
+        if isinstance(arr, jax.Array) and len(arr.sharding.device_set) != n_mesh:
+            return jax.device_put(arr, repl)
+        return arr
+
+    _core.set_param_place_hook(place_param)
 
     def _concrete(a):
         return isinstance(a, jax.Array) and not isinstance(a, jax.core.Tracer)
@@ -163,8 +173,15 @@ def ensure_env() -> ParallelEnv:
 
 
 def reset_env():
+    """Tear down the mesh and uninstall dispatcher/parameter hooks (test
+    isolation; also the path to re-init after an elastic resize)."""
     global _global_env
     _global_env = None
+    from ..ops import dispatch as _dispatch
+    from ..framework import core as _core
+
+    _dispatch.set_mesh_hook(None)
+    _core.set_param_place_hook(None)
 
 
 def get_mesh() -> Mesh | None:

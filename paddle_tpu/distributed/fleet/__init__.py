@@ -59,8 +59,8 @@ def get_hybrid_communicate_group() -> HybridCommunicateGroup:
 def distributed_model(model):
     """Parity: `fleet.distributed_model` (`fleet/model.py:30`)."""
     from ..parallel import DataParallel
-    from ..meta_parallel.pipeline_parallel import PipelineParallel
-    from ..meta_parallel.parallel_layers.pp_layers import PipelineLayer
+    from .meta_parallel.pipeline_parallel import PipelineParallel
+    from .meta_parallel.parallel_layers.pp_layers import PipelineLayer
 
     hcg = ensure_hcg()
     if hcg.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):

@@ -1,0 +1,20 @@
+"""Meta-parallel layers and schedules (parity:
+`python/paddle/distributed/fleet/meta_parallel/`)."""
+from .parallel_layers.mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .parallel_layers.pp_layers import (  # noqa: F401
+    LayerDesc, PipelineLayer, SharedLayerDesc,
+)
+from .parallel_layers.random import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+
+__all__ = [
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "ParallelCrossEntropy", "LayerDesc", "SharedLayerDesc", "PipelineLayer",
+    "PipelineParallel", "RNGStatesTracker", "get_rng_state_tracker",
+    "model_parallel_random_seed",
+]

@@ -1,0 +1,80 @@
+"""Pipeline-parallel training driver.
+
+Reference parity: `PipelineParallel` / `PipelineParallelWithInterleave`
+(`fleet/meta_parallel/pipeline_parallel.py:130,383,815`) — the host-side
+F-then-B / 1F1B micro-batch scheduler with p2p activation exchange.
+
+TPU-first design: the schedule is compiled INTO the XLA program by
+`PipelineLayer._pipeline_blocks` (shard_map + ppermute GPipe loop), so this
+class only keeps the reference's `train_batch`/`eval_batch` driver API:
+forward the full batch (micro-batching happens inside the op), compute loss,
+one backward, one optimizer step. 1F1B's memory benefit is delivered by
+`recompute_interval` (jax.checkpoint) instead of host-side scheduling;
+interleaved virtual stages are a schedule variant of the same shard_map loop
+(future work tracked in SURVEY §7 hard-part (b)).
+"""
+from __future__ import annotations
+
+from .parallel_layers.pp_layers import PipelineLayer
+
+
+class PipelineParallel:
+    def __init__(self, layers, hcg, strategy=None):
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = (strategy.pipeline_configs if strategy is not None else {}) or {}
+        self.accumulate_steps = int(cfg.get("accumulate_steps", 1) or 1)
+        self.micro_batch_size = int(cfg.get("micro_batch_size", 1) or 1)
+        self.total_loss = None
+
+    def __getattr__(self, name):
+        return getattr(self._layers, name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def _n_micro(self):
+        return max(self.accumulate_steps,
+                   self._hcg.get_pipe_parallel_world_size())
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        """Parity: `pipeline_parallel.py:383`. Runs fwd+bwd for one global
+        batch; returns the (averaged) loss tensor."""
+        inputs, labels = data
+        out = self._layers(inputs, n_microbatches=self._n_micro())
+        if self._layers.loss_fn is None:
+            raise ValueError("PipelineLayer needs loss_fn for train_batch")
+        loss = self._layers.loss_fn(out, labels)
+        if loss.ndim:
+            loss = loss.mean()
+        scaled = scaler.scale(loss) if scaler is not None else loss
+        scaled.backward()
+        self.total_loss = loss
+        return loss
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Parity: `PipelineParallel.train_batch`."""
+        loss = self.forward_backward_pipeline(data, scaler)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        from ....autograd.tape import no_grad
+
+        inputs, labels = data
+        with no_grad():
+            out = self._layers(inputs, n_microbatches=self._n_micro())
+            if compute_loss and self._layers.loss_fn is not None:
+                loss = self._layers.loss_fn(out, labels)
+                return loss.mean() if loss.ndim else loss
+        return out

@@ -58,6 +58,20 @@ def shard_tensor(x, mesh=None, placements=None, *, spec=None,
     parts = tuple(spec if spec is not None else (placements or ()))
     e = env_mod.ensure_env()
     mesh = mesh or e.mesh
+    # drop axes that don't divide their dim (e.g. a 'dp' batch hint on a
+    # batch smaller than the dp degree) instead of failing the program
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    shape = t.shape
+    cleaned = []
+    for i, p in enumerate(parts):
+        names = p if isinstance(p, (tuple, list)) else (p,)
+        n = 1
+        for nm in names:
+            if nm is not None:
+                n *= sizes.get(nm, 1)
+        cleaned.append(p if (i < len(shape) and n and shape[i] % n == 0)
+                       else None)
+    parts = tuple(cleaned)
     sharding = NamedSharding(mesh, PartitionSpec(*parts))
 
     # jax.device_put: eager -> physical reshard onto the mesh; traced ->

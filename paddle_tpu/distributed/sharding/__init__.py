@@ -1,0 +1,5 @@
+from .group_sharded import (  # noqa: F401
+    group_sharded_parallel, save_group_sharded_model,
+)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]

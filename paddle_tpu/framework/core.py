@@ -318,6 +318,18 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     return Tensor(data, dtype=dtype, stop_gradient=stop_gradient, place=place)
 
 
+# Parameter placement hook: installed by paddle_tpu.distributed when a mesh
+# is active. New parameters are placed on the mesh (replicated) so every
+# downstream eager op / vjp closure lives in one consistent device world —
+# the role of the reference's data_transform place propagation.
+_param_place_hook = None
+
+
+def set_param_place_hook(fn):
+    global _param_place_hook
+    _param_place_hook = fn
+
+
 class EagerParamBase(Tensor):
     """Parameter: a trainable, persistable Tensor
     (parity: `EagerParamBase` in reference `python/paddle/fluid/framework.py`)."""
@@ -326,6 +338,9 @@ class EagerParamBase(Tensor):
 
     def __init__(self, data, dtype=None, name=None, trainable=True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        if _param_place_hook is not None and not isinstance(
+                self._data, jax.core.Tracer):
+            self._data = _param_place_hook(self._data)
         self.persistable = True
         self.is_parameter = True
         self.trainable = trainable

@@ -1,0 +1,3 @@
+from .moe_layer import MoELayer  # noqa: F401
+
+__all__ = ["MoELayer"]

@@ -1,0 +1,159 @@
+"""Mixture-of-Experts with expert parallelism (EP).
+
+Reference parity: `MoELayer` and its gates
+(`python/paddle/incubate/distributed/models/moe/moe_layer.py:263`,
+`gate/{gshard,switch,naive}_gate.py`) dispatching tokens with the
+`global_scatter`/`global_gather` all-to-all collective ops
+(`fluid/operators/collective/global_scatter_op.cc`).
+
+TPU-first design (SURVEY §2.6: "MoE ⇒ all_to_all within shard_map" — or,
+simpler and faster under GSPMD): the GShard formulation. Routing builds
+dispatch/combine one-hot tensors and the expert computation is three
+einsums; expert weights are stacked [E, ...] and SHARDED over a mesh axis,
+so XLA partitions the einsums over experts and inserts the token all-to-all
+automatically — `global_scatter`'s exact data movement, derived from
+layouts. Capacity-factor token dropping matches the reference gates'
+behavior (overflowed tokens pass through the residual).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .....distributed import shard
+from .....framework.core import Tensor
+from .....nn import functional as F  # noqa: F401  (doc parity)
+from .....nn import initializer as I
+from .....nn.layer.layers import Layer
+from .....ops.dispatch import apply
+
+
+def _top2_gating(logits, capacity, *, rng_key=None):
+    """GShard top-2 gate (reference `gate/gshard_gate.py`): returns
+    [T, E, C] combine and dispatch tensors. T tokens, E experts."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    idx1 = jnp.argmax(probs, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, E, dtype=probs.dtype)
+    probs_wo1 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs_wo1, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, E, dtype=probs.dtype)
+
+    # positions within each expert's capacity buffer (first-come order)
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1
+    pos2 = (jnp.cumsum(mask2, axis=0) - mask2
+            + jnp.sum(mask1, axis=0, keepdims=True)) * mask2
+    keep1 = mask1 * (pos1 < capacity)
+    keep2 = mask2 * (pos2 < capacity)
+
+    g1 = jnp.sum(probs * keep1, axis=-1)
+    g2 = jnp.sum(probs * keep2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    loc1 = jnp.sum(pos1 * keep1, axis=-1).astype(jnp.int32)
+    loc2 = jnp.sum(pos2 * keep2, axis=-1).astype(jnp.int32)
+    cap1 = jax.nn.one_hot(loc1, capacity, dtype=probs.dtype)
+    cap2 = jax.nn.one_hot(loc2, capacity, dtype=probs.dtype)
+    combine = (g1[:, None, None] * keep1[:, :, None] * cap1[:, None, :]
+               + g2[:, None, None] * keep2[:, :, None] * cap2[:, None, :])
+    dispatch = (combine > 0).astype(probs.dtype)
+
+    # load-balancing aux loss (GShard eq.4)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux = jnp.sum(me * ce) * E
+    return combine, dispatch, aux
+
+
+def _top1_gating(logits, capacity):
+    """Switch-Transformer top-1 gate (reference `gate/switch_gate.py`)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    mask = jax.nn.one_hot(idx, E, dtype=probs.dtype)
+    pos = jnp.cumsum(mask, axis=0) * mask - mask
+    keep = mask * (pos < capacity)
+    g = jnp.sum(probs * keep, axis=-1)
+    loc = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)
+    cap = jax.nn.one_hot(loc, capacity, dtype=probs.dtype)
+    combine = g[:, None, None] * keep[:, :, None] * cap[:, None, :]
+    dispatch = (combine > 0).astype(probs.dtype)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(mask, axis=0)
+    aux = jnp.sum(me * ce) * E
+    return combine, dispatch, aux
+
+
+class MoELayer(Layer):
+    """Expert-parallel FFN block.
+
+    Experts are a stacked SwiGLU-free 2-layer MLP: w_in [E, H, F],
+    w_out [E, F, H], sharded over ``expert_axis`` ('dp' by default — experts
+    distributed across the data-parallel ranks like the reference's EP
+    group). Forward dispatches [B,S,H] tokens to expert capacity buffers,
+    runs the expert einsums, and combines; the load-balancing aux loss is
+    stored on ``self.aux_loss`` (add it to the training loss, reference
+    MoELayer does the same via gate.get_loss()).
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, top_k=2,
+                 capacity_factor=1.25, activation="gelu",
+                 expert_axis="dp", gate="gshard", name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.gate_type = gate
+        self.act = activation
+        self.gate_weight = self.create_parameter(
+            [d_model, num_experts], default_initializer=I.XavierNormal())
+        self.w_in = self.create_parameter(
+            [num_experts, d_model, d_hidden],
+            default_initializer=I.XavierNormal())
+        self.w_out = self.create_parameter(
+            [num_experts, d_hidden, d_model],
+            default_initializer=I.XavierNormal())
+        shard.shard_parameter(self.w_in, expert_axis, None, None)
+        shard.shard_parameter(self.w_out, expert_axis, None, None)
+        self.expert_axis = expert_axis
+        self.aux_loss = None
+
+    def forward(self, x):
+        B, S, H = x.shape
+        E = self.num_experts
+        T = B * S
+        capacity = int(math.ceil(T / E * self.capacity_factor))
+        act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+               "silu": jax.nn.silu}[self.act]
+        gate_fn = _top2_gating if self.gate_type == "gshard" and self.top_k == 2 \
+            else _top1_gating
+        axis = self.expert_axis
+
+        def kernel(xa, wg, w_in, w_out):
+            tokens = xa.reshape(T, H)
+            logits = tokens @ wg.astype(xa.dtype)
+            combine, dispatch, aux = gate_fn(logits, capacity)
+            combine = combine.astype(xa.dtype)
+            dispatch = dispatch.astype(xa.dtype)
+            # dispatch: [T,E,C] x [T,H] -> expert buffers [E,C,H]
+            buf = jnp.einsum("tec,th->ech", dispatch, tokens)
+            # keep expert dim sharded: XLA emits the token all_to_all here
+            buf = jax.device_put(
+                buf, shard._named_sharding(axis, None, None))
+            h = act(jnp.einsum("ech,ehf->ecf", buf, w_in.astype(xa.dtype)))
+            out = jnp.einsum("ecf,efh->ech", h, w_out.astype(xa.dtype))
+            out = jax.device_put(
+                out, shard._named_sharding(axis, None, None))
+            y = jnp.einsum("tec,ech->th", combine, out)
+            return y.reshape(B, S, H), aux.astype(jnp.float32)
+
+        y, aux = apply("moe_layer", kernel,
+                       (x, self.gate_weight, self.w_in, self.w_out))
+        self.aux_loss = aux
+        return y

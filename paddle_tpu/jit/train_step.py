@@ -1,0 +1,215 @@
+"""Whole-train-step compilation: forward + backward + optimizer in ONE
+XLA program.
+
+Reference parity: this is the TPU answer to the reference's static-graph
+training path — `Executor.run(program)` over a ProgramDesc containing
+forward, backward (appended by `append_backward`) and optimizer ops,
+executed by the StandaloneExecutor (`new_executor/standalone_executor.h:34`).
+Where the reference builds that program from graph-mode Python, we *trace*
+the eager code: the tape (`autograd/tape.py`) records on jax tracers, the
+optimizer rules are pure (`optimizer.py` `_init_state`/`_update`), so one
+`jax.jit` captures the complete step — gradients, clipping, weight decay,
+multi-precision masters, LR — and XLA fuses and overlaps everything
+(including the GSPMD gradient collectives under a mesh). Parameter and
+optimizer-state buffers are DONATED, so the step runs in-place in HBM like
+the reference's inplace-addto pass.
+
+This is the engine under `hapi.Model.fit`'s compiled path, `bench.py`, and
+the multichip dry-run.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape
+from ..framework import random as rng
+from ..framework.core import Tensor
+from ..optimizer.lr import LRScheduler
+
+
+class TrainStep:
+    """Compile `(model, optimizer, loss_fn)` into one cached XLA program.
+
+    loss_fn(model, *batch) -> scalar loss Tensor. Default: model(*batch)
+    is the loss. Retraces per batch (shape, dtype) signature.
+
+    Usage:
+        step = TrainStep(model, opt, lambda m, x, y: m(x, y))
+        loss = step(x, y)          # Tensors or arrays
+    """
+
+    def __init__(self, model, optimizer, loss_fn=None, donate=True):
+        self._model = model
+        self._opt = optimizer
+        self._loss_fn = loss_fn or (lambda m, *batch: m(*batch))
+        self._donate = donate
+        self._params = [
+            p for p in model.parameters() if not p.stop_gradient
+        ]
+        self._buffers = [b for _, b in model.named_buffers()]
+        # optimizer state lives here in functional form, aligned to _params
+        self._state: list[dict] = []
+        self._masters: list = []
+        self._step_count = 0
+        self._cache = {}
+
+    # -- functional per-param update mirroring Optimizer.step's eager loop --
+    def _param_update(self, p, arr, g, state, master, lr, step):
+        opt = self._opt
+        opt._current_param = p
+        opt._current_reg = getattr(p, "regularizer", None)
+        attrs = getattr(p, "optimize_attr", None)
+        lr_p = lr * float(attrs.get("learning_rate", 1.0)) if attrs else lr
+        low_prec = arr.dtype.name in ("bfloat16", "float16")
+        if opt._multi_precision and low_prec:
+            work = master
+            g_arr = g.astype(jnp.float32)
+        else:
+            work = arr
+            g_arr = g.astype(arr.dtype)
+        work = opt._apply_decoupled_decay(work, lr_p, p)
+        new_w, new_state = opt._update(work, g_arr, state, lr_p, step)
+        if opt._multi_precision and low_prec:
+            return new_w.astype(arr.dtype), new_state, new_w
+        return new_w, new_state, None
+
+    def _ensure_state(self):
+        if self._state:
+            return
+        opt = self._opt
+        for p in self._params:
+            arr = p._data
+            low_prec = arr.dtype.name in ("bfloat16", "float16")
+            if opt._multi_precision and low_prec:
+                master = opt._place_master(arr.astype(jnp.float32))
+                self._state.append(opt._place_state(opt._init_state(master)))
+                self._masters.append(master)
+            else:
+                self._state.append(opt._place_state(opt._init_state(arr)))
+                self._masters.append(None)
+
+    def _flatten_state(self):
+        flat = []
+        for st in self._state:
+            for k in sorted(st):
+                flat.append(st[k])
+        flat.extend(m for m in self._masters if m is not None)
+        return flat
+
+    def _unflatten_state(self, flat):
+        pos = 0
+        state, masters = [], []
+        for st in self._state:
+            d = {}
+            for k in sorted(st):
+                d[k] = flat[pos]
+                pos += 1
+            state.append(d)
+        for m in self._masters:
+            if m is None:
+                masters.append(None)
+            else:
+                masters.append(flat[pos])
+                pos += 1
+        return state, masters
+
+    def _build(self, batch_sig):
+        params, buffers = self._params, self._buffers
+        model, opt = self._model, self._opt
+        loss_fn = self._loss_fn
+        outer = self
+
+        def step_fn(param_arrays, state_flat, buffer_arrays, lr, step, prng,
+                    batch_arrays):
+            state, masters = outer._unflatten_state(state_flat)
+            saved = [(t, t._data, t._grad_node) for t in params + buffers]
+            try:
+                for p, a in zip(params, param_arrays):
+                    p._data = a
+                    p._grad_node = None
+                for b, a in zip(buffers, buffer_arrays):
+                    b._data = a
+                batch = [Tensor(a) for a in batch_arrays]
+                with rng.rng_scope(prng), tape.enable_grad():
+                    loss = loss_fn(model, *batch)
+                grads = tape.grad(loss, params, allow_unused=True,
+                                  retain_graph=False)
+                pg = [(p, g) for p, g in zip(params, grads)]
+                if opt._grad_clip is not None:
+                    pg = opt._grad_clip(pg)
+                new_params, new_state, new_masters = [], [], []
+                for (p, g), arr, st, m in zip(pg, param_arrays, state, masters):
+                    if g is None:
+                        new_params.append(arr)
+                        new_state.append(st)
+                        new_masters.append(m)
+                        continue
+                    np_, ns_, nm_ = outer._param_update(
+                        p, arr, g._data, st, m, lr, step)
+                    new_params.append(np_)
+                    new_state.append(ns_)
+                    new_masters.append(nm_ if nm_ is not None else m)
+                new_buffers = [b._data for b in buffers]
+                flat_state = []
+                for st in new_state:
+                    for k in sorted(st):
+                        flat_state.append(st[k])
+                flat_state.extend(m for m in new_masters if m is not None)
+                return new_params, flat_state, new_buffers, loss._data
+            finally:
+                for t, a, gn in saved:
+                    t._data = a
+                    t._grad_node = gn
+
+        donate = (0, 1, 2) if self._donate else ()
+        return jax.jit(step_fn, donate_argnums=donate)
+
+    def __call__(self, *batch):
+        self._ensure_state()
+        arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        training = getattr(self._model, "training", True)
+        sig = (tuple((tuple(a.shape), str(a.dtype)) for a in arrays), training)
+        fn = self._cache.get(sig)
+        if fn is None:
+            fn = self._build(sig)
+            self._cache[sig] = fn
+        lr = self._opt.get_lr()
+        self._step_count += 1
+
+        def place(x):
+            # host-side scalars/batches join the params' mesh (replicated)
+            from ..distributed import env as env_mod
+
+            e = env_mod.get_env()
+            if e is None or e.mesh.size == 1:
+                return x
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return jax.device_put(x, NamedSharding(e.mesh, PartitionSpec()))
+
+        new_params, flat_state, new_buffers, loss = fn(
+            [p._data for p in self._params],
+            self._flatten_state(),
+            [b._data for b in self._buffers],
+            place(jnp.asarray(lr, jnp.float32)),
+            place(jnp.asarray(self._step_count, jnp.int32)),
+            place(rng.next_key()),
+            [place(a) for a in arrays],
+        )
+        for p, a in zip(self._params, new_params):
+            p._data = a
+            p._grad_node = None
+            p.grad = None
+        self._state, self._masters = self._unflatten_state(flat_state)
+        for b, a in zip(self._buffers, new_buffers):
+            b._data = a
+        if isinstance(self._opt._learning_rate, LRScheduler):
+            pass  # caller drives scheduler.step(), paddle-style
+        return Tensor(loss)
+
+    # -- introspection --
+    @property
+    def compiled_count(self):
+        return len(self._cache)

@@ -1,0 +1,12 @@
+"""Flagship model families (the reference ships these via PaddleNLP/PaddleClas;
+the benchmark configs in BASELINE.md name Llama, BERT, ResNet, ERNIE —
+they live in-tree here so the framework is benchmarkable standalone)."""
+from . import llama  # noqa: F401
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaForCausalLMPipe, LlamaModel,
+)
+
+__all__ = [
+    "llama", "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+    "LlamaForCausalLMPipe",
+]

@@ -44,6 +44,20 @@ class Optimizer:
         # master weights for low-precision params (multi_precision)
         self._master_weights: dict[int, jax.Array] = {}
         self._current_reg = None
+        # placement hook for freshly created accumulator state (ZeRO: the
+        # group_sharded wrapper sets this to shard moments over the
+        # 'sharding' mesh axis — reference GroupShardedOptimizerStage2)
+        self._state_placement = None
+
+    def _place_state(self, state: dict) -> dict:
+        if self._state_placement is None:
+            return state
+        return {k: self._state_placement(v) for k, v in state.items()}
+
+    def _place_master(self, arr):
+        """fp32 master weights are optimizer state too — ZeRO shards them
+        (they are the largest single saving)."""
+        return arr if self._state_placement is None else self._state_placement(arr)
 
     # ---- lr ----
     def get_lr(self):
@@ -115,7 +129,7 @@ class Optimizer:
             if self._multi_precision and param_arr.dtype.name in ("bfloat16", "float16"):
                 master = self._master_weights.get(key)
                 if master is None:
-                    master = param_arr.astype(jnp.float32)
+                    master = self._place_master(param_arr.astype(jnp.float32))
                 work = master
                 g_arr = g._data.astype(jnp.float32)
             else:
@@ -123,7 +137,7 @@ class Optimizer:
                 g_arr = g._data.astype(param_arr.dtype)
             state = self._accumulators.get(key)
             if state is None:
-                state = self._init_state(work)
+                state = self._place_state(self._init_state(work))
                 self._accumulators[key] = state
             work = self._apply_decoupled_decay(work, lr_p, p)
             new_p, new_state = self._update(work, g_arr, state, lr_p, step)

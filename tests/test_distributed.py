@@ -20,7 +20,7 @@ def _mesh():
     yield
     from paddle_tpu.distributed import env as env_mod
 
-    env_mod.init_mesh(dp=-1)  # restore default so other test files are unaffected
+    env_mod.reset_env()  # other test files run mesh-free
 
 
 class TestMeshEnv:

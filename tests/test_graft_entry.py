@@ -1,0 +1,32 @@
+"""Driver-contract tests: __graft_entry__.entry / dryrun_multichip."""
+import sys
+
+import jax
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    yield
+    from paddle_tpu.distributed import env as env_mod
+
+    env_mod.reset_env()
+
+
+def _graft():
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as g
+
+    return g
+
+
+def test_entry_jits():
+    g = _graft()
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (2, 256, 8192)
+
+
+def test_dryrun_multichip_8():
+    g = _graft()
+    g.dryrun_multichip(8)
