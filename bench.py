@@ -3,7 +3,7 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 The metric is tokens/sec/chip for a compiled full train step (fwd+bwd+AdamW,
-bf16 params with fp32 masters) on a ~1.2B-param Llama config — the
+bf16 params with fp32 masters) on a ~0.44B-param Llama config (sized to one v5e chip) — the
 single-chip proxy for BASELINE config 4. "vs_baseline" is model FLOPs
 utilization (MFU) divided by the 0.45 north-star target from BASELINE.json,
 so 1.0 means the 45%-MFU goal is met on this chip.
@@ -63,7 +63,7 @@ def main():
     opt = pt.optimizer.AdamW(
         learning_rate=1e-4, parameters=model.parameters(),
         multi_precision=cfg.dtype == "bfloat16")
-    step = TrainStep(model, opt, lambda m, i, l: m(i, l))
+    step = TrainStep(model, opt, lambda m, i, l: m(i, l), donate=True)
 
     ids = pt.to_tensor(np.random.randint(0, cfg.vocab_size, (batch, seq)))
     labels = pt.to_tensor(np.random.randint(0, cfg.vocab_size, (batch, seq)))

@@ -1,0 +1,359 @@
+// TCPStore: key-value rendezvous store for distributed bootstrap.
+//
+// Reference parity: `paddle/phi/core/distributed/store/tcp_store.{h,cc}` and
+// `tcp_utils.cc` — the master rank listens, workers connect; supports
+// set/get/add/wait with blocking waits, used to exchange bootstrap ids.
+//
+// TPU-first role: jax.distributed has its own coordination service for the
+// runtime itself, but framework-level rendezvous (elastic membership, user
+// barriers, launch coordination) still wants a tiny KV store that does not
+// depend on the XLA runtime being up. This is that store, exposed to Python
+// via ctypes (no pybind11 in the image).
+//
+// Protocol (all little-endian):
+//   request:  u8 cmd | u32 klen | key bytes | u32 vlen | value bytes
+//   response: u32 vlen | value bytes   (vlen = 0xFFFFFFFF => not found)
+// cmds: 0=SET 1=GET 2=ADD(value=i64 delta, returns new i64) 3=WAIT
+//       4=PING 5=DELETE
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+
+  void set(const std::string& k, const std::string& v) {
+    {
+      std::lock_guard<std::mutex> g(mu);
+      kv[k] = v;
+    }
+    cv.notify_all();
+  }
+  bool get(const std::string& k, std::string* out) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = kv.find(k);
+    if (it == kv.end()) return false;
+    *out = it->second;
+    return true;
+  }
+  int64_t add(const std::string& k, int64_t delta) {
+    std::lock_guard<std::mutex> g(mu);
+    int64_t cur = 0;
+    auto it = kv.find(k);
+    if (it != kv.end() && it->second.size() == sizeof(int64_t))
+      memcpy(&cur, it->second.data(), sizeof(int64_t));
+    cur += delta;
+    std::string v(sizeof(int64_t), '\0');
+    memcpy(&v[0], &cur, sizeof(int64_t));
+    kv[k] = v;
+    cv.notify_all();
+    return cur;
+  }
+  bool wait(const std::string& k, int timeout_ms, std::string* out) {
+    std::unique_lock<std::mutex> g(mu);
+    bool ok = cv.wait_for(g, std::chrono::milliseconds(timeout_ms),
+                          [&] { return kv.count(k) > 0; });
+    if (ok) *out = kv[k];
+    return ok;
+  }
+  void del(const std::string& k) {
+    std::lock_guard<std::mutex> g(mu);
+    kv.erase(k);
+  }
+};
+
+bool read_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_blob(int fd, std::string* out) {
+  uint32_t len;
+  if (!read_all(fd, &len, 4)) return false;
+  out->resize(len);
+  return len == 0 || read_all(fd, &(*out)[0], len);
+}
+
+bool write_blob(int fd, const std::string& s) {
+  uint32_t len = static_cast<uint32_t>(s.size());
+  if (!write_all(fd, &len, 4)) return false;
+  return s.empty() || write_all(fd, s.data(), s.size());
+}
+
+constexpr uint32_t kNotFound = 0xFFFFFFFFu;
+
+struct Server {
+  Store store;
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> running{false};
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::vector<int> conn_fds;
+  std::mutex workers_mu;
+
+  void serve_conn(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    while (running.load()) {
+      uint8_t cmd;
+      if (!read_all(fd, &cmd, 1)) break;
+      std::string key, val;
+      if (!read_blob(fd, &key)) break;
+      if (!read_blob(fd, &val)) break;
+      switch (cmd) {
+        case 0:  // SET
+          store.set(key, val);
+          write_blob(fd, "");
+          break;
+        case 1: {  // GET
+          std::string out;
+          if (store.get(key, &out)) {
+            write_blob(fd, out);
+          } else {
+            write_all(fd, &kNotFound, 4);
+          }
+          break;
+        }
+        case 2: {  // ADD
+          int64_t delta = 0;
+          if (val.size() == sizeof(int64_t))
+            memcpy(&delta, val.data(), sizeof(int64_t));
+          int64_t res = store.add(key, delta);
+          std::string out(sizeof(int64_t), '\0');
+          memcpy(&out[0], &res, sizeof(int64_t));
+          write_blob(fd, out);
+          break;
+        }
+        case 3: {  // WAIT (val = u32 timeout_ms)
+          uint32_t to = 300000;
+          if (val.size() == 4) memcpy(&to, val.data(), 4);
+          std::string out;
+          if (store.wait(key, static_cast<int>(to), &out)) {
+            write_blob(fd, out);
+          } else {
+            write_all(fd, &kNotFound, 4);
+          }
+          break;
+        }
+        case 4:  // PING
+          write_blob(fd, "pong");
+          break;
+        case 5:  // DELETE
+          store.del(key);
+          write_blob(fd, "");
+          break;
+        default:
+          close(fd);
+          return;
+      }
+    }
+    close(fd);
+  }
+
+  bool start(int want_port) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(want_port));
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+      return false;
+    socklen_t len = sizeof(addr);
+    getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+    port = ntohs(addr.sin_port);
+    if (::listen(listen_fd, 128) < 0) return false;
+    running.store(true);
+    accept_thread = std::thread([this] {
+      while (running.load()) {
+        int fd = ::accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) break;
+        std::lock_guard<std::mutex> g(workers_mu);
+        conn_fds.push_back(fd);
+        workers.emplace_back([this, fd] { serve_conn(fd); });
+      }
+    });
+    return true;
+  }
+
+  void stop() {
+    running.store(false);
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      close(listen_fd);
+      listen_fd = -1;
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    std::lock_guard<std::mutex> g(workers_mu);
+    // unblock conn threads stuck in recv() so join cannot deadlock
+    for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+    workers.clear();
+    conn_fds.clear();
+  }
+
+  ~Server() { stop(); }
+};
+
+struct Client {
+  int fd = -1;
+
+  bool connect_to(const char* host, int port, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    while (std::chrono::steady_clock::now() < deadline) {
+      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      inet_pton(AF_INET, host, &addr.sin_addr);
+      if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      close(fd);
+      fd = -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    return false;
+  }
+
+  bool request(uint8_t cmd, const std::string& key, const std::string& val,
+               std::string* out, bool* found) {
+    if (fd < 0) return false;
+    if (!write_all(fd, &cmd, 1)) return false;
+    if (!write_blob(fd, key)) return false;
+    if (!write_blob(fd, val)) return false;
+    uint32_t len;
+    if (!read_all(fd, &len, 4)) return false;
+    if (len == kNotFound) {
+      *found = false;
+      return true;
+    }
+    *found = true;
+    out->resize(len);
+    return len == 0 || read_all(fd, &(*out)[0], len);
+  }
+
+  ~Client() {
+    if (fd >= 0) close(fd);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tcp_store_server_start(int port) {
+  auto* s = new Server();
+  if (!s->start(port)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int tcp_store_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+void tcp_store_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->stop();
+  delete s;
+}
+
+void* tcp_store_client_connect(const char* host, int port, int timeout_ms) {
+  auto* c = new Client();
+  if (!c->connect_to(host, port, timeout_ms)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void tcp_store_client_close(void* h) { delete static_cast<Client*>(h); }
+
+// returns length of value, -1 not found / error. Caller passes buffer+cap;
+// value truncated to cap.
+static int do_req(void* h, uint8_t cmd, const char* key, const char* val,
+                  int vlen, char* out, int cap) {
+  std::string v(val ? val : "", val ? static_cast<size_t>(vlen) : 0);
+  std::string res;
+  bool found = false;
+  if (!static_cast<Client*>(h)->request(cmd, key, v, &res, &found)) return -2;
+  if (!found) return -1;
+  int n = static_cast<int>(res.size());
+  if (out && cap > 0) memcpy(out, res.data(), std::min(n, cap));
+  return n;
+}
+
+int tcp_store_set(void* h, const char* key, const char* val, int vlen) {
+  return do_req(h, 0, key, val, vlen, nullptr, 0);
+}
+
+int tcp_store_get(void* h, const char* key, char* out, int cap) {
+  return do_req(h, 1, key, nullptr, 0, out, cap);
+}
+
+long long tcp_store_add(void* h, const char* key, long long delta) {
+  char buf[8];
+  memcpy(buf, &delta, 8);
+  char out[8] = {0};
+  int n = do_req(h, 2, key, buf, 8, out, 8);
+  if (n != 8) return -1;
+  long long res;
+  memcpy(&res, out, 8);
+  return res;
+}
+
+int tcp_store_wait(void* h, const char* key, int timeout_ms, char* out,
+                   int cap) {
+  char buf[4];
+  uint32_t to = static_cast<uint32_t>(timeout_ms);
+  memcpy(buf, &to, 4);
+  return do_req(h, 3, key, buf, 4, out, cap);
+}
+
+int tcp_store_delete(void* h, const char* key) {
+  return do_req(h, 5, key, nullptr, 0, nullptr, 0);
+}
+
+}  // extern "C"

@@ -74,8 +74,15 @@ _LAZY = {
     "framework": ".framework",
     "utils": ".utils",
     "text": ".text",
+    "quantization": ".quantization",
     "audio": ".audio",
     "onnx": ".onnx",
+}
+
+
+_LAZY_ATTRS = {
+    "Model": (".hapi.model", "Model"),
+    "DataParallel": (".distributed.parallel", "DataParallel"),
 }
 
 
@@ -84,6 +91,11 @@ def __getattr__(name):
         mod = _importlib.import_module(_LAZY[name], __name__)
         globals()[name] = mod
         return mod
+    if name in _LAZY_ATTRS:
+        modname, attr = _LAZY_ATTRS[name]
+        val = getattr(_importlib.import_module(modname, __name__), attr)
+        globals()[name] = val
+        return val
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

@@ -1,0 +1,97 @@
+"""AMP numerical debugging (parity: `python/paddle/amp/debugging.py` —
+TensorChecker / check_numerics / collect_operator_stats).
+
+The op-level NaN/Inf watchdog itself lives in framework.flags
+(FLAGS_check_nan_inf, the reference's `nan_inf_utils`); this module adds the
+user-facing debug API surface.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..ops import registry
+
+__all__ = ["enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "enable_tensor_checker", "disable_tensor_checker",
+           "check_numerics", "DebugMode", "TensorCheckerConfig"]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+
+
+_baseline = None
+
+
+def enable_operator_stats_collection():
+    global _baseline
+    _baseline = dict(registry.op_stats())
+
+
+def disable_operator_stats_collection():
+    global _baseline
+    base = _baseline or {}
+    cur = registry.op_stats()
+    delta = {k: v - base.get(k, 0) for k, v in cur.items()
+             if v - base.get(k, 0) > 0}
+    _baseline = None
+    print("<------------------------------ op list ------------------------------->")
+    for name, n in sorted(delta.items(), key=lambda kv: -kv[1]):
+        print(f"  {name:<40} calls: {n}")
+    print("<----------------------------------- done ----------------------------->")
+    return delta
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Parity: `paddle.amp.debugging.check_numerics` — returns
+    (num_nan, num_inf, num_zero) and raises on NaN/Inf in abort mode."""
+    arr = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    n_nan = int(jnp.isnan(arr).sum())
+    n_inf = int(jnp.isinf(arr).sum())
+    n_zero = int((arr == 0).sum())
+    if debug_mode in (None, DebugMode.CHECK_NAN_INF_AND_ABORT) and \
+            (n_nan or n_inf):
+        raise FloatingPointError(
+            f"[check_numerics] op={op_type} var={var_name}: "
+            f"{n_nan} NaN, {n_inf} Inf")
+    return (Tensor(jnp.asarray(n_nan)), Tensor(jnp.asarray(n_inf)),
+            Tensor(jnp.asarray(n_zero)))
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    from ..framework import flags
+
+    flags.set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    from ..framework import flags
+
+    flags.set_flags({"FLAGS_check_nan_inf": False})

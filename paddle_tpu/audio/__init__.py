@@ -1,0 +1,199 @@
+"""Audio features (parity: `python/paddle/audio/` — Spectrogram,
+MelSpectrogram, LogMelSpectrogram, MFCC layers + window/mel functionals).
+
+Pure-jnp STFT/mel pipeline; on TPU the FFT lowers to XLA's native FFT HLO.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply
+
+__all__ = ["functional", "features"]
+
+
+# ---- functional ----
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    n = win_length
+    if window == "hann":
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n) / n)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * np.arange(n) / n)
+    elif window == "blackman":
+        x = 2 * np.pi * np.arange(n) / n
+        w = 0.42 - 0.5 * np.cos(x) + 0.08 * np.cos(2 * x)
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(jnp.asarray(w, jnp.dtype(dtype)))
+
+
+def hz_to_mel(freq, htk=False):
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+    f = np.asarray(freq, np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(f / min_log_hz) / logstep, mels)
+
+
+def mel_to_hz(mel, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+    m = np.asarray(mel, np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    f_max = f_max or sr / 2
+    fft_freqs = np.linspace(0, sr / 2, n_fft // 2 + 1)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fb = np.zeros((n_mels, len(fft_freqs)))
+    for i in range(n_mels):
+        lo, ctr, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+        up = (fft_freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - fft_freqs) / max(hi - ctr, 1e-10)
+        fb[i] = np.maximum(0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:n_mels + 2] - hz_pts[:n_mels])
+        fb *= enorm[:, None]
+    return Tensor(jnp.asarray(fb, jnp.dtype(dtype)))
+
+
+def _stft_mag(x, n_fft, hop_length, win):
+    """x: [..., T] -> power spectrogram [..., n_fft//2+1, frames]."""
+    pad = n_fft // 2
+    x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)], mode="reflect")
+    T = x.shape[-1]
+    n_frames = 1 + (T - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])
+    frames = x[..., idx] * win  # [..., frames, n_fft]
+    spec = jnp.fft.rfft(frames, axis=-1)
+    return jnp.moveaxis(jnp.abs(spec) ** 2, -1, -2)
+
+
+# ---- features (Layer classes) ----
+
+class _FeatureModule(Layer):
+    pass
+
+
+class Spectrogram(_FeatureModule):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        w = get_window(window, self.win_length, dtype=dtype)._data
+        if self.win_length < n_fft:
+            lpad = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - self.win_length - lpad))
+        self._win = w
+
+    def forward(self, x):
+        def fn(a):
+            p = _stft_mag(a, self.n_fft, self.hop_length, self._win)
+            return p if self.power == 2.0 else p ** (self.power / 2.0)
+
+        return apply("spectrogram", fn, (x,))
+
+
+class MelSpectrogram(_FeatureModule):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, dtype=dtype)
+        self._fbank = compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype)._data
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        return apply("mel_spectrogram",
+                     lambda s: jnp.einsum("mf,...ft->...mt", self._fbank, s),
+                     (spec,))
+
+
+class LogMelSpectrogram(_FeatureModule):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, n_mels, f_min, f_max, htk, norm,
+                                  dtype)
+        self.amin = amin
+        self.ref_value = ref_value
+        self.top_db = top_db
+
+    def forward(self, x):
+        m = self.mel(x)
+
+        def fn(s):
+            logm = 10.0 * jnp.log10(jnp.maximum(s, self.amin) /
+                                    self.ref_value)
+            if self.top_db is not None:
+                logm = jnp.maximum(logm, logm.max() - self.top_db)
+            return logm
+
+        return apply("log_mel", fn, (m,))
+
+
+class MFCC(_FeatureModule):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 n_mels=64, f_min=50.0, f_max=None, dtype="float32"):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, n_mels=n_mels,
+                                        f_min=f_min, f_max=f_max, dtype=dtype)
+        n = n_mels
+        k = np.arange(n_mfcc)[:, None]
+        dct = np.cos(np.pi / n * (np.arange(n)[None, :] + 0.5) * k) * \
+            math.sqrt(2.0 / n)
+        dct[0] *= math.sqrt(0.5)
+        self._dct = jnp.asarray(dct, jnp.dtype(dtype))
+
+    def forward(self, x):
+        lm = self.logmel(x)
+        return apply("mfcc",
+                     lambda s: jnp.einsum("km,...mt->...kt", self._dct, s),
+                     (lm,))
+
+
+class functional:  # namespace parity: paddle.audio.functional.*
+    get_window = staticmethod(get_window)
+    hz_to_mel = staticmethod(hz_to_mel)
+    mel_to_hz = staticmethod(mel_to_hz)
+    compute_fbank_matrix = staticmethod(compute_fbank_matrix)
+
+
+class features:  # namespace parity: paddle.audio.features.*
+    Spectrogram = Spectrogram
+    MelSpectrogram = MelSpectrogram
+    LogMelSpectrogram = LogMelSpectrogram
+    MFCC = MFCC

@@ -1,3 +1,6 @@
-from .tape import (
+from .tape import (  # noqa: F401
     no_grad, enable_grad, is_grad_enabled, set_grad_enabled, grad, run_backward,
 )
+from .py_layer import PyLayer, PyLayerContext, once_differentiable  # noqa: F401
+from . import functional  # noqa: F401
+from .functional import Jacobian, hessian, jacobian, jvp, vhp, vjp  # noqa: F401

@@ -1,0 +1,142 @@
+"""Functional autograd transforms: jvp/vjp/jacobian/hessian/vhp.
+
+Reference parity: `python/paddle/autograd` functional API (the incubate
+autograd jvp/vjp/Jacobian/Hessian surface, `python/paddle/incubate/autograd`).
+
+TPU-first design: these are direct jax transforms over a functionalized view
+of the user function — no double-backward machinery needed (the reference
+builds these from repeated tape passes; jax gives forward- and
+reverse-mode natively, so `hessian` is one `jax.hessian`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .tape import no_grad
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def _wrap(x):
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap(v) for v in x)
+    return Tensor(x) if hasattr(x, "dtype") else x
+
+
+def _functionalize(func):
+    """Tensor-in/Tensor-out python fn -> array fn (runs the eager code under
+    no_grad on traced arrays; the outer jax transform provides the grads)."""
+
+    def fn(*arrays):
+        with no_grad():
+            out = func(*[Tensor(a) for a in arrays])
+        return _unwrap(out)
+
+    return fn
+
+
+def vjp(func, xs, v=None):
+    """Parity: `paddle.incubate.autograd.vjp(func, xs, v)` ->
+    (func_out, vjp_result)."""
+    single = not isinstance(xs, (list, tuple))
+    xs_t = [xs] if single else list(xs)
+    arrays = [_unwrap(x) for x in xs_t]
+    out, pullback = jax.vjp(_functionalize(func), *arrays)
+    if v is None:
+        ct = jnp.ones_like(out) if not isinstance(out, tuple) else tuple(
+            jnp.ones_like(o) for o in out)
+    else:
+        ct = _unwrap(v)
+    grads = pullback(ct)
+    grads = _wrap(list(grads))
+    return _wrap(out), grads[0] if single else grads
+
+
+def jvp(func, xs, v=None):
+    """Parity: `paddle.incubate.autograd.jvp(func, xs, v)`."""
+    single = not isinstance(xs, (list, tuple))
+    xs_t = [xs] if single else list(xs)
+    arrays = [_unwrap(x) for x in xs_t]
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        v_t = [v] if single else list(v)
+        tangents = [_unwrap(t) for t in v_t]
+    out, jv = jax.jvp(_functionalize(func), tuple(arrays), tuple(tangents))
+    return _wrap(out), _wrap(jv)
+
+
+class Jacobian:
+    """Parity: `paddle.autograd.jacobian` / incubate `Jacobian` — lazy
+    matrix view of d(func)/d(xs)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        single = not isinstance(xs, (list, tuple))
+        xs_t = [xs] if single else list(xs)
+        arrays = [_unwrap(x) for x in xs_t]
+        jac = jax.jacrev(_functionalize(func),
+                         argnums=tuple(range(len(arrays))))(*arrays)
+        self._jac = jac[0] if single else jac
+        self._single = single
+
+    def __getitem__(self, idx):
+        return _wrap(self._jac[idx] if not self._single else self._jac[idx])
+
+    @property
+    def shape(self):
+        j = self._jac if self._single else self._jac[0]
+        return list(j.shape)
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._jac if self._single else self._jac[0])
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    single = not isinstance(xs, (list, tuple))
+    xs_t = [xs] if single else list(xs)
+    arrays = [_unwrap(x) for x in xs_t]
+    jac = jax.jacrev(_functionalize(func),
+                     argnums=tuple(range(len(arrays))))(*arrays)
+    out = _wrap(list(jac))
+    return out[0] if single else out
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """Parity: `paddle.incubate.autograd.hessian` (scalar-output func)."""
+    single = not isinstance(xs, (list, tuple))
+    xs_t = [xs] if single else list(xs)
+    arrays = [_unwrap(x) for x in xs_t]
+    h = jax.hessian(_functionalize(func),
+                    argnums=tuple(range(len(arrays))))(*arrays)
+    if single:
+        return _wrap(h[0][0])
+    return _wrap([[c for c in row] for row in h])
+
+
+def vhp(func, xs, v=None):
+    """vector-Hessian product (parity: incubate autograd vhp)."""
+    single = not isinstance(xs, (list, tuple))
+    xs_t = [xs] if single else list(xs)
+    arrays = [_unwrap(x) for x in xs_t]
+    fn = _functionalize(func)
+
+    grad_fn = jax.grad(fn, argnums=tuple(range(len(arrays))))
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrays)
+    else:
+        v_t = [v] if single else list(v)
+        tangents = tuple(_unwrap(t) for t in v_t)
+    out = fn(*arrays)
+    _, hvp_val = jax.jvp(lambda *a: grad_fn(*a), tuple(arrays), tangents)
+    hvp_w = _wrap(list(hvp_val))
+    return _wrap(out), hvp_w[0] if single else hvp_w

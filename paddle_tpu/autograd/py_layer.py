@@ -1,0 +1,109 @@
+"""PyLayer: user-defined autograd functions.
+
+Reference parity: `paddle.autograd.PyLayer`
+(`python/paddle/autograd/py_layer.py:269`) and the C++ side
+`fluid/pybind/eager_py_layer.cc` — `forward`/`backward` staticmethods with a
+ctx carrying `save_for_backward`.
+
+TPU-first design: the user's backward plugs into the tape as the recorded
+node's pullback directly (no C++ PyLayerNode): forward runs under no_grad,
+then a GradNode is created whose vjp_fn invokes `backward(ctx, *grads)`.
+Because the tape executes pullbacks with plain arrays/tracers, a PyLayer
+works identically in eager mode and inside a compiled TrainStep trace.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tape import GradNode, is_grad_enabled, no_grad
+from ..framework.core import Tensor
+
+
+class PyLayerContext:
+    """Parity: `PyLayerContext` (save_for_backward / saved_tensor /
+    not_inplace-style attrs are free-form)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.needs_input_grad = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_in = [a for a in args if isinstance(a, Tensor)]
+        requires = [isinstance(a, Tensor) and not a.stop_gradient
+                    for a in args]
+        ctx.needs_input_grad = tuple(requires)
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+
+        record = is_grad_enabled() and any(requires)
+        if not record:
+            return out
+
+        n_args = len(args)
+
+        def vjp_fn(cts):
+            cts = cts if isinstance(cts, tuple) else (cts,)
+            ct_tensors = [Tensor(c) for c in cts]
+            grads = cls.backward(ctx, *ct_tensors)
+            grads = grads if isinstance(grads, (tuple, list)) else (grads,)
+            grad_arrays = []
+            gi = iter(grads)
+            for a, req in zip(args, requires):
+                if not isinstance(a, Tensor):
+                    grad_arrays.append(None)
+                    continue
+                g = next(gi, None)
+                grad_arrays.append(
+                    g._data if isinstance(g, Tensor)
+                    else (jnp.asarray(g) if g is not None else None))
+            # tape contract: one cotangent per recorded operand
+            return tuple(
+                g if g is not None else jnp.zeros(a._data.shape, a._data.dtype)
+                for a, g in zip(args, grad_arrays) if isinstance(a, Tensor)
+            )
+
+        in_tensors = [a for a in args if isinstance(a, Tensor)]
+        in_requires = [not t.stop_gradient for t in in_tensors]
+        out_avals = [(o._data.shape, o._data.dtype) for o in outs]
+        node = GradNode(cls.__name__, vjp_fn, in_tensors, in_requires,
+                        out_avals, multi=len(outs) > 1)
+
+        import weakref
+
+        results = []
+        for i, o in enumerate(outs):
+            t = Tensor(o._data, stop_gradient=False)
+            t._grad_node = node
+            t._out_index = i
+            node.out_tensor_refs[i] = weakref.ref(t)
+            results.append(t)
+        return tuple(results) if multi else results[0]
+
+
+def once_differentiable(fn):  # decorator parity
+    return fn

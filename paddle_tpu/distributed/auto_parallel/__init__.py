@@ -1,0 +1,214 @@
+"""Semi-automatic parallelism (parity: `python/paddle/distributed/auto_parallel/`).
+
+Reference parity: `shard_tensor` annotations (`interface.py:28`), ProcessMesh,
+and the `Engine` train driver (`static/engine.py:55` — fit/evaluate/predict
+over an annotated model). The reference's Completer/Partitioner/Resharder
+compiler stages (`completion.py`, `partitioner.py`, `reshard.py`) ARE
+XLA's GSPMD propagation (SURVEY §2.6 "TPU build"), so this module is thin:
+mesh description + annotations + a fit driver over the whole-step compiled
+TrainStep.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import env as env_mod
+from ..shard import shard_tensor as _shard_tensor_spec
+from ...framework.core import Tensor
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Shard", "Replicate",
+           "Partial", "Engine", "Strategy", "to_static"]
+
+
+class Shard:
+    """Placement: shard along tensor dim `dim` (parity: dist.Shard)."""
+
+    def __init__(self, dim):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate:
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial:
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+
+class ProcessMesh:
+    """Parity: `paddle.distributed.ProcessMesh(mesh, dim_names)`. Maps to
+    (a view of) the global device mesh: dim_names must be mesh axis names."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None,
+                 process_ids=None):
+        if shape is None and mesh is not None:
+            shape = np.asarray(mesh).shape
+        self.shape = list(shape) if shape is not None else []
+        self.dim_names = list(dim_names) if dim_names else \
+            [f"d{i}" for i in range(len(self.shape))]
+        self.process_ids = process_ids
+
+    def __getitem__(self, idx):
+        return ProcessMesh(shape=self.shape[1:], dim_names=self.dim_names[1:])
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+
+_DIM_ALIAS = {"x": "dp", "y": "mp", "z": "pp", "dp": "dp", "mp": "mp",
+              "tp": "mp", "pp": "pp", "sharding": "sharding", "sep": "sep"}
+
+
+def shard_tensor(x, mesh=None, placements=None, **kwargs):
+    """Parity: `dist.shard_tensor(x, process_mesh, placements)` with
+    Shard/Replicate placement objects; maps mesh dim names onto the global
+    mesh axes."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    if placements is None:
+        return t
+    ndim = t.ndim
+    parts = [None] * ndim
+    dim_names = mesh.dim_names if isinstance(mesh, ProcessMesh) else []
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            name = dim_names[mesh_dim] if mesh_dim < len(dim_names) else "dp"
+            parts[p.dim] = _DIM_ALIAS.get(name, name)
+    return _shard_tensor_spec(t, spec=tuple(parts))
+
+
+def shard_op(op_fn, mesh=None, in_placements=None, out_placements=None):
+    """Parity: `dist.shard_op` — annotations on an op call; GSPMD derives
+    the rest, so this is a passthrough wrapper."""
+
+    def wrapped(*args, **kwargs):
+        return op_fn(*args, **kwargs)
+
+    return wrapped
+
+
+class Strategy:
+    """Parity: `auto_parallel.Strategy` (strategy.py + constants.py)."""
+
+    class _Section(dict):
+        def __getattr__(self, k):
+            return self.get(k)
+
+        def __setattr__(self, k, v):
+            self[k] = v
+
+    def __init__(self, config=None):
+        self.amp = Strategy._Section(enable=False, dtype="float16", level="o1")
+        self.recompute = Strategy._Section(enable=False)
+        self.sharding = Strategy._Section(enable=False, degree=1, stage=1)
+        self.pipeline = Strategy._Section(enable=False, schedule_mode="1F1B",
+                                          accumulate_steps=1)
+        self.gradient_merge = Strategy._Section(enable=False, k_steps=1)
+        self.fused_passes = Strategy._Section(enable=False)
+
+
+class Engine:
+    """Parity: `auto_parallel.Engine(model, loss, optimizer, metrics,
+    strategy)` (`static/engine.py:55`): fit/evaluate/predict drive the
+    GSPMD-compiled train step; dist_saver-style save/load via
+    `distributed.checkpoint`."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics or []
+        self._strategy = strategy or Strategy()
+        env_mod.ensure_env()
+        self._train_step = None
+
+    def _ensure_step(self):
+        if self._train_step is None:
+            from ...jit.train_step import TrainStep
+
+            def loss_fn(model, *batch):
+                n_in = max(len(batch) - 1, 1)
+                outs = model(*batch[:n_in])
+                if self._loss is None:
+                    return outs
+                loss = self._loss(outs, *batch[n_in:])
+                return loss.mean() if loss.ndim else loss
+
+            self._train_step = TrainStep(self._model, self._optimizer,
+                                         loss_fn)
+        return self._train_step
+
+    def fit(self, train_data, train_sample_split=None, batch_size=1,
+            epochs=1, steps_per_epoch=None, log_freq=10, valid_data=None,
+            **kwargs):
+        from ...io.reader import DataLoader
+
+        step_fn = self._ensure_step()
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=True)
+        history = []
+        for epoch in range(epochs):
+            for i, batch in enumerate(loader):
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                loss = step_fn(*batch)
+                if i % log_freq == 0:
+                    history.append(float(loss.numpy()))
+                if steps_per_epoch and i + 1 >= steps_per_epoch:
+                    break
+        return history
+
+    def evaluate(self, valid_data, batch_size=1, steps=None, **kwargs):
+        from ...autograd.tape import no_grad
+        from ...io.reader import DataLoader
+
+        loader = valid_data if isinstance(valid_data, DataLoader) else \
+            DataLoader(valid_data, batch_size=batch_size)
+        losses = []
+        with no_grad():
+            for i, batch in enumerate(loader):
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                n_in = max(len(batch) - 1, 1)
+                outs = self._model(*batch[:n_in])
+                if self._loss is not None:
+                    loss = self._loss(outs, *batch[n_in:])
+                    losses.append(float(np.asarray(loss.numpy()).mean()))
+                if steps and i + 1 >= steps:
+                    break
+        return {"loss": float(np.mean(losses))} if losses else {}
+
+    def predict(self, test_data, batch_size=1, steps=None, **kwargs):
+        from ...autograd.tape import no_grad
+        from ...io.reader import DataLoader
+
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size)
+        outs = []
+        with no_grad():
+            for i, batch in enumerate(loader):
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                outs.append(self._model(*batch[:max(len(batch) - 1, 1)]))
+                if steps and i + 1 >= steps:
+                    break
+        return outs
+
+    def save(self, path, training=True):
+        from ..checkpoint import save_state_dict
+
+        save_state_dict(dict(self._model.named_parameters()), path)
+
+    def load(self, path, strict=True, load_optimizer=True):
+        from ..checkpoint import load_state_dict
+
+        load_state_dict(dict(self._model.named_parameters()), path)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """Parity: `dist.to_static` — returns an Engine-like compiled wrapper."""
+    return Engine(model=layer, loss=loss, optimizer=optimizer,
+                  strategy=strategy)

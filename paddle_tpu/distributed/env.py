@@ -114,6 +114,10 @@ def init_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1,
     mesh = Mesh(arr, AXIS_ORDER)
     _global_env = ParallelEnv(mesh, degrees)
     _install_mesh_hook(mesh)
+    from .fleet.base import topology as _topo
+
+    if _topo.get_hcg() is not None:  # rebuild the view over the new mesh
+        _topo.set_hcg(_topo.HybridCommunicateGroup())
     return _global_env
 
 
@@ -182,6 +186,12 @@ def reset_env():
 
     _dispatch.set_mesh_hook(None)
     _core.set_param_place_hook(None)
+    # fleet-side globals snapshot the env; clear them too
+    from .fleet.base import topology as _topo
+    from . import fleet as _fleet
+
+    _topo.set_hcg(None)
+    _fleet._fleet_strategy = None
 
 
 def get_mesh() -> Mesh | None:

@@ -1,0 +1,114 @@
+"""Elastic training: membership, failure detection, scale up/down.
+
+Reference parity: `ElasticManager` (`fleet/elastic/manager.py:126`) — etcd3
+node registration, heartbeat + watch on the member set, scale decision,
+kill-and-relaunch of local trainers with rewritten env.
+
+TPU-first design: membership rides our own C++ TCPStore
+(`distributed/store.py`) instead of etcd — heartbeat keys with host ids,
+the master watches the key-set; on membership change the decision is
+relaunch-and-re-pjit: checkpoints are reshard-on-load
+(`distributed/checkpoint.py`), so a job restarted on a different mesh shape
+resumes exactly (SURVEY §5.3 "elastic = re-pjit on new mesh after relaunch").
+Slice health itself comes from the TPU runtime via jax device health.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = ["ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = 1
+    ERROR = 2
+    HOLD = 3
+    RESTART = 4
+    EXIT = 5
+
+
+class ElasticManager:
+    """Heartbeat-based membership over TCPStore.
+
+    master: `ElasticManager(job_id, rank=0, is_master=True)` — starts the
+    store server and the watcher. workers: connect with the master address.
+    `watch()` returns an ElasticStatus when membership changes or the
+    job completes.
+    """
+
+    def __init__(self, job_id="default", rank=0, hosts=None, is_master=None,
+                 host=None, port=0, np=1, heartbeat_interval=2.0,
+                 timeout=10.0):
+        from ...store import TCPStore
+
+        self.job_id = job_id
+        self.rank = rank
+        self.np = int(np)
+        self.heartbeat_interval = heartbeat_interval
+        self.timeout = timeout
+        is_master = (rank == 0) if is_master is None else is_master
+        addr = host or os.environ.get("PADDLE_ELASTIC_SERVER",
+                                      "127.0.0.1")
+        self.store = TCPStore(host=addr, port=port, is_master=is_master,
+                              timeout=timeout)
+        self.port = self.store.port
+        self._stop = threading.Event()
+        self._node_key = f"{job_id}/nodes/{rank}"
+        self._members_at_start = None
+        self._hb = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        self._hb.start()
+
+    # -- membership --
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            self.store.set(self._node_key, str(time.time()))
+            self._stop.wait(self.heartbeat_interval)
+
+    def alive_nodes(self):
+        now = time.time()
+        alive = []
+        for r in range(self.np):
+            try:
+                ts = float(self.store.get(f"{self.job_id}/nodes/{r}"))
+            except KeyError:
+                continue
+            if now - ts <= self.timeout:
+                alive.append(r)
+        return alive
+
+    def wait_for_np(self, np=None, timeout=60.0):  # noqa: A002
+        want = np or self.np
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if len(self.alive_nodes()) >= want:
+                return True
+            time.sleep(self.heartbeat_interval / 2)
+        return False
+
+    def watch(self):
+        """Blocks until membership changes (RESTART) or completion (EXIT)."""
+        if self._members_at_start is None:
+            self._members_at_start = set(self.alive_nodes())
+        while not self._stop.is_set():
+            try:
+                self.store.get(f"{self.job_id}/completed")
+                return ElasticStatus.COMPLETED
+            except KeyError:
+                pass
+            cur = set(self.alive_nodes())
+            if cur != self._members_at_start:
+                self._members_at_start = cur
+                return ElasticStatus.RESTART
+            time.sleep(self.heartbeat_interval)
+        return ElasticStatus.EXIT
+
+    def mark_completed(self):
+        self.store.set(f"{self.job_id}/completed", "1")
+
+    def exit(self, completed=False):
+        if completed:
+            self.mark_completed()
+        self._stop.set()
+        self._hb.join(timeout=5)

@@ -1,0 +1,3 @@
+"""Distributed launcher (parity: `python -m paddle.distributed.launch`,
+reference `launch/main.py:18`, `launch/controllers/collective.py`)."""
+from .main import main  # noqa: F401

@@ -1,0 +1,114 @@
+"""`python -m paddle_tpu.distributed.launch` — the trainer launcher.
+
+Reference parity: `launch/main.py:18` and `CollectiveController.build_pod`
+(`launch/controllers/collective.py:37,124-220`): builds the node's process
+set, assigns `PADDLE_TRAINER_ID`/`PADDLE_TRAINERS_NUM`/`PADDLE_MASTER` env,
+spawns and babysits workers, relaunching or tearing down on failure.
+
+TPU-first design: single-controller SPMD needs ONE process per *host* (it
+drives every local chip), not one per device — so `--nproc_per_node`
+defaults to 1 and the reference's GPU-visibility plumbing
+(FLAGS_selected_gpus) has no equivalent. Multi-host: the launcher stamps the
+coordinator address (PADDLE_MASTER) consumed by
+`init_parallel_env` -> `jax.distributed.initialize`. A local
+`--nnodes`-style simulation spawns N processes with
+JAX_PLATFORMS=cpu for testing the multi-process path without TPUs.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse():
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch distributed training (single-controller SPMD)")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="number of hosts (or host range 'N:M' for elastic)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (SPMD default: 1)")
+    p.add_argument("--master", type=str, default=None,
+                   help="coordinator ip:port (defaults to this host)")
+    p.add_argument("--rank", type=int, default=-1, help="node rank")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--devices", type=str, default=None,
+                   help="visible device ids (sets JAX local device filter)")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def _spawn(args, rank, nprocs, master):
+    env = dict(os.environ)
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    env["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    env["PADDLE_RANK_IN_NODE"] = str(rank)
+    env["PADDLE_JOB_ID"] = args.job_id
+    if master:
+        env["PADDLE_MASTER"] = master
+    if args.devices is not None:
+        env["TPU_VISIBLE_DEVICES"] = args.devices
+    os.makedirs(args.log_dir, exist_ok=True)
+    log = open(os.path.join(args.log_dir,
+                            f"workerlog.{rank}"), "ab", buffering=0)
+    cmd = ([sys.executable, "-u", args.training_script]
+           + args.training_script_args)
+    proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=subprocess.STDOUT)
+    return proc, log
+
+
+def main():
+    args = _parse()
+    nnodes = int(str(args.nnodes).split(":")[0])
+    nprocs = args.nproc_per_node * nnodes if nnodes > 1 and args.rank < 0 \
+        else args.nproc_per_node
+    master = args.master
+    if nprocs > 1 and master is None:
+        master = "127.0.0.1:49178"
+
+    procs = []
+    restarts = 0
+    try:
+        for r in range(nprocs):
+            procs.append(_spawn(args, r, nprocs, master))
+        while True:
+            states = [p.poll() for p, _ in procs]
+            if all(s is not None for s in states):
+                bad = [s for s in states if s != 0]
+                sys.exit(bad[0] if bad else 0)
+            failed = [i for i, s in enumerate(states) if s not in (None, 0)]
+            if failed:
+                if restarts >= args.max_restart:
+                    for p, _ in procs:
+                        if p.poll() is None:
+                            p.send_signal(signal.SIGTERM)
+                    sys.exit(states[failed[0]])
+                # elastic-lite: relaunch the whole pod (reference
+                # ElasticManager kills and relaunches local trainers)
+                restarts += 1
+                for p, _ in procs:
+                    if p.poll() is None:
+                        p.send_signal(signal.SIGTERM)
+                for p, _ in procs:
+                    p.wait()
+                procs = [
+                    _spawn(args, r, nprocs, master) for r in range(nprocs)
+                ]
+            time.sleep(0.5)
+    finally:
+        for p, log in procs:
+            if p.poll() is None:
+                p.terminate()
+            log.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,133 @@
+"""TCPStore: native KV rendezvous store.
+
+Reference parity: `paddle/phi/core/distributed/store/tcp_store.{h,cc}` (the
+C++ master/worker bootstrap store) and its python binding used by
+`init_parallel_env` (`parallel.py:858` `_start_kv_server`).
+
+The server/client are C++ (`native/tcp_store.cpp`), compiled on first use
+via `paddle_tpu.utils.cpp_extension.load` (same mechanism users get for
+custom ops) and driven through ctypes. jax's own coordination service
+bootstraps the XLA runtime; this store carries framework-level rendezvous:
+elastic membership, user barriers, launcher coordination.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "tcp_store.cpp")
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        from ..utils.cpp_extension import load
+
+        _lib = load("paddle_tpu_tcp_store", [_SRC])
+        _lib.tcp_store_server_start.restype = ctypes.c_void_p
+        _lib.tcp_store_server_start.argtypes = [ctypes.c_int]
+        _lib.tcp_store_server_port.argtypes = [ctypes.c_void_p]
+        _lib.tcp_store_server_port.restype = ctypes.c_int
+        _lib.tcp_store_server_stop.argtypes = [ctypes.c_void_p]
+        _lib.tcp_store_client_connect.restype = ctypes.c_void_p
+        _lib.tcp_store_client_connect.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        _lib.tcp_store_client_close.argtypes = [ctypes.c_void_p]
+        _lib.tcp_store_set.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        _lib.tcp_store_set.restype = ctypes.c_int
+        _lib.tcp_store_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        _lib.tcp_store_get.restype = ctypes.c_int
+        _lib.tcp_store_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+        _lib.tcp_store_add.restype = ctypes.c_longlong
+        _lib.tcp_store_wait.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_int]
+        _lib.tcp_store_wait.restype = ctypes.c_int
+        _lib.tcp_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        _lib.tcp_store_delete.restype = ctypes.c_int
+    return _lib
+
+
+class TCPStore:
+    """Parity: `paddle.distributed.TCPStore(host, port, world_size,
+    is_master, timeout)` — master also runs the in-process C++ server."""
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=900):
+        lib = _load()
+        self._lib = lib
+        self._server = None
+        self._timeout_ms = int(timeout * 1000)
+        if is_master:
+            self._server = lib.tcp_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot listen on port {port}")
+            port = lib.tcp_store_server_port(self._server)
+        self.host = host
+        self.port = port
+        self._client = lib.tcp_store_client_connect(
+            host.encode(), port, self._timeout_ms)
+        if not self._client:
+            self._shutdown_server()
+            raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+
+    # -- KV API (paddle/torch-shaped) --
+    def set(self, key, value):
+        data = value if isinstance(value, bytes) else str(value).encode()
+        rc = self._lib.tcp_store_set(self._client, key.encode(), data,
+                                     len(data))
+        if rc < 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key):
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = self._lib.tcp_store_get(self._client, key.encode(), buf,
+                                    len(buf))
+        if n == -1:
+            raise KeyError(key)
+        if n < 0:
+            raise RuntimeError("TCPStore.get failed")
+        return buf.raw[:n]
+
+    def add(self, key, amount=1):
+        res = self._lib.tcp_store_add(self._client, key.encode(), amount)
+        if res < 0 and amount >= 0:
+            raise RuntimeError("TCPStore.add failed")
+        return int(res)
+
+    def wait(self, keys, timeout=None):
+        if isinstance(keys, str):
+            keys = [keys]
+        to = int((timeout or self._timeout_ms / 1000) * 1000)
+        buf = ctypes.create_string_buffer(1 << 20)
+        for k in keys:
+            n = self._lib.tcp_store_wait(self._client, k.encode(), to, buf,
+                                         len(buf))
+            if n == -1:
+                raise TimeoutError(f"TCPStore.wait timed out on {k!r}")
+            if n < -1:
+                raise RuntimeError("TCPStore.wait failed")
+
+    def delete_key(self, key):
+        return self._lib.tcp_store_delete(self._client, key.encode()) >= 0
+
+    def _shutdown_server(self):
+        if self._server:
+            self._lib.tcp_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            if getattr(self, "_client", None):
+                self._lib.tcp_store_client_close(self._client)
+                self._client = None
+            self._shutdown_server()
+        except Exception:
+            pass

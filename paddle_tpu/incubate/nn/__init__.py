@@ -1,0 +1,160 @@
+"""Fused transformer layers (parity: `python/paddle/incubate/nn/` —
+FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
+fused functional ops).
+
+TPU-first design: "fused" on TPU means "compiled as one XLA fusion region +
+flash-attention Pallas kernel", not a hand-written megakernel — these layers
+express the fused pattern (no intermediate layout round-trips, single
+residual+norm epilogue) and XLA does the fusing.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import tensor as T
+from ...framework.core import Tensor
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer
+from ...nn.layer.norm import LayerNorm
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "functional"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """Parity: `incubate.nn.FusedMultiHeadAttention` — pre/post-LN MHA with
+    fused QKV projection and flash attention."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.0,
+                 attn_dropout_rate=0.0, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, linear_weight_attr=None,
+                 pre_ln_scale_attr=None, ln_scale_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.qkv_weight = self.create_parameter(
+            [embed_dim, 3 * embed_dim], attr=qkv_weight_attr,
+            default_initializer=I.XavierUniform())
+        self.qkv_bias = self.create_parameter([3 * embed_dim], is_bias=True)
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr,
+            default_initializer=I.XavierUniform())
+        self.linear_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.pre_ln = LayerNorm(embed_dim, epsilon=epsilon)
+        self.ln = LayerNorm(embed_dim, epsilon=epsilon)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        residual = x
+        if self.normalize_before:
+            x = self.pre_ln(x)
+        b, s = x.shape[0], x.shape[1]
+        qkv = F.linear(x, self.qkv_weight, self.qkv_bias)
+        q, k, v = T.split(qkv, 3, axis=-1)
+        q = q.reshape([b, s, self.num_heads, self.head_dim])
+        k = k.reshape([b, s, self.num_heads, self.head_dim])
+        v = v.reshape([b, s, self.num_heads, self.head_dim])
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask, self.attn_dropout_rate,
+            training=self.training)
+        out = out.reshape([b, s, self.embed_dim])
+        out = F.linear(out, self.linear_weight, self.linear_bias)
+        out = F.dropout(out, self.dropout_rate, training=self.training)
+        out = residual + out
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """Parity: `incubate.nn.FusedFeedForward`."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear2_weight_attr=None, ln1_scale_attr=None, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (act_dropout_rate if act_dropout_rate
+                                 is not None else dropout_rate)
+        self.activation = activation
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr,
+            default_initializer=I.XavierUniform())
+        self.linear1_bias = self.create_parameter([dim_feedforward],
+                                                  is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr,
+            default_initializer=I.XavierUniform())
+        self.linear2_bias = self.create_parameter([d_model], is_bias=True)
+        self.ln = LayerNorm(d_model, epsilon=epsilon)
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        act = getattr(F, self.activation)
+        h = act(F.linear(x, self.linear1_weight, self.linear1_bias))
+        h = F.dropout(h, self.act_dropout_rate, training=self.training)
+        h = F.linear(h, self.linear2_weight, self.linear2_bias)
+        h = F.dropout(h, self.dropout_rate, training=self.training)
+        out = residual + h
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False, name=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate,
+            attn_dropout_rate if attn_dropout_rate is not None
+            else dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, src_mask))
+
+
+class functional:
+    """Namespace parity: `paddle.incubate.nn.functional.*`."""
+
+    @staticmethod
+    def fused_rotary_position_embedding(q, k, v=None, sin=None, cos=None,
+                                        position_ids=None,
+                                        use_neox_rotary_style=True):
+        from ...models.llama import apply_rotary_pos_emb
+
+        q2, k2 = apply_rotary_pos_emb(q, k)
+        return (q2, k2, v) if v is not None else (q2, k2, None)
+
+    @staticmethod
+    def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+        if transpose_weight:
+            return F.linear(x, weight.t(), bias)
+        return F.linear(x, weight, bias)
+
+    @staticmethod
+    def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                          name=None):
+        return F.dropout(x, p, training=training, mode=mode) + y
+
+    @staticmethod
+    def swiglu(x, y=None, name=None):
+        if y is None:
+            x, y = T.split(x, 2, axis=-1)
+        return F.silu(x) * y

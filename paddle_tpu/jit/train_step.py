@@ -25,7 +25,6 @@ import jax.numpy as jnp
 from ..autograd import tape
 from ..framework import random as rng
 from ..framework.core import Tensor
-from ..optimizer.lr import LRScheduler
 
 
 class TrainStep:
@@ -37,9 +36,15 @@ class TrainStep:
     Usage:
         step = TrainStep(model, opt, lambda m, x, y: m(x, y))
         loss = step(x, y)          # Tensors or arrays
+
+    donate=True enables XLA buffer donation (in-place HBM update — halves
+    peak memory for params+optimizer state). The cost: optimizer-state
+    arrays snapshotted between steps (e.g. a held state_dict) are
+    invalidated by the next call, so keep it off when checkpointing
+    mid-run from external references.
     """
 
-    def __init__(self, model, optimizer, loss_fn=None, donate=True):
+    def __init__(self, model, optimizer, loss_fn=None, donate=False):
         self._model = model
         self._opt = optimizer
         self._loss_fn = loss_fn or (lambda m, *batch: m(*batch))
@@ -78,16 +83,33 @@ class TrainStep:
         if self._state:
             return
         opt = self._opt
+        self._step_count = opt._global_step
         for p in self._params:
             arr = p._data
             low_prec = arr.dtype.name in ("bfloat16", "float16")
+            existing = opt._accumulators.get(id(p))
             if opt._multi_precision and low_prec:
-                master = opt._place_master(arr.astype(jnp.float32))
-                self._state.append(opt._place_state(opt._init_state(master)))
+                master = opt._master_weights.get(id(p))
+                if master is None:
+                    master = opt._place_master(arr.astype(jnp.float32))
+                self._state.append(existing if existing is not None else
+                                   opt._place_state(opt._init_state(master)))
                 self._masters.append(master)
             else:
-                self._state.append(opt._place_state(opt._init_state(arr)))
+                self._state.append(existing if existing is not None else
+                                   opt._place_state(opt._init_state(arr)))
                 self._masters.append(None)
+
+    def _sync_optimizer(self):
+        """Mirror functional state back onto the Optimizer's dict form so
+        optimizer.state_dict()/checkpointing sees compiled-path training."""
+        opt = self._opt
+        opt._global_step = self._step_count
+        for p, st, m in zip(self._params, self._state, self._masters):
+            opt._accumulators[id(p)] = st
+            opt._step_counts[id(p)] = self._step_count
+            if m is not None:
+                opt._master_weights[id(p)] = m
 
     def _flatten_state(self):
         flat = []
@@ -205,8 +227,7 @@ class TrainStep:
         self._state, self._masters = self._unflatten_state(flat_state)
         for b, a in zip(self._buffers, new_buffers):
             b._data = a
-        if isinstance(self._opt._learning_rate, LRScheduler):
-            pass  # caller drives scheduler.step(), paddle-style
+        self._sync_optimizer()
         return Tensor(loss)
 
     # -- introspection --

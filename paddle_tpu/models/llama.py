@@ -52,6 +52,7 @@ class LlamaConfig:
         rope_theta=10000.0,
         tie_word_embeddings=False,
         sequence_parallel=False,
+        context_parallel=False,
         use_parallel_cross_entropy=True,
         recompute=False,
         dtype="float32",
@@ -71,6 +72,7 @@ class LlamaConfig:
         self.rope_theta = rope_theta
         self.tie_word_embeddings = tie_word_embeddings
         self.sequence_parallel = sequence_parallel
+        self.context_parallel = context_parallel
         self.use_parallel_cross_entropy = use_parallel_cross_entropy
         self.recompute = recompute
         self.dtype = dtype
@@ -160,7 +162,12 @@ class LlamaAttention(Layer):
         q = shard.sharding_constraint(q, None, None, "mp", None)
         k = shard.sharding_constraint(k, None, None, "mp", None)
         v = shard.sharding_constraint(v, None, None, "mp", None)
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        if cfg.context_parallel:
+            # ring attention over the 'sep' axis: exact attention with the
+            # sequence sharded across chips (long-context path)
+            out = F.ring_flash_attention(q, k, v, axis="sep", causal=True)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         return self.o_proj(out)
 

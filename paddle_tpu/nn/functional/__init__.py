@@ -35,5 +35,5 @@ from .loss import (  # noqa: F401
     square_error_cost, log_loss, ctc_loss, sigmoid_focal_loss,
 )
 from .attention import (  # noqa: F401
-    scaled_dot_product_attention, flash_attention,
+    scaled_dot_product_attention, flash_attention, ring_flash_attention,
 )

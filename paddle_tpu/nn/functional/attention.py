@@ -79,3 +79,27 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     if return_softmax:
         return out, None
     return out, None
+
+
+_ring_cache: dict = {}
+
+
+def ring_flash_attention(query, key, value, axis="sep", causal=True,
+                         name=None):
+    """Context-parallel exact attention: sequence sharded over mesh ``axis``,
+    KV blocks rotating on the ICI ring (`ops/ring_attention.py`). Exceeds the
+    reference (SURVEY §5.7: no ring/context parallelism in the snapshot).
+    Degree-1 axes fall back to the regular flash_attention path."""
+    from ...distributed import env as env_mod
+    from ...ops.ring_attention import make_ring_attention
+
+    e = env_mod.ensure_env()
+    if e.degree(axis) <= 1:
+        return scaled_dot_product_attention(query, key, value,
+                                            is_causal=causal)
+
+    ring = _ring_cache.get((e.mesh, axis, causal))
+    if ring is None:
+        ring = make_ring_attention(e.mesh, axis=axis, causal=causal)
+        _ring_cache[(e.mesh, axis, causal)] = ring
+    return apply("ring_flash_attention", ring, (query, key, value))

@@ -1,3 +1,4 @@
 from . import dispatch, registry
 from .dispatch import apply, apply_nondiff
 from .registry import register_kernel, list_ops, op_stats
+from . import pallas  # registers TPU kernel overrides (inert off-TPU)

@@ -59,6 +59,19 @@ def set_check_hook(fn):
     _check_hook = fn
 
 
+# Program-capture hook: set by paddle_tpu.static while building a Program.
+# Called as fn(op_name, kernel_fn, operands, static_kwargs, results) after
+# each dispatch, recording the op into the current static Program (the
+# TPU analogue of appending an OpDesc to the current Block —
+# `python/paddle/fluid/framework.py` append_op).
+_program_hook = None
+
+
+def set_program_hook(fn):
+    global _program_hook
+    _program_hook = fn
+
+
 def _unwrap(x):
     return x._data if isinstance(x, Tensor) else x
 
@@ -139,6 +152,9 @@ def apply(op_name, fn, operands, n_outputs=None, **static):
             node.out_tensor_refs[i] = weakref.ref(t)
         results.append(t)
 
+    if _program_hook is not None:
+        _program_hook(op_name, fn, operands, static, results)
+
     return tuple(results) if multi else results[0]
 
 
@@ -151,5 +167,10 @@ def apply_nondiff(op_name, fn, operands, **static):
         arrays = _mesh_hook(arrays)
     out = fn(*arrays, **static)
     if isinstance(out, (tuple, list)):
-        return tuple(Tensor(o) for o in out)
-    return Tensor(out)
+        results = tuple(Tensor(o) for o in out)
+    else:
+        results = Tensor(out)
+    if _program_hook is not None:
+        _program_hook(op_name, fn, operands, static,
+                      list(results) if isinstance(results, tuple) else [results])
+    return results

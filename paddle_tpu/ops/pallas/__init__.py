@@ -1,0 +1,10 @@
+"""Pallas TPU kernel overrides (the reference's hand-written CUDA/CUTLASS
+kernel layer — `phi/kernels/fusion/`, external flashattn — reimagined as
+Mosaic kernels). Importing this package registers every kernel for platform
+'tpu'; the registry only selects them when running on TPU."""
+from . import flash_attention as _fa
+
+_fa.register(platform="tpu")
+
+flash_attention_kernel = _fa.flash_attention_kernel
+register_flash_attention = _fa.register

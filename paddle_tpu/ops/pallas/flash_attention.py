@@ -1,0 +1,306 @@
+"""FlashAttention forward/backward as Pallas TPU kernels.
+
+Reference parity: the reference binds the external FlashAttention CUDA
+library as a PHI kernel (`paddle/phi/kernels/gpu/flash_attn_kernel.cu`,
+`cmake/external/flashattn.cmake`). Here the same role is played by a
+tiled streaming-softmax kernel pair written in Pallas (SURVEY §5.7:
+"implement splash/flash attention in Pallas").
+
+Algorithm: FlashAttention-2. Forward streams K/V blocks through VMEM with a
+running (max, sum) softmax, never materializing the [sq, sk] score matrix in
+HBM; saves per-row logsumexp for backward. Backward recomputes scores per
+block (dq kernel over q-rows, dkv kernel over k-columns), also O(block²)
+VMEM only. Layout: [batch, seq, heads, head_dim] — paddle's flash-attn
+layout — processed as one (batch·head) per grid row.
+
+Registered as the 'flash_attention' kernel override for platform 'tpu', so
+`paddle.nn.functional.scaled_dot_product_attention` transparently uses it on
+TPU (mask / dropout calls fall back to the XLA composite implementation).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .. import registry
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
+                block_k, seq_k):
+    # q_ref: [block_q, d]; k_ref/v_ref: [seq_k, d] (whole K/V row in VMEM)
+    q_idx = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32) * scale
+
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, d), jnp.float32)
+
+    n_kb = seq_k // block_k
+    # causal: only stream K blocks up to (and including) the diagonal
+    if causal:
+        q_end = (q_idx + 1) * block_q  # rows cover [q_idx*bq, q_end)
+        n_kb_eff = pl.cdiv(q_end, block_k)
+    else:
+        n_kb_eff = n_kb
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            rows = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_kb_eff, body, (m, l, acc))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, causal, scale, block_k, seq_k):
+    q_idx = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    dq = jnp.zeros_like(q)
+
+    if causal:
+        n_kb_eff = pl.cdiv((q_idx + 1) * block_q, block_k)
+    else:
+        n_kb_eff = seq_k // block_k
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            rows = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, n_kb_eff, body, dq)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, causal, scale, block_q, seq_q):
+    k_idx = pl.program_id(1)
+    block_k = k_ref.shape[1]
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    dk = jnp.zeros_like(k)
+    dv = jnp.zeros_like(v)
+
+    n_qb = seq_q // block_q
+    if causal:
+        qb_start = (k_idx * block_k) // block_q  # first q block on/after diag
+    else:
+        qb_start = 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            rows = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = k_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(qb_start, n_qb, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _pick_block(seq, target=512):
+    b = min(seq, target)
+    while seq % b:
+        b //= 2
+    return max(b, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhsd(q, k, v, causal, scale, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, scale, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, interpret):
+    """q,k,v: [bh, s, d] -> (out [bh, s, d], lse [bh, s])."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = _pick_block(sq)
+    block_k = _pick_block(sk)
+    grid = (bh, sq // block_q)
+    kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                               block_k=block_k, seq_k=sk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=int(4 * bh * sq * sk * d * (0.5 if causal else 1.0)),
+            bytes_accessed=int(q.size * 2 + k.size * 2 + v.size * 2),
+            transcendentals=int(bh * sq * sk),
+        ),
+    )(q, k, v)
+    return out, lse
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, scale, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, scale, interpret, res, g):
+    q, k, v, out, lse = res
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = _pick_block(sq)
+    block_k = _pick_block(sk)
+    g = g.astype(q.dtype)
+    # delta_i = sum_d(do * o) per row (FlashAttention-2 eq. for ds)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)  # [bh, sq]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
+                          block_k=block_k, seq_k=sk),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
+                          block_q=block_q, seq_q=sq),
+        grid=(bh, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, sq), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, sq), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
+                           interpret=False):
+    """Kernel-registry entry: [b, s, h, d] inputs, same signature as the
+    default XLA implementation in nn/functional/attention.py. Falls back to
+    the composite path for masks/dropout/odd shapes."""
+    if rest or dropout > 0.0:
+        from ...nn.functional.attention import _sdpa_reference
+
+        return _sdpa_reference(q, k, v, *rest, causal=causal, dropout=dropout)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if sq < 16 or sk < 16 or d % 128 or k.shape[2] != h:
+        from ...nn.functional.attention import _sdpa_reference
+
+        return _sdpa_reference(q, k, v, causal=causal, dropout=0.0)
+    scale = 1.0 / math.sqrt(d)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    out = _flash_bhsd(qt, kt, vt, causal, scale, interpret)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def register(platform="tpu", interpret=False):
+    fn = functools.partial(flash_attention_kernel, interpret=interpret)
+    registry.register_kernel("flash_attention", platform)(fn)
+    return fn

@@ -1,0 +1,171 @@
+"""Quantization: QAT fake-quant + PTQ observers.
+
+Reference parity: `python/paddle/quantization/` — `QuantConfig`, `QAT`
+(fake-quant insertion with straight-through estimator), `PTQ` (observer
+collection + convert), quanted layer variants.
+
+TPU-first design: int8 matmuls on TPU go through XLA's native int8 MXU path;
+fake-quant here is the standard symmetric per-tensor/per-channel STE
+(quantize→dequantize in the forward, identity gradient), so a QAT model
+trains in one compiled step like any other model.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..nn import functional as F
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMax",
+           "AbsmaxObserver", "quant_dequant"]
+
+
+def _fake_quant(x, scale, bits=8):
+    qmax = 2.0 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-9) / qmax
+    q = jnp.clip(jnp.round(x / s), -qmax - 1, qmax)
+    deq = q * s
+    # straight-through estimator: forward uses deq, gradient sees identity
+    return x + jax.lax.stop_gradient(deq - x)
+
+
+def quant_dequant(x, scale, bits=8):
+    return apply("fake_quant",
+                 lambda a, sc: _fake_quant(a, sc, bits), (x, scale))
+
+
+class FakeQuanterWithAbsMax(Layer):
+    """Parity: FakeQuanterWithAbsMaxObserver — running abs-max scale +
+    quant/dequant with STE."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+        self.register_buffer("scale", Tensor(jnp.ones(())))
+        self._initialized = False
+
+    def forward(self, x):
+        if self.training:
+            cur = jnp.max(jnp.abs(x._data)).astype(jnp.float32)
+            if not self._initialized:
+                new = cur
+                self._initialized = True
+            else:
+                new = (self.moving_rate * self.scale._data
+                       + (1 - self.moving_rate) * cur)
+            self.scale._data = jax.lax.stop_gradient(new)
+        return quant_dequant(x, self.scale, self.bit_length)
+
+
+class AbsmaxObserver(Layer):
+    """PTQ observer: tracks abs-max without quantizing."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.register_buffer("scale", Tensor(jnp.zeros(())))
+
+    def forward(self, x):
+        cur = jnp.max(jnp.abs(x._data)).astype(jnp.float32)
+        self.scale._data = jnp.maximum(self.scale._data, cur)
+        return x
+
+    def cal_thresholds(self):
+        return float(np.asarray(self.scale._data))
+
+
+class QuantedLinear(Layer):
+    def __init__(self, inner: Linear, activation_quanter, weight_quanter):
+        super().__init__()
+        self.inner = inner
+        self.weight = inner.weight
+        self.bias = inner.bias
+        self.activation_quanter = activation_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self.bias)
+
+
+class QuantConfig:
+    """Parity: `paddle.quantization.QuantConfig` — maps layer types to
+    quanter factories."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation or (lambda: FakeQuanterWithAbsMax())
+        self.weight = weight or (lambda: FakeQuanterWithAbsMax())
+        self._types = (Linear, Conv2D)
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        self._types = tuple(set(self._types) | set(layer_types))
+        if activation:
+            self.activation = activation
+        if weight:
+            self.weight = weight
+
+
+def _swap_layers(model, config, act_factory, w_factory):
+    for name, sub in list(model._sub_layers.items()):
+        if isinstance(sub, Linear):
+            model._sub_layers[name] = QuantedLinear(
+                sub, act_factory(), w_factory())
+            object.__setattr__(model, name, model._sub_layers[name])
+        else:
+            _swap_layers(sub, config, act_factory, w_factory)
+    return model
+
+
+class QAT:
+    """Parity: `paddle.quantization.QAT(config).quantize(model)`."""
+
+    def __init__(self, config: QuantConfig | None = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=True):
+        return _swap_layers(model, self.config, self.config.activation,
+                            self.config.weight)
+
+    def convert(self, model, inplace=True):
+        return model
+
+
+class PTQ:
+    """Parity: `paddle.quantization.PTQ` — insert observers, calibrate with
+    data, then freeze scales into fake-quant layers."""
+
+    def __init__(self, config: QuantConfig | None = None):
+        self.config = config or QuantConfig(
+            activation=lambda: AbsmaxObserver(),
+            weight=lambda: AbsmaxObserver())
+
+    def quantize(self, model, inplace=True):
+        return _swap_layers(model, self.config, self.config.activation,
+                            self.config.weight)
+
+    def convert(self, model, inplace=True):
+        """Replace observers with fixed-scale fake quanters."""
+        for sub in model.sublayers():
+            if isinstance(sub, QuantedLinear):
+                for attr in ("activation_quanter", "weight_quanter"):
+                    obs = getattr(sub, attr)
+                    if isinstance(obs, AbsmaxObserver):
+                        fq = FakeQuanterWithAbsMax(moving_rate=1.0)
+                        fq.scale._data = obs.scale._data
+                        fq._initialized = True
+                        fq.eval()
+                        setattr(sub, attr, fq)
+        return model

@@ -1,0 +1,139 @@
+"""Sparse tensors (parity: `python/paddle/sparse/` — COO/CSR creation,
+elementwise/matmul ops, sparse nn helpers).
+
+TPU-first design: backed by `jax.experimental.sparse.BCOO` — XLA's batched-
+COO format with native lowering (scatter/gather/dot_general), instead of the
+reference's dedicated SparseCooTensor/SparseCsrTensor PHI kernels. The shell
+keeps paddle's surface: `sparse_coo_tensor`, `.to_dense()`, `.values()`,
+`.indices()`, `sparse.add/matmul/...`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..framework.core import Tensor
+from ..ops.dispatch import apply
+
+__all__ = [
+    "SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor", "add",
+    "subtract", "multiply", "matmul", "masked_matmul", "relu", "is_sparse",
+]
+
+
+class SparseCooTensor:
+    """Thin shell over BCOO mirroring paddle's SparseCooTensor surface."""
+
+    def __init__(self, bcoo):
+        self._bcoo = bcoo
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)  # paddle: [ndim, nnz]
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """Parity: `paddle.sparse.sparse_coo_tensor(indices [ndim, nnz],
+    values [nnz], shape)`."""
+    idx = np.asarray(indices._data if isinstance(indices, Tensor)
+                     else indices)
+    val = jnp.asarray(values._data if isinstance(values, Tensor) else values,
+                      dtype=dtype)
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=1))
+    bcoo = jsparse.BCOO((val, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    """CSR is stored as BCOO internally (XLA has no CSR kernels); the
+    crows/cols surface is converted on construction."""
+    crows = np.asarray(crows._data if isinstance(crows, Tensor) else crows)
+    cols = np.asarray(cols._data if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    idx = np.stack([rows, cols])
+    return sparse_coo_tensor(idx, values, shape, dtype)
+
+
+def _unwrap(x):
+    return x._bcoo if isinstance(x, SparseCooTensor) else (
+        x._data if isinstance(x, Tensor) else x)
+
+
+def add(x, y, name=None):
+    out = _unwrap(x) + _unwrap(y)
+    return SparseCooTensor(out) if isinstance(out, jsparse.BCOO) else Tensor(out)
+
+
+def subtract(x, y, name=None):
+    return add(x, multiply(y, -1.0))
+
+
+def multiply(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, (int, float)):
+        b = x._bcoo
+        return SparseCooTensor(
+            jsparse.BCOO((b.data * y, b.indices), shape=b.shape))
+    out = _unwrap(x) * _unwrap(y)
+    return SparseCooTensor(out) if isinstance(out, jsparse.BCOO) else Tensor(out)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (the training-relevant case: embedding-grad
+    style SpMM, lowered by XLA to gather/scatter)."""
+    xb, yb = _unwrap(x), _unwrap(y)
+    if isinstance(xb, jsparse.BCOO):
+        out = jsparse.bcoo_dot_general(
+            xb, yb, dimension_numbers=(((len(xb.shape) - 1,), (0,)), ((), ())))
+        return Tensor(out)
+    return Tensor(jnp.matmul(xb, yb))
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense sampled at mask's sparsity (SDDMM)."""
+    xd, yd = _unwrap(x), _unwrap(y)
+    mb = mask._bcoo
+    dense = xd @ yd
+    rows, cols = mb.indices[:, 0], mb.indices[:, 1]
+    vals = dense[rows, cols]
+    return SparseCooTensor(jsparse.BCOO((vals, mb.indices), shape=mb.shape))
+
+
+def relu(x, name=None):
+    b = x._bcoo
+    return SparseCooTensor(
+        jsparse.BCOO((jnp.maximum(b.data, 0), b.indices), shape=b.shape))
+
+
+def is_sparse(x):
+    return isinstance(x, SparseCooTensor)

@@ -1,0 +1,70 @@
+"""Text utilities (parity: `python/paddle/text/` — ViterbiDecoder plus the
+dataset loaders; datasets require local files in the no-egress environment).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply_nondiff
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """Parity: `paddle.text.viterbi_decode` — CRF Viterbi over
+    [batch, seq, n_tags] emissions with [n_tags, n_tags] transitions.
+    Returns (scores [batch], paths [batch, seq])."""
+
+    def decode(emis, trans):
+        B, T, N = emis.shape
+
+        def step(carry, e_t):
+            score = carry  # [B, N]
+            cand = score[:, :, None] + trans[None, :, :]  # [B, from, to]
+            best = jnp.max(cand, axis=1) + e_t
+            idx = jnp.argmax(cand, axis=1)
+            return best, idx
+
+        init = emis[:, 0, :]
+        if include_bos_eos_tag:
+            # bos: transition from tag N-2 ("start") per reference convention
+            init = init + trans[None, N - 2, :]
+        scores, backptrs = jax.lax.scan(
+            step, init, jnp.moveaxis(emis[:, 1:, :], 1, 0))
+        final = scores
+        if include_bos_eos_tag:
+            final = final + trans[None, :, N - 1]
+        best_score = jnp.max(final, axis=-1)
+        last_tag = jnp.argmax(final, axis=-1)
+
+        def backtrack(carry, ptr_t):
+            tag = carry
+            prev = jnp.take_along_axis(ptr_t, tag[:, None], 1)[:, 0]
+            return prev, prev  # ys[i] = tag at position i
+
+        _, path_rev = jax.lax.scan(backtrack, last_tag, backptrs,
+                                   reverse=True)
+        path = jnp.concatenate(
+            [jnp.moveaxis(path_rev, 0, 1),
+             last_tag[:, None]], axis=1)
+        return best_score, path.astype(jnp.int32)
+
+    scores, paths = apply_nondiff(
+        "viterbi_decode", decode, (potentials, transition_params))
+    return scores, paths
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(jnp.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,22 @@
+"""paddle.utils parity (`python/paddle/utils/`)."""
+from . import cpp_extension  # noqa: F401
+
+try:  # optional helpers
+    from .lazy_import import try_import  # noqa: F401
+except ImportError:
+    pass
+
+
+def run_check():
+    """Parity: `paddle.utils.run_check()` — verifies the framework can
+    compute on the available device(s)."""
+    import jax
+
+    import paddle_tpu as paddle
+
+    x = paddle.ones([2, 2])
+    y = (x @ x).sum()
+    assert float(y.numpy()) == 8.0
+    n = len(jax.devices())
+    print(f"PaddleTPU works well on {n} device(s) "
+          f"({jax.default_backend()}).")

@@ -1,0 +1,151 @@
+"""Vision datasets (parity: `python/paddle/vision/datasets/`).
+
+No-egress environment: `download=True` raises; datasets read standard local
+files (MNIST idx, CIFAR pickle) when present. `FakeData` provides the
+deterministic synthetic stream used by benchmarks (the role of the
+reference's `paddle.vision.datasets.FakeData`-style fixtures in CI).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.randint(0, 256, self.image_shape).astype(np.uint8)
+        label = np.array([rng.randint(self.num_classes)], dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img.astype(np.float32), label
+
+
+def _require_no_download(download, what):
+    if download:
+        raise RuntimeError(
+            f"{what}: this environment has no network egress; place the "
+            "files locally and pass their path (download=False)")
+
+
+class MNIST(Dataset):
+    """Parity: `paddle.vision.datasets.MNIST` over local idx/gz files."""
+
+    _FILES = {
+        "train": ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        "test": ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend="cv2", root=None):
+        _require_no_download(download and not (image_path or root), "MNIST")
+        self.transform = transform
+        if image_path is None:
+            root = root or "."
+            img_name, lbl_name = self._FILES[mode]
+            image_path = self._find(root, img_name)
+            label_path = self._find(root, lbl_name)
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _find(root, name):
+        for cand in (os.path.join(root, name), os.path.join(root, name + ".gz")):
+            if os.path.exists(cand):
+                return cand
+        raise FileNotFoundError(f"MNIST file {name}[.gz] not under {root}")
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051, f"bad MNIST image magic {magic}"
+            data = np.frombuffer(f.read(n * rows * cols), np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            assert magic == 2049, f"bad MNIST label magic {magic}"
+            return np.frombuffer(f.read(n), np.uint8).astype(np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None] / 255.0
+        return img, np.array([self.labels[idx]], dtype=np.int64)
+
+
+FashionMNIST = MNIST
+
+
+class Cifar10(Dataset):
+    """Parity: `paddle.vision.datasets.Cifar10` over the local python-pickle
+    batches (cifar-10-batches-py/)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        _require_no_download(download and data_file is None, "Cifar10")
+        self.transform = transform
+        root = data_file or "cifar-10-batches-py"
+        names = ([f"data_batch_{i}" for i in range(1, 6)]
+                 if mode == "train" else ["test_batch"])
+        imgs, labels = [], []
+        for name in names:
+            with open(os.path.join(root, name), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            imgs.append(np.asarray(d[b"data"], np.uint8))
+            labels.extend(d[b"labels"])
+        self.images = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].transpose(1, 2, 0)  # HWC for transforms
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.transpose(2, 0, 1).astype(np.float32) / 255.0
+        return img, np.array([self.labels[idx]], dtype=np.int64)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        _require_no_download(download and data_file is None, "Cifar100")
+        self.transform = transform
+        root = data_file or "cifar-100-python"
+        name = "train" if mode == "train" else "test"
+        with open(os.path.join(root, name), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        self.images = np.asarray(d[b"data"], np.uint8).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(d[b"fine_labels"], np.int64)

@@ -1,0 +1,48 @@
+"""Auto-parallel Engine + ProcessMesh tests (reference `test/auto_parallel/`)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import auto_parallel as ap
+from paddle_tpu.distributed import env as env_mod, fleet
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _mesh():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    yield
+    env_mod.reset_env()
+
+
+class DS(pt.io.Dataset):
+    def __len__(self):
+        return 32
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(i)
+        x = rng.randn(8).astype(np.float32)
+        return x, np.array([x.sum()], dtype=np.float32)
+
+
+def test_shard_tensor_with_placements():
+    mesh = ap.ProcessMesh([[0, 1], [2, 3]], dim_names=["dp", "mp"])
+    x = pt.to_tensor(np.zeros((4, 8), np.float32))
+    xs = ap.shard_tensor(x, mesh, [ap.Shard(0), ap.Replicate()])
+    assert tuple(xs._data.sharding.spec)[0] == "dp"
+    ys = ap.shard_tensor(x, mesh, [ap.Replicate(), ap.Shard(1)])
+    assert tuple(ys._data.sharding.spec)[1] == "mp"
+
+
+def test_engine_fit_evaluate(tmp_path):
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = pt.optimizer.Adam(0.01, parameters=net.parameters())
+    eng = ap.Engine(model=net, loss=nn.MSELoss(), optimizer=opt)
+    hist = eng.fit(DS(), batch_size=8, epochs=15, log_freq=1)
+    assert hist[-1] < hist[0]
+    logs = eng.evaluate(DS(), batch_size=8)
+    assert logs["loss"] < hist[0]
+    eng.save(str(tmp_path / "ckpt"))
+    eng.load(str(tmp_path / "ckpt"))

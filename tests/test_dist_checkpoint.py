@@ -1,0 +1,63 @@
+"""Distributed checkpoint reshard-on-load tests (reference
+`test/auto_parallel/test_dist_saver.py` + converter tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed import fleet
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    yield
+    from paddle_tpu.distributed import env as env_mod
+
+    env_mod.reset_env()
+
+
+def _tp_model(mp):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8 // mp, "mp_degree": mp,
+                               "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    from paddle_tpu.distributed.fleet.meta_parallel import ColumnParallelLinear
+
+    pt.seed(5)
+    return ColumnParallelLinear(8, 16, gather_output=True)
+
+
+def test_save_load_reshard_tp2_to_tp4(tmp_path):
+    m2 = _tp_model(mp=2)
+    w_ref = m2.weight.numpy().copy()
+    ckpt.save_state_dict({"w": m2.weight, "b": m2.bias}, str(tmp_path))
+
+    m4 = _tp_model(mp=4)
+    m4.weight.set_value(np.zeros_like(w_ref))  # scramble, then restore
+    ckpt.load_state_dict({"w": m4.weight, "b": m4.bias}, str(tmp_path))
+    np.testing.assert_allclose(m4.weight.numpy(), w_ref)
+    # destination keeps ITS OWN (tp4) sharding after load
+    assert tuple(m4.weight._data.sharding.spec) == (None, "mp")
+
+
+def test_async_save(tmp_path):
+    x = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    t = ckpt.save_state_dict({"x": x}, str(tmp_path), async_save=True)
+    t.join()
+    loaded = ckpt.load_checkpoint(str(tmp_path))
+    np.testing.assert_allclose(loaded["x"], x.numpy())
+
+
+def test_shape_mismatch_raises(tmp_path):
+    x = pt.to_tensor(np.zeros((2, 2), np.float32))
+    ckpt.save_state_dict({"x": x}, str(tmp_path))
+    y = pt.to_tensor(np.zeros((3, 3), np.float32))
+    with pytest.raises(ValueError):
+        ckpt.load_state_dict({"x": y}, str(tmp_path))
+
+
+def test_missing_key_raises(tmp_path):
+    x = pt.to_tensor(np.zeros(2, np.float32))
+    ckpt.save_state_dict({"a": x}, str(tmp_path))
+    with pytest.raises(KeyError):
+        ckpt.load_state_dict({"zz": x}, str(tmp_path))

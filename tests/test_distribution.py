@@ -1,0 +1,112 @@
+"""Distribution package tests (reference `test/distribution/`)."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (
+    Bernoulli, Beta, Categorical, Dirichlet, Exponential, Gamma, Gumbel,
+    Laplace, LogNormal, Multinomial, Normal, Uniform, kl_divergence,
+)
+
+
+class TestNormal:
+    def test_log_prob_matches_scipy(self):
+        d = Normal(1.0, 2.0)
+        v = paddle.to_tensor(np.array([0.0, 1.0, 3.0], np.float32))
+        np.testing.assert_allclose(
+            d.log_prob(v).numpy(),
+            st.norm(1.0, 2.0).logpdf([0.0, 1.0, 3.0]), rtol=1e-5)
+
+    def test_sample_stats(self):
+        paddle.seed(0)
+        d = Normal(2.0, 0.5)
+        s = d.sample((20000,)).numpy()
+        assert abs(s.mean() - 2.0) < 0.02
+        assert abs(s.std() - 0.5) < 0.02
+
+    def test_entropy_and_kl(self):
+        p, q = Normal(0.0, 1.0), Normal(1.0, 2.0)
+        np.testing.assert_allclose(p.entropy().numpy(),
+                                   st.norm(0, 1).entropy(), rtol=1e-5)
+        ref = (np.log(2.0 / 1.0) + (1.0 + 1.0) / (2 * 4.0) - 0.5)
+        np.testing.assert_allclose(kl_divergence(p, q).numpy(), ref,
+                                   rtol=1e-5)
+
+    def test_log_prob_grad(self):
+        d = Normal(0.0, 1.0)
+        v = paddle.to_tensor(np.array([0.5], np.float32),
+                             stop_gradient=False)
+        d.log_prob(v).sum().backward()
+        np.testing.assert_allclose(v.grad.numpy(), [-0.5], rtol=1e-5)
+
+
+class TestOthers:
+    def test_uniform(self):
+        d = Uniform(0.0, 2.0)
+        v = paddle.to_tensor(np.array([0.5], np.float32))
+        np.testing.assert_allclose(d.log_prob(v).numpy(),
+                                   [np.log(0.5)], rtol=1e-6)
+        assert np.isneginf(
+            d.log_prob(paddle.to_tensor([3.0], "float32")).numpy())[0]
+
+    def test_bernoulli(self):
+        d = Bernoulli(probs=0.3)
+        np.testing.assert_allclose(
+            d.log_prob(paddle.to_tensor(1.0, "float32")).numpy(),
+            np.log(0.3), rtol=1e-5)
+
+    def test_categorical(self):
+        d = Categorical(logits=np.log([[0.2, 0.8]], dtype=np.float32))
+        v = paddle.to_tensor(np.array([1]))
+        np.testing.assert_allclose(d.log_prob(v).numpy(), [np.log(0.8)],
+                                   rtol=1e-5)
+        paddle.seed(1)
+        s = d.sample((5000,)).numpy()
+        assert abs((s == 1).mean() - 0.8) < 0.03
+
+    def test_beta_gamma_scipy(self):
+        b = Beta(2.0, 3.0)
+        np.testing.assert_allclose(
+            b.log_prob(paddle.to_tensor(0.4, "float32")).numpy(),
+            st.beta(2, 3).logpdf(0.4), rtol=1e-5)
+        g = Gamma(2.0, 3.0)
+        np.testing.assert_allclose(
+            g.log_prob(paddle.to_tensor(0.7, "float32")).numpy(),
+            st.gamma(2, scale=1 / 3).logpdf(0.7), rtol=1e-5)
+
+    def test_laplace_lognormal_gumbel(self):
+        np.testing.assert_allclose(
+            Laplace(0.0, 1.0).log_prob(
+                paddle.to_tensor(0.5, "float32")).numpy(),
+            st.laplace.logpdf(0.5), rtol=1e-5)
+        np.testing.assert_allclose(
+            LogNormal(0.0, 1.0).log_prob(
+                paddle.to_tensor(2.0, "float32")).numpy(),
+            st.lognorm(1.0).logpdf(2.0), rtol=1e-5)
+        np.testing.assert_allclose(
+            Gumbel(0.0, 1.0).log_prob(
+                paddle.to_tensor(0.5, "float32")).numpy(),
+            st.gumbel_r.logpdf(0.5), rtol=1e-5)
+
+    def test_dirichlet_multinomial(self):
+        d = Dirichlet(np.array([2.0, 3.0], np.float32))
+        v = paddle.to_tensor(np.array([0.4, 0.6], np.float32))
+        np.testing.assert_allclose(
+            d.log_prob(v).numpy(), st.dirichlet([2, 3]).logpdf([0.4, 0.6]),
+            rtol=1e-5)
+        m = Multinomial(4, np.array([0.5, 0.5], np.float32))
+        v = paddle.to_tensor(np.array([2.0, 2.0], np.float32))
+        np.testing.assert_allclose(
+            m.log_prob(v).numpy(),
+            st.multinomial(4, [0.5, 0.5]).logpmf([2, 2]), rtol=1e-5)
+
+    def test_exponential(self):
+        d = Exponential(2.0)
+        np.testing.assert_allclose(
+            d.log_prob(paddle.to_tensor(1.0, "float32")).numpy(),
+            st.expon(scale=0.5).logpdf(1.0), rtol=1e-5)
+
+    def test_kl_unregistered_raises(self):
+        with pytest.raises(NotImplementedError):
+            kl_divergence(Normal(0., 1.), Uniform(0., 1.))

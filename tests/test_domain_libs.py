@@ -1,0 +1,126 @@
+"""Domain libraries: sparse, audio, text, quantization, distribution glue
+(reference `test/quantization`, `test/legacy_test/test_sparse_*`,
+`test/legacy_test/test_viterbi_decode_op.py`)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        s = paddle.sparse.sparse_coo_tensor(
+            [[0, 1, 2], [1, 2, 0]], [1.0, 2.0, 3.0], (3, 3))
+        d = s.to_dense().numpy()
+        assert d[0, 1] == 1.0 and d[2, 0] == 3.0
+        assert s.nnz == 3
+        assert s.indices().shape == [2, 3]
+
+    def test_spmm(self):
+        s = paddle.sparse.sparse_coo_tensor(
+            [[0, 1], [1, 0]], [2.0, 3.0], (2, 2))
+        dense = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(2, 2))
+        out = paddle.sparse.matmul(s, dense)
+        ref = s.to_dense().numpy() @ dense.numpy()
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_csr_construct(self):
+        s = paddle.sparse.sparse_csr_tensor(
+            [0, 1, 2], [1, 0], [5.0, 6.0], (2, 2))
+        d = s.to_dense().numpy()
+        assert d[0, 1] == 5.0 and d[1, 0] == 6.0
+
+    def test_sparse_relu(self):
+        s = paddle.sparse.sparse_coo_tensor(
+            [[0, 0], [0, 1]], [-1.0, 2.0], (1, 2))
+        out = paddle.sparse.relu(s).to_dense().numpy()
+        np.testing.assert_allclose(out, [[0.0, 2.0]])
+
+
+class TestAudio:
+    def test_spectrogram_shapes(self):
+        x = paddle.to_tensor(
+            np.sin(np.linspace(0, 100, 2000)).astype(np.float32)[None])
+        spec = paddle.audio.features.Spectrogram(n_fft=256)(x)
+        assert spec.shape[1] == 129  # n_fft//2+1 bins
+
+    def test_logmel_and_mfcc(self):
+        x = paddle.to_tensor(
+            np.random.randn(1, 2000).astype(np.float32))
+        lm = paddle.audio.features.LogMelSpectrogram(
+            sr=8000, n_fft=256, n_mels=32)(x)
+        assert lm.shape[1] == 32
+        mfcc = paddle.audio.features.MFCC(
+            sr=8000, n_mfcc=13, n_fft=256, n_mels=32)(x)
+        assert mfcc.shape[1] == 13
+
+    def test_mel_scale_invertible(self):
+        f = 1234.5
+        assert abs(paddle.audio.functional.mel_to_hz(
+            paddle.audio.functional.hz_to_mel(f)) - f) < 1e-6
+
+
+class TestViterbi:
+    def test_matches_brute_force(self):
+        emis = np.random.RandomState(0).randn(1, 4, 5).astype(np.float32)
+        trans = np.random.RandomState(1).randn(5, 5).astype(np.float32)
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(emis), paddle.to_tensor(trans),
+            include_bos_eos_tag=False)
+        best, bp = -1e9, None
+        for p in itertools.product(range(5), repeat=4):
+            sc = emis[0, 0, p[0]] + sum(
+                trans[p[i - 1], p[i]] + emis[0, i, p[i]]
+                for i in range(1, 4))
+            if sc > best:
+                best, bp = sc, p
+        np.testing.assert_allclose(scores.numpy()[0], best, rtol=1e-5)
+        assert tuple(paths.numpy()[0]) == bp
+
+
+class TestQuantization:
+    def test_fake_quant_ste_gradient(self):
+        from paddle_tpu.quantization import quant_dequant
+
+        x = paddle.to_tensor(np.array([0.5, -0.3], np.float32),
+                             stop_gradient=False)
+        y = quant_dequant(x, paddle.to_tensor(1.0, "float32"))
+        (y * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])  # STE
+
+    def test_qat_quantize_and_train(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import QAT, QuantConfig
+
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        qnet = QAT(QuantConfig()).quantize(net)
+        opt = paddle.optimizer.Adam(0.01, parameters=qnet.parameters())
+        x = paddle.randn([4, 8])
+        y = paddle.randn([4, 4])
+        losses = []
+        for _ in range(8):
+            loss = ((qnet(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_ptq_calibrate_convert(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import PTQ, AbsmaxObserver
+
+        net = nn.Sequential(nn.Linear(4, 4))
+        ptq = PTQ()
+        qnet = ptq.quantize(net)
+        for _ in range(3):
+            qnet(paddle.randn([2, 4]))
+        qnet = ptq.convert(qnet)
+        from paddle_tpu.quantization import FakeQuanterWithAbsMax
+
+        quanters = [s for s in qnet.sublayers()
+                    if isinstance(s, FakeQuanterWithAbsMax)]
+        assert quanters and all(
+            float(q.scale.numpy()) > 0 for q in quanters)

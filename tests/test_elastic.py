@@ -1,0 +1,21 @@
+"""Elastic manager tests (reference `test/collective/fleet` elastic tests)."""
+import time
+
+from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+
+
+def test_membership_and_restart_detection():
+    m = ElasticManager(job_id="jt", rank=0, np=2, heartbeat_interval=0.2,
+                       timeout=2.0)
+    w = ElasticManager(job_id="jt", rank=1, np=2, host="127.0.0.1",
+                       port=m.port, is_master=False,
+                       heartbeat_interval=0.2, timeout=2.0)
+    try:
+        assert m.wait_for_np(2, timeout=5)
+        assert set(m.alive_nodes()) == {0, 1}
+        w.exit()
+        assert m.watch() == ElasticStatus.RESTART
+        m.mark_completed()
+        assert m.watch() == ElasticStatus.COMPLETED
+    finally:
+        m.exit()

@@ -1,0 +1,124 @@
+"""hapi Model + vision package tests (reference `test/legacy_test/test_model.py`,
+`test/legacy_test/test_vision_models.py`)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.vision.models import LeNet, resnet18
+
+
+class RegDS(paddle.io.Dataset):
+    def __len__(self):
+        return 64
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(i)
+        x = rng.randn(10).astype(np.float32)
+        return x, np.array([x.sum()], dtype=np.float32)
+
+
+class TestHapiModel:
+    def test_fit_evaluate_predict_save_load(self, tmp_path):
+        net = nn.Sequential(nn.Linear(10, 32), nn.ReLU(), nn.Linear(32, 1))
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.Adam(0.01, parameters=net.parameters()),
+            paddle.nn.MSELoss())
+        model.fit(RegDS(), epochs=20, batch_size=16, verbose=0)
+        logs = model.evaluate(RegDS(), batch_size=16, verbose=0)
+        assert logs["loss"] < 1.0
+        preds = model.predict(RegDS(), batch_size=16, stack_outputs=True)
+        assert preds[0].shape == (64, 1)
+        p = str(tmp_path / "ckpt")
+        model.save(p)
+        model.load(p)
+
+    def test_metrics_accuracy(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+
+        class ClsDS(paddle.io.Dataset):
+            def __len__(self):
+                return 48
+
+            def __getitem__(self, i):
+                rng = np.random.RandomState(i)
+                x = rng.randn(4).astype(np.float32)
+                return x, np.array([i % 3], dtype=np.int64)
+
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.Adam(0.01, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss(),
+            paddle.metric.Accuracy())
+        logs = model.evaluate(ClsDS(), batch_size=16, verbose=0)
+        assert "acc" in logs
+
+    def test_early_stopping(self):
+        net = nn.Linear(4, 1)
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.SGD(0.0, parameters=net.parameters()),
+            paddle.nn.MSELoss())
+
+        class DS(paddle.io.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return (np.ones(4, np.float32),
+                        np.array([1.0], np.float32))
+
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+
+        es = EarlyStopping(monitor="loss", patience=0, mode="min")
+        model.fit(DS(), eval_data=DS(), epochs=5, batch_size=4, verbose=0,
+                  callbacks=[es])
+        # lr=0 -> no improvement -> stops after patience runs out
+        assert model.stop_training
+
+    def test_summary_counts(self):
+        net = nn.Sequential(nn.Linear(10, 32), nn.ReLU(), nn.Linear(32, 1))
+        info = paddle.summary(net, (1, 10))
+        assert info["total_params"] == 10 * 32 + 32 + 32 + 1
+
+
+class TestVision:
+    def test_resnet18_forward_backward(self):
+        m = resnet18(num_classes=10)
+        y = m(paddle.randn([2, 3, 64, 64]))
+        assert y.shape == [2, 10]
+        y.mean().backward()
+        assert m.conv1.weight.grad is not None
+
+    def test_resnet_eval_batchnorm_stats(self):
+        m = resnet18(num_classes=4)
+        x = paddle.randn([2, 3, 32, 32])
+        m.train()
+        m(x)
+        mean_after_train = m.bn1._mean.numpy().copy()
+        m.eval()
+        m(x)
+        np.testing.assert_allclose(m.bn1._mean.numpy(), mean_after_train)
+
+    def test_lenet_mnist_shape(self):
+        m = LeNet()
+        y = m(paddle.randn([4, 1, 28, 28]))
+        assert y.shape == [4, 10]
+
+    def test_transforms_pipeline(self):
+        tf = T.Compose([T.Resize(32), T.CenterCrop(28),
+                        T.RandomHorizontalFlip(1.0), T.ToTensor(),
+                        T.Normalize([0.5] * 3, [0.5] * 3)])
+        ds = FakeData(size=4, image_shape=(16, 16, 3), num_classes=10,
+                      transform=tf)
+        img, lbl = ds[0]
+        assert img.shape == (3, 28, 28)
+        assert img.dtype == np.float32
+        assert 0 <= int(lbl[0]) < 10
+
+    def test_fakedata_deterministic(self):
+        a = FakeData(size=4, image_shape=(3, 8, 8), seed=7)
+        b = FakeData(size=4, image_shape=(3, 8, 8), seed=7)
+        np.testing.assert_array_equal(a[2][0], b[2][0])

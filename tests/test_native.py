@@ -1,0 +1,98 @@
+"""Native C++ components: TCPStore + cpp_extension custom op (reference
+`test/cpp_extension/`, TCPStore tests in `test/collective`)."""
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+class TestTCPStore:
+    @pytest.fixture(scope="class")
+    def stores(self):
+        from paddle_tpu.distributed.store import TCPStore
+
+        master = TCPStore(is_master=True, port=0)
+        worker = TCPStore(host="127.0.0.1", port=master.port)
+        yield master, worker
+
+    def test_set_get(self, stores):
+        master, worker = stores
+        master.set("k1", b"hello")
+        assert worker.get("k1") == b"hello"
+
+    def test_add_counter(self, stores):
+        master, worker = stores
+        assert worker.add("ctr", 5) == 5
+        assert master.add("ctr", 2) == 7
+
+    def test_blocking_wait(self, stores):
+        master, worker = stores
+
+        def setter():
+            time.sleep(0.2)
+            master.set("late", b"arrived")
+
+        t = threading.Thread(target=setter)
+        t.start()
+        worker.wait(["late"], timeout=5)
+        assert worker.get("late") == b"arrived"
+        t.join()
+
+    def test_missing_key_raises(self, stores):
+        _, worker = stores
+        with pytest.raises(KeyError):
+            worker.get("missing")
+
+    def test_wait_timeout(self, stores):
+        _, worker = stores
+        with pytest.raises(TimeoutError):
+            worker.wait(["never_set"], timeout=0.3)
+
+    def test_delete(self, stores):
+        master, worker = stores
+        master.set("gone", b"x")
+        worker.delete_key("gone")
+        with pytest.raises(KeyError):
+            worker.get("gone")
+
+
+class TestCppExtension:
+    def test_load_and_custom_op(self, tmp_path):
+        from paddle_tpu.utils.cpp_extension import (
+            custom_op_from_library, load,
+        )
+
+        src = tmp_path / "my_op.cpp"
+        src.write_text(textwrap.dedent("""
+            #include <cstdint>
+            extern "C" void relu_plus_one(const float* in, float* out,
+                                          long long n) {
+              for (long long i = 0; i < n; ++i)
+                out[i] = (in[i] > 0 ? in[i] : 0.0f) + 1.0f;
+            }
+        """))
+        lib = load("my_op_test", [str(src)],
+                   build_directory=str(tmp_path))
+        op = custom_op_from_library(lib, "relu_plus_one")
+
+        import paddle_tpu as paddle
+
+        x = paddle.to_tensor(np.array([-1.0, 2.0, -3.0, 4.0], np.float32))
+        y = op(x)
+        np.testing.assert_allclose(y.numpy(), [1.0, 3.0, 1.0, 5.0])
+
+    def test_rebuild_cache(self, tmp_path):
+        import os
+
+        from paddle_tpu.utils.cpp_extension import load
+
+        src = tmp_path / "noop.cpp"
+        src.write_text('extern "C" int answer() { return 42; }')
+        lib1 = load("noop", [str(src)], build_directory=str(tmp_path))
+        n_so = len([f for f in os.listdir(tmp_path) if f.endswith(".so")])
+        lib2 = load("noop", [str(src)], build_directory=str(tmp_path))
+        n_so2 = len([f for f in os.listdir(tmp_path) if f.endswith(".so")])
+        assert n_so == n_so2  # content unchanged -> no rebuild
+        assert lib2.answer() == 42

@@ -1,0 +1,61 @@
+"""Pallas flash-attention kernel parity tests (interpret mode on CPU —
+the fake-device strategy of SURVEY §4, reference test/custom_runtime/)."""
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu.nn.functional.attention import _sdpa_reference
+from paddle_tpu.ops.pallas.flash_attention import flash_attention_kernel
+
+
+@pytest.fixture(autouse=True)
+def _highest_precision():
+    old = jax.config.jax_default_matmul_precision
+    jax.config.update("jax_default_matmul_precision", "highest")
+    yield
+    jax.config.update("jax_default_matmul_precision", old or "highest")
+
+
+def _qkv(b=1, s=128, h=2, d=128, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: rng.randn(b, s, h, d).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_parity(causal):
+    q, k, v = _qkv()
+    out = flash_attention_kernel(q, k, v, causal=causal, interpret=True)
+    ref = _sdpa_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grad_parity(causal):
+    q, k, v = _qkv(s=128)
+    w = np.random.RandomState(1).randn(*q.shape).astype(np.float32)
+
+    g1 = jax.grad(lambda *a: (flash_attention_kernel(
+        *a, causal=causal, interpret=True) * w).sum(), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: (_sdpa_reference(
+        *a, causal=causal) * w).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        scale = np.abs(np.asarray(b)).max() + 1e-9
+        np.testing.assert_allclose(np.asarray(a) / scale,
+                                   np.asarray(b) / scale, atol=1e-4)
+
+
+def test_fallback_small_head_dim():
+    # d=64 < 128 lane tile: must fall back to composite without error
+    q, k, v = _qkv(d=64)
+    out = flash_attention_kernel(q, k, v, causal=True, interpret=True)
+    ref = _sdpa_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_registry_selects_on_tpu_only():
+    from paddle_tpu.ops import registry
+
+    # on the CPU test platform the override must NOT be selected
+    assert registry.lookup_kernel("flash_attention") is None
+    assert "tpu" in registry._OPS["flash_attention"].kernels

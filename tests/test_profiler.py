@@ -1,0 +1,61 @@
+"""Profiler tests (reference `test/legacy_test/test_profiler.py`,
+`test_newprofiler.py`)."""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+
+
+class TestProfiler:
+    def test_record_and_export(self, tmp_path):
+        p = profiler.Profiler()
+        p.start()
+        with profiler.RecordEvent("my_region"):
+            x = paddle.ones([4, 4])
+            (x @ x).sum()
+        p.stop()
+        path = p.export(str(tmp_path / "trace.json"))
+        data = json.load(open(path))
+        names = [e["name"] for e in data["traceEvents"]]
+        assert "my_region" in names
+        assert "matmul" in names or any("matmul" in n for n in names)
+
+    def test_summary_counts_ops(self, capsys):
+        p = profiler.Profiler()
+        p.start()
+        x = paddle.ones([2, 2])
+        for _ in range(3):
+            x = x + 1
+        p.stop()
+        table = p.summary()
+        assert "add" in table
+
+    def test_scheduler_states(self):
+        sched = profiler.make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        states = [sched(i) for i in range(4)]
+        assert states[0] == profiler.ProfilerState.CLOSED
+        assert states[1] == profiler.ProfilerState.READY
+        assert states[2] == profiler.ProfilerState.RECORD
+        assert states[3] == profiler.ProfilerState.RECORD_AND_RETURN
+
+    def test_on_trace_ready_handler(self, tmp_path):
+        handler = profiler.export_chrome_tracing(str(tmp_path))
+        with profiler.Profiler(on_trace_ready=handler):
+            paddle.ones([2]) + 1
+        files = os.listdir(tmp_path)
+        assert any(f.endswith(".json") for f in files)
+
+    def test_benchmark_ips(self):
+        b = profiler.Benchmark()
+        b.begin()
+        import time
+
+        for _ in range(3):
+            time.sleep(0.01)
+            b.step(num_samples=8)
+        info = b.step_info()
+        assert "ips" in info
+        assert b.ips > 0

@@ -1,0 +1,116 @@
+"""PyLayer + functional autograd tests (reference
+`test/legacy_test/test_pylayer_op.py`, `test/autograd/`)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer, hessian, jacobian, jvp, vjp
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()
+                return grad * 3.0 * x * x
+
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        y = Cube.apply(x)
+        np.testing.assert_allclose(y.numpy(), [8.0, 27.0])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0, 27.0])
+
+    def test_wrong_backward_detected_by_shape(self):
+        class Bad(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                return grad  # claims d/dx = 1 (wrong value, right shape)
+
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        y = Bad.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(3))  # user's rule
+
+    def test_multi_output(self):
+        class Split2(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 2, x * 3
+
+            @staticmethod
+            def backward(ctx, g1, g2):
+                return g1 * 2 + g2 * 3
+
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        a, b = Split2.apply(x)
+        (a.sum() + b.sum()).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_inside_layer_training(self):
+        import paddle_tpu.nn as nn
+
+        class ScaledReLU(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return paddle.maximum(x, paddle.zeros_like(x)) * 2
+
+            @staticmethod
+            def backward(ctx, g):
+                (x,) = ctx.saved_tensor()
+                mask = paddle.to_tensor(
+                    (x.numpy() > 0).astype(np.float32))
+                return g * mask * 2
+
+        lin = nn.Linear(4, 4)
+        x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+        y = ScaledReLU.apply(lin(x))
+        y.mean().backward()
+        assert lin.weight.grad is not None
+
+
+class TestFunctional:
+    def test_vjp(self):
+        def f(x):
+            return (x ** 3).sum()
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out, g = vjp(f, x)
+        np.testing.assert_allclose(g.numpy(), [3.0, 12.0], rtol=1e-6)
+
+    def test_jvp(self):
+        def f(x):
+            return x * x
+
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        v = paddle.to_tensor(np.array([1.0], np.float32))
+        out, tangent = jvp(f, x, v)
+        np.testing.assert_allclose(tangent.numpy(), [6.0], rtol=1e-6)
+
+    def test_jacobian(self):
+        def f(x):
+            return x * x
+
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        J = jacobian(f, x)
+        np.testing.assert_allclose(J.numpy(), np.diag([2.0, 4.0, 6.0]),
+                                   rtol=1e-6)
+
+    def test_hessian(self):
+        def f(x):
+            return (x ** 3).sum()
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        H = hessian(f, x)
+        np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0]), rtol=1e-6)

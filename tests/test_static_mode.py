@@ -1,0 +1,73 @@
+"""paddle.static Program/Executor tests (reference
+`test/legacy_test/test_executor_*.py`, `test_inference_model_io.py`)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+static = paddle.static
+
+
+def _build(prog, net):
+    with static.program_guard(prog):
+        x = static.data("x", [None, 4], "float32")
+        out = net(x)
+    return x, out
+
+
+class TestStaticProgram:
+    def test_record_and_run(self):
+        prog = static.Program()
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x, out = _build(prog, net)
+        assert len(prog.ops) == 3
+        exe = static.Executor()
+        feed = np.random.randn(3, 4).astype(np.float32)
+        res, = exe.run(prog, feed={"x": feed}, fetch_list=[out])
+        ref = np.maximum(feed @ net[0].weight.numpy() + net[0].bias.numpy(),
+                         0) @ net[2].weight.numpy() + net[2].bias.numpy()
+        np.testing.assert_allclose(res, ref, atol=1e-5)
+
+    def test_program_tracks_weight_updates(self):
+        prog = static.Program()
+        net = nn.Sequential(nn.Linear(4, 2))
+        x, out = _build(prog, net)
+        exe = static.Executor()
+        feed = np.ones((2, 4), np.float32)
+        r1, = exe.run(prog, feed={"x": feed}, fetch_list=[out])
+        net[0].weight.set_value(net[0].weight.numpy() * 2)
+        r2, = exe.run(prog, feed={"x": feed}, fetch_list=[out])
+        expect = feed @ net[0].weight.numpy() + net[0].bias.numpy()
+        np.testing.assert_allclose(r2, expect, atol=1e-5)
+        assert not np.allclose(r1, r2)
+
+    def test_multiple_feeds_and_fetches(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            a = static.data("a", [2, 3], "float32")
+            b = static.data("b", [2, 3], "float32")
+            s = a + b
+            p = a * b
+        exe = static.Executor()
+        av = np.random.randn(2, 3).astype(np.float32)
+        bv = np.random.randn(2, 3).astype(np.float32)
+        rs, rp = exe.run(prog, feed={"a": av, "b": bv}, fetch_list=[s, p])
+        np.testing.assert_allclose(rs, av + bv, atol=1e-6)
+        np.testing.assert_allclose(rp, av * bv, atol=1e-6)
+
+    def test_save_load_inference_model(self, tmp_path):
+        prog = static.Program()
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x, out = _build(prog, net)
+        exe = static.Executor()
+        prefix = str(tmp_path / "model")
+        static.save_inference_model(prefix, [x], [out], exe, program=prog)
+        prog2, feeds, fetch_ids = static.load_inference_model(prefix, exe)
+        feed = np.random.randn(3, 4).astype(np.float32)
+        r1, = exe.run(prog, feed={"x": feed}, fetch_list=[out])
+        r2, = exe.run(prog2, feed={"x": feed}, fetch_list=fetch_ids)
+        np.testing.assert_allclose(r1, r2, atol=1e-6)
+
+    def test_default_main_program(self):
+        prog = static.default_main_program()
+        assert isinstance(prog, static.Program)
