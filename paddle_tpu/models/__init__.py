@@ -1,7 +1,10 @@
 """Flagship model families (the reference ships these via PaddleNLP/PaddleClas;
 the benchmark configs in BASELINE.md name Llama, BERT, ResNet, ERNIE —
 they live in-tree here so the framework is benchmarkable standalone)."""
-from . import llama  # noqa: F401
+from . import bert, llama  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig, BertForMaskedLM, BertForSequenceClassification, BertModel,
+)
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaForCausalLMPipe, LlamaModel,
 )
@@ -9,4 +12,6 @@ from .llama import (  # noqa: F401
 __all__ = [
     "llama", "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
     "LlamaForCausalLMPipe",
+    "bert", "BertConfig", "BertModel", "BertForMaskedLM",
+    "BertForSequenceClassification",
 ]

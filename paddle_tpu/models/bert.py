@@ -1,0 +1,196 @@
+"""BERT (encoder) family — BASELINE config 3's workload (BERT-base MLM,
+AMP O2, flash attention).
+
+The reference distributes BERT through PaddleNLP on `paddle.nn`
+TransformerEncoder; this in-tree implementation uses the same paddle-shaped
+building blocks, TP-ready via the meta-parallel linears, with attention
+routed through the "flash_attention" op (Pallas kernel on TPU).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import tensor as T
+from ..distributed import shard
+from ..distributed.fleet.meta_parallel import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+)
+from ..framework.core import Tensor
+from ..nn import functional as F
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act="gelu",
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 layer_norm_eps=1e-12, pad_token_id=0, dtype="float32"):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.layer_norm_eps = layer_norm_eps
+        self.pad_token_id = pad_token_id
+        self.dtype = dtype
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 128)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("max_position_embeddings", 64)
+        return cls(**kw)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size)
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = Embedding(
+            config.type_vocab_size, config.hidden_size)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        s = input_ids.shape[1]
+        pos = Tensor(np.arange(s, dtype=np.int32)[None, :])
+        emb = self.word_embeddings(input_ids) \
+            + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        emb = shard.sharding_constraint(emb, "dp", None, None)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
+        self.out = RowParallelLinear(h, h, input_is_parallel=True)
+        self.dropout_p = config.attention_probs_dropout_prob
+
+    def forward(self, x, attn_mask=None):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv(x)
+        q, k, v = T.split(qkv, 3, axis=-1)
+        q = q.reshape([b, s, self.num_heads, self.head_dim])
+        k = k.reshape([b, s, self.num_heads, self.head_dim])
+        v = v.reshape([b, s, self.num_heads, self.head_dim])
+        q = shard.sharding_constraint(q, None, None, "mp", None)
+        k = shard.sharding_constraint(k, None, None, "mp", None)
+        v = shard.sharding_constraint(v, None, None, "mp", None)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask, self.dropout_p, is_causal=False,
+            training=self.training)
+        return self.out(out.reshape([b, s, self.num_heads * self.head_dim]))
+
+
+class BertLayer(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.attention = BertSelfAttention(config)
+        self.attn_norm = LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.inter = ColumnParallelLinear(h, config.intermediate_size,
+                                          gather_output=False)
+        self.output = RowParallelLinear(config.intermediate_size, h,
+                                        input_is_parallel=True)
+        self.out_norm = LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.act = getattr(F, config.hidden_act)
+
+    def forward(self, x, attn_mask=None):
+        a = self.attn_norm(x + self.dropout(self.attention(x, attn_mask)))
+        f = self.output(self.act(self.inter(a)))
+        return self.out_norm(a + self.dropout(f))
+
+
+class BertModel(Layer):
+    """Parity shape: PaddleNLP BertModel (pooler included)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.layers = []
+        for i in range(config.num_hidden_layers):
+            blk = BertLayer(config)
+            self.add_sublayer(f"encoder.{i}", blk)
+            self.layers.append(blk)
+        self.pooler = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        mask_bias = None
+        if attention_mask is not None:
+            # [b, s] 1/0 -> additive [b, 1, 1, s]
+            m = attention_mask.astype(self.config.dtype)
+            mask_bias = (m.unsqueeze(1).unsqueeze(1) - 1.0) * 1e4
+        x = self.embeddings(input_ids, token_type_ids)
+        for blk in self.layers:
+            x = blk(x, mask_bias)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForMaskedLM(Layer):
+    """MLM head tied to the word embedding (the benchmark config)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = LayerNorm(config.hidden_size,
+                                        epsilon=config.layer_norm_eps)
+        self.decoder_bias = self.create_parameter(
+            [config.vocab_size], is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None, ignore_index=-100):
+        hidden, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(hidden)))
+        # tied head: logits = h @ E^T
+        logits = T.matmul(h, self.bert.embeddings.word_embeddings.weight,
+                          transpose_y=True) + self.decoder_bias
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits.astype("float32").reshape([-1, self.config.vocab_size]),
+            labels.reshape([-1, 1]), ignore_index=ignore_index,
+            reduction="mean")
+        return loss
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
