@@ -11,9 +11,74 @@ so 1.0 means the 45%-MFU goal is met on this chip.
 from __future__ import annotations
 
 import json
+import os
+import signal
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+_METRIC = "llama_train_tokens_per_sec_per_chip"
+
+_PIN_PLATFORM = (
+    "import os, jax\n"
+    "_p = os.environ.get('JAX_PLATFORMS')\n"
+    "if _p:\n"
+    "    jax.config.update('jax_platforms', _p)\n"
+)
+
+
+def _emit(value, vs_baseline, **extra):
+    """The one JSON line the driver parses. Exactly one call wins."""
+    global _EMITTED
+    if _EMITTED:
+        return
+    _EMITTED = True
+    print(json.dumps({
+        "metric": _METRIC,
+        "value": value,
+        "unit": "tokens/s",
+        "vs_baseline": vs_baseline,
+        **extra,
+    }), flush=True)
+
+
+_EMITTED = False
+
+
+def _probe_backend(timeout_s: int = 240) -> str:
+    """Check the jax backend initializes, in a throwaway subprocess so a
+    hung/held TPU cannot wedge this process. Returns the backend name.
+
+    Round-1 failure mode (VERDICT §weak 2): the chip was held by a
+    timed-out client and backend init raised UNAVAILABLE — so retry with
+    backoff before giving up, and never let one attempt hang forever.
+    """
+    # honor JAX_PLATFORMS via jax.config: the host sitecustomize pins the
+    # platform *config* at interpreter start, which silently overrides env
+    # vars (round-1 driver failure — see VERDICT).
+    code = (_PIN_PLATFORM +
+            "import jax; "
+            "print(jax.default_backend(), len(jax.devices()), flush=True)")
+    last_err = "unknown"
+    for attempt in range(5):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout_s)
+            if proc.returncode == 0 and proc.stdout.strip():
+                return proc.stdout.split()[0]
+            last_err = (proc.stderr or proc.stdout)[-500:]
+        except subprocess.TimeoutExpired:
+            last_err = f"backend init timed out after {timeout_s}s"
+        if attempt < 4:
+            wait = 15 * (attempt + 1)
+            print(f"bench: backend probe attempt {attempt + 1} failed "
+                  f"({last_err.splitlines()[-1] if last_err.strip() else last_err}); "
+                  f"retrying in {wait}s", file=sys.stderr, flush=True)
+            time.sleep(wait)
+    raise RuntimeError(f"jax backend unavailable after retries: {last_err}")
 
 
 # bf16 peak FLOPs/s per chip by TPU generation (public spec sheets)
@@ -35,7 +100,12 @@ def _peak_flops(device) -> float:
 
 
 def main():
+    backend = _probe_backend()
+    print(f"bench: backend={backend}", file=sys.stderr, flush=True)
     import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     import paddle_tpu as pt
     from paddle_tpu.jit.train_step import TrainStep
@@ -80,13 +150,36 @@ def main():
     tokens_per_sec = batch * seq * steps / dt
     flops_tok = model.flops_per_token(seq)
     mfu = tokens_per_sec * flops_tok / _peak_flops(jax.devices()[0])
-    print(json.dumps({
-        "metric": "llama_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 2),
-        "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.45, 4),
-    }))
+    extra = {"mfu": round(mfu, 4), "model_params_b": round(
+        sum(int(np.prod(p.shape)) for p in model.parameters()) / 1e9, 3)}
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        if peak is not None:
+            extra["peak_hbm_gib"] = round(peak / 2**30, 2)
+    except Exception:
+        pass
+    if on_cpu:
+        extra["note"] = "cpu smoke mode; not a TPU number"
+    _emit(round(tokens_per_sec, 2), round(mfu / 0.45, 4), **extra)
+
+
+def _watchdog(seconds: int = 2700):
+    """Guarantee a JSON line even if something hangs past the driver's
+    patience: emit a structured failure and exit non-zero."""
+
+    def _fire(signum, frame):
+        _emit(0.0, 0.0, error=f"bench watchdog fired after {seconds}s")
+        os._exit(3)
+
+    signal.signal(signal.SIGALRM, _fire)
+    signal.alarm(seconds)
 
 
 if __name__ == "__main__":
-    main()
+    _watchdog()
+    try:
+        main()
+    except BaseException as e:  # noqa: BLE001 — the JSON line must happen
+        _emit(0.0, 0.0, error=f"{type(e).__name__}: {e}"[:500])
+        raise

@@ -1,0 +1,68 @@
+"""Eager op-dispatch micro-benchmark.
+
+Reference parity: `test/cpp/eager/performance_tests/benchmark_fluid_cuda.cc`
+(per-op eager latency). Measures µs/op for a chained eager op loop with
+autograd recording, with and without the compiled-primitive cache in
+`ops/dispatch.py` (SURVEY §7 hard part (a)).
+
+Run: python benchmarks/eager_op_bench.py  (pin JAX_PLATFORMS=cpu for a
+deterministic host-side number; on TPU the dispatch overhead is the same
+python path).
+"""
+from __future__ import annotations
+
+import os
+import time
+
+if __name__ == "__main__":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def bench_loop(n_iter=200, size=16, disable_cache=False):
+    import paddle_tpu as pt
+    from paddle_tpu.ops import dispatch
+
+    x = pt.to_tensor(np.random.randn(size, size).astype(np.float32))
+    w = pt.to_tensor(np.random.randn(size, size).astype(np.float32),
+                     stop_gradient=False)
+
+    if disable_cache:
+        orig = dispatch._get_primitive
+        dispatch._get_primitive = lambda *a: None
+    try:
+        def step():
+            y = pt.matmul(x, w)
+            y = pt.tanh(y)
+            y = y + x
+            y = y * 0.5
+            return y.sum()
+
+        step().numpy()  # warm
+        t0 = time.perf_counter()
+        for _ in range(n_iter):
+            out = step()
+        out.numpy()
+        dt = time.perf_counter() - t0
+    finally:
+        if disable_cache:
+            dispatch._get_primitive = orig
+    n_ops = 5 * n_iter
+    return dt / n_ops * 1e6  # µs/op
+
+
+def main():
+    cold = bench_loop(disable_cache=True)
+    warm = bench_loop(disable_cache=False)
+    print(f"eager dispatch, 5-op chain with grad recording:")
+    print(f"  uncached (per-call jax.vjp trace): {cold:9.1f} µs/op")
+    print(f"  compiled-primitive cache:          {warm:9.1f} µs/op")
+    print(f"  speedup: {cold / warm:.1f}x")
+
+
+if __name__ == "__main__":
+    main()

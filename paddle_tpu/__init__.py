@@ -77,6 +77,7 @@ _LAZY = {
     "quantization": ".quantization",
     "audio": ".audio",
     "onnx": ".onnx",
+    "fft": ".fft",
 }
 
 

@@ -62,6 +62,13 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         }
         arrays[fname] = arr
 
+    if async_save:
+        # snapshot to host SYNCHRONOUSLY: the live jax.Arrays may be donated
+        # or rebound by the very next train step (round-1 ADVICE: the writer
+        # thread could read invalidated/torn buffers). Only file I/O is
+        # deferred to the thread.
+        arrays = {f: np.asarray(a) for f, a in arrays.items()}
+
     def write():
         for fname, arr in arrays.items():
             np.save(os.path.join(path, fname),

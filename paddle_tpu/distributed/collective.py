@@ -410,11 +410,47 @@ recv = send
 
 
 def barrier(group=None):
-    """Parity: `paddle.distributed.barrier`. Single-controller: dispatch is
-    ordered by the runtime; block the host on a trivial device round-trip."""
+    """Parity: `paddle.distributed.barrier`.
+
+    Multi-host: a real rendezvous — every process must reach this point
+    before any continues (host-side effects ordered around it, e.g. rank-0
+    writes a file the others read). Uses the jax.distributed coordination
+    service when initialized; a compiled psum over the mesh only orders
+    *device* work, not hosts, so it is not sufficient (round-1 ADVICE).
+    Single-process: a device round-trip flushes dispatched work.
+    """
     e = env_mod.ensure_env()
+    if jax.process_count() > 1:
+        try:
+            from jax._src import distributed as _jd
+
+            client = getattr(_jd.global_state, "client", None)
+            if client is not None:
+                client.wait_at_barrier(
+                    f"paddle_tpu_barrier_{_barrier_seq[0]}", 60_000)
+                _barrier_seq[0] += 1
+                return None
+        except Exception:
+            pass
+        # fallback: an all-reduce across the world mesh — devices of every
+        # host participate, so completion implies every host dispatched it
+        f = _barrier_fns.get(e.mesh)
+        if f is None:
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            ax = tuple(e.mesh.axis_names)
+            f = jax.jit(shard_map(lambda x: jax.lax.psum(x, ax), mesh=e.mesh,
+                                  in_specs=P(), out_specs=P()))
+            _barrier_fns[e.mesh] = f
+        f(jnp.ones(())).block_until_ready()
+        return None
     jnp.zeros(()).block_until_ready()
     return None
+
+
+_barrier_seq = [0]
+_barrier_fns: dict = {}
 
 
 def wait(tensor, group=None, use_calc_stream=True):

@@ -2,6 +2,7 @@
 `python/paddle/distributed/fleet/meta_parallel/`)."""
 from .parallel_layers.mp_layers import (  # noqa: F401
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    masked_token_mean,
     VocabParallelEmbedding,
 )
 from .parallel_layers.pp_layers import (  # noqa: F401
@@ -14,7 +15,7 @@ from .pipeline_parallel import PipelineParallel  # noqa: F401
 
 __all__ = [
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
-    "ParallelCrossEntropy", "LayerDesc", "SharedLayerDesc", "PipelineLayer",
+    "ParallelCrossEntropy", "masked_token_mean", "LayerDesc", "SharedLayerDesc", "PipelineLayer",
     "PipelineParallel", "RNGStatesTracker", "get_rng_state_tracker",
     "model_parallel_random_seed",
 ]

@@ -153,3 +153,17 @@ class ParallelCrossEntropy(Layer):
             return jnp.where(lb2 == ignore, jnp.zeros((), loss.dtype), loss)[..., None]
 
         return apply("parallel_cross_entropy", ce, (logits, label))
+
+
+def masked_token_mean(loss, labels, ignore_index=-100):
+    """Mean of per-token loss over NON-ignored tokens — the reference
+    cross-entropy 'mean' reduction divides by the count of valid labels,
+    not the total token count (round-1 ADVICE: padded batches were
+    under-weighted)."""
+
+    def f(l, lb):
+        valid = lb != ignore_index
+        cnt = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
+        return (jnp.sum(l.astype(jnp.float32)) / cnt).astype(l.dtype)
+
+    return apply("masked_token_mean", f, (loss, labels))

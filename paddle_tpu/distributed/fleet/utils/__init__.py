@@ -1,0 +1,3 @@
+from .recompute import recompute, recompute_sequential  # noqa: F401
+
+__all__ = ["recompute", "recompute_sequential"]

@@ -1,0 +1,152 @@
+"""User-facing activation recomputation (gradient checkpointing).
+
+Reference parity: `paddle.distributed.fleet.utils.recompute` /
+`recompute_sequential` (`fleet/recompute/recompute.py:69,334`) — a PyLayer
+that stashes inputs + RNG state in forward and re-runs the forward inside
+backward.
+
+TPU-first design: the segment becomes ONE taped op whose pure function is
+wrapped in `jax.checkpoint`. `jax.vjp` of a checkpointed function stores
+only the segment *inputs*; the pullback rematerializes the forward — the
+same storage contract as the reference's PyLayer, but it composes with jit
+(`TrainStep` whole-step compilation sees the remat annotation and XLA
+schedules the recompute). RNG determinism needs no state save/restore: the
+PRNG key is threaded as an operand, so the rematerialized forward replays
+the identical dropout masks by construction (the reference must snapshot
+and restore CUDA RNG state — `recompute.py:113` `swith_rng_state_tracker`).
+"""
+from __future__ import annotations
+
+import jax
+
+from ....autograd import tape
+from ....autograd.tape import no_grad
+from ....framework import random as rng
+from ....framework.core import Tensor
+from ....jit.program import _flatten, _unflatten
+from ....nn.layer.layers import Layer
+from ....ops.dispatch import apply
+
+
+def _collect_state(function):
+    """Differentiable params + aux buffers of the Layer behind ``function``
+    (the Layer itself, or a bound method of one)."""
+    layer = None
+    if isinstance(function, Layer):
+        layer = function
+    else:
+        owner = getattr(function, "__self__", None)
+        if isinstance(owner, Layer):
+            layer = owner
+    if layer is None:
+        return [], []
+    diff, aux = [], []
+    seen = set()
+    for _, p in layer.named_parameters():
+        if id(p) not in seen:
+            seen.add(id(p))
+            (aux if p.stop_gradient else diff).append(p)
+    for _, b in layer.named_buffers():
+        if id(b) not in seen:
+            seen.add(id(b))
+            aux.append(b)
+    return diff, aux
+
+
+def recompute(function, *args, **kwargs):
+    """Run ``function(*args, **kwargs)`` without storing its intermediate
+    activations; the backward pass recomputes them. Gradients flow to the
+    tensor arguments and to the parameters of ``function``'s Layer (pass a
+    Layer or a Layer's bound method, e.g. ``recompute(self.block, x)``).
+    """
+    kwargs.pop("preserve_rng_state", True)   # always preserved (see module doc)
+    kwargs.pop("use_reentrant", None)        # accepted for API parity
+    if not tape.is_grad_enabled():
+        return function(*args, **kwargs)
+
+    diff, aux = _collect_state(function)
+    leaves: list[Tensor] = []
+    in_spec = _flatten((args, kwargs), leaves)
+    stop_flags = [t.stop_gradient for t in leaves]
+    n_diff, n_aux = len(diff), len(aux)
+    prng = rng.next_key()
+    entry = {}
+
+    def pure(*arrays):
+        param_arrays = arrays[:n_diff]
+        aux_arrays = arrays[n_diff:n_diff + n_aux]
+        key = arrays[n_diff + n_aux]
+        input_arrays = arrays[n_diff + n_aux + 1:]
+        for t, a in zip(diff, param_arrays):
+            t._data = a
+        for t, a in zip(aux, aux_arrays):
+            t._data = a
+        input_tensors = [
+            Tensor(a, stop_gradient=sg)
+            for a, sg in zip(input_arrays, stop_flags)
+        ]
+        call_args, call_kwargs = _unflatten(in_spec, input_tensors, pos=[0])
+        with no_grad(), rng.rng_scope(key):
+            out = function(*call_args, **call_kwargs)
+        out_leaves: list[Tensor] = []
+        entry["out_spec"] = _flatten(out, out_leaves)
+        entry["n_user_out"] = len(out_leaves)
+        return tuple(t._data for t in out_leaves) + tuple(
+            t._data for t in aux)
+
+    ckpt = jax.checkpoint(pure)
+    saved = [(t, t._data) for t in diff + aux]
+    try:
+        outs = apply("recompute", ckpt, (*diff, *aux, prng, *leaves))
+    finally:
+        for t, a in saved:
+            t._data = a
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    user_outs = list(outs[: entry["n_user_out"]])
+    new_aux = outs[entry["n_user_out"]:]
+    with no_grad():
+        for t, new in zip(aux, new_aux):
+            t._data = new._data
+    return _unflatten(entry["out_spec"], user_outs, pos=[0])
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Parity: `recompute.py:334` — split a Sequential/LayerList into
+    ``segments`` chunks and recompute each chunk.
+
+    ``ctx``: dict with optional ``segments`` (default 1) and
+    ``preserve_rng_state``.
+    """
+    segments = int((ctx or {}).get("segments", 1) or 1)
+    preserve = (ctx or {}).get("preserve_rng_state", True)
+    if isinstance(functions, Layer):
+        layers = list(functions)     # Sequential / LayerList iterate children
+    else:
+        layers = list(functions)
+
+    class _Segment(Layer):
+        def __init__(self, subs):
+            super().__init__()
+            for i, s in enumerate(subs):
+                self.add_sublayer(str(i), s)
+            self._subs = subs
+
+        def forward(self, *xs, **kw):
+            out = xs
+            for s in self._subs:
+                out = s(*out, **kw) if isinstance(out, tuple) else s(out, **kw)
+                if not isinstance(out, tuple):
+                    out = (out,)
+                kw = {}
+            return out[0] if len(out) == 1 else out
+
+    n = len(layers)
+    seg_size = max(1, (n + segments - 1) // segments)
+    out = args
+    for start in range(0, n, seg_size):
+        seg = _Segment(layers[start:start + seg_size])
+        if not isinstance(out, tuple):
+            out = (out,)
+        out = recompute(seg, *out, preserve_rng_state=preserve, **kwargs)
+        kwargs = {}
+    return out

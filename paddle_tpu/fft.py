@@ -1,0 +1,240 @@
+"""Discrete Fourier transforms — the `paddle.fft` public namespace.
+
+Reference parity: `python/paddle/fft.py` (fft/ifft/rfft/irfft/hfft/ihfft
++ 2d/nd variants + fftfreq/rfftfreq/fftshift/ifftshift), which lowers to
+the pocketfft-backed C2C/R2C/C2R PHI kernels (`phi/kernels/cpu/fft_kernel`,
+`cmake/external/pocketfft.cmake`).
+
+TPU-first design: XLA has a native FFT HLO (ducc on CPU, TPU kernel on
+device) surfaced as `jnp.fft.*`; every transform is one dispatched op so
+AMP/tape/profiler hooks apply and `jax.vjp` provides the gradients the
+reference implements by hand (conjugate-transform rules).
+
+Note: like the reference, ``norm`` accepts "backward" (default), "ortho",
+"forward".
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .framework.core import Tensor
+from .ops.dispatch import apply
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(
+            f"Unexpected norm: {norm!r}. Norm should be 'forward', "
+            f"'backward' or 'ortho'")
+    return norm
+
+
+def _tup(s):
+    if s is None:
+        return None
+    return tuple(int(v) for v in s) if isinstance(s, (list, tuple)) else int(s)
+
+
+# -- 1d complex-to-complex ---------------------------------------------------
+
+def _fft_fn(a, n=None, axis=-1, norm="backward"):
+    return jnp.fft.fft(a, n=n, axis=axis, norm=norm)
+
+
+def _ifft_fn(a, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ifft(a, n=n, axis=axis, norm=norm)
+
+
+def _rfft_fn(a, n=None, axis=-1, norm="backward"):
+    return jnp.fft.rfft(a, n=n, axis=axis, norm=norm)
+
+
+def _irfft_fn(a, n=None, axis=-1, norm="backward"):
+    return jnp.fft.irfft(a, n=n, axis=axis, norm=norm)
+
+
+def _hfft_fn(a, n=None, axis=-1, norm="backward"):
+    return jnp.fft.hfft(a, n=n, axis=axis, norm=norm)
+
+
+def _ihfft_fn(a, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ihfft(a, n=n, axis=axis, norm=norm)
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply("fft", _fft_fn, (x,), n=_tup(n), axis=int(axis),
+                 norm=_check_norm(norm))
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply("ifft", _ifft_fn, (x,), n=_tup(n), axis=int(axis),
+                 norm=_check_norm(norm))
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply("rfft", _rfft_fn, (x,), n=_tup(n), axis=int(axis),
+                 norm=_check_norm(norm))
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply("irfft", _irfft_fn, (x,), n=_tup(n), axis=int(axis),
+                 norm=_check_norm(norm))
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply("hfft", _hfft_fn, (x,), n=_tup(n), axis=int(axis),
+                 norm=_check_norm(norm))
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply("ihfft", _ihfft_fn, (x,), n=_tup(n), axis=int(axis),
+                 norm=_check_norm(norm))
+
+
+# -- nd / 2d -----------------------------------------------------------------
+
+def _fftn_fn(a, s=None, axes=None, norm="backward"):
+    return jnp.fft.fftn(a, s=s, axes=axes, norm=norm)
+
+
+def _ifftn_fn(a, s=None, axes=None, norm="backward"):
+    return jnp.fft.ifftn(a, s=s, axes=axes, norm=norm)
+
+
+def _rfftn_fn(a, s=None, axes=None, norm="backward"):
+    return jnp.fft.rfftn(a, s=s, axes=axes, norm=norm)
+
+
+def _irfftn_fn(a, s=None, axes=None, norm="backward"):
+    return jnp.fft.irfftn(a, s=s, axes=axes, norm=norm)
+
+
+def _hfftn_fn(a, s=None, axes=None, norm="backward"):
+    # jnp lacks hfftn; hermitian-even nd = irfftn of the conjugate, scaled
+    # to match the 'backward' convention of hfft (see reference fftn_c2r)
+    x = jnp.conj(a)
+    axes_ = axes if axes is not None else tuple(range(a.ndim))
+    out = jnp.fft.irfftn(x, s=s, axes=axes, norm=None)
+    total = np.prod([out.shape[ax] for ax in axes_])
+    if norm == "backward":
+        return out * total
+    if norm == "ortho":
+        return out * np.sqrt(total)
+    return out  # forward
+
+
+def _ihfftn_fn(a, s=None, axes=None, norm="backward"):
+    x = jnp.fft.rfftn(a, s=s, axes=axes, norm=None)
+    axes_ = axes if axes is not None else tuple(range(a.ndim))
+    sizes = [a.shape[ax] if s is None else s[i]
+             for i, ax in enumerate(axes_)]
+    total = np.prod(sizes)
+    if norm == "backward":
+        out = x / total
+    elif norm == "ortho":
+        out = x / np.sqrt(total)
+    else:
+        out = x
+    return jnp.conj(out)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply("fftn", _fftn_fn, (x,), s=_tup(s), axes=_tup(axes),
+                 norm=_check_norm(norm))
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply("ifftn", _ifftn_fn, (x,), s=_tup(s), axes=_tup(axes),
+                 norm=_check_norm(norm))
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply("rfftn", _rfftn_fn, (x,), s=_tup(s), axes=_tup(axes),
+                 norm=_check_norm(norm))
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply("irfftn", _irfftn_fn, (x,), s=_tup(s), axes=_tup(axes),
+                 norm=_check_norm(norm))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply("hfftn", _hfftn_fn, (x,), s=_tup(s), axes=_tup(axes),
+                 norm=_check_norm(norm))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply("ihfftn", _ihfftn_fn, (x,), s=_tup(s), axes=_tup(axes),
+                 norm=_check_norm(norm))
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return fftn(x, s, axes, norm, name)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ifftn(x, s, axes, norm, name)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return rfftn(x, s, axes, norm, name)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return irfftn(x, s, axes, norm, name)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s, axes, norm, name)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s, axes, norm, name)
+
+
+# -- helpers -----------------------------------------------------------------
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.dtype import convert_dtype
+
+    dt = convert_dtype(dtype) if dtype else None
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    if dt is not None:
+        out = out.astype(dt)
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.dtype import convert_dtype
+
+    dt = convert_dtype(dtype) if dtype else None
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    if dt is not None:
+        out = out.astype(dt)
+    return Tensor(out)
+
+
+def _fftshift_fn(a, axes=None):
+    return jnp.fft.fftshift(a, axes=axes)
+
+
+def _ifftshift_fn(a, axes=None):
+    return jnp.fft.ifftshift(a, axes=axes)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply("fftshift", _fftshift_fn, (x,), axes=_tup(axes))
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply("ifftshift", _ifftshift_fn, (x,), axes=_tup(axes))

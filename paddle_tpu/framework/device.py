@@ -101,6 +101,105 @@ def _init_default():
     _state.name = f"{platform}:0"
 
 
+# ---- memory observability -------------------------------------------------
+# Reference parity: `paddle/fluid/memory/stats.cc` and the
+# `paddle.device.cuda.{memory,max_memory}_{allocated,reserved}` API. On TPU
+# allocation is owned by PJRT; these surface its per-device stats
+# (bytes_in_use / peak_bytes_in_use / bytes_limit). PJRT peaks are
+# process-monotonic, so reset_* records a baseline and subsequent maxima are
+# reported relative to observations after it (best effort, documented).
+
+_mem_baseline: dict = {}
+
+
+def _resolve(device=None) -> jax.Device:
+    if device is None:
+        return current_device()
+    if isinstance(device, jax.Device):
+        return device
+    return _lookup(device)
+
+
+def _lookup(name: str) -> jax.Device:
+    platform = _platform_of(str(name))
+    index = _index_of(str(name))
+    plats = _available_platforms()
+    for cand in _PLATFORM_ALIASES.get(platform, (platform,)):
+        if cand in plats:
+            return plats[cand][index]
+    raise ValueError(f"device {name!r} not available")
+
+
+def memory_stats(device=None) -> dict:
+    """Raw PJRT allocator stats for ``device`` (empty dict if the backend
+    does not expose them, e.g. some CPU builds)."""
+    d = _resolve(device)
+    try:
+        return dict(d.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently held by live buffers on ``device``."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak bytes in use on ``device`` (since process start, or since the
+    last :func:`reset_max_memory_allocated`)."""
+    d = _resolve(device)
+    stats = memory_stats(d)
+    peak = int(stats.get("peak_bytes_in_use", 0))
+    base = _mem_baseline.get(id(d))
+    if base is not None and peak <= base:
+        # PJRT peaks are monotonic; after a reset report the live number
+        return int(stats.get("bytes_in_use", 0))
+    return peak
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes reserved by the allocator pool (PJRT: limit-tracked pool)."""
+    stats = memory_stats(device)
+    return int(stats.get("bytes_reserved",
+                         stats.get("pool_bytes", stats.get("bytes_in_use", 0))))
+
+
+def max_memory_reserved(device=None) -> int:
+    stats = memory_stats(device)
+    return int(stats.get("peak_bytes_reserved",
+                         stats.get("peak_pool_bytes",
+                                   stats.get("peak_bytes_in_use", 0))))
+
+
+def reset_max_memory_allocated(device=None) -> None:
+    d = _resolve(device)
+    _mem_baseline[id(d)] = int(
+        memory_stats(d).get("peak_bytes_in_use", 0))
+
+
+def reset_max_memory_reserved(device=None) -> None:
+    reset_max_memory_allocated(device)
+
+
+def empty_cache() -> None:
+    """Parity no-op: PJRT owns its pools; XLA frees donated/dead buffers."""
+
+
+def get_device_properties(device=None):
+    """Total/free memory and identity of ``device`` (parity:
+    `paddle.device.cuda.get_device_properties`)."""
+    d = _resolve(device)
+    stats = memory_stats(d)
+    return {
+        "name": getattr(d, "device_kind", d.platform),
+        "platform": d.platform,
+        "index": d.id,
+        "total_memory": int(stats.get("bytes_limit", 0)),
+        "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+    }
+
+
 def is_compiled_with_tpu() -> bool:
     plats = _available_platforms()
     return bool(plats.get("tpu") or plats.get("axon"))

@@ -29,6 +29,7 @@ from .. import tensor as T
 from ..distributed import shard
 from ..distributed.fleet.meta_parallel import (
     ColumnParallelLinear, LayerDesc, ParallelCrossEntropy, PipelineLayer,
+    masked_token_mean,
     RowParallelLinear, VocabParallelEmbedding,
 )
 from ..framework.core import Tensor
@@ -260,10 +261,13 @@ class LlamaForCausalLM(Layer):
             return logits
         if self.loss_fn is not None:
             loss = self.loss_fn(logits.astype("float32"), labels)
+            ignore = self.loss_fn.ignore_index
         else:
             loss = F.cross_entropy(logits.astype("float32"),
                                    labels.unsqueeze(-1), reduction="none")
-        loss = loss.mean()
+            ignore = -100
+        # divide by the non-ignored token count, not total tokens
+        loss = masked_token_mean(loss, labels, ignore)
         if self.config.moe_num_experts > 1:
             # GShard load-balancing aux loss, consumed in the same trace it
             # was produced in (the MoE layers stash it during forward)
@@ -336,10 +340,12 @@ class LlamaForCausalLMPipe(PipelineLayer):
 
         def loss_fn(logits, labels):
             if ce is not None:
-                return ce(logits.astype("float32"), labels).mean()
-            return F.cross_entropy(logits.astype("float32"),
-                                   labels.unsqueeze(-1),
-                                   reduction="none").mean()
+                per_tok = ce(logits.astype("float32"), labels)
+                return masked_token_mean(per_tok, labels, ce.ignore_index)
+            per_tok = F.cross_entropy(logits.astype("float32"),
+                                      labels.unsqueeze(-1),
+                                      reduction="none")
+            return masked_token_mean(per_tok, labels, -100)
 
         descs = (
             [LayerDesc(_EmbeddingStage, config)]

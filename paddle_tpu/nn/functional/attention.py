@@ -31,11 +31,17 @@ def _sdpa_reference(q, k, v, *rest, causal=False, dropout=0.0, scale=None,
     if rest:
         mask = rest[0]
         logits = logits + mask.astype(logits.dtype)
+    row_valid = None
     if causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
         cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         logits = jnp.where(cm, logits, jnp.asarray(-1e30, logits.dtype))
+        row_valid = cm.any(-1)  # rows with no visible key (sq > sk head rows)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if row_valid is not None:
+        # flash-attn >= 2.1: a query row that attends to nothing outputs 0
+        probs = jnp.where(row_valid[..., None], probs,
+                          jnp.zeros((), probs.dtype))
     if dropout > 0.0 and dropout_key is not None:
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout), jnp.zeros((), probs.dtype))

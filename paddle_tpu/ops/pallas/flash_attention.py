@@ -6,16 +6,25 @@ library as a PHI kernel (`paddle/phi/kernels/gpu/flash_attn_kernel.cu`,
 tiled streaming-softmax kernel pair written in Pallas (SURVEY §5.7:
 "implement splash/flash attention in Pallas").
 
-Algorithm: FlashAttention-2. Forward streams K/V blocks through VMEM with a
-running (max, sum) softmax, never materializing the [sq, sk] score matrix in
-HBM; saves per-row logsumexp for backward. Backward recomputes scores per
-block (dq kernel over q-rows, dkv kernel over k-columns), also O(block²)
-VMEM only. Layout: [batch, seq, heads, head_dim] — paddle's flash-attn
-layout — processed as one (batch·head) per grid row.
+Algorithm: FlashAttention-2. The grid iterates over BOTH q-blocks and
+k-blocks — the (max, sum, acc) streaming-softmax state lives in VMEM
+scratch and is carried across the k-minor grid dimension, so VMEM usage is
+O(block_q·block_k + block_q·d) regardless of sequence length (the whole
+point of flash attention; round-1 kept full K/V rows in VMEM which capped
+seq at a few K). Backward recomputes scores per block pair (dq kernel with
+k-minor grid, dkv kernel with q-minor grid), also block-local VMEM only.
+
+Causal masking is bottom-right aligned (rows of the score matrix count
+back from the last key), matching flash-attn >= 2.1 and `_sdpa_reference`
+in nn/functional/attention.py (`jnp.tril(..., k=sk-sq)`).
+
+Layout: [batch, seq, heads, head_dim] — paddle's flash-attn layout —
+processed as one (batch·head) per grid row.
 
 Registered as the 'flash_attention' kernel override for platform 'tpu', so
-`paddle.nn.functional.scaled_dot_product_attention` transparently uses it on
-TPU (mask / dropout calls fall back to the XLA composite implementation).
+`paddle.nn.functional.scaled_dot_product_attention` transparently uses it
+on TPU (mask / dropout calls fall back to the XLA composite
+implementation, with the caller's dropout PRNG key preserved).
 """
 from __future__ import annotations
 
@@ -30,142 +39,168 @@ from jax.experimental.pallas import tpu as pltpu
 from .. import registry
 
 NEG_INF = -1e30
+# lane width for the m/l scratch rows (fp32 VMEM tiles are (8, 128))
+_LANES = 128
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
-                block_k, seq_k):
-    # q_ref: [block_q, d]; k_ref/v_ref: [seq_k, d] (whole K/V row in VMEM)
+def _causal_mask(s, q_idx, k_idx, block_q, block_k, offset):
+    """Bottom-right-aligned causal mask for one [block_q, block_k] tile.
+
+    Global query row r may attend key col c iff  r + offset >= c,
+    where offset = seq_k - seq_q.
+    """
+    rows = q_idx * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = k_idx * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(rows + offset >= cols, s, NEG_INF)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, causal, scale, offset, n_kb):
     q_idx = pl.program_id(1)
-    block_q = q_ref.shape[1]
-    d = q_ref.shape[2]
-    q = q_ref[0].astype(jnp.float32) * scale
+    k_idx = pl.program_id(2)
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    block_k = k_ref.shape[1]
 
-    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q, 1), jnp.float32)
-    acc = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(k_idx == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    n_kb = seq_k // block_k
-    # causal: only stream K blocks up to (and including) the diagonal
-    if causal:
-        q_end = (q_idx + 1) * block_q  # rows cover [q_idx*bq, q_end)
-        n_kb_eff = pl.cdiv(q_end, block_k)
-    else:
-        n_kb_eff = n_kb
-
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, bk]
         if causal:
-            rows = q_idx * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            s = _causal_mask(s, q_idx, k_idx, block_q, block_k, offset)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
-        acc_new = alpha * acc + jax.lax.dot_general(
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, n_kb_eff, body, (m, l, acc))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+    if causal:
+        # tiles strictly above the (bottom-right-aligned) diagonal are
+        # entirely masked — skip their compute (their HBM fetch still
+        # happens; the win is MXU time, which is the bottleneck here).
+        pl.when(k_idx * block_k < (q_idx + 1) * block_q + offset)(_step)
+    else:
+        _step()
+
+    @pl.when(k_idx == n_kb - 1)
+    def _fini():
+        m = m_ref[:, :1]
+        l_safe = jnp.maximum(l_ref[:, :1], 1e-30)
+        # rows with no valid key (bottom-right causal with sq > sk) output
+        # exactly 0 — flash-attn >= 2.1 semantics, matched by the composite
+        # fallback; m stays at NEG_INF iff every score was masked/skipped
+        valid = m > NEG_INF * 0.5
+        o_ref[0] = jnp.where(
+            valid, acc_ref[...] / l_safe, 0.0).astype(o_ref.dtype)
+        lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, causal, scale, block_k, seq_k):
+                   dq_acc_ref, *, causal, scale, offset, n_kb):
     q_idx = pl.program_id(1)
+    k_idx = pl.program_id(2)
     block_q = q_ref.shape[1]
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
-    dq = jnp.zeros_like(q)
+    block_k = k_ref.shape[1]
 
-    if causal:
-        n_kb_eff = pl.cdiv((q_idx + 1) * block_q, block_k)
-    else:
-        n_kb_eff = seq_k // block_k
+    @pl.when(k_idx == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
-    def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if causal:
-            rows = q_idx * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)                       # [bq, bk]
+            s = _causal_mask(s, q_idx, k_idx, block_q, block_k, offset)
+        # no-valid-key rows have lse ~ NEG_INF; exp(s - lse) would blow up
+        p = jnp.where(lse > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)  # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        return dq + jax.lax.dot_general(
+        dq_acc_ref[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, n_kb_eff, body, dq)
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    if causal:
+        pl.when(k_idx * block_k < (q_idx + 1) * block_q + offset)(_step)
+    else:
+        _step()
+
+    @pl.when(k_idx == n_kb - 1)
+    def _fini():
+        dq_ref[0] = dq_acc_ref[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, causal, scale, block_q, seq_q):
+                    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
+                    *, causal, scale, offset, n_qb):
     k_idx = pl.program_id(1)
+    q_idx = pl.program_id(2)
     block_k = k_ref.shape[1]
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    dk = jnp.zeros_like(k)
-    dv = jnp.zeros_like(v)
+    block_q = q_ref.shape[1]
 
-    n_qb = seq_q // block_q
-    if causal:
-        qb_start = (k_idx * block_k) // block_q  # first q block on/after diag
-    else:
-        qb_start = 0
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+    def _step():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, bk]
         if causal:
-            rows = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = k_idx * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)
-        dv_new = dv + jax.lax.dot_general(
+            s = _causal_mask(s, q_idx, k_idx, block_q, block_k, offset)
+        p = jnp.where(lse > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)
+        dv_acc_ref[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        dk_new = dk + jax.lax.dot_general(
+        dk_acc_ref[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk_new, dv_new
 
-    dk, dv = jax.lax.fori_loop(qb_start, n_qb, body, (dk, dv))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if causal:
+        # tile has any unmasked entry iff last row can see first col
+        pl.when(k_idx * block_k < (q_idx + 1) * block_q + offset)(_step)
+    else:
+        _step()
+
+    @pl.when(q_idx == n_qb - 1)
+    def _fini():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
 def _pick_block(seq, target=512):
@@ -187,25 +222,33 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
     sk = k.shape[1]
     block_q = _pick_block(sq)
     block_k = _pick_block(sk)
-    grid = (bh, sq // block_q)
+    n_kb = sk // block_k
+    grid = (bh, sq // block_q, n_kb)
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
-                               block_k=block_k, seq_k=sk)
+                               offset=sk - sq, n_kb=n_kb)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, sq), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY)),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
             flops=int(4 * bh * sq * sk * d * (0.5 if causal else 1.0)),
@@ -227,6 +270,9 @@ def _flash_bwd_rule(causal, scale, interpret, res, g):
     sk = k.shape[1]
     block_q = _pick_block(sq)
     block_k = _pick_block(sk)
+    n_qb = sq // block_q
+    n_kb = sk // block_k
+    offset = sk - sq
     g = g.astype(q.dtype)
     # delta_i = sum_d(do * o) per row (FlashAttention-2 eq. for ds)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
@@ -234,41 +280,50 @@ def _flash_bwd_rule(causal, scale, interpret, res, g):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
-                          block_k=block_k, seq_k=sk),
-        grid=(bh, sq // block_q),
+                          offset=offset, n_kb=n_kb),
+        grid=(bh, n_qb, n_kb),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY)),
         interpret=interpret,
     )(q, k, v, g, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
-                          block_q=block_q, seq_q=sq),
-        grid=(bh, sk // block_k),
+                          offset=offset, n_qb=n_qb),
+        grid=(bh, n_kb, n_qb),
         in_specs=[
-            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, sq), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, sq), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY)),
         interpret=interpret,
     )(q, k, v, g, lse, delta)
     return dq, dk, dv
@@ -278,20 +333,25 @@ _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
-                           interpret=False):
+                           default_fn=None, interpret=False):
     """Kernel-registry entry: [b, s, h, d] inputs, same signature as the
     default XLA implementation in nn/functional/attention.py. Falls back to
-    the composite path for masks/dropout/odd shapes."""
-    if rest or dropout > 0.0:
+    ``default_fn`` (the caller's composite closure, which carries the live
+    dropout PRNG key) for masks/dropout/odd shapes."""
+
+    def fallback(dp):
+        if default_fn is not None:
+            return default_fn(q, k, v, *rest, causal=causal, dropout=dp)
         from ...nn.functional.attention import _sdpa_reference
 
-        return _sdpa_reference(q, k, v, *rest, causal=causal, dropout=dropout)
+        return _sdpa_reference(q, k, v, *rest, causal=causal, dropout=dp)
+
+    if rest or dropout > 0.0:
+        return fallback(dropout)
     b, sq, h, d = q.shape
     sk = k.shape[1]
     if sq < 16 or sk < 16 or d % 128 or k.shape[2] != h:
-        from ...nn.functional.attention import _sdpa_reference
-
-        return _sdpa_reference(q, k, v, causal=causal, dropout=0.0)
+        return fallback(0.0)
     scale = 1.0 / math.sqrt(d)
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
@@ -302,5 +362,8 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
 
 def register(platform="tpu", interpret=False):
     fn = functools.partial(flash_attention_kernel, interpret=interpret)
+    # ask dispatch to pass the caller's composite closure as default_fn so
+    # fallback paths keep caller state (the live dropout PRNG key).
+    fn.wants_default = True
     registry.register_kernel("flash_attention", platform)(fn)
     return fn

@@ -209,12 +209,34 @@ class Profiler:
 
     def step(self, num_samples=None):
         self.step_num += 1
+        if not self._scheduler or self._state in (
+                ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._emit_memory_counter()
         if self._scheduler:
             prev = self._state
             self._state = self._scheduler(self.step_num)
             if (prev == ProfilerState.RECORD_AND_RETURN
                     and self._on_trace_ready is not None):
                 self._on_trace_ready(self)
+
+    def _emit_memory_counter(self):
+        """Chrome-trace counter event with the device allocator stats
+        (parity: `mem_tracing.h` memory events merged into the trace)."""
+        from ..framework import device as dev
+
+        stats = dev.memory_stats()
+        if not stats:
+            return
+        now = time.perf_counter()
+        with _recorder._lock:
+            _recorder.events.append({
+                "name": "device memory", "ph": "C", "ts": now * 1e6,
+                "pid": os.getpid(), "cat": "memory",
+                "args": {
+                    "bytes_in_use": stats.get("bytes_in_use", 0),
+                    "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+                },
+            })
 
     def __enter__(self):
         self.start()

@@ -151,17 +151,21 @@ def multiplex(inputs, index, name=None):
 
 
 # ---- matmul family ----
+def _matmul_fn(a, b, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if transpose_y:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    return jnp.matmul(a, b)
+
+
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     """Batched matmul on the MXU (parity: paddle.matmul,
     `phi/kernels/gpu|cpu/matmul_kernel`). transpose flags avoid materialized
-    transposes — XLA folds them into the dot dimension numbers."""
-    def f(a, b):
-        if transpose_x:
-            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
-        if transpose_y:
-            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
-        return jnp.matmul(a, b)
-    return apply("matmul", f, (x, y))
+    transposes — XLA folds them into the dot dimension numbers. Flags ride
+    as static kwargs so the dispatch-level primitive cache applies."""
+    return apply("matmul", _matmul_fn, (x, y),
+                 transpose_x=transpose_x, transpose_y=transpose_y)
 
 
 def dot(x, y, name=None):

@@ -1,0 +1,105 @@
+"""`paddle_tpu.fft` parity tests vs numpy.fft (OpTest-style numeric parity,
+reference `test/fft/test_fft.py`)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import fft
+
+
+def _x(shape=(4, 16), complex_=False, seed=0):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(*shape).astype(np.float32)
+    if complex_:
+        a = (a + 1j * rng.randn(*shape)).astype(np.complex64)
+    return a
+
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+def test_fft_ifft_roundtrip(norm):
+    a = _x(complex_=True)
+    out = fft.fft(pt.to_tensor(a), norm=norm)
+    np.testing.assert_allclose(out.numpy(), np.fft.fft(a, norm=norm),
+                               rtol=1e-4, atol=1e-4)
+    back = fft.ifft(out, norm=norm)
+    np.testing.assert_allclose(back.numpy(), a, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("fn,nfn", [
+    ("rfft", np.fft.rfft), ("hfft", np.fft.hfft),
+])
+def test_real_family(fn, nfn):
+    a = _x() if fn == "rfft" else _x((4, 9), complex_=True)
+    out = getattr(fft, fn)(pt.to_tensor(a))
+    np.testing.assert_allclose(out.numpy(), nfn(a), rtol=1e-3, atol=1e-3)
+
+
+def test_irfft_ihfft():
+    a = _x((4, 9), complex_=True)
+    np.testing.assert_allclose(fft.irfft(pt.to_tensor(a)).numpy(),
+                               np.fft.irfft(a), rtol=1e-4, atol=1e-4)
+    r = _x((4, 16))
+    np.testing.assert_allclose(fft.ihfft(pt.to_tensor(r)).numpy(),
+                               np.fft.ihfft(r), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+def test_fft2_fftn(norm):
+    a = _x((3, 8, 8), complex_=True)
+    np.testing.assert_allclose(
+        fft.fft2(pt.to_tensor(a), norm=norm).numpy(),
+        np.fft.fft2(a, norm=norm), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(
+        fft.fftn(pt.to_tensor(a), norm=norm).numpy(),
+        np.fft.fftn(a, norm=norm), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(
+        fft.rfftn(pt.to_tensor(a.real.copy()), norm=norm).numpy(),
+        np.fft.rfftn(a.real, norm=norm), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(
+        fft.irfftn(pt.to_tensor(np.fft.rfftn(a.real)), norm=norm).numpy(),
+        np.fft.irfftn(np.fft.rfftn(a.real), norm=norm), rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+def test_hfftn_matches_hfft_1d(norm):
+    # hfftn/ihfftn are hand-normalized (jnp lacks them); pin to numpy's 1d
+    a = _x((9,), complex_=True)
+    np.testing.assert_allclose(
+        fft.hfftn(pt.to_tensor(a), norm=norm).numpy(),
+        np.fft.hfft(a, norm=norm), rtol=1e-3, atol=1e-3)
+    r = _x((16,))
+    np.testing.assert_allclose(
+        fft.ihfftn(pt.to_tensor(r), norm=norm).numpy(),
+        np.fft.ihfft(r, norm=norm), rtol=1e-4, atol=1e-4)
+
+
+def test_helpers():
+    np.testing.assert_allclose(fft.fftfreq(8, 0.5).numpy(),
+                               np.fft.fftfreq(8, 0.5), atol=1e-6)
+    np.testing.assert_allclose(fft.rfftfreq(8, 0.5).numpy(),
+                               np.fft.rfftfreq(8, 0.5), atol=1e-6)
+    a = _x((4, 8))
+    np.testing.assert_allclose(fft.fftshift(pt.to_tensor(a)).numpy(),
+                               np.fft.fftshift(a), atol=0)
+    np.testing.assert_allclose(fft.ifftshift(pt.to_tensor(a)).numpy(),
+                               np.fft.ifftshift(a), atol=0)
+
+
+def test_norm_validation():
+    with pytest.raises(ValueError, match="norm"):
+        fft.fft(pt.to_tensor(_x()), norm="bogus")
+
+
+def test_rfft_gradient():
+    # grads flow through the op path (the reference implements conjugate
+    # rules by hand; jax.vjp supplies them here)
+    x = pt.to_tensor(_x((8,)), stop_gradient=False)
+    y = fft.rfft(x)
+    loss = (y.real() ** 2 + y.imag() ** 2).sum()
+    loss.backward()
+    g = x.grad.numpy()
+    assert np.all(np.isfinite(g)) and np.abs(g).max() > 0
+
+
+def test_namespace_attr():
+    assert pt.fft.fft is fft.fft

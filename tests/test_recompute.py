@@ -1,0 +1,126 @@
+"""User-facing recompute API tests.
+
+Parity contract (reference `fleet/recompute/recompute.py:69,334` +
+`test/collective/fleet/test_dygraph_recompute*.py`): identical loss and
+grads with/without recompute, deterministic dropout replay, and
+`recompute_sequential` segmenting.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet.utils import recompute, recompute_sequential
+
+H = 16
+
+
+class Block(nn.Layer):
+    def __init__(self, h=H, dropout=0.0):
+        super().__init__()
+        self.fc1 = nn.Linear(h, 4 * h)
+        self.fc2 = nn.Linear(4 * h, h)
+        self.p = dropout
+
+    def forward(self, x):
+        y = pt.tanh(self.fc1(x))
+        if self.p:
+            y = nn.functional.dropout(y, self.p)
+        return x + self.fc2(y)
+
+
+class Net(nn.Layer):
+    def __init__(self, n=3, use_recompute=False, dropout=0.0):
+        super().__init__()
+        self.blocks = nn.LayerList([Block(dropout=dropout) for _ in range(n)])
+        self.head = nn.Linear(H, 2)
+        self.use_recompute = use_recompute
+
+    def forward(self, x):
+        for b in self.blocks:
+            x = recompute(b, x) if self.use_recompute else b(x)
+        return self.head(x)
+
+
+def _run(use_recompute, dropout=0.0, seed=7):
+    pt.seed(seed)
+    np.random.seed(seed)
+    m = Net(use_recompute=use_recompute, dropout=dropout)
+    x = pt.to_tensor(np.random.randn(4, H).astype(np.float32))
+    loss = (m(x) ** 2).mean()
+    loss.backward()
+    grads = {n: p.grad.numpy().copy() for n, p in m.named_parameters()
+             if p.grad is not None}
+    return float(loss.numpy()), grads
+
+
+def test_loss_and_grads_match():
+    l0, g0 = _run(False)
+    l1, g1 = _run(True)
+    assert abs(l0 - l1) < 1e-6
+    assert set(g0) == set(g1) and len(g0) > 0
+    for k in g0:
+        np.testing.assert_allclose(g0[k], g1[k], atol=1e-5, err_msg=k)
+
+
+def test_dropout_deterministic_replay():
+    # grads must be finite and reproducible across seeds: the recomputed
+    # forward replays the same dropout mask (key is an operand, not state)
+    l1, g1 = _run(True, dropout=0.5, seed=3)
+    l2, g2 = _run(True, dropout=0.5, seed=3)
+    assert l1 == l2
+    for k in g1:
+        np.testing.assert_allclose(g1[k], g2[k], atol=0)
+
+
+def test_no_grad_passthrough():
+    m = Block()
+    x = pt.to_tensor(np.random.randn(2, H).astype(np.float32))
+    with pt.no_grad():
+        y = recompute(m, x)
+    assert y.stop_gradient
+
+
+def test_recompute_reduces_saved_residuals():
+    # the taped node for a recomputed segment must store only the segment
+    # inputs (params + x + key), not intermediate activations
+    m = Block()
+    x = pt.to_tensor(np.random.randn(2, H).astype(np.float32))
+    y = recompute(m, x)
+    node = y._grad_node
+    assert node is not None and node.op_name == "recompute"
+
+
+def test_recompute_sequential():
+    pt.seed(11)
+    blocks = nn.LayerList([Block() for _ in range(4)])
+    x = pt.to_tensor(np.random.randn(2, H).astype(np.float32))
+    y_ref = x
+    for b in blocks:
+        y_ref = b(y_ref)
+    y = recompute_sequential({"segments": 2}, blocks, x)
+    np.testing.assert_allclose(y.numpy(), y_ref.numpy(), atol=1e-6)
+    (y ** 2).mean().backward()
+    assert blocks[0].fc1.weight.grad is not None
+
+
+def test_grad_matches_finite_difference():
+    pt.seed(5)
+    m = Block(h=4)
+    x = pt.to_tensor(np.random.randn(2, 4).astype(np.float32))
+    loss = (recompute(m, x) ** 2).mean()
+    loss.backward()
+    w = m.fc1.weight
+    g = w.grad.numpy()
+    eps = 1e-3
+    wv = w.numpy().copy()
+    idx = (0, 1)
+    wplus = wv.copy(); wplus[idx] += eps
+    wminus = wv.copy(); wminus[idx] -= eps
+    outs = []
+    for wa in (wplus, wminus):
+        w.set_value(wa)
+        outs.append(float(((m(x) ** 2).mean()).numpy()))
+    w.set_value(wv)
+    fd = (outs[0] - outs[1]) / (2 * eps)
+    assert abs(fd - g[idx]) < 1e-2
