@@ -78,6 +78,7 @@ _LAZY = {
     "audio": ".audio",
     "onnx": ".onnx",
     "fft": ".fft",
+    "inference": ".inference",
 }
 
 

@@ -1,0 +1,232 @@
+"""Auto-tuner: black-box search over hybrid-parallel configurations.
+
+Reference parity: `python/paddle/distributed/auto_tuner/{tuner,search,
+prune}.py` — enumerate (dp, mp, pp, sharding, micro-batch, recompute)
+candidates for a world size, prune invalid/doomed ones, launch trials,
+record metrics, return the best config.
+
+TPU-first design: a trial is not a multi-process relaunch (the reference
+re-execs `paddle.distributed.launch` per candidate) but one in-process
+re-jit of the whole train step over a re-factorized `jax.sharding.Mesh` —
+GSPMD makes re-partitioning a compile-time decision, so candidates cost
+seconds, not process round-trips. The measurement callback is pluggable so
+tests (and CPU hosts) can search synthetic cost surfaces.
+
+Pruning rules mirror `prune.py`:
+- product(dp, mp, pp, sharding) must equal the device count
+- mp must divide attention heads and hidden size
+- pp must divide layer count; micro-batches must divide the global batch
+- optional HBM estimate against per-chip capacity (prune_by_memory)
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+
+__all__ = ["AutoTuner", "generate_candidates", "default_prunes",
+           "estimate_memory_bytes"]
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def generate_candidates(world_size, tuner_cfg=None):
+    """Cartesian candidate list (reference `search.py` GridSearch).
+
+    tuner_cfg keys (all optional): dp_degree/mp_degree/pp_degree/
+    sharding_degree ("auto" or list), micro_batch_size (list),
+    use_recompute (list of bool), global_batch_size.
+    """
+    cfg = dict(tuner_cfg or {})
+
+    def axis(name):
+        v = cfg.get(name, "auto")
+        return _divisors(world_size) if v in (None, "auto") else [
+            int(x) for x in (v if isinstance(v, (list, tuple)) else [v])
+        ]
+
+    gbs = int(cfg.get("global_batch_size", 0) or 0)
+    micro = cfg.get("micro_batch_size", "auto")
+    if micro in (None, "auto"):
+        micros = _divisors(gbs) if gbs else [1]
+    else:
+        micros = [int(x) for x in (
+            micro if isinstance(micro, (list, tuple)) else [micro])]
+    recomputes = cfg.get("use_recompute", [False, True])
+    if not isinstance(recomputes, (list, tuple)):
+        recomputes = [bool(recomputes)]
+
+    out = []
+    for dp, mp, pp, sh, mb, rc in itertools.product(
+            axis("dp_degree"), axis("mp_degree"), axis("pp_degree"),
+            axis("sharding_degree"), micros, recomputes):
+        out.append({
+            "dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+            "sharding_degree": sh, "micro_batch_size": mb,
+            "use_recompute": bool(rc),
+        })
+    return out
+
+
+def estimate_memory_bytes(candidate, model_cfg):
+    """Coarse per-chip HBM estimate (reference `prune.py` memory prune):
+    params sharded over (mp, pp, sharding), optimizer x3 (fp32 master +
+    two Adam moments), activations ~ micro_batch * seq * hidden * layers /
+    (mp * pp), halved under recompute."""
+    h = model_cfg.get("hidden_size", 0)
+    layers = model_cfg.get("num_hidden_layers", 0)
+    vocab = model_cfg.get("vocab_size", 0)
+    seq = model_cfg.get("seq_length", 1024)
+    ffn = model_cfg.get("intermediate_size", 4 * h)
+    n_params = layers * (4 * h * h + 3 * h * ffn) + 2 * vocab * h
+    mp = candidate["mp_degree"]
+    pp = candidate["pp_degree"]
+    sh = max(candidate["sharding_degree"], 1)
+    param_bytes = 2 * n_params / (mp * pp)            # bf16 shards
+    opt_bytes = 12 * n_params / (mp * pp * sh)        # ZeRO over sharding
+    act = candidate["micro_batch_size"] * seq * h * layers * 16 / (mp * pp)
+    if candidate["use_recompute"]:
+        act /= 4
+    return param_bytes + opt_bytes + act
+
+
+def default_prunes(world_size, model_cfg=None, hbm_bytes=None):
+    """The rule set from `prune.py`, as composable predicates
+    (candidate -> reason-string-or-None)."""
+    model_cfg = model_cfg or {}
+
+    def prune_world(c):
+        prod = (c["dp_degree"] * c["mp_degree"] * c["pp_degree"]
+                * c["sharding_degree"])
+        if prod != world_size:
+            return f"dp*mp*pp*sharding={prod} != world_size={world_size}"
+        return None
+
+    def prune_mp(c):
+        heads = model_cfg.get("num_attention_heads")
+        hidden = model_cfg.get("hidden_size")
+        if heads and heads % c["mp_degree"]:
+            return f"mp={c['mp_degree']} does not divide heads={heads}"
+        if hidden and hidden % c["mp_degree"]:
+            return f"mp={c['mp_degree']} does not divide hidden={hidden}"
+        return None
+
+    def prune_pp(c):
+        layers = model_cfg.get("num_hidden_layers")
+        if layers and layers % c["pp_degree"]:
+            return f"pp={c['pp_degree']} does not divide layers={layers}"
+        return None
+
+    def prune_batch(c):
+        gbs = model_cfg.get("global_batch_size")
+        if not gbs:
+            return None
+        local = gbs // c["dp_degree"] if gbs % c["dp_degree"] == 0 else None
+        if local is None:
+            return f"dp={c['dp_degree']} does not divide batch={gbs}"
+        if local % c["micro_batch_size"]:
+            return (f"micro_batch={c['micro_batch_size']} does not divide "
+                    f"local batch={local}")
+        return None
+
+    def prune_memory(c):
+        if not hbm_bytes:
+            return None
+        est = estimate_memory_bytes(c, model_cfg)
+        if est > hbm_bytes:
+            return f"estimated {est/2**30:.1f}GiB > HBM {hbm_bytes/2**30:.1f}GiB"
+        return None
+
+    return [prune_world, prune_mp, prune_pp, prune_batch, prune_memory]
+
+
+class AutoTuner:
+    """Parity: `tuner.py` AutoTuner.
+
+    ``run_fn(candidate) -> float`` measures one candidate (higher is
+    better, e.g. tokens/s); exceptions or non-finite results mark the
+    candidate failed (the reference parses launch logs for OOM the same
+    way). ``history_path`` persists every trial as JSON lines.
+    """
+
+    def __init__(self, world_size, tuner_cfg=None, model_cfg=None,
+                 run_fn=None, hbm_bytes=None, history_path=None,
+                 max_trials=None, time_budget_s=None):
+        self.world_size = world_size
+        self.tuner_cfg = dict(tuner_cfg or {})
+        self.model_cfg = dict(model_cfg or {})
+        if "global_batch_size" in self.tuner_cfg:
+            self.model_cfg.setdefault(
+                "global_batch_size", self.tuner_cfg["global_batch_size"])
+        self.run_fn = run_fn
+        self.prunes = default_prunes(world_size, self.model_cfg, hbm_bytes)
+        self.history: list = []
+        self.history_path = history_path
+        self.max_trials = max_trials
+        self.time_budget_s = time_budget_s
+        self._pruned: list = []
+
+    def candidates(self):
+        cands, seen = [], set()
+        for c in generate_candidates(self.world_size, self.tuner_cfg):
+            key = tuple(sorted(c.items()))
+            if key in seen:
+                continue
+            seen.add(key)
+            reason = next(
+                (r for r in (p(c) for p in self.prunes) if r), None)
+            if reason:
+                self._pruned.append({"candidate": c, "reason": reason})
+            else:
+                cands.append(c)
+        # memory-safest first (the reference sorts candidates so OOM-prone
+        # configs run last): more sharding/recompute first, then larger mp
+        cands.sort(key=lambda c: (
+            -c["use_recompute"], -c["sharding_degree"], -c["mp_degree"],
+            c["micro_batch_size"]))
+        return cands
+
+    def tune(self):
+        """Run trials; returns (best_candidate, best_metric)."""
+        if self.run_fn is None:
+            raise ValueError("AutoTuner needs run_fn to measure candidates")
+        t0 = time.time()
+        best, best_metric = None, float("-inf")
+        for i, cand in enumerate(self.candidates()):
+            if self.max_trials is not None and i >= self.max_trials:
+                break
+            if (self.time_budget_s is not None
+                    and time.time() - t0 > self.time_budget_s):
+                break
+            rec = {"candidate": cand, "ok": False, "metric": None}
+            try:
+                t1 = time.time()
+                metric = float(self.run_fn(cand))
+                rec["elapsed_s"] = round(time.time() - t1, 3)
+                if metric == metric and metric not in (float("inf"),):
+                    rec["ok"] = True
+                    rec["metric"] = metric
+                    if metric > best_metric:
+                        best, best_metric = cand, metric
+            except Exception as e:  # failed trial = pruned at runtime
+                rec["error"] = f"{type(e).__name__}: {e}"[:300]
+            self.history.append(rec)
+            self._persist()
+        return best, best_metric
+
+    def _persist(self):
+        if not self.history_path:
+            return
+        d = os.path.dirname(self.history_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(self.history_path, "w") as f:
+            json.dump({"history": self.history, "pruned": self._pruned}, f,
+                      indent=1)
+
+    @property
+    def pruned(self):
+        return list(self._pruned)

@@ -46,12 +46,15 @@ def _parse():
     return p.parse_args()
 
 
-def _spawn(args, rank, nprocs, master):
+def _spawn(args, rank, nprocs, master, restarts=0):
     env = dict(os.environ)
     env["PADDLE_TRAINER_ID"] = str(rank)
     env["PADDLE_TRAINERS_NUM"] = str(nprocs)
     env["PADDLE_RANK_IN_NODE"] = str(rank)
     env["PADDLE_JOB_ID"] = args.job_id
+    # scripts use this to detect an elastic relaunch and resume from their
+    # latest checkpoint (reference: PADDLE_ELASTIC_* env rewrite on restart)
+    env["PADDLE_RESTART_COUNT"] = str(restarts)
     if master:
         env["PADDLE_MASTER"] = master
     if args.devices is not None:
@@ -74,36 +77,69 @@ def main():
     if nprocs > 1 and master is None:
         master = "127.0.0.1:49178"
 
+    # elastic membership watch (reference ElasticManager in the launcher
+    # agent): enabled when a store server address is provided — covers
+    # failures subprocess polling can't see (a remote host going dark)
+    manager = None
+    if os.environ.get("PADDLE_ELASTIC_SERVER") or args.run_mode == "elastic":
+        try:
+            from ..fleet.elastic import ElasticManager
+
+            manager = ElasticManager(
+                job_id=args.job_id, rank=max(args.rank, 0),
+                is_master=args.rank <= 0, np=nnodes)
+        except Exception as e:
+            print(f"launch: elastic manager unavailable: {e}",
+                  file=sys.stderr)
+
     procs = []
     restarts = 0
+
+    def _relaunch_pod():
+        nonlocal procs, restarts
+        restarts += 1
+        for p, _ in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p, _ in procs:
+            p.wait()
+        for _, log in procs:
+            log.close()
+        procs = [_spawn(args, r, nprocs, master, restarts)
+                 for r in range(nprocs)]
+
     try:
         for r in range(nprocs):
             procs.append(_spawn(args, r, nprocs, master))
+        members = set(manager.alive_nodes()) if manager else None
         while True:
             states = [p.poll() for p, _ in procs]
             if all(s is not None for s in states):
                 bad = [s for s in states if s != 0]
+                if manager and not bad:
+                    manager.exit(completed=True)
                 sys.exit(bad[0] if bad else 0)
             failed = [i for i, s in enumerate(states) if s not in (None, 0)]
-            if failed:
+            membership_changed = False
+            if manager is not None:
+                cur = set(manager.alive_nodes())
+                membership_changed = members is not None and cur < members
+                members = cur if membership_changed else (
+                    cur | (members or set()))
+            if failed or membership_changed:
                 if restarts >= args.max_restart:
                     for p, _ in procs:
                         if p.poll() is None:
                             p.send_signal(signal.SIGTERM)
-                    sys.exit(states[failed[0]])
-                # elastic-lite: relaunch the whole pod (reference
-                # ElasticManager kills and relaunches local trainers)
-                restarts += 1
-                for p, _ in procs:
-                    if p.poll() is None:
-                        p.send_signal(signal.SIGTERM)
-                for p, _ in procs:
-                    p.wait()
-                procs = [
-                    _spawn(args, r, nprocs, master) for r in range(nprocs)
-                ]
+                    sys.exit(states[failed[0]] if failed else 1)
+                # relaunch the whole pod (reference ElasticManager kills and
+                # relaunches local trainers); workers resume from their last
+                # dist.checkpoint via PADDLE_RESTART_COUNT
+                _relaunch_pod()
             time.sleep(0.5)
     finally:
+        if manager is not None:
+            manager.exit()
         for p, log in procs:
             if p.poll() is None:
                 p.terminate()

@@ -1,0 +1,222 @@
+"""Inference engine: Config + Predictor over exported StableHLO.
+
+Reference parity: `paddle_infer.Config` / `AnalysisPredictor`
+(`paddle/fluid/inference/api/analysis_predictor.h:94`,
+`paddle_inference_api.h`) — load a saved program, optimize, run with
+zero-copy input/output handles.
+
+TPU-first design: the saved artifact is a `jax.export` StableHLO blob
+(`jit.save` — the `.pdmodel` equivalent) with parameters baked in as
+constants. The reference's analysis passes (IR fusion, TRT subgraph,
+mixed precision rewrite) are XLA's job at load time; the Predictor's
+configurable surface maps to what matters on TPU:
+
+- device selection (`config.set_device`)
+- input-precision cast (`config.set_precision("bfloat16")` — the
+  auto-mixed-precision pass analogue for inference)
+- buffer donation (`config.enable_memory_optim()` — donates input buffers
+  to the executable, the zero-copy-run analogue)
+- warmup compile at predictor creation (`config.set_warmup(True)`)
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
+
+
+class Config:
+    """Parity: `paddle_infer.Config` (the subset meaningful on TPU)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # paddle passes "<prefix>.pdmodel", "<prefix>.pdiparams"; accept the
+        # prefix itself too
+        prefix = prog_file or ""
+        for suffix in (".pdmodel", ".pdiparams"):
+            if prefix.endswith(suffix):
+                prefix = prefix[: -len(suffix)]
+        self._prefix = prefix
+        self._device = None          # default: current device
+        self._precision = None       # None = as exported
+        self._donate = False
+        self._warmup = True
+
+    # -- model location --
+    def set_prog_file(self, path):
+        self._prefix = path[:-len(".pdmodel")] if path.endswith(".pdmodel") \
+            else path
+
+    def prog_file(self):
+        return self._prefix + ".pdmodel"
+
+    def set_model(self, prog_file, params_file=None):
+        self.set_prog_file(prog_file)
+
+    # -- device / precision / memory --
+    def set_device(self, device):
+        self._device = device
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=0, device_id=0):
+        # accepted for source compatibility; "gpu" maps to the accelerator
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def set_precision(self, precision):
+        """"float32" | "bfloat16" | "float16": cast floating inputs before
+        the compiled program (reference: auto-mixed-precision inference)."""
+        self._precision = precision
+
+    def enable_memory_optim(self, x=True):
+        self._donate = bool(x)
+
+    def set_warmup(self, warmup):
+        self._warmup = bool(warmup)
+
+    # source-compat no-ops (XLA owns these concerns)
+    def switch_ir_optim(self, x=True):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def summary(self):
+        return (f"Config(prefix={self._prefix!r}, device={self._device}, "
+                f"precision={self._precision}, donate={self._donate})")
+
+
+class PredictorTensor:
+    """Zero-copy-style I/O handle (parity: `ZeroCopyTensor`)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.asarray(arr)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(np.shape(self._value))
+
+
+class Predictor:
+    """Parity: `paddle_infer.Predictor` / `AnalysisPredictor`."""
+
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+
+        self._config = config
+        self._translated = jit_load(config._prefix)
+        self._meta = self._translated._meta
+        ins = self._meta.get("inputs", [])
+        self._in_names = [
+            (m.get("name") or f"input_{i}") for i, m in enumerate(ins)
+        ]
+        self._in_dtypes = [np.dtype(m["dtype"]) for m in ins]
+        self._inputs = {n: PredictorTensor(n) for n in self._in_names}
+        self._outputs: dict = {}
+        self._out_names: list = []
+        self._exec = self._build_executable()
+        if config._warmup:
+            self._warmup_compile()
+
+    def _build_executable(self):
+        call = self._translated._exported.call
+        precision = self._config._precision
+        donate = self._config._donate
+        in_dtypes = self._in_dtypes
+
+        def run(*arrays):
+            cast = []
+            for a, dt in zip(arrays, in_dtypes):
+                if (precision is not None
+                        and np.issubdtype(dt, np.floating)):
+                    a = a.astype(precision)
+                    a = a.astype(dt) if str(dt) != str(precision) else a
+                cast.append(a)
+            out = call(*cast)
+            return out if isinstance(out, (list, tuple)) else (out,)
+
+        kw = {}
+        if donate:
+            kw["donate_argnums"] = tuple(range(len(self._in_names)))
+        dev = self._config._device
+        if dev is not None:
+            from ..framework.device import _lookup
+
+            kw["device"] = _lookup(dev)
+        return jax.jit(run, **kw)
+
+    def _warmup_compile(self):
+        shapes = [m["shape"] for m in self._meta.get("inputs", [])]
+        if any(d is None for s in shapes for d in s):
+            return  # dynamic dims: compile happens per concrete shape
+        zeros = [np.zeros(s, dt)
+                 for s, dt in zip(shapes, self._in_dtypes)]
+        try:
+            outs = self._exec(*zeros)
+            jax.block_until_ready(outs)
+        except Exception:
+            pass  # warmup is best-effort; real run surfaces real errors
+
+    # -- handle API --
+    def get_input_names(self):
+        return list(self._in_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return list(self._out_names)
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def run(self, inputs=None):
+        """Handle-style: stage via copy_from_cpu then run(); or direct:
+        run([np_arrays...]) -> [np_arrays...] (reference both exist)."""
+        if inputs is not None:
+            arrays = [
+                x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+                for x in inputs
+            ]
+        else:
+            arrays = [self._inputs[n].copy_to_cpu() for n in self._in_names]
+        outs = self._exec(*arrays)
+        outs = [np.asarray(o) for o in outs]
+        self._out_names = [f"output_{i}" for i in range(len(outs))]
+        self._outputs = {}
+        for n, o in zip(self._out_names, outs):
+            h = PredictorTensor(n)
+            h.copy_from_cpu(o)
+            self._outputs[n] = h
+        if inputs is not None:
+            return outs
+        return True
+
+    def clear_intermediate_tensor(self):
+        self._outputs = {}
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    """Parity: `paddle_infer.create_predictor`."""
+    return Predictor(config)

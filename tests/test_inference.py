@@ -1,0 +1,80 @@
+"""Inference Predictor tests (reference `test/inference` +
+`analysis_predictor_tester.cc` behavior at the Python API surface)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.jit import InputSpec, save
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(pt.tanh(self.fc1(x)))
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    pt.seed(0)
+    net = Net()
+    path = str(tmp_path_factory.mktemp("infer") / "net")
+    save(net, path, input_spec=[InputSpec([2, 8], "float32", "x")])
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    ref = net(pt.to_tensor(x)).numpy()
+    return path, x, ref
+
+
+def test_predictor_direct_run(saved_model):
+    path, x, ref = saved_model
+    pred = create_predictor(Config(path))
+    outs = pred.run([x])
+    assert len(outs) == 1
+    np.testing.assert_allclose(outs[0], ref, atol=1e-5)
+
+
+def test_predictor_handle_api(saved_model):
+    path, x, ref = saved_model
+    pred = create_predictor(Config(path + ".pdmodel"))
+    names = pred.get_input_names()
+    assert names == ["x"]
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(x)
+    assert pred.run() is True
+    out_names = pred.get_output_names()
+    assert len(out_names) == 1
+    out = pred.get_output_handle(out_names[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_predictor_precision_and_donation(saved_model):
+    path, x, ref = saved_model
+    cfg = Config(path)
+    cfg.set_precision("bfloat16")
+    cfg.enable_memory_optim()
+    pred = create_predictor(cfg)
+    out = pred.run([x])[0]
+    # bf16 squeeze: close but not bit-equal
+    np.testing.assert_allclose(out, ref, atol=0.1)
+    assert np.abs(out - ref).max() > 0 or np.allclose(out, ref)
+
+
+def test_predictor_device_cpu(saved_model):
+    path, x, ref = saved_model
+    cfg = Config(path)
+    cfg.disable_gpu()
+    pred = create_predictor(cfg)
+    np.testing.assert_allclose(pred.run([x])[0], ref, atol=1e-5)
+
+
+def test_config_summary(saved_model):
+    path, _, _ = saved_model
+    cfg = Config(path)
+    cfg.set_precision("bfloat16")
+    assert "bfloat16" in cfg.summary()
+    assert cfg.prog_file().endswith(".pdmodel")
