@@ -187,16 +187,23 @@ class TrainStep:
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(step_fn, donate_argnums=donate)
 
-    def __call__(self, *batch):
+    def _get_compiled(self, batch):
+        """Normalize batch to arrays and return (jitted_fn, arrays) from
+        the signature cache — shared by __call__ and memory_analysis so
+        the analyzed executable is the one that actually runs."""
         self._ensure_state()
         arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(b)
                   for b in batch]
         training = getattr(self._model, "training", True)
-        sig = (tuple((tuple(a.shape), str(a.dtype)) for a in arrays), training)
+        sig = (tuple((tuple(a.shape), str(a.dtype)) for a in arrays),
+               training)
         fn = self._cache.get(sig)
         if fn is None:
-            fn = self._build(sig)
-            self._cache[sig] = fn
+            fn = self._cache[sig] = self._build(sig)
+        return fn, arrays
+
+    def __call__(self, *batch):
+        fn, arrays = self._get_compiled(batch)
         lr = self._opt.get_lr()
         self._step_count += 1
 
@@ -234,3 +241,24 @@ class TrainStep:
     @property
     def compiled_count(self):
         return len(self._cache)
+
+    def memory_analysis(self, *batch):
+        """XLA memory accounting of the compiled step for these batch
+        shapes (``argument/output/temp/generated_code`` bytes, as reported
+        by the executable). The HBM-footprint source of truth on platforms
+        whose PJRT plugin returns no allocator stats
+        (``device.memory_stats() is None`` over the tunneled chip). Pays
+        one AOT compile — the in-process jit cache is separate."""
+        fn, arrays = self._get_compiled(batch)
+        lowered = fn.lower(
+            [p._data for p in self._params],
+            self._flatten_state(),
+            [b._data for b in self._buffers],
+            jnp.asarray(self._opt.get_lr(), jnp.float32),
+            jnp.asarray(self._step_count, jnp.int32),
+            # only the key's aval matters for lowering; a fixed key keeps
+            # this introspection free of global-PRNG side effects
+            jax.random.key(0),
+            arrays,
+        )
+        return lowered.compile().memory_analysis()

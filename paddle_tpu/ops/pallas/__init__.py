@@ -8,3 +8,40 @@ _fa.register(platform="tpu")
 
 flash_attention_kernel = _fa.flash_attention_kernel
 register_flash_attention = _fa.register
+
+
+def check_tpu_lowering():
+    """Lower every registered Pallas kernel for the TPU platform.
+
+    Runs on any host (no chip needed): ``jax.export(platforms=['tpu'])``
+    performs the full Mosaic lowering, including the block-mapping checks
+    that interpret-mode skips. Raises on the first kernel that would fail
+    on real hardware — wired into ``__graft_entry__.entry()`` and the
+    bench pre-flight so a kernel regression fails loudly *before* it can
+    zero a hardware run (the round-2 failure mode).
+
+    Coverage is registry-driven: each kernel registers a
+    ``check_lowering`` self-check attribute alongside itself, so new
+    Pallas kernels are covered automatically (a kernel without one is a
+    hard error — an unchecked kernel is exactly how round 2 failed).
+    """
+    from .. import registry
+
+    kernels = registry.platform_kernels("tpu")
+    for name, fn in kernels:
+        check = getattr(fn, "check_lowering", None)
+        if check is None:
+            raise RuntimeError(
+                f"Pallas kernel {name!r} registered without a "
+                f"check_lowering self-check; attach one in its register()")
+        check()
+
+
+def disable():
+    """Drop every Pallas override so ops fall back to the XLA composite
+    path — the bench pre-flight's containment action when a kernel fails
+    to lower (a kernel bug must cost MFU, not the run)."""
+    from .. import registry
+
+    for name, _ in registry.platform_kernels("tpu"):
+        registry.deregister_kernel(name, "tpu")

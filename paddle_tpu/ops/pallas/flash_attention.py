@@ -39,7 +39,11 @@ from jax.experimental.pallas import tpu as pltpu
 from .. import registry
 
 NEG_INF = -1e30
-# lane width for the m/l scratch rows (fp32 VMEM tiles are (8, 128))
+# lane width for the m/l scratch rows and the lse/delta side outputs.
+# Mosaic requires the last block dim to be 128-divisible (or equal to the
+# array dim), so per-row scalars are carried lane-broadcast — the same
+# layout the splash/flash kernels in jax.experimental.pallas.ops.tpu use
+# (fp32 VMEM tiles are (8, 128)).
 _LANES = 128
 
 
@@ -108,7 +112,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         valid = m > NEG_INF * 0.5
         o_ref[0] = jnp.where(
             valid, acc_ref[...] / l_safe, 0.0).astype(o_ref.dtype)
-        lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+        lse_ref[0] = jnp.broadcast_to(m + jnp.log(l_safe),
+                                      lse_ref.shape[1:])
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -125,8 +130,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _step():
         q = q_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         s = scale * jax.lax.dot_general(
@@ -172,8 +177,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0].astype(jnp.float32)
         q = q_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, bk]
@@ -217,7 +222,11 @@ def _flash_bhsd(q, k, v, causal, scale, interpret):
 
 
 def _flash_fwd(q, k, v, causal, scale, interpret):
-    """q,k,v: [bh, s, d] -> (out [bh, s, d], lse [bh, s])."""
+    """q,k,v: [bh, s, d] -> (out [bh, s, d], lse [bh, s, _LANES]).
+
+    lse is returned lane-broadcast (last dim `_LANES`) so its BlockSpec
+    satisfies Mosaic's lane-divisibility rule; consumers read [..., :1].
+    """
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = _pick_block(sq)
@@ -236,11 +245,11 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -274,9 +283,12 @@ def _flash_bwd_rule(causal, scale, interpret, res, g):
     n_kb = sk // block_k
     offset = sk - sq
     g = g.astype(q.dtype)
-    # delta_i = sum_d(do * o) per row (FlashAttention-2 eq. for ds)
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)  # [bh, sq]
+    # delta_i = sum_d(do * o) per row (FlashAttention-2 eq. for ds),
+    # lane-broadcast to match the lse layout (see _flash_fwd docstring)
+    delta = jnp.broadcast_to(
+        jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1, keepdims=True),
+        (bh, sq, _LANES))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
@@ -287,8 +299,8 @@ def _flash_bwd_rule(causal, scale, interpret, res, g):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
@@ -307,8 +319,8 @@ def _flash_bwd_rule(causal, scale, interpret, res, g):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -350,7 +362,13 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
         return fallback(dropout)
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    if sq < 16 or sk < 16 or d % 128 or k.shape[2] != h:
+    # d is never blocked, so any 8-multiple head_dim lowers (block dim ==
+    # array dim); d=64 (BERT-base) engages the kernel, matching the
+    # reference flash_attn kernel's head_dim support. The seq blocks must
+    # be sublane-aligned when they tile the sequence.
+    bq, bk = _pick_block(sq), _pick_block(sk)
+    ok_blocks = (bq == sq or bq % 8 == 0) and (bk == sk or bk % 8 == 0)
+    if sq < 16 or sk < 16 or d % 8 or k.shape[2] != h or not ok_blocks:
         return fallback(0.0)
     scale = 1.0 / math.sqrt(d)
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
@@ -360,10 +378,35 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 
+def check_lowering():
+    """Mosaic-lower fwd+bwd for platform 'tpu' at the kernel's contract
+    shapes (BERT-base d=64, Llama d=128, cross-length) — runs on any host
+    via jax.export, no chip needed."""
+    shapes = [(8, 1024, 1024, 64), (8, 1024, 1024, 128), (4, 512, 1024, 128)]
+    for bh, sq, sk, d in shapes:
+        q = jnp.zeros((bh, sq, d), jnp.bfloat16)
+        kv = jnp.zeros((bh, sk, d), jnp.bfloat16)
+        scale = 1.0 / math.sqrt(d)
+
+        def fwd(q, k, v, _s=scale):
+            return _flash_bhsd(q, k, v, True, _s, False)
+
+        def bwd(q, k, v, _s=scale):
+            return jax.grad(
+                lambda *a: fwd(*a, _s=_s).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2))(q, k, v)
+
+        jax.export.export(jax.jit(fwd), platforms=["tpu"])(q, kv, kv)
+        jax.export.export(jax.jit(bwd), platforms=["tpu"])(q, kv, kv)
+
+
 def register(platform="tpu", interpret=False):
     fn = functools.partial(flash_attention_kernel, interpret=interpret)
     # ask dispatch to pass the caller's composite closure as default_fn so
     # fallback paths keep caller state (the live dropout PRNG key).
     fn.wants_default = True
+    # the lowering self-check travels with the kernel so the pre-flight
+    # (ops.pallas.check_tpu_lowering) covers every registered kernel
+    fn.check_lowering = check_lowering
     registry.register_kernel("flash_attention", platform)(fn)
     return fn

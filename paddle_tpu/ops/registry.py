@@ -52,6 +52,21 @@ def register_kernel(op_name: str, platform: str = "tpu"):
     return deco
 
 
+def deregister_kernel(op_name: str, platform: str = "tpu"):
+    """Drop a platform override so the op falls back to the default XLA
+    implementation (the bench pre-flight's containment action)."""
+    rec = _OPS.get(op_name)
+    if rec is not None:
+        rec.kernels.pop(platform, None)
+
+
+def platform_kernels(platform: str = "tpu"):
+    """All (op_name, kernel) overrides registered for ``platform``."""
+    return [(name, rec.kernels[platform])
+            for name, rec in sorted(_OPS.items())
+            if platform in rec.kernels]
+
+
 def lookup_kernel(op_name: str):
     rec = _OPS.get(op_name)
     if rec is None or not rec.kernels:
