@@ -186,11 +186,13 @@ def main():
             # own executable memory accounting (args incl. donated params
             # + temporaries = live HBM during the step)
             prev, remaining = _timeboxed_alarm(600)
+            t_ma = time.monotonic()
             try:
                 ma = step.memory_analysis(ids, labels)
             finally:
+                elapsed = int(time.monotonic() - t_ma)
                 signal.signal(signal.SIGALRM, prev)
-                signal.alarm(max(remaining - 600, 60) if remaining else 0)
+                signal.alarm(max(remaining - elapsed, 60) if remaining else 0)
             peak = ma.argument_size_in_bytes + ma.temp_size_in_bytes
             extra["peak_hbm_gib"] = round(peak / 2**30, 2)
             extra["hbm_args_gib"] = round(

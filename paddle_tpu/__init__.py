@@ -79,6 +79,8 @@ _LAZY = {
     "onnx": ".onnx",
     "fft": ".fft",
     "inference": ".inference",
+    "geometric": ".geometric",
+    "signal": ".signal",
 }
 
 

@@ -72,9 +72,26 @@ class Group:
 
     @property
     def rank(self) -> int:
-        return 0 if self.nranks > 0 else -1
+        """Single-controller semantics: the python process is not one rank
+        of the group — it drives ALL shards of the mesh at once, so "this
+        process's rank" is 0 by convention (the reference's per-process
+        rank does not map onto GSPMD). Code that branches per-rank should
+        instead shard by mesh axis; see `get_group_rank`."""
+        if self.nranks <= 0:
+            return -1
+        return 0
 
     def get_group_rank(self, rank):
+        """Identity under the single-controller model: global rank == group
+        rank because there is exactly one controller. Reference code that
+        uses this to pick a subset of data must use sharding instead —
+        raise loudly if the caller asks for a rank this controller does
+        not own (anything other than its own world)."""
+        if not isinstance(rank, int) or rank < 0 or rank >= max(self.nranks, 1):
+            raise ValueError(
+                f"rank {rank} out of range for single-controller group "
+                f"with {self.nranks} shards; per-rank branching does not "
+                f"exist under GSPMD — express the split as a sharding")
         return rank
 
     def __repr__(self):

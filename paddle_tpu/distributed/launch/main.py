@@ -116,6 +116,12 @@ def main():
             states = [p.poll() for p, _ in procs]
             if all(s is not None for s in states):
                 bad = [s for s in states if s != 0]
+                if bad and restarts < args.max_restart:
+                    # whole pod died (single-proc pods land here, never in
+                    # the partial-failure branch below) — relaunch, resume
+                    # from checkpoint via PADDLE_RESTART_COUNT
+                    _relaunch_pod()
+                    continue
                 if manager and not bad:
                     manager.exit(completed=True)
                 sys.exit(bad[0] if bad else 0)

@@ -1,4 +1,4 @@
-from . import core, device, dtype, random
+from . import core, device, dtype, errors, random
 from .core import Tensor, Parameter, EagerParamBase, to_tensor
 from .device import set_device, get_device, device_count, is_compiled_with_tpu
 from .dtype import (

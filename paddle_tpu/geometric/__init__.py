@@ -1,0 +1,293 @@
+"""paddle.geometric — graph learning ops.
+
+Reference parity: `python/paddle/geometric/` — segment reductions
+(`math.py:23-191`, PHI `segment_pool` kernel), message passing
+(`message_passing/send_recv.py:36,179,376` — `send_u_recv`, `send_ue_recv`,
+`send_uv` over the `graph_send_recv`/`graph_send_ue_recv` kernels), graph
+reindex (`reindex.py:25,136`) and neighbor sampling
+(`sampling/neighbors.py:23,175`).
+
+TPU-first design: the reduce ops lower to `jax.ops.segment_*` — XLA
+scatter-reduce HLOs that fuse with surrounding compute and differentiate
+through the standard scatter/gather transpose rules (the reference writes
+CUDA kernels + hand-written grad kernels for the same ops). Segment counts
+are static shapes: they are taken from concrete index values in eager mode
+(or from ``out_size``), because XLA requires static output shapes — inside
+a trace, pass ``out_size`` explicitly. Reindex and neighbor sampling are
+host-side index manipulation feeding the data pipeline (not MXU work), so
+they run as NumPy on the host — the TPU analogue of the reference's
+CPU sampling path, without a device round-trip.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..ops.dispatch import apply
+
+__all__ = [
+    "send_u_recv",
+    "send_ue_recv",
+    "send_uv",
+    "segment_sum",
+    "segment_mean",
+    "segment_min",
+    "segment_max",
+    "reindex_graph",
+    "reindex_heter_graph",
+    "sample_neighbors",
+    "weighted_sample_neighbors",
+]
+
+
+def _static_count(index, out_size):
+    """Static segment count: out_size if given, else max(index)+1 taken
+    from concrete values (eager). Inside jit, out_size is required."""
+    if out_size is not None:
+        if isinstance(out_size, Tensor):
+            out_size = out_size._data
+        size = int(out_size)
+        if size > 0:
+            return size
+    arr = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    if isinstance(arr, jax.core.Tracer):
+        raise ValueError(
+            "geometric ops need a static output size under tracing; pass "
+            "out_size explicitly (XLA requires static shapes)")
+    if arr.size == 0:
+        return 0
+    return int(jnp.max(arr)) + 1
+
+
+def _seg_reduce(data, seg_ids, num, op):
+    if op == "sum":
+        return jax.ops.segment_sum(data, seg_ids, num)
+    if op == "mean":
+        total = jax.ops.segment_sum(data, seg_ids, num)
+        cnt = jax.ops.segment_sum(
+            jnp.ones(seg_ids.shape, data.dtype), seg_ids, num)
+        cnt = jnp.maximum(cnt, 1).reshape((num,) + (1,) * (data.ndim - 1))
+        return total / cnt
+    if op in ("min", "max"):
+        fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        out = fn(data, seg_ids, num)
+        # untouched rows come back ±inf (or int extremes); the reference
+        # zero-initializes its output buffer, so empty segments are 0
+        touched = jax.ops.segment_sum(
+            jnp.ones(seg_ids.shape, jnp.float32), seg_ids, num) > 0
+        touched = touched.reshape((num,) + (1,) * (data.ndim - 1))
+        return jnp.where(touched, out, jnp.zeros((), data.dtype))
+    raise ValueError(f"unsupported reduce_op {op!r}")
+
+
+def _segment(name, op):
+    def f(data, segment_ids, name=None):
+        num = _static_count(segment_ids, None)
+
+        def fn(d, ids):
+            return _seg_reduce(d, ids, num, op)
+
+        return apply(f.__op_name__, fn, (data, segment_ids))
+
+    f.__name__ = f.__qualname__ = name
+    f.__op_name__ = name
+    f.__doc__ = (
+        f"Segment {op} along axis 0 (parity: paddle.geometric.{name}; "
+        f"reference `geometric/math.py`, PHI `segment_pool`). segment_ids "
+        f"must be sorted non-decreasing, result has max(id)+1 rows.")
+    return f
+
+
+segment_sum = _segment("segment_sum", "sum")
+segment_mean = _segment("segment_mean", "mean")
+segment_min = _segment("segment_min", "min")
+segment_max = _segment("segment_max", "max")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather rows of ``x`` at ``src_index``, scatter-reduce them into the
+    ``dst_index`` rows of a zero output (parity:
+    `geometric/message_passing/send_recv.py:36`, `graph_send_recv` kernel).
+    Output has ``out_size`` rows (default: x.shape[0])."""
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    num = _out_rows(x, out_size)
+
+    def fn(x, src, dst):
+        return _seg_reduce(jnp.take(x, src, axis=0), dst, num, reduce_op)
+
+    return apply("graph_send_recv", fn, (x, src_index, dst_index))
+
+
+def _out_rows(x, out_size):
+    """Reference contract: out_size unset or <= 0 means the output keeps
+    x's row count; otherwise out_size rows."""
+    if out_size is not None:
+        if isinstance(out_size, Tensor):
+            out_size = int(out_size._data)
+        if int(out_size) > 0:
+            return int(out_size)
+    return x.shape[0]
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Gather ``x[src]``, combine with edge features ``y`` via
+    ``message_op`` (add/sub/mul/div), scatter-reduce to ``dst`` (parity:
+    `send_recv.py:179`, `graph_send_ue_recv` kernel)."""
+    ops = {"add": jnp.add, "sub": jnp.subtract,
+           "mul": jnp.multiply, "div": jnp.divide}
+    if message_op not in ops:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    num = _out_rows(x, out_size)
+
+    def fn(x, y, src, dst):
+        msg = ops[message_op](jnp.take(x, src, axis=0), _edge_align(y, x))
+        return _seg_reduce(msg, dst, num, reduce_op)
+
+    return apply("graph_send_ue_recv", fn, (x, y, src_index, dst_index))
+
+
+def _edge_align(y, x):
+    """Left-align edge features on the edge axis: y of shape [E] or
+    [E, f] gains trailing singleton dims to broadcast against [E, ...]
+    messages (jnp broadcasting is right-aligned, the edge axis is left)."""
+    while y.ndim < x.ndim:
+        y = y[..., None]
+    return y
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message ``op(x[src], y[dst])`` with no reduction — returns
+    [num_edges, ...] (parity: `send_recv.py:376`, `graph_send_uv`)."""
+    ops = {"add": jnp.add, "sub": jnp.subtract,
+           "mul": jnp.multiply, "div": jnp.divide}
+    if message_op not in ops:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+
+    def fn(x, y, src, dst):
+        return ops[message_op](jnp.take(x, src, axis=0),
+                               jnp.take(y, dst, axis=0))
+
+    return apply("graph_send_uv", fn, (x, y, src_index, dst_index))
+
+
+# ---- host-side graph utilities (data pipeline, not compute graph) ----
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x._data)
+    return np.asarray(x)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact node ids to 0..n-1 with input nodes first (parity:
+    `geometric/reindex.py:25`, `graph_reindex` kernel). Returns
+    (reindex_src, reindex_dst, out_nodes)."""
+    xs, nb, cnt = _np(x), _np(neighbors), _np(count)
+    # out_nodes: x first, then neighbors not already in x, first-seen order
+    seen = {int(v): i for i, v in enumerate(xs)}
+    out = list(xs)
+    for v in nb:
+        v = int(v)
+        if v not in seen:
+            seen[v] = len(out)
+            out.append(v)
+    reindex_src = np.asarray([seen[int(v)] for v in nb], dtype=xs.dtype)
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=xs.dtype), cnt)
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(np.asarray(out, dtype=xs.dtype))))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: per-edge-type neighbor/count lists share one
+    id space (parity: `geometric/reindex.py:136`)."""
+    xs = _np(x)
+    seen = {int(v): i for i, v in enumerate(xs)}
+    out = list(xs)
+    srcs, dsts = [], []
+    for nb, cnt in zip(neighbors, count):
+        nb, cnt = _np(nb), _np(cnt)
+        for v in nb:
+            v = int(v)
+            if v not in seen:
+                seen[v] = len(out)
+                out.append(v)
+        srcs.append(np.asarray([seen[int(v)] for v in nb], dtype=xs.dtype))
+        dsts.append(np.repeat(np.arange(len(xs), dtype=xs.dtype), cnt))
+    return (Tensor(jnp.asarray(np.concatenate(srcs))),
+            Tensor(jnp.asarray(np.concatenate(dsts))),
+            Tensor(jnp.asarray(np.asarray(out, dtype=xs.dtype))))
+
+
+def _sample_from_csc(row, colptr, nodes, sample_size, eids, weights, rng):
+    out_nb, out_cnt, out_eids = [], [], []
+    for n in nodes:
+        lo, hi = int(colptr[n]), int(colptr[n + 1])
+        deg = hi - lo
+        idx = np.arange(lo, hi)
+        if 0 <= sample_size < deg:
+            if weights is None:
+                idx = rng.choice(idx, size=sample_size, replace=False)
+            else:
+                w = weights[lo:hi].astype(np.float64)
+                p = w / w.sum() if w.sum() > 0 else None
+                idx = rng.choice(idx, size=sample_size, replace=False, p=p)
+        out_nb.append(row[idx])
+        out_cnt.append(len(idx))
+        if eids is not None:
+            out_eids.append(eids[idx])
+    nb = np.concatenate(out_nb) if out_nb else np.zeros((0,), row.dtype)
+    cnt = np.asarray(out_cnt, dtype=row.dtype)
+    ei = (np.concatenate(out_eids) if out_eids else np.zeros((0,), row.dtype)) \
+        if eids is not None else None
+    return nb, cnt, ei
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling from a CSC graph (parity:
+    `geometric/sampling/neighbors.py:23`, `graph_sample_neighbors` kernel).
+    Returns (neighbors, count[, eids])."""
+    from ..framework import random as rng_mod
+
+    rng = np.random.default_rng(
+        int(jax.random.randint(rng_mod.next_key(), (), 0, 2**31 - 1)))
+    nb, cnt, ei = _sample_from_csc(
+        _np(row), _np(colptr), _np(input_nodes), sample_size,
+        _np(eids) if (return_eids and eids is not None) else None, None, rng)
+    outs = (Tensor(jnp.asarray(nb)), Tensor(jnp.asarray(cnt)))
+    if return_eids:
+        if ei is None:
+            raise ValueError("return_eids=True requires eids")
+        outs += (Tensor(jnp.asarray(ei)),)
+    return outs
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional neighbor sampling (parity:
+    `geometric/sampling/neighbors.py:175`)."""
+    from ..framework import random as rng_mod
+
+    rng = np.random.default_rng(
+        int(jax.random.randint(rng_mod.next_key(), (), 0, 2**31 - 1)))
+    nb, cnt, ei = _sample_from_csc(
+        _np(row), _np(colptr), _np(input_nodes), sample_size,
+        _np(eids) if (return_eids and eids is not None) else None,
+        _np(edge_weight), rng)
+    outs = (Tensor(jnp.asarray(nb)), Tensor(jnp.asarray(cnt)))
+    if return_eids:
+        if ei is None:
+            raise ValueError("return_eids=True requires eids")
+        outs += (Tensor(jnp.asarray(ei)),)
+    return outs

@@ -1,4 +1,5 @@
 """paddle.incubate parity (`python/paddle/incubate/`)."""
-from . import distributed, nn  # noqa: F401
+from . import asp, distributed, nn  # noqa: F401
+from .model_average import ModelAverage  # noqa: F401
 
-__all__ = ["nn", "distributed"]
+__all__ = ["nn", "distributed", "asp", "ModelAverage"]

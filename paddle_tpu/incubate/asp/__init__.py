@@ -1,0 +1,270 @@
+"""paddle.incubate.asp — n:m structured sparsity (2:4 by default).
+
+Reference parity: `python/paddle/incubate/asp/asp.py` (ASPHelper,
+`decorate`, `prune_model`, excluded layers) + `asp/utils.py`
+(`get_mask_1d`, `get_mask_2d_greedy/best`, `check_mask_*`,
+`create_mask`, `check_sparsity`, `calculate_density`).
+
+Semantics: an `n:m` pattern has AT LEAST n zeros in every 1×m block.
+Masks are generated along the matmul reduction dimension (weight.T for
+[in, out] Linear weights — the same orientation the reference's
+fc/linear prune funcs use for cuSPARSELt), applied once at prune time,
+and re-applied after every optimizer update by the decorated optimizer
+(`Optimizer._param_masks`, mirroring OptimizerWithSparsityGuarantee) —
+inside the compiled TrainStep the mask multiply fuses into the update.
+
+TPU note: v5p+ MXUs have no 2:4 hardware path like sparse tensor cores;
+the capability here is *sparsity-aware training* (mask generation +
+preservation), which is hardware-agnostic — the masked weights stay
+exactly zero so exported checkpoints can target sparse inference engines.
+"""
+from __future__ import annotations
+
+from enum import Enum
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor
+
+__all__ = [
+    "MaskAlgo", "CheckMethod", "calculate_density", "check_mask_1d",
+    "get_mask_1d", "check_mask_2d", "get_mask_2d_greedy", "get_mask_2d_best",
+    "create_mask", "check_sparsity", "decorate", "prune_model",
+    "set_excluded_layers", "reset_excluded_layers",
+]
+
+
+class MaskAlgo(Enum):
+    MASK_1D = "get_mask_1d"
+    MASK_2D_GREEDY = "get_mask_2d_greedy"
+    MASK_2D_BEST = "get_mask_2d_best"
+
+
+class CheckMethod(Enum):
+    CHECK_1D = "check_mask_1d"
+    CHECK_2D = "check_mask_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo):
+        if mask_algo == MaskAlgo.MASK_1D:
+            return CheckMethod.CHECK_1D
+        return CheckMethod.CHECK_2D
+
+
+def calculate_density(x):
+    """Fraction of non-zero elements (parity: asp.calculate_density)."""
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(a)) / max(a.size, 1)
+
+
+def _reshape_1d(mat, m):
+    """Pad the row length to a multiple of m, view as [rows*ceil, m]."""
+    pad = (-mat.shape[1]) % m
+    padded = np.concatenate(
+        [mat, np.zeros((mat.shape[0], pad), mat.dtype)], axis=1)
+    return padded.reshape(-1, m), padded.shape
+
+
+def check_mask_1d(mat, n, m):
+    """True iff every 1×m block of `mat` has at least n zeros."""
+    mat = np.asarray(mat)
+    if mat.ndim != 2:
+        mat = mat.reshape(mat.shape[0], -1)
+    flat, _ = _reshape_1d(mat, m)
+    zeros_per_block = (flat == 0).sum(axis=1)
+    return bool((zeros_per_block >= n).all())
+
+
+def get_mask_1d(mat, n, m):
+    """Zero the n smallest-|value| entries of every 1×m row block
+    (parity: asp.utils.get_mask_1d)."""
+    mat = np.asarray(mat)
+    flat, padded_shape = _reshape_1d(mat, m)
+    order = np.argsort(np.abs(flat), axis=1)
+    mask_flat = np.ones_like(flat)
+    np.put_along_axis(mask_flat, order[:, :n], 0, axis=1)
+    mask = mask_flat.reshape(padded_shape)[:, :mat.shape[1]]
+    return mask.astype(mat.dtype)
+
+
+def _reshape_2d(mat, m):
+    pad_r = (-mat.shape[0]) % m
+    pad_c = (-mat.shape[1]) % m
+    padded = np.pad(mat, ((0, pad_r), (0, pad_c)))
+    r, c = padded.shape
+    blocks = padded.reshape(r // m, m, c // m, m).transpose(0, 2, 1, 3)
+    return blocks.reshape(-1, m, m), padded.shape
+
+
+def check_mask_2d(mat, n, m):
+    """True iff every m×m block has at least n zeros in every row AND
+    every column."""
+    mat = np.asarray(mat)
+    if mat.ndim != 2:
+        mat = mat.reshape(mat.shape[0], -1)
+    blocks, _ = _reshape_2d(mat, m)
+    zero = blocks == 0
+    return bool(((zero.sum(axis=2) >= n).all()
+                 and (zero.sum(axis=1) >= n).all()))
+
+
+def get_mask_2d_greedy(mat, n, m):
+    """Greedy 2-D n:m mask: per m×m block, pick the largest-|value|
+    entries subject to per-row/per-column non-zero budgets of (m - n)
+    (parity: asp.utils.get_mask_2d_greedy)."""
+    mat = np.asarray(mat)
+    blocks, padded_shape = _reshape_2d(mat, m)
+    mask_blocks = np.zeros_like(blocks)
+    budget = m - n
+    for b in range(blocks.shape[0]):
+        sub = np.abs(blocks[b])
+        order = np.argsort(-sub, axis=None)
+        row_cnt = np.zeros(m, np.int64)
+        col_cnt = np.zeros(m, np.int64)
+        for flat_idx in order:
+            i, j = divmod(int(flat_idx), m)
+            if row_cnt[i] < budget and col_cnt[j] < budget:
+                mask_blocks[b, i, j] = 1
+                row_cnt[i] += 1
+                col_cnt[j] += 1
+    r, c = padded_shape
+    mask = mask_blocks.reshape(r // m, c // m, m, m).transpose(0, 2, 1, 3)
+    mask = mask.reshape(r, c)[: mat.shape[0], : mat.shape[1]]
+    return mask.astype(mat.dtype)
+
+
+def get_mask_2d_best(mat, n, m):
+    """Best-effort 2-D mask: greedy result (the reference's exhaustive
+    search over permutations is exponential; greedy matches it for 2:4 in
+    practice and satisfies the same check_mask_2d contract)."""
+    return get_mask_2d_greedy(mat, n, m)
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n=2, m=4):
+    """Mask for an arbitrary-rank tensor: collapse to 2-D
+    [prod(shape[:-1]), shape[-1]] like the reference, mask, reshape back."""
+    t = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
+    shape = t.shape
+    mat = t.reshape(-1, shape[-1]) if t.ndim != 2 else t
+    fn = globals()[func_name.value if isinstance(func_name, MaskAlgo)
+                   else str(func_name)]
+    mask = fn(mat, n, m)
+    return mask.reshape(shape)
+
+
+def check_sparsity(tensor, func_name=CheckMethod.CHECK_1D, n=2, m=4):
+    t = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
+    mat = t.reshape(-1, t.shape[-1]) if t.ndim != 2 else t
+    fn = globals()[func_name.value if isinstance(func_name, CheckMethod)
+                   else str(func_name)]
+    return fn(mat, n, m)
+
+
+# ---- model-level API ----
+
+_EXCLUDED: set[str] = set()
+# id(param) -> (weakref(param), mask). The weakref guards against python
+# id recycling: a GC'd parameter's id can be reused by an unrelated new
+# object, which must not inherit the old mask (cross-test flake).
+import weakref as _weakref  # noqa: E402
+
+_PARAM_MASKS: dict[int, tuple] = {}
+# decorated optimizers, re-synced whenever prune_model computes new masks
+# so decorate() and prune_model() compose in either order
+_DECORATED: "_weakref.WeakSet" = _weakref.WeakSet()
+
+
+def _mask_for(p):
+    entry = _PARAM_MASKS.get(id(p))
+    if entry is None:
+        return None
+    ref, mask = entry
+    if ref() is not p:  # stale id-recycled entry
+        _PARAM_MASKS.pop(id(p), None)
+        return None
+    return mask
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Exclude parameters (by name) from pruning (parity:
+    asp.set_excluded_layers)."""
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def _prunable_params(model):
+    """Multi-dim weights of Linear/Conv-like layers, by reference policy:
+    2-D+ weights, both dims >= m would be checked at prune time; biases
+    and norm scales (1-D) are never pruned."""
+    for name, p in model.named_parameters():
+        if p.stop_gradient or name in _EXCLUDED or getattr(
+                p, "name", None) in _EXCLUDED:
+            continue
+        if len(p.shape) >= 2:
+            yield name, p
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune every supported weight of ``model`` in place to the n:m
+    pattern and remember the masks (parity: asp.prune_model). Call
+    ``decorate(optimizer)`` (before or after) so training preserves the
+    pattern. Returns {param_name: mask Tensor}."""
+    algo = {"mask_1d": MaskAlgo.MASK_1D,
+            "mask_2d_greedy": MaskAlgo.MASK_2D_GREEDY,
+            "mask_2d_best": MaskAlgo.MASK_2D_BEST}[mask_algo]
+    masks = {}
+    for name, p in _prunable_params(model):
+        w = np.asarray(p._data)
+        # mask along the reduction dim: transpose 2-D weights ([in, out]
+        # Linear) like the reference's fc prune func, collapse conv
+        # weights to [cout, cin*kh*kw]
+        if w.ndim == 2:
+            mask = create_mask(w.T, algo, n, m).T
+        else:
+            flat = w.reshape(w.shape[0], -1)
+            mask = create_mask(flat, algo, n, m).reshape(w.shape)
+        p._data = p._data * jnp.asarray(mask, p._data.dtype)
+        if with_mask:
+            masks[name] = Tensor(jnp.asarray(mask))
+            _PARAM_MASKS[id(p)] = (_weakref.ref(p), jnp.asarray(mask))
+    model._asp_masks = masks
+    # optimizers decorated before this prune call must see the new masks
+    # (the compiled TrainStep reads optimizer._param_masks at trace time
+    # and never goes through the wrapped step())
+    for opt in list(_DECORATED):
+        opt._asp_sync_masks()
+    return masks
+
+
+def decorate(optimizer):
+    """Attach mask preservation to the optimizer: after every update the
+    masked weights are re-zeroed (parity: asp.decorate /
+    OptimizerWithSparsityGuarantee). Works for both the eager `step()`
+    and the compiled TrainStep path."""
+    orig_step = optimizer.step
+
+    def _sync_masks():
+        optimizer._param_masks.clear()
+        for p in optimizer._parameter_list or []:
+            mask = _mask_for(p)
+            if mask is not None:
+                optimizer._param_masks[id(p)] = mask
+
+    def step():
+        _sync_masks()
+        return orig_step()
+
+    optimizer.step = step
+    # the compiled path reads _param_masks directly — populate eagerly,
+    # and register so a later prune_model() re-syncs (either call order
+    # works; a TrainStep must still be built AFTER prune_model, since the
+    # mask is a compile-time constant of the step)
+    _sync_masks()
+    optimizer._asp_sync_masks = _sync_masks
+    _DECORATED.add(optimizer)
+    optimizer._asp_decorated = True
+    return optimizer

@@ -1,0 +1,129 @@
+"""ModelAverage — Polyak-style windowed parameter averaging.
+
+Reference parity: `python/paddle/incubate/optimizer/modelaverage.py` over
+the `average_accumulates_` PHI kernel
+(`paddle/phi/kernels/impl/average_accumulates_kernel_impl.h`): per-param
+accumulators (sum_1, sum_2, sum_3, num_accumulates, old_num_accumulates,
+num_updates) with the kMaxNumAccumulates=16384 precision shift, window
+restart when the window outgrows min(max_average_window,
+num_updates * average_window_rate), and `apply()`/`restore()` swapping the
+averaged parameters in and out for evaluation.
+
+TPU-first: the accumulator update is a pure jnp expression per parameter
+(fuses into whatever step it's called from); the counters are host ints —
+they gate python control flow exactly like the reference's CPU-side
+counter reads.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+_K_MAX_NUM_ACCUMULATES = 16384
+
+
+class ModelAverage:
+    """Accumulate running parameter sums and serve windowed averages.
+
+    Usage::
+
+        ma = ModelAverage(0.15, parameters=model.parameters(),
+                          min_average_window=2, max_average_window=10)
+        for batch in data:
+            train_step(batch)
+            ma.step()              # accumulate after each optimizer step
+        with ma.apply(model):      # evaluate with averaged params
+            evaluate(model)
+    """
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if min_average_window > max_average_window:
+            raise ValueError(
+                f"min_average_window {min_average_window} must be <= "
+                f"max_average_window {max_average_window}")
+        self._rate = float(average_window_rate)
+        self._min_w = int(min_average_window)
+        self._max_w = int(max_average_window)
+        self._params = list(parameters or [])
+        self._sum_1 = [jnp.zeros_like(p._data) for p in self._params]
+        self._sum_2 = [jnp.zeros_like(p._data) for p in self._params]
+        self._sum_3 = [jnp.zeros_like(p._data) for p in self._params]
+        self._num_accumulates = 0
+        self._old_num_accumulates = 0
+        self._num_updates = 0
+        self._saved = None
+
+    def step(self):
+        """Accumulate the current parameter values (the
+        `average_accumulates_` update, applied to every tracked param)."""
+        self._num_updates += 1
+        self._num_accumulates += 1
+        self._sum_1 = [s + p._data for s, p in zip(self._sum_1, self._params)]
+        if self._num_updates % _K_MAX_NUM_ACCUMULATES == 0:
+            # precision shift: fold sum_1 into sum_2
+            self._sum_2 = [s2 + s1 for s2, s1 in
+                           zip(self._sum_2, self._sum_1)]
+            self._sum_1 = [jnp.zeros_like(s) for s in self._sum_1]
+        if (self._num_accumulates >= self._min_w
+                and self._num_accumulates >= min(
+                    self._max_w, self._num_updates * self._rate)):
+            # window exceeded: discard the old sum_3
+            self._sum_3 = [s1 + s2 for s1, s2 in
+                           zip(self._sum_1, self._sum_2)]
+            self._sum_1 = [jnp.zeros_like(s) for s in self._sum_1]
+            self._sum_2 = [jnp.zeros_like(s) for s in self._sum_2]
+            self._old_num_accumulates = self._num_accumulates
+            self._num_accumulates = 0
+
+    # paddle's ModelAverage exposes minimize/step via optimizer protocol;
+    # the accumulators are what matter here
+    update = step
+
+    def _averaged(self):
+        total = self._num_accumulates + self._old_num_accumulates
+        if total == 0:
+            return [p._data for p in self._params]
+        scale = 1.0 / total
+        return [
+            ((s1 + s2 + s3) * scale).astype(p._data.dtype)
+            for s1, s2, s3, p in zip(
+                self._sum_1, self._sum_2, self._sum_3, self._params)
+        ]
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged parameters in (context manager, like the
+        reference's `apply`)."""
+        self._saved = [p._data for p in self._params]
+        for p, avg in zip(self._params, self._averaged()):
+            p._data = avg
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._saved is not None:
+            for p, a in zip(self._params, self._saved):
+                p._data = a
+            self._saved = None
+
+    def state_dict(self):
+        return {
+            "sum_1": self._sum_1, "sum_2": self._sum_2, "sum_3": self._sum_3,
+            "num_accumulates": self._num_accumulates,
+            "old_num_accumulates": self._old_num_accumulates,
+            "num_updates": self._num_updates,
+        }
+
+    def set_state_dict(self, state):
+        self._sum_1 = list(state["sum_1"])
+        self._sum_2 = list(state["sum_2"])
+        self._sum_3 = list(state["sum_3"])
+        self._num_accumulates = int(state["num_accumulates"])
+        self._old_num_accumulates = int(state["old_num_accumulates"])
+        self._num_updates = int(state["num_updates"])

@@ -75,6 +75,10 @@ class TrainStep:
             g_arr = g.astype(arr.dtype)
         work = opt._apply_decoupled_decay(work, lr_p, p)
         new_w, new_state = opt._update(work, g_arr, state, lr_p, step)
+        mask = getattr(opt, "_param_masks", {}).get(id(p))
+        if mask is not None:
+            # ASP sparsity mask baked into the compiled step as a constant
+            new_w = new_w * mask.astype(new_w.dtype)
         if opt._multi_precision and low_prec:
             return new_w.astype(arr.dtype), new_state, new_w
         return new_w, new_state, None

@@ -12,7 +12,7 @@ from .common import (  # noqa: F401
     label_smooth, pad, zeropad2d, normalize, cosine_similarity,
     pairwise_distance, pixel_shuffle, pixel_unshuffle, channel_shuffle,
     interpolate, upsample, unfold, fold, bilinear, grid_sample, affine_grid,
-    sequence_mask,
+    sequence_mask, class_center_sample, gather_tree, temporal_shift,
 )
 from .conv import (  # noqa: F401
     conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
@@ -22,6 +22,7 @@ from .pooling import (  # noqa: F401
     max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
     adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d, lp_pool2d,
+    max_unpool1d, max_unpool2d, max_unpool3d,
 )
 from .norm import (  # noqa: F401
     batch_norm, layer_norm, rms_norm, group_norm, instance_norm,
@@ -32,7 +33,8 @@ from .loss import (  # noqa: F401
     smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
     kl_div, margin_ranking_loss, hinge_embedding_loss, cosine_embedding_loss,
     triplet_margin_loss, multi_label_soft_margin_loss, soft_margin_loss,
-    square_error_cost, log_loss, ctc_loss, sigmoid_focal_loss,
+    square_error_cost, log_loss, ctc_loss, sigmoid_focal_loss, huber_loss,
+    edit_distance, hsigmoid_loss,
 )
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, ring_flash_attention,

@@ -224,3 +224,11 @@ def glu(x, axis=-1, name=None):
 def tanh_(x, name=None):
     from ...tensor.manipulation import _adopt_inplace
     return _adopt_inplace(x, tanh(x))
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    """x if x > threshold else 0 (parity: F.thresholded_relu, ref
+    `nn/functional/activation.py:1465`, `thresholded_relu` op)."""
+    return apply("thresholded_relu",
+                 lambda a: jnp.where(a > threshold, a, jnp.zeros((), a.dtype)),
+                 (x,))

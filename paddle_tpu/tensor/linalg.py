@@ -156,8 +156,11 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
 
 
 def lu(x, pivot=True, get_infos=False, name=None):
+    # jax.scipy returns 0-based swap indices; paddle's contract (LAPACK
+    # ipiv) is 1-based — `lu_unpack` below relies on this
     out = apply(
-        "lu", lambda a: tuple(jax.scipy.linalg.lu_factor(a)), (x,)
+        "lu", lambda a: (lambda f, p: (f, p + 1))(
+            *jax.scipy.linalg.lu_factor(a)), (x,)
     )
     lu_mat, piv = out
     if get_infos:
@@ -215,3 +218,43 @@ def householder_product(x, tau, name=None):
             q = q @ h
         return q[..., :, :n]
     return apply("householder_product", f, (x, tau))
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack `paddle.linalg.lu` results into (P, L, U) (parity:
+    paddle.linalg.lu_unpack, `lu_unpack` op). x: packed LU [.., m, n],
+    y: 1-based pivots [.., min(m, n)]."""
+    from ..ops.dispatch import apply, apply_nondiff
+
+    m_rows = x.shape[-2]
+
+    def split_lu(a):
+        m, n = a.shape[-2], a.shape[-1]
+        k = min(m, n)
+        l = jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+        u = jnp.triu(a[..., :k, :])
+        return l, u
+
+    def perm(p):
+        # pivots: 1-based sequential row swaps over the first k of m rows;
+        # P must be m x m (P @ L @ U == A also for non-square A)
+        k = p.shape[-1]
+
+        def one(pv):
+            order = jnp.arange(m_rows)
+
+            def body(i, o):
+                j = pv[i] - 1
+                oi, oj = o[i], o[j]
+                return o.at[i].set(oj).at[j].set(oi)
+
+            order = jax.lax.fori_loop(0, k, body, order)
+            return jnp.eye(m_rows)[order].T
+
+        flat = p.reshape((-1, k))
+        mats = jax.vmap(one)(flat)
+        return mats.reshape(p.shape[:-1] + (m_rows, m_rows))
+
+    l, u = apply("lu_unpack", split_lu, (x,))
+    pmat = apply_nondiff("lu_unpack_pivots", perm, (y,))
+    return pmat, l, u

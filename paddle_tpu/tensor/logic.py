@@ -69,3 +69,23 @@ def isin(x, test_x, assume_unique=False, invert=False, name=None):
     return apply_nondiff(
         "isin", lambda a, b: jnp.isin(a, b, invert=invert), (x, test_x)
     )
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    """Logical-and reduction (parity: paddle.all, `all` op)."""
+    from ..ops.dispatch import apply_nondiff
+
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_nondiff(
+        "all", lambda a: jnp.all(a.astype(bool), axis=ax, keepdims=keepdim),
+        (x,))
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    """Logical-or reduction (parity: paddle.any, `any` op)."""
+    from ..ops.dispatch import apply_nondiff
+
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_nondiff(
+        "any", lambda a: jnp.any(a.astype(bool), axis=ax, keepdims=keepdim),
+        (x,))

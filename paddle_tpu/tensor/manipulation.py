@@ -471,3 +471,111 @@ def atleast_2d(*inputs, name=None):
 def atleast_3d(*inputs, name=None):
     outs = [apply("atleast_3d", jnp.atleast_3d, (x,)) for x in inputs]
     return outs[0] if len(outs) == 1 else outs
+
+
+# ---- round-3 op-coverage additions (audited vs phi/api/yaml/ops.yaml) ----
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):  # noqa: A002
+    """Batched diagonal embedding: last dim of ``input`` becomes the
+    (offset) diagonal of a new matrix spanned by (dim1, dim2) (parity:
+    paddle.diag_embed, ref `nn/functional/extension.py:34`,
+    `diag_embed` op)."""
+
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        batch = a.shape[:-1]
+        out = jnp.zeros(batch + (n, n), a.dtype)
+        rows = jnp.arange(a.shape[-1]) + max(-offset, 0)
+        cols = jnp.arange(a.shape[-1]) + max(offset, 0)
+        out = out.at[..., rows, cols].set(a)
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        # the two new axes currently sit at (-2, -1); move to (dim1, dim2)
+        return jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+
+    return apply("diag_embed", f, (input,))
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """Out-of-place diagonal fill (ref `tensor/manipulation.py:913`,
+    `fill_diagonal` op). For ndim > 2 all dims must match and the fill is
+    on the hyper-diagonal; for tall 2-D matrices ``wrap`` repeats the
+    diagonal every ncols rows like numpy.fill_diagonal."""
+
+    def f(a):
+        if a.ndim == 2:
+            rows, cols = a.shape
+            if wrap and rows > cols:
+                # numpy.fill_diagonal wrap semantics: walk the flat buffer
+                # with stride cols+1 (restarting the diagonal one row
+                # below each time it runs off the right edge)
+                start = offset if offset >= 0 else -offset * cols
+                flats = np.arange(start, rows * cols, cols + 1)
+                ii, jj = flats // cols, flats % cols
+            else:
+                ii = np.arange(rows)
+                jj = ii + offset
+                valid = (jj >= 0) & (jj < cols)
+                ii, jj = ii[valid], jj[valid]
+            return a.at[jnp.asarray(ii), jnp.asarray(jj)].set(
+                jnp.asarray(value, a.dtype))
+        # ndim > 2: reference contract — hyper-diagonal only, offset 0,
+        # all dims equal (silently partial-filling would be a wrong answer)
+        if offset != 0 or wrap:
+            raise ValueError(
+                "fill_diagonal supports offset/wrap only for 2-D tensors")
+        if len(set(a.shape)) != 1:
+            raise ValueError(
+                f"fill_diagonal on a {a.ndim}-D tensor requires all dims "
+                f"equal, got {a.shape}")
+        idx = jnp.arange(a.shape[0])
+        return a.at[(idx,) * a.ndim].set(jnp.asarray(value, a.dtype))
+
+    return apply("fill_diagonal", f, (x,))
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """In-place variant (parity: Tensor.fill_diagonal_)."""
+    return _adopt_inplace(x, fill_diagonal(x, value, offset, wrap))
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Fill the (dim1, dim2) diagonal of ``x`` with tensor ``y`` (parity:
+    paddle.fill_diagonal_tensor, ref `tensor/manipulation.py:1009`,
+    `fill_diagonal_tensor` op). y's shape must equal the diagonal's."""
+
+    def f(a, b):
+        nd = a.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        if d1 > d2:
+            d1, d2 = d2, d1
+            off = -offset
+        else:
+            off = offset
+        # move diagonal-spanning dims last: [..., n1, n2]
+        m = jnp.moveaxis(a, (d1, d2), (nd - 2, nd - 1))
+        n1, n2 = m.shape[-2], m.shape[-1]
+        dlen = min(n1 + min(off, 0), n2 - max(off, 0))
+        rows = jnp.arange(dlen) + max(-off, 0)
+        cols = jnp.arange(dlen) + max(off, 0)
+        bshape = m.shape[:-2] + (dlen,)
+        bb = jnp.broadcast_to(b.astype(a.dtype), bshape)
+        m = m.at[..., rows, cols].set(bb)
+        return jnp.moveaxis(m, (nd - 2, nd - 1), (d1, d2))
+
+    return apply("fill_diagonal_tensor", f, (x, y))
+
+
+def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """In-place variant (parity: Tensor.fill_diagonal_tensor_)."""
+    return _adopt_inplace(x, fill_diagonal_tensor(x, y, offset, dim1, dim2))
+
+
+def fill(x, value, name=None):
+    """Out-of-place full-tensor fill (`fill` op)."""
+    return apply("fill", lambda a: jnp.full_like(a, value), (x,))
+
+
+def fill_(x, value, name=None):
+    """In-place variant (parity: Tensor.fill_)."""
+    return _adopt_inplace(x, fill(x, value))

@@ -402,3 +402,85 @@ def increment(x, value=1.0, name=None):
     x._out_index = out._out_index
     x.stop_gradient = out.stop_gradient and x.stop_gradient
     return x
+
+
+# ---- round-3 op-coverage additions (audited vs phi/api/yaml/ops.yaml) ----
+
+i0e = _unary("i0e", jax.scipy.special.i0e)
+i1 = _unary("i1", jax.scipy.special.i1)
+i1e = _unary("i1e", jax.scipy.special.i1e)
+
+
+def logit(x, eps=None, name=None):
+    """log(x / (1-x)) with optional clamp of x into [eps, 1-eps]
+    (parity: paddle.logit, ref `tensor/math.py:4606`, `logit` op)."""
+
+    def f(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a) - jnp.log1p(-a)
+
+    return apply("logit", f, (x,))
+
+
+def polygamma(x, n, name=None):
+    """n-th derivative of digamma (parity: paddle.polygamma, ref
+    `tensor/math.py:6125`, `polygamma` op)."""
+    if n < 0:
+        raise ValueError(f"polygamma order must be >= 0, got {n}")
+    if n == 0:
+        return apply("digamma", jax.scipy.special.digamma, (x,))
+    return apply("polygamma",
+                 lambda a: jax.scipy.special.polygamma(n, a), (x,))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp the p-norm of every slice along ``axis`` to ``max_norm``
+    (parity: paddle.renorm, ref `tensor/math.py:2138`, `renorm` op)."""
+
+    def f(a):
+        reduce_axes = tuple(i for i in range(a.ndim) if i != axis % a.ndim)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=reduce_axes,
+                        keepdims=True) ** (1.0 / p)
+        scale_f = jnp.where(norms > max_norm,
+                            max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return a * scale_f
+
+    return apply("renorm", f, (x,))
+
+
+def inverse(x, name=None):
+    """Matrix inverse of the trailing 2 dims (parity: paddle.inverse, ref
+    `tensor/math.py:2394`, `inverse` op)."""
+    return apply("inverse", jnp.linalg.inv, (x,))
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """Rescale so the global L2 norm is at most ``max_norm`` (parity:
+    paddle.nn.clip_by_norm / `clip_by_norm` op)."""
+
+    def f(a):
+        norm2 = jnp.sqrt(jnp.sum(a.astype(jnp.float32) ** 2))
+        scale_f = jnp.where(norm2 > max_norm,
+                            max_norm / jnp.maximum(norm2, 1e-12), 1.0)
+        return a * scale_f.astype(a.dtype)
+
+    return apply("clip_by_norm", f, (x,))
+
+
+def squared_l2_norm(x, name=None):
+    """sum(x**2) as a 0-d tensor — the grad-clip building block (parity:
+    `squared_l2_norm` op, used by ClipGradByGlobalNorm in the reference)."""
+    return apply("squared_l2_norm",
+                 lambda a: jnp.sum(jnp.square(a.astype(jnp.float32))), (x,))
+
+
+def frobenius_norm(x, axis=None, keepdim=False, name=None):
+    """Frobenius norm over ``axis`` (default: all dims) (parity:
+    `frobenius_norm` op behind paddle.norm(p='fro'))."""
+
+    def f(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
+
+    return apply("frobenius_norm", f, (x,))

@@ -1,5 +1,5 @@
 """paddle.vision parity (reference `python/paddle/vision/`)."""
-from . import datasets, models, transforms  # noqa: F401
+from . import datasets, models, ops, transforms  # noqa: F401
 from .models import *  # noqa: F401,F403
 
-__all__ = ["datasets", "models", "transforms"]
+__all__ = ["datasets", "models", "ops", "transforms"]

@@ -1,12 +1,38 @@
 """Vision model zoo (parity: `python/paddle/vision/models/`)."""
+from .densenet import (  # noqa: F401
+    DenseNet, densenet121, densenet161, densenet169, densenet201,
+    densenet264,
+)
 from .lenet import LeNet  # noqa: F401
+from .mobilenet import (  # noqa: F401
+    MobileNetV1, MobileNetV2, MobileNetV3Large, MobileNetV3Small,
+    mobilenet_v1, mobilenet_v2, mobilenet_v3_large, mobilenet_v3_small,
+)
 from .resnet import (  # noqa: F401
     BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34, resnet50,
     resnet101, resnet152, resnext50_32x4d, wide_resnet50_2, wide_resnet101_2,
 )
+from .small_nets import (  # noqa: F401
+    AlexNet, GoogLeNet, InceptionV3, ShuffleNetV2, SqueezeNet, alexnet,
+    googlenet, inception_v3, shufflenet_v2_x0_25, shufflenet_v2_x0_33,
+    shufflenet_v2_x0_5, shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0, squeezenet1_0, squeezenet1_1,
+)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 
 __all__ = [
     "LeNet", "ResNet", "BasicBlock", "BottleneckBlock",
     "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
     "wide_resnet50_2", "wide_resnet101_2", "resnext50_32x4d",
+    "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+    "MobileNetV1", "MobileNetV2", "MobileNetV3Small", "MobileNetV3Large",
+    "mobilenet_v1", "mobilenet_v2", "mobilenet_v3_small",
+    "mobilenet_v3_large",
+    "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
+    "densenet264",
+    "AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+    "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+    "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+    "shufflenet_v2_x2_0", "GoogLeNet", "googlenet", "InceptionV3",
+    "inception_v3",
 ]

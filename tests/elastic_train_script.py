@@ -1,0 +1,81 @@
+"""Elastic kill/resume fixture: a tiny training run that checkpoints every
+step, crashes mid-training on its first life, and resumes exactly from the
+last checkpoint when the launcher relaunches it.
+
+Used by tests/test_elastic.py::test_kill_relaunch_resume — the reference
+contract is `ElasticManager` watch -> kill -> relaunch with rewritten env
+(`fleet/elastic/manager.py:126`) + checkpoint resume; here the launcher's
+babysit loop provides relaunch (PADDLE_RESTART_COUNT) and
+`paddle.distributed.checkpoint` provides exact resume.
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+from paddle_tpu.distributed import checkpoint as dckpt  # noqa: E402
+
+WORKDIR = sys.argv[1]
+CRASH_AT = int(os.environ.get("ELASTIC_CRASH_AT", "-1"))
+TOTAL_STEPS = 6
+restart_count = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+
+paddle.seed(0)
+model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+opt = paddle.optimizer.AdamW(learning_rate=5e-2,
+                             parameters=model.parameters())
+
+rng = np.random.default_rng(0)
+xs = rng.standard_normal((TOTAL_STEPS, 16, 8)).astype("float32")
+w_true = rng.standard_normal((8, 1)).astype("float32")
+
+ckpt_dir = os.path.join(WORKDIR, "ckpt")
+meta_path = os.path.join(WORKDIR, "meta.json")
+start_step = 0
+if os.path.exists(meta_path):
+    with open(meta_path) as f:
+        meta = json.load(f)
+    start_step = meta["step"]
+    flat = dckpt.load_checkpoint(ckpt_dir)
+    model.set_state_dict({k[len("model."):]: v for k, v in flat.items()
+                          if k.startswith("model.")})
+    opt_state = {k[len("opt."):]: v for k, v in flat.items()
+                 if k.startswith("opt.")}
+    opt_state.update(meta["opt_scalars"])  # global_step, per-param counts
+    opt.set_state_dict(opt_state)
+
+losses = []
+for step in range(start_step, TOTAL_STEPS):
+    x = paddle.to_tensor(xs[step])
+    y = paddle.to_tensor(xs[step] @ w_true)
+    loss = F.mse_loss(model(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    losses.append(float(loss.numpy()))
+    # record incrementally so a life that crashes still leaves its trace
+    with open(os.path.join(WORKDIR, f"losses_r{restart_count}.json"),
+              "w") as f:
+        json.dump({"start": start_step, "losses": losses}, f)
+    flat = {}
+    scalars = {}
+    for k, v in model.state_dict().items():
+        flat[f"model.{k}"] = v
+    for k, v in opt.state_dict().items():
+        if isinstance(v, (int, float)):
+            scalars[k] = v
+        else:
+            flat[f"opt.{k}"] = v
+    dckpt.save_state_dict(flat, ckpt_dir)
+    with open(meta_path, "w") as f:
+        json.dump({"step": step + 1, "opt_scalars": scalars}, f)
+    if restart_count == 0 and step + 1 == CRASH_AT:
+        os._exit(17)  # simulated hard failure mid-training

@@ -19,3 +19,58 @@ def test_membership_and_restart_detection():
         assert m.watch() == ElasticStatus.COMPLETED
     finally:
         m.exit()
+
+
+def test_kill_relaunch_resume(tmp_path):
+    """End-to-end elastic capability (VERDICT r2 item 6): a worker dies
+    mid-training with a non-zero exit, the launcher's babysit loop
+    relaunches the pod (reference `ElasticManager` watch->kill->relaunch,
+    `fleet/elastic/manager.py:126`), and the relaunched worker resumes
+    from its `distributed.checkpoint` — the full loss trajectory must
+    EXACTLY match an uninterrupted run (loss continuity)."""
+    import json
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    script = str(Path(__file__).parent / "elastic_train_script.py")
+    repo = str(Path(__file__).parent.parent)
+
+    def run(workdir, crash_at):
+        env = dict(os.environ)
+        env["ELASTIC_CRASH_AT"] = str(crash_at)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("PADDLE_RESTART_COUNT", None)
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--max_restart", "2", "--log_dir", str(workdir / "log"),
+             script, str(workdir)],
+            cwd=repo, env=env, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, (
+            f"launcher rc={proc.returncode}\n{proc.stderr[-2000:]}\n"
+            + "".join(open(p).read()[-2000:]
+                      for p in (workdir / "log").glob("workerlog.*")))
+        losses = {}
+        for f in sorted(workdir.glob("losses_r*.json")):
+            data = json.loads(f.read_text())
+            for i, l in enumerate(data["losses"]):
+                losses[data["start"] + i] = l
+        return losses
+
+    clean_dir = tmp_path / "clean"
+    clean_dir.mkdir()
+    crash_dir = tmp_path / "crash"
+    crash_dir.mkdir()
+    clean = run(clean_dir, crash_at=-1)
+    crashed = run(crash_dir, crash_at=3)
+
+    assert sorted(clean) == sorted(crashed) == list(range(6))
+    # crashed run must have resumed at step 3 (not restarted from zero)
+    r1 = json.loads(
+        next(crash_dir.glob("losses_r1.json")).read_text())
+    assert r1["start"] == 3
+    for step in range(6):
+        assert abs(clean[step] - crashed[step]) < 1e-6, (
+            step, clean[step], crashed[step])

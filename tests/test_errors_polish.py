@@ -1,0 +1,100 @@
+"""Round-3 polish: structured errors (enforce), implicit-mesh warning,
+Group.rank semantics (VERDICT r2 weak #7/#8, missing #6)."""
+import warnings
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import errors
+
+
+class TestEnforce:
+    def test_enforce_raises_with_location(self):
+        with pytest.raises(errors.InvalidArgumentError) as ei:
+            errors.enforce(False, "shape mismatch")
+        msg = str(ei.value)
+        assert "InvalidArgument" in msg and "shape mismatch" in msg
+        assert "test_errors_polish.py" in msg  # raising source location
+
+    def test_enforce_passes(self):
+        errors.enforce(True, "never raised")
+
+    def test_comparison_helpers_show_operands(self):
+        with pytest.raises(errors.InvalidArgumentError) as ei:
+            errors.enforce_eq(3, 4, "ranks must match")
+        assert "lhs=3" in str(ei.value) and "rhs=4" in str(ei.value)
+        errors.enforce_le(1, 1, "ok")
+        with pytest.raises(errors.OutOfRangeError):
+            errors.enforce_lt(5, 2, "index", error=errors.OutOfRangeError)
+
+    def test_builtin_subclassing(self):
+        # except ValueError must keep working for InvalidArgument
+        with pytest.raises(ValueError):
+            errors.enforce(False, "x")
+        with pytest.raises(NotImplementedError):
+            raise errors.UnimplementedError("not yet")
+        assert errors.enforce_not_none("v", "missing") == "v"
+        with pytest.raises(LookupError):
+            errors.enforce_not_none(None, "missing")
+
+
+class TestDistributedPolish:
+    def test_implicit_env_warns_on_multidevice(self):
+        import jax
+
+        from paddle_tpu.distributed import env as env_mod
+
+        env_mod.reset_env()
+        try:
+            with warnings.catch_warnings(record=True) as rec:
+                warnings.simplefilter("always")
+                env_mod.ensure_env()
+            if len(jax.devices()) > 1:
+                assert any("fleet.init" in str(w.message) for w in rec)
+            # explicit init never warns
+            env_mod.reset_env()
+            env_mod.init_mesh(dp=-1)
+            with warnings.catch_warnings(record=True) as rec:
+                warnings.simplefilter("always")
+                env_mod.ensure_env()
+            assert not any("fleet.init" in str(w.message) for w in rec)
+        finally:
+            env_mod.reset_env()
+
+    def test_group_rank_contract(self):
+        import paddle_tpu.distributed as dist
+
+        g = dist.collective._world_group()
+        assert g.rank == 0
+        assert g.get_group_rank(0) == 0
+        with pytest.raises(ValueError):
+            g.get_group_rank(g.nranks + 5)
+        from paddle_tpu.distributed import env as env_mod
+
+        env_mod.reset_env()
+
+
+class TestOnnxExport:
+    def test_export_produces_stablehlo_artifact(self, tmp_path):
+        import numpy as np
+
+        import paddle_tpu as pd
+        import paddle_tpu.nn as nn
+
+        net = nn.Sequential(nn.Linear(4, 3))
+        p = str(tmp_path / "model")
+        pd.onnx.export(net, p, input_spec=[
+            pd.jit.InputSpec([None, 4], "float32")])
+        loaded = pd.jit.load(p)
+        x = pd.to_tensor(np.ones((2, 4), "float32"))
+        np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                                   rtol=1e-5)
+
+    def test_onnx_suffix_gated_with_actionable_error(self, tmp_path):
+        import paddle_tpu as pd
+        import paddle_tpu.nn as nn
+        from paddle_tpu.framework import errors
+
+        with pytest.raises((errors.UnavailableError, NotImplementedError)):
+            pd.onnx.export(nn.Linear(2, 2), str(tmp_path / "m.onnx"),
+                           input_spec=[pd.jit.InputSpec([1, 2], "float32")])

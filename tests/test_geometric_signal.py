@@ -1,0 +1,215 @@
+"""paddle.geometric + paddle.signal parity tests.
+
+Reference vectors from the docstrings/examples in
+`python/paddle/geometric/message_passing/send_recv.py` and
+`python/paddle/signal.py`; gradient checks via finite differences.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric, signal
+
+
+class TestSegment:
+    def test_segment_sum(self):
+        data = paddle.to_tensor(
+            np.asarray([[1., 2., 3.], [3., 2., 1.], [4., 5., 6.]], "float32"))
+        ids = paddle.to_tensor(np.asarray([0, 0, 1], "int32"))
+        out = geometric.segment_sum(data, ids)
+        np.testing.assert_allclose(
+            out.numpy(), [[4., 4., 4.], [4., 5., 6.]])
+
+    def test_segment_mean_min_max(self):
+        data = paddle.to_tensor(
+            np.asarray([[1., 2., 3.], [3., 2., 1.], [4., 5., 6.]], "float32"))
+        ids = paddle.to_tensor(np.asarray([0, 0, 1], "int32"))
+        np.testing.assert_allclose(
+            geometric.segment_mean(data, ids).numpy(),
+            [[2., 2., 2.], [4., 5., 6.]])
+        np.testing.assert_allclose(
+            geometric.segment_min(data, ids).numpy(),
+            [[1., 2., 1.], [4., 5., 6.]])
+        np.testing.assert_allclose(
+            geometric.segment_max(data, ids).numpy(),
+            [[3., 2., 3.], [4., 5., 6.]])
+
+    def test_empty_segment_zero_filled(self):
+        data = paddle.to_tensor(np.asarray([[1., 5.]], "float32"))
+        ids = paddle.to_tensor(np.asarray([2], "int32"))
+        out = geometric.segment_max(data, ids)
+        np.testing.assert_allclose(
+            out.numpy(), [[0., 0.], [0., 0.], [1., 5.]])
+
+    def test_segment_sum_grad(self):
+        data = paddle.to_tensor(
+            np.asarray([[1., 2.], [3., 4.], [5., 6.]], "float32"),
+            stop_gradient=False)
+        ids = paddle.to_tensor(np.asarray([0, 1, 1], "int32"))
+        out = geometric.segment_sum(data, ids)
+        out.sum().backward()
+        np.testing.assert_allclose(data.grad.numpy(), np.ones((3, 2)))
+
+
+class TestSendRecv:
+    def _xsd(self):
+        x = paddle.to_tensor(
+            np.asarray([[0., 2., 3.], [1., 4., 5.], [2., 6., 7.]], "float32"))
+        src = paddle.to_tensor(np.asarray([0, 1, 2, 0], "int32"))
+        dst = paddle.to_tensor(np.asarray([1, 2, 1, 0], "int32"))
+        return x, src, dst
+
+    def test_send_u_recv_sum_reference_example(self):
+        x, src, dst = self._xsd()
+        out = geometric.send_u_recv(x, src, dst, reduce_op="sum")
+        np.testing.assert_allclose(
+            out.numpy(), [[0., 2., 3.], [2., 8., 10.], [1., 4., 5.]])
+
+    def test_send_u_recv_mean_max_min(self):
+        x, src, dst = self._xsd()
+        np.testing.assert_allclose(
+            geometric.send_u_recv(x, src, dst, reduce_op="mean").numpy(),
+            [[0., 2., 3.], [1., 4., 5.], [1., 4., 5.]])
+        np.testing.assert_allclose(
+            geometric.send_u_recv(x, src, dst, reduce_op="max").numpy(),
+            [[0., 2., 3.], [2., 6., 7.], [1., 4., 5.]])
+        np.testing.assert_allclose(
+            geometric.send_u_recv(x, src, dst, reduce_op="min").numpy(),
+            [[0., 2., 3.], [0., 2., 3.], [1., 4., 5.]])
+
+    def test_send_u_recv_out_size(self):
+        x, src, dst = self._xsd()
+        out = geometric.send_u_recv(x, src, dst, out_size=5)
+        assert out.shape == [5, 3]
+        np.testing.assert_allclose(out.numpy()[3:], np.zeros((2, 3)))
+
+    def test_send_ue_recv(self):
+        x, src, dst = self._xsd()
+        y = paddle.to_tensor(np.asarray([1., 1., 1., 1.], "float32"))
+        out = geometric.send_ue_recv(x, y, src, dst, "add", "sum")
+        np.testing.assert_allclose(
+            out.numpy(), [[1., 3., 4.], [4., 10., 12.], [2., 5., 6.]])
+
+    def test_send_uv(self):
+        x, src, dst = self._xsd()
+        out = geometric.send_uv(x, x, src, dst, message_op="add")
+        np.testing.assert_allclose(
+            out.numpy(),
+            [[1., 6., 8.], [3., 10., 12.], [3., 10., 12.], [0., 4., 6.]])
+
+    def test_send_u_recv_grad(self):
+        x, src, dst = self._xsd()
+        x.stop_gradient = False
+        geometric.send_u_recv(x, src, dst).sum().backward()
+        # node 0 feeds 2 edges, nodes 1/2 one each
+        np.testing.assert_allclose(
+            x.grad.numpy(), [[2., 2., 2.], [1., 1., 1.], [1., 1., 1.]])
+
+    def test_bad_ops_raise(self):
+        x, src, dst = self._xsd()
+        with pytest.raises(ValueError):
+            geometric.send_u_recv(x, src, dst, reduce_op="prod")
+        with pytest.raises(ValueError):
+            geometric.send_uv(x, x, src, dst, message_op="pow")
+
+
+class TestReindexSampling:
+    def test_reindex_graph_reference_example(self):
+        x = paddle.to_tensor(np.asarray([0, 1, 2], "int64"))
+        neighbors = paddle.to_tensor(
+            np.asarray([8, 9, 0, 4, 7, 6, 7], "int64"))
+        count = paddle.to_tensor(np.asarray([2, 3, 2], "int32"))
+        src, dst, nodes = geometric.reindex_graph(x, neighbors, count)
+        np.testing.assert_array_equal(src.numpy(), [3, 4, 0, 5, 6, 7, 6])
+        np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+        np.testing.assert_array_equal(nodes.numpy(), [0, 1, 2, 8, 9, 4, 7, 6])
+
+    def test_sample_neighbors(self):
+        # CSC: node i's neighbors are row[colptr[i]:colptr[i+1]]
+        row = np.asarray([1, 2, 3, 0, 2, 0, 1], "int64")
+        colptr = np.asarray([0, 3, 5, 7, 7], "int64")
+        nb, cnt = geometric.sample_neighbors(
+            row, colptr, np.asarray([0, 2, 3], "int64"), sample_size=2)
+        assert list(cnt.numpy()) == [2, 2, 0]
+        assert set(nb.numpy()[:2]) <= {1, 2, 3}
+        assert set(nb.numpy()[2:4]) <= {0, 1}
+
+    def test_weighted_sample_respects_support(self):
+        row = np.asarray([1, 2, 3], "int64")
+        colptr = np.asarray([0, 3], "int64")
+        w = np.asarray([0.0, 0.0, 100.0], "float32")
+        nb, cnt = geometric.weighted_sample_neighbors(
+            row, colptr, w, np.asarray([0], "int64"), sample_size=1)
+        assert list(cnt.numpy()) == [1]
+        assert nb.numpy()[0] == 3  # only positive-weight neighbor
+
+
+class TestSignal:
+    def test_frame_axis_minus1(self):
+        x = paddle.to_tensor(np.arange(8, dtype="float32"))
+        y = signal.frame(x, frame_length=4, hop_length=2, axis=-1)
+        np.testing.assert_allclose(
+            y.numpy(),
+            [[0, 2, 4], [1, 3, 5], [2, 4, 6], [3, 5, 7]])
+
+    def test_frame_axis_0(self):
+        x = paddle.to_tensor(np.arange(8, dtype="float32"))
+        y = signal.frame(x, frame_length=4, hop_length=2, axis=0)
+        np.testing.assert_allclose(
+            y.numpy(), [[0, 1, 2, 3], [2, 3, 4, 5], [4, 5, 6, 7]])
+
+    def test_frame_batched(self):
+        x = paddle.to_tensor(
+            np.arange(16, dtype="float32").reshape(2, 8))
+        y = signal.frame(x, 4, 2, axis=-1)
+        assert y.shape == [2, 4, 3]
+
+    def test_overlap_add_inverts_frame_nonoverlap(self):
+        x = paddle.to_tensor(np.arange(8, dtype="float32"))
+        y = signal.frame(x, frame_length=4, hop_length=4, axis=-1)
+        back = signal.overlap_add(y, hop_length=4, axis=-1)
+        np.testing.assert_allclose(back.numpy(), x.numpy())
+
+    def test_overlap_add_overlap_counts(self):
+        ones = paddle.to_tensor(np.ones((4, 3), "float32"))  # [fl, n]
+        out = signal.overlap_add(ones, hop_length=2, axis=-1)
+        np.testing.assert_allclose(
+            out.numpy(), [1, 1, 2, 2, 2, 2, 1, 1])
+
+    def test_stft_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal(128).astype("float32")
+        spec = signal.stft(paddle.to_tensor(x), n_fft=32, hop_length=16,
+                           center=False).numpy()
+        n = 1 + (128 - 32) // 16
+        ref = np.stack([np.fft.rfft(x[i * 16:i * 16 + 32]) for i in range(n)],
+                       axis=-1)
+        np.testing.assert_allclose(spec, ref, atol=1e-4)
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal(256).astype("float32")
+        win = np.hanning(64).astype("float32")
+        spec = signal.stft(paddle.to_tensor(x), n_fft=64, hop_length=16,
+                           window=paddle.to_tensor(win), center=True)
+        back = signal.istft(spec, n_fft=64, hop_length=16,
+                            window=paddle.to_tensor(win), center=True,
+                            length=256)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-3)
+
+    def test_frame_grad(self):
+        x = paddle.to_tensor(np.arange(8, dtype="float32"),
+                             stop_gradient=False)
+        signal.frame(x, 4, 2, axis=-1).sum().backward()
+        # element i participates in (number of frames covering i)
+        np.testing.assert_allclose(
+            x.grad.numpy(), [1, 1, 2, 2, 2, 2, 1, 1])
+
+    def test_errors(self):
+        x = paddle.to_tensor(np.arange(8, dtype="float32"))
+        with pytest.raises(ValueError):
+            signal.frame(x, 4, 0)
+        with pytest.raises(ValueError):
+            signal.frame(x, 9, 2)
+        with pytest.raises(ValueError):
+            signal.frame(x, 4, 2, axis=1)

@@ -1,0 +1,91 @@
+"""Vision model zoo: forward shapes + compiled-train-step smoke for every
+architecture family (VERDICT r2 item 7: >=4 new architectures training
+under TrainStep). Reference: `python/paddle/vision/models/`."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.train_step import TrainStep
+from paddle_tpu.vision import models as M
+
+NC = 7  # small head to keep tests fast
+
+
+def _img(b=2, s=64):
+    return paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((b, 3, s, s))
+        .astype("float32"))
+
+
+FORWARD_CASES = [
+    ("vgg11", lambda: M.vgg11(num_classes=NC), 64),
+    ("vgg16_bn", lambda: M.vgg16(batch_norm=True, num_classes=NC), 64),
+    ("mobilenet_v1", lambda: M.mobilenet_v1(num_classes=NC, scale=0.25), 64),
+    ("mobilenet_v2", lambda: M.mobilenet_v2(num_classes=NC, scale=0.25), 64),
+    ("mobilenet_v3_small",
+     lambda: M.mobilenet_v3_small(num_classes=NC, scale=0.5), 64),
+    ("mobilenet_v3_large",
+     lambda: M.mobilenet_v3_large(num_classes=NC, scale=0.5), 64),
+    ("densenet121", lambda: M.densenet121(num_classes=NC), 64),
+    ("alexnet", lambda: M.alexnet(num_classes=NC), 224),
+    ("squeezenet1_1", lambda: M.squeezenet1_1(num_classes=NC), 64),
+    ("shufflenet_v2_x0_25",
+     lambda: M.shufflenet_v2_x0_25(num_classes=NC), 64),
+    ("inception_v3", lambda: M.inception_v3(num_classes=NC), 128),
+]
+
+
+@pytest.mark.parametrize("name,ctor,size", FORWARD_CASES,
+                         ids=[c[0] for c in FORWARD_CASES])
+def test_forward_shape(name, ctor, size):
+    paddle.seed(0)
+    model = ctor()
+    model.eval()
+    out = model(_img(2, size))
+    assert out.shape == [2, NC]
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_googlenet_aux_heads():
+    paddle.seed(0)
+    model = M.googlenet(num_classes=NC)
+    model.train()
+    out, aux1, aux2 = model(_img(2, 224))
+    assert out.shape == [2, NC]
+    assert aux1.shape == [2, NC] and aux2.shape == [2, NC]
+    model.eval()
+    out, aux1, aux2 = model(_img(2, 224))
+    assert aux1 is None and aux2 is None
+
+
+TRAIN_CASES = [
+    ("vgg11", lambda: M.vgg11(num_classes=NC), 64),
+    ("mobilenet_v2", lambda: M.mobilenet_v2(num_classes=NC, scale=0.25), 64),
+    ("mobilenet_v3_small",
+     lambda: M.mobilenet_v3_small(num_classes=NC, scale=0.5), 64),
+    ("densenet121", lambda: M.densenet121(num_classes=NC), 64),
+    ("shufflenet_v2_x0_25",
+     lambda: M.shufflenet_v2_x0_25(num_classes=NC), 64),
+    ("squeezenet1_1", lambda: M.squeezenet1_1(num_classes=NC), 64),
+]
+
+
+@pytest.mark.parametrize("name,ctor,size", TRAIN_CASES,
+                         ids=[c[0] for c in TRAIN_CASES])
+def test_trains_under_trainstep(name, ctor, size):
+    paddle.seed(0)
+    model = ctor()
+    model.train()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    import paddle_tpu.nn.functional as F
+
+    step = TrainStep(model, opt, lambda m, x, y: F.cross_entropy(m(x), y))
+    x = _img(2, size)
+    y = paddle.to_tensor(np.asarray([0, 1], "int64"))
+    l0 = float(step(x, y).numpy())
+    for _ in range(3):
+        loss = step(x, y)
+    l1 = float(loss.numpy())
+    assert np.isfinite(l0) and np.isfinite(l1)
+    assert l1 < l0  # same-batch loss must drop in 4 steps

@@ -1,0 +1,324 @@
+"""Round-3 op-coverage additions: parity tests vs numpy/scipy references.
+
+Ops audited against `phi/api/yaml/ops.yaml` (see docs/OP_COVERAGE.md):
+logit, i0e/i1/i1e, polygamma, renorm, inverse, clip_by_norm,
+squared_l2_norm, frobenius_norm, diag_embed, fill_diagonal(_tensor),
+fill, thresholded_relu, gather_tree, temporal_shift, huber_loss,
+edit_distance, hsigmoid_loss, max-pool-with-index, max_unpool1/2/3d.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+class TestMathAdditions:
+    def test_logit(self):
+        x = paddle.to_tensor(np.asarray([0.2, 0.5, 0.9], "float32"))
+        np.testing.assert_allclose(
+            paddle.logit(x).numpy(),
+            np.log(np.asarray([0.2, 0.5, 0.9]) / (1 - np.asarray([0.2, 0.5, 0.9]))),
+            rtol=1e-5)
+        # eps clamps out-of-range values to finite results
+        y = paddle.to_tensor(np.asarray([0.0, 1.0], "float32"))
+        out = paddle.logit(y, eps=1e-3).numpy()
+        assert np.isfinite(out).all()
+
+    def test_bessel(self):
+        from scipy import special
+
+        x = np.linspace(0.1, 4.0, 7).astype("float32")
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.i0e(t).numpy(), special.i0e(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.i1(t).numpy(), special.i1(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.i1e(t).numpy(), special.i1e(x), rtol=1e-5)
+
+    def test_polygamma(self):
+        from scipy import special
+
+        x = np.linspace(0.5, 3.0, 5).astype("float32")
+        t = paddle.to_tensor(x)
+        for n in (0, 1, 2):
+            np.testing.assert_allclose(
+                paddle.polygamma(t, n).numpy(), special.polygamma(n, x),
+                rtol=2e-4, atol=1e-5)
+        with pytest.raises(ValueError):
+            paddle.polygamma(t, -1)
+
+    def test_renorm(self):
+        x = paddle.to_tensor(np.asarray([[3., 4.], [0.3, 0.4]], "float32"))
+        out = paddle.renorm(x, p=2.0, axis=0, max_norm=1.0).numpy()
+        np.testing.assert_allclose(out[0], [0.6, 0.8], rtol=1e-5)
+        np.testing.assert_allclose(out[1], [0.3, 0.4], rtol=1e-5)  # unchanged
+
+    def test_inverse_and_grad(self):
+        a = np.asarray([[2., 1.], [1., 3.]], "float32")
+        x = paddle.to_tensor(a, stop_gradient=False)
+        inv = paddle.inverse(x)
+        np.testing.assert_allclose(inv.numpy(), np.linalg.inv(a), rtol=1e-5)
+        inv.sum().backward()
+        assert x.grad is not None
+
+    def test_clip_by_norm_and_squared_l2(self):
+        x = paddle.to_tensor(np.asarray([3., 4.], "float32"))
+        np.testing.assert_allclose(
+            paddle.clip_by_norm(x, 1.0).numpy(), [0.6, 0.8], rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.clip_by_norm(x, 10.0).numpy(), [3., 4.])
+        np.testing.assert_allclose(
+            float(paddle.squared_l2_norm(x).numpy()), 25.0)
+
+    def test_frobenius_norm(self):
+        a = np.random.default_rng(0).standard_normal((3, 4)).astype("float32")
+        np.testing.assert_allclose(
+            float(paddle.frobenius_norm(paddle.to_tensor(a)).numpy()),
+            np.linalg.norm(a), rtol=1e-5)
+
+
+class TestManipulationAdditions:
+    def test_diag_embed(self):
+        d = paddle.to_tensor(np.asarray([[1., 2.], [3., 4.]], "float32"))
+        out = paddle.diag_embed(d)
+        assert out.shape == [2, 2, 2]
+        np.testing.assert_allclose(out.numpy()[0], np.diag([1., 2.]))
+        out_off = paddle.diag_embed(d, offset=1)
+        assert out_off.shape == [2, 3, 3]
+        np.testing.assert_allclose(
+            out_off.numpy()[1], np.diag([3., 4.], k=1))
+
+    def test_diag_embed_dims(self):
+        d = paddle.to_tensor(np.asarray([1., 2., 3.], "float32"))
+        out = paddle.diag_embed(d, offset=0, dim1=0, dim2=1)
+        np.testing.assert_allclose(out.numpy(), np.diag([1., 2., 3.]))
+
+    def test_fill_diagonal_reference_example(self):
+        x = paddle.ones([4, 3]) * 2
+        x.fill_diagonal_(1.0)
+        np.testing.assert_allclose(
+            x.numpy(),
+            [[1., 2., 2.], [2., 1., 2.], [2., 2., 1.], [2., 2., 2.]])
+
+    def test_fill_diagonal_offset(self):
+        x = paddle.zeros([3, 4])
+        out = paddle.tensor.manipulation.fill_diagonal(x, 5.0, offset=1)
+        np.testing.assert_allclose(out.numpy(), np.diag([5.] * 3, k=1)[:3])
+
+    def test_fill_diagonal_tensor(self):
+        x = paddle.zeros([3, 3])
+        y = paddle.to_tensor(np.asarray([1., 2., 3.], "float32"))
+        out = paddle.fill_diagonal_tensor(x, y)
+        np.testing.assert_allclose(out.numpy(), np.diag([1., 2., 3.]))
+
+    def test_fill(self):
+        x = paddle.zeros([2, 2])
+        np.testing.assert_allclose(
+            paddle.tensor.manipulation.fill(x, 7.0).numpy(), np.full((2, 2), 7.0))
+
+
+class TestFunctionalAdditions:
+    def test_thresholded_relu(self):
+        x = paddle.to_tensor(np.asarray([0.5, 1.5, -1.0], "float32"))
+        np.testing.assert_allclose(
+            F.thresholded_relu(x).numpy(), [0., 1.5, 0.])
+        np.testing.assert_allclose(
+            F.thresholded_relu(x, threshold=0.2).numpy(), [0.5, 1.5, 0.])
+
+    def test_gather_tree_reference_example(self):
+        ids = paddle.to_tensor(np.asarray(
+            [[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]], "int64"))
+        parents = paddle.to_tensor(np.asarray(
+            [[[0, 0], [1, 1]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]], "int64"))
+        out = F.gather_tree(ids, parents)
+        np.testing.assert_array_equal(
+            out.numpy(),
+            [[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]])
+
+    def test_temporal_shift(self):
+        x = np.arange(2 * 2 * 4 * 1 * 1, dtype="float32").reshape(4, 4, 1, 1)
+        out = F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                               shift_ratio=0.25).numpy()
+        v = x.reshape(2, 2, 4, 1, 1)
+        # channel 0 shifts backward (from t-1), channel 1 forward (t+1)
+        assert out.reshape(2, 2, 4)[0, 0, 0] == 0  # t=0 gets zero pad
+        assert out.reshape(2, 2, 4)[0, 1, 0] == v[0, 0, 0, 0, 0]
+        assert out.reshape(2, 2, 4)[0, 0, 1] == v[0, 1, 1, 0, 0]
+
+    def test_huber_loss(self):
+        a = paddle.to_tensor(np.asarray([0.0, 2.0], "float32"))
+        b = paddle.to_tensor(np.asarray([0.5, 0.0], "float32"))
+        out = F.huber_loss(a, b, delta=1.0, reduction="none").numpy()
+        np.testing.assert_allclose(out, [0.125, 1.5])
+
+    def test_edit_distance(self):
+        # "kitten" vs "sitting" = 3
+        hyp = paddle.to_tensor(np.asarray(
+            [[ord(c) for c in "kitten."]], "int64"))
+        ref = paddle.to_tensor(np.asarray(
+            [[ord(c) for c in "sitting"]], "int64"))
+        dist, n = F.edit_distance(
+            hyp, ref, normalized=False,
+            input_length=paddle.to_tensor(np.asarray([6], "int64")),
+            label_length=paddle.to_tensor(np.asarray([7], "int64")))
+        assert float(dist.numpy()[0]) == 3.0
+        assert int(n.numpy()[0]) == 1
+        dn, _ = F.edit_distance(
+            hyp, ref, normalized=True,
+            input_length=paddle.to_tensor(np.asarray([6], "int64")),
+            label_length=paddle.to_tensor(np.asarray([7], "int64")))
+        np.testing.assert_allclose(float(dn.numpy()[0]), 3.0 / 7, rtol=1e-6)
+
+    def test_edit_distance_batch_and_empty(self):
+        hyp = paddle.to_tensor(np.asarray([[1, 2, 3], [1, 2, 3]], "int64"))
+        ref = paddle.to_tensor(np.asarray([[1, 2, 3], [4, 5, 6]], "int64"))
+        dist, _ = F.edit_distance(hyp, ref, normalized=False)
+        np.testing.assert_allclose(dist.numpy()[:, 0], [0.0, 3.0])
+        d0, _ = F.edit_distance(
+            hyp, ref, normalized=False,
+            input_length=paddle.to_tensor(np.asarray([0, 3], "int64")),
+            label_length=paddle.to_tensor(np.asarray([3, 0], "int64")))
+        np.testing.assert_allclose(d0.numpy()[:, 0], [3.0, 3.0])
+
+    def test_hsigmoid_loss(self):
+        rng = np.random.default_rng(0)
+        num_classes, d, b = 6, 8, 4
+        x = paddle.to_tensor(rng.standard_normal((b, d)).astype("float32"),
+                             stop_gradient=False)
+        w = paddle.to_tensor(
+            rng.standard_normal((num_classes - 1, d)).astype("float32"),
+            stop_gradient=False)
+        bias = paddle.to_tensor(
+            rng.standard_normal((num_classes - 1,)).astype("float32"))
+        label = paddle.to_tensor(np.asarray([0, 1, 4, 5], "int64"))
+        out = F.hsigmoid_loss(x, label, num_classes, w, bias)
+        assert out.shape == [b, 1]
+        assert np.isfinite(out.numpy()).all()
+        assert (out.numpy() > 0).all()  # -log p is positive
+        out.sum().backward()
+        assert x.grad is not None and w.grad is not None
+        # sum over all classes of p(class) == 1 for a complete binary tree
+        probs = []
+        for c in range(num_classes):
+            lab_c = paddle.to_tensor(np.full((b,), c, "int64"))
+            nll = F.hsigmoid_loss(
+                paddle.to_tensor(x.numpy()), lab_c, num_classes,
+                paddle.to_tensor(w.numpy()), bias)
+            probs.append(np.exp(-nll.numpy()[:, 0]))
+        np.testing.assert_allclose(np.sum(probs, axis=0), np.ones(b),
+                                   rtol=1e-4)
+
+
+class TestPoolIndexUnpool:
+    def test_pool_index_matches_plain(self):
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((2, 3, 8, 8)).astype("float32"))
+        out, idx = F.max_pool2d(x, 2, 2, return_mask=True)
+        np.testing.assert_allclose(
+            out.numpy(), F.max_pool2d(x, 2, 2).numpy())
+        # indices point at the max values
+        flat = x.numpy().reshape(2, 3, 64)
+        gathered = np.take_along_axis(flat, idx.numpy().reshape(2, 3, -1), -1)
+        np.testing.assert_allclose(gathered, out.numpy().reshape(2, 3, -1))
+
+    def test_pool_index_padded(self):
+        rng = np.random.default_rng(1)
+        x = paddle.to_tensor(rng.standard_normal((1, 2, 7, 7)).astype("float32"))
+        out, idx = F.max_pool2d(x, 3, 2, padding=1, return_mask=True)
+        np.testing.assert_allclose(
+            out.numpy(), F.max_pool2d(x, 3, 2, padding=1).numpy())
+        assert (idx.numpy() >= 0).all() and (idx.numpy() < 49).all()
+
+    def test_unpool_roundtrip_2d(self):
+        rng = np.random.default_rng(2)
+        x = paddle.to_tensor(rng.standard_normal((2, 3, 8, 8)).astype("float32"))
+        out, idx = F.max_pool2d(x, 2, 2, return_mask=True)
+        back = F.max_unpool2d(out, idx, 2, 2)
+        assert back.shape == [2, 3, 8, 8]
+        nz = back.numpy() != 0
+        assert nz.sum() == 2 * 3 * 16
+        np.testing.assert_allclose(back.numpy()[nz].sum(),
+                                   out.numpy().sum(), rtol=1e-5)
+
+    def test_unpool_1d_3d(self):
+        rng = np.random.default_rng(3)
+        x1 = paddle.to_tensor(rng.standard_normal((2, 3, 16)).astype("float32"))
+        o1, i1 = F.max_pool1d(x1, 4, 4, return_mask=True)
+        assert F.max_unpool1d(o1, i1, 4, 4).shape == [2, 3, 16]
+        x3 = paddle.to_tensor(
+            rng.standard_normal((1, 2, 4, 4, 4)).astype("float32"))
+        o3, i3 = F.max_pool3d(x3, 2, 2, return_mask=True)
+        assert F.max_unpool3d(o3, i3, 2, 2).shape == [1, 2, 4, 4, 4]
+
+    def test_unpool_grad(self):
+        x = paddle.to_tensor(
+            np.random.default_rng(4).standard_normal((1, 1, 4, 4))
+            .astype("float32"), stop_gradient=False)
+        out, idx = F.max_pool2d(x, 2, 2, return_mask=True)
+        F.max_unpool2d(out, idx, 2, 2).sum().backward()
+        # gradient flows only to the max positions, one per window
+        assert x.grad.numpy().astype(bool).sum() == 4
+
+
+class TestReviewRegressions:
+    def test_pool_index_ceil_mode_matches_plain(self):
+        rng = np.random.default_rng(5)
+        x = paddle.to_tensor(rng.standard_normal((2, 1, 5, 5)).astype("f4"))
+        plain = F.max_pool2d(x, 2, 2, ceil_mode=True)
+        out, idx = F.max_pool2d(x, 2, 2, ceil_mode=True, return_mask=True)
+        assert out.shape == plain.shape == [2, 1, 3, 3]
+        np.testing.assert_allclose(out.numpy(), plain.numpy())
+
+    def test_fill_diagonal_wrap_matches_numpy(self):
+        a = np.zeros((7, 3), "float32")
+        np.fill_diagonal(a, 4.0, wrap=True)
+        x = paddle.zeros([7, 3])
+        out = paddle.tensor.manipulation.fill_diagonal(x, 4.0, wrap=True)
+        np.testing.assert_allclose(out.numpy(), a)
+
+    def test_maxpool_layer_returns_mask(self):
+        import paddle_tpu.nn as nn
+
+        x = paddle.to_tensor(
+            np.random.default_rng(6).standard_normal((1, 2, 4, 4))
+            .astype("f4"))
+        out, idx = nn.MaxPool2D(2, 2, return_mask=True)(x)
+        assert out.shape == [1, 2, 2, 2] and idx.shape == [1, 2, 2, 2]
+
+    def test_edit_distance_no_dtype_warning(self):
+        import warnings
+
+        hyp = paddle.to_tensor(np.asarray([[1, 2, 3]], "int64"))
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            F.edit_distance(hyp, hyp, normalized=False)
+
+    def test_lu_unpack_nonsquare(self):
+        for shape in [(4, 2), (2, 4)]:
+            a = np.random.default_rng(7).standard_normal(shape).astype("f4")
+            lu, piv = paddle.linalg.lu(paddle.to_tensor(a))
+            P, L, U = paddle.linalg.lu_unpack(lu, piv)
+            assert P.shape == [shape[0], shape[0]]
+            np.testing.assert_allclose(
+                P.numpy() @ L.numpy() @ U.numpy(), a, atol=1e-5)
+
+    def test_fill_diagonal_nd_contract(self):
+        x3 = paddle.zeros([3, 3, 3])
+        out = paddle.tensor.manipulation.fill_diagonal(x3, 1.0)
+        assert out.numpy()[1, 1, 1] == 1.0
+        with pytest.raises(ValueError):
+            paddle.tensor.manipulation.fill_diagonal(x3, 1.0, offset=1)
+        with pytest.raises(ValueError):
+            paddle.tensor.manipulation.fill_diagonal(
+                paddle.zeros([4, 3, 3]), 1.0)
+
+    def test_max_pool1d_mask_channel_last_rejected(self):
+        x = paddle.to_tensor(np.zeros((1, 8, 2), "f4"))
+        with pytest.raises(ValueError):
+            F.max_pool1d(x, 2, 2, return_mask=True, data_format="NLC")
+
+    def test_hsigmoid_table_cached(self):
+        from paddle_tpu.nn.functional.loss import _simple_code_tables
+
+        t1 = _simple_code_tables(64)
+        t2 = _simple_code_tables(64)
+        assert t1[0] is t2[0]  # same cached object, no per-call rebuild

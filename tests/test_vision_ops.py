@@ -1,0 +1,242 @@
+"""paddle.vision.ops detection-op tests (parity vs hand-computed and
+structural invariants; reference `python/paddle/vision/ops.py`)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def t(a, dt="float32"):
+    return paddle.to_tensor(np.asarray(a, dt))
+
+
+class TestNms:
+    def test_basic_suppression(self):
+        boxes = t([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]])
+        scores = t([0.9, 0.8, 0.7])
+        kept = V.nms(boxes, 0.5, scores).numpy()
+        # box 1 overlaps box 0 heavily -> suppressed
+        np.testing.assert_array_equal(kept, [0, 2])
+
+    def test_no_scores_keeps_order(self):
+        boxes = t([[0, 0, 10, 10], [100, 0, 110, 10]])
+        kept = V.nms(boxes, 0.5).numpy()
+        np.testing.assert_array_equal(kept, [0, 1])
+
+    def test_categories_isolate(self):
+        boxes = t([[0, 0, 10, 10], [1, 1, 11, 11]])
+        scores = t([0.9, 0.8])
+        cat = t([0, 1], "int64")
+        kept = V.nms(boxes, 0.5, scores, cat, [0, 1]).numpy()
+        assert len(kept) == 2  # different categories never suppress
+
+    def test_top_k(self):
+        boxes = t([[0, 0, 10, 10], [100, 0, 110, 10], [200, 0, 210, 10]])
+        scores = t([0.5, 0.9, 0.7])
+        kept = V.nms(boxes, 0.5, scores, top_k=2).numpy()
+        np.testing.assert_array_equal(kept, [1, 2])
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.default_rng(0)
+        priors = np.abs(rng.standard_normal((5, 4))).astype("float32")
+        priors[:, 2:] = priors[:, :2] + 1.0 + np.abs(priors[:, 2:])
+        targets = priors + 0.1
+        enc = V.box_coder(t(priors), [0.1, 0.1, 0.2, 0.2], t(targets),
+                          code_type="encode_center_size")
+        assert enc.shape == [5, 5, 4]
+        # decode the diagonal (each target against its own prior)
+        diag = np.stack([enc.numpy()[i, i] for i in range(5)])[None]
+        dec = V.box_coder(t(priors), [0.1, 0.1, 0.2, 0.2],
+                          t(np.repeat(diag, 5, 0).transpose(1, 0, 2)),
+                          code_type="decode_center_size", axis=0)
+        np.testing.assert_allclose(
+            np.stack([dec.numpy()[i, i] for i in range(5)]),
+            targets, rtol=1e-4, atol=1e-4)
+
+    def test_variance_tensor_matches_list(self):
+        priors = t([[0., 0., 2., 2.], [1., 1., 3., 3.]])
+        targets = t([[0., 0., 2., 2.]])
+        e1 = V.box_coder(priors, [0.1, 0.1, 0.2, 0.2], targets).numpy()
+        e2 = V.box_coder(
+            priors, t([[0.1, 0.1, 0.2, 0.2]] * 2), targets).numpy()
+        np.testing.assert_allclose(e1, e2)
+
+
+class TestPriorBox:
+    def test_shapes_and_variances(self):
+        feat = t(np.zeros((1, 8, 4, 4)))
+        img = t(np.zeros((1, 3, 32, 32)))
+        boxes, var = V.prior_box(feat, img, min_sizes=[8.0],
+                                 aspect_ratios=[1.0, 2.0], clip=True)
+        assert boxes.shape == [4, 4, 2, 4]
+        assert var.shape == [4, 4, 2, 4]
+        b = boxes.numpy()
+        assert (b >= 0).all() and (b <= 1).all()
+        np.testing.assert_allclose(var.numpy()[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+    def test_max_sizes_add_prior(self):
+        feat = t(np.zeros((1, 8, 2, 2)))
+        img = t(np.zeros((1, 3, 16, 16)))
+        boxes, _ = V.prior_box(feat, img, min_sizes=[4.0], max_sizes=[8.0],
+                               aspect_ratios=[1.0])
+        assert boxes.shape[2] == 2  # min + sqrt(min*max)
+
+
+class TestYoloBox:
+    def test_shapes_and_threshold(self):
+        n, s, cls, h = 1, 2, 3, 4
+        x = t(np.random.default_rng(0).standard_normal(
+            (n, s * (5 + cls), h, h)))
+        img = t(np.asarray([[64, 64]]), "int32")
+        boxes, scores = V.yolo_box(x, img, [10, 13, 16, 30], cls,
+                                   conf_thresh=0.5, downsample_ratio=8)
+        assert boxes.shape == [n, h * h * s, 4]
+        assert scores.shape == [n, h * h * s, cls]
+        # zeroed entries where conf < thresh
+        z = (np.abs(boxes.numpy()).sum(-1) == 0)
+        sz = (scores.numpy().sum(-1) == 0)
+        np.testing.assert_array_equal(z, sz)
+
+    def test_clip_bbox(self):
+        n, s, cls, h = 1, 1, 1, 2
+        x = t(np.full((n, s * (5 + cls), h, h), 3.0))
+        img = t(np.asarray([[16, 16]]), "int32")
+        boxes, _ = V.yolo_box(x, img, [100, 100], cls, conf_thresh=0.0,
+                              downsample_ratio=8, clip_bbox=True)
+        b = boxes.numpy()
+        assert (b >= 0).all() and (b <= 15).all()
+
+
+class TestRoiOps:
+    def test_roi_align_constant_field(self):
+        # constant feature map -> every bin averages to the constant
+        x = t(np.full((1, 2, 8, 8), 3.0))
+        boxes = t([[0., 0., 7., 7.], [2., 2., 6., 6.]])
+        out = V.roi_align(x, boxes, t([2], "int32"), output_size=2)
+        assert out.shape == [2, 2, 2, 2]
+        np.testing.assert_allclose(out.numpy(), np.full((2, 2, 2, 2), 3.0),
+                                   rtol=1e-5)
+
+    def test_roi_align_gradient(self):
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((1, 1, 8, 8))
+            .astype("float32"), stop_gradient=False)
+        boxes = t([[0., 0., 7., 7.]])
+        V.roi_align(x, boxes, t([1], "int32"), 2).sum().backward()
+        assert x.grad is not None and np.abs(x.grad.numpy()).sum() > 0
+
+    def test_roi_pool_max(self):
+        feat = np.zeros((1, 1, 4, 4), "float32")
+        feat[0, 0, 1, 1] = 5.0
+        feat[0, 0, 3, 3] = 7.0
+        out = V.roi_pool(t(feat), t([[0., 0., 3., 3.]]), t([1], "int32"), 2)
+        o = out.numpy()[0, 0]
+        assert o[0, 0] == 5.0 and o[1, 1] == 7.0
+
+    def test_psroi_pool(self):
+        # channels = oc * ph * pw = 1*2*2; each bin reads its own channel
+        feat = np.stack([np.full((4, 4), float(i)) for i in range(4)])[None]
+        out = V.psroi_pool(t(feat), t([[0., 0., 3., 3.]]), t([1], "int32"),
+                           output_size=2, spatial_scale=1.0)
+        assert out.shape == [1, 1, 2, 2]
+        np.testing.assert_allclose(
+            out.numpy()[0, 0], [[0., 1.], [2., 3.]])
+
+
+class TestSelectionOps:
+    def test_matrix_nms_shapes(self):
+        rng = np.random.default_rng(0)
+        bboxes = np.abs(rng.standard_normal((1, 6, 4))).astype("float32")
+        bboxes[..., 2:] = bboxes[..., :2] + 1.0
+        scores = rng.uniform(0, 1, (1, 3, 6)).astype("float32")
+        out, idx, num = V.matrix_nms(
+            t(bboxes), t(scores), score_threshold=0.1, post_threshold=0.0,
+            nms_top_k=10, keep_top_k=5, return_index=True)
+        assert out.shape[1] == 6
+        assert int(num.numpy()[0]) == out.shape[0]
+        assert idx.shape[0] == out.shape[0]
+
+    def test_generate_proposals(self):
+        rng = np.random.default_rng(1)
+        h = w = 4
+        a = 2
+        scores = rng.uniform(0, 1, (1, a, h, w)).astype("float32")
+        deltas = rng.standard_normal((1, 4 * a, h, w)).astype("float32") * 0.1
+        anchors = np.stack(np.meshgrid(np.arange(h), np.arange(w),
+                                       indexing="ij"), -1)
+        anchors = np.concatenate(
+            [np.tile(anchors.reshape(-1, 2), (a, 1)).astype("float32"),
+             np.tile(anchors.reshape(-1, 2), (a, 1)).astype("float32") + 4.0],
+            axis=1)
+        var = np.full_like(anchors, 0.1)
+        rois, probs, num = V.generate_proposals(
+            t(scores), t(deltas), t([[32., 32.]]), t(anchors), t(var),
+            pre_nms_top_n=20, post_nms_top_n=5, return_rois_num=True)
+        assert rois.shape[0] <= 5 and rois.shape[1] == 4
+        assert probs.shape[0] == rois.shape[0]
+        assert int(num.numpy()[0]) == rois.shape[0]
+
+    def test_distribute_fpn_proposals(self):
+        rois = t([[0., 0., 10., 10.],     # small -> low level
+                  [0., 0., 200., 200.]])  # large -> high level
+        multi, restore = V.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+        assert len(multi) == 4
+        total = sum(m.shape[0] for m in multi)
+        assert total == 2
+        r = restore.numpy()[:, 0]
+        assert sorted(r.tolist()) == [0, 1]
+
+
+class TestDeformConv:
+    def test_zero_offset_matches_conv(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(0)
+        x = t(rng.standard_normal((1, 3, 8, 8)))
+        w = t(rng.standard_normal((4, 3, 3, 3)) * 0.1)
+        off = t(np.zeros((1, 2 * 9, 6, 6)))
+        out = V.deform_conv2d(x, off, w)
+        ref = F.conv2d(x, w)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_mask_halves_output(self):
+        rng = np.random.default_rng(1)
+        x = t(rng.standard_normal((1, 2, 6, 6)))
+        w = t(rng.standard_normal((2, 2, 3, 3)) * 0.1)
+        off = t(np.zeros((1, 2 * 9, 4, 4)))
+        m_full = t(np.ones((1, 9, 4, 4)))
+        m_half = t(np.full((1, 9, 4, 4), 0.5))
+        o1 = V.deform_conv2d(x, off, w, mask=m_full).numpy()
+        o2 = V.deform_conv2d(x, off, w, mask=m_half).numpy()
+        np.testing.assert_allclose(o2, o1 * 0.5, rtol=1e-4, atol=1e-5)
+
+    def test_gradient_flows(self):
+        rng = np.random.default_rng(2)
+        x = paddle.to_tensor(rng.standard_normal((1, 2, 6, 6))
+                             .astype("float32"), stop_gradient=False)
+        w = paddle.to_tensor(rng.standard_normal((2, 2, 3, 3))
+                             .astype("float32") * 0.1, stop_gradient=False)
+        off = paddle.to_tensor(
+            (rng.standard_normal((1, 18, 4, 4)) * 0.1).astype("float32"),
+            stop_gradient=False)
+        V.deform_conv2d(x, off, w).sum().backward()
+        assert x.grad is not None and w.grad is not None
+        assert off.grad is not None
+
+
+class TestImageIO:
+    def test_read_decode_jpeg_roundtrip(self, tmp_path):
+        from PIL import Image
+
+        arr = np.random.default_rng(0).integers(
+            0, 255, (16, 16, 3), dtype=np.uint8)
+        p = tmp_path / "img.jpg"
+        Image.fromarray(arr).save(p, quality=95)
+        raw = V.read_file(str(p))
+        assert np.dtype(raw.numpy().dtype) == np.uint8
+        img = V.decode_jpeg(raw)
+        assert img.shape == [3, 16, 16]
