@@ -155,10 +155,20 @@ class LlamaAttention(Layer):
         k = k.reshape([b, s, self.num_kv_heads, self.head_dim])
         v = v.reshape([b, s, self.num_kv_heads, self.head_dim])
         q, k = apply_rotary_pos_emb(q, k, cfg.rope_theta)
-        if self.num_kv_heads != self.num_heads:  # GQA: repeat KV heads
-            rep = self.num_heads // self.num_kv_heads
-            k = T.repeat_interleave(k, rep, axis=2)
-            v = T.repeat_interleave(v, rep, axis=2)
+        if self.num_kv_heads != self.num_heads:
+            # GQA: scaled_dot_product_attention handles grouped KV
+            # natively (Pallas shared-KV index maps / composite repeat),
+            # so the repeat is only materialized when (a) the ring
+            # context-parallel path runs (it requires equal head counts)
+            # or (b) mp sharding couldn't split the unrepeated KV heads
+            from ..distributed import env as env_mod
+
+            e = env_mod.get_env()
+            mp = e.degree("mp") if e is not None else 1
+            if cfg.context_parallel or (mp > 1 and self.num_kv_heads % mp):
+                rep = self.num_heads // self.num_kv_heads
+                k = T.repeat_interleave(k, rep, axis=2)
+                v = T.repeat_interleave(v, rep, axis=2)
         # heads stay mp-sharded through attention (dim 2)
         q = shard.sharding_constraint(q, None, None, "mp", None)
         k = shard.sharding_constraint(k, None, None, "mp", None)

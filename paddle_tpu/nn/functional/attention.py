@@ -23,8 +23,14 @@ from ...ops.dispatch import apply
 
 def _sdpa_reference(q, k, v, *rest, causal=False, dropout=0.0, scale=None,
                     dropout_key=None):
-    """q,k,v: [batch, seq, heads, head_dim] (paddle flash-attn layout)."""
+    """q,k,v: [batch, seq, heads, head_dim] (paddle flash-attn layout).
+    GQA/MQA: kv_heads may divide q heads — KV is repeated here (the
+    Pallas kernel instead streams shared KV blocks without the repeat)."""
     hd = q.shape[-1]
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     s = scale if scale is not None else 1.0 / math.sqrt(hd)
     # [b, h, sq, sk]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * s

@@ -161,13 +161,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
-                    *, causal, scale, offset, n_qb):
+                    *, causal, scale, offset, n_qb, n_iters):
+    """dk/dv accumulate over the q-minor grid dim, which iterates
+    group × q-blocks under GQA (the same KV block serves every q head of
+    its group; q_idx below is the position within one head's q blocks)."""
     k_idx = pl.program_id(1)
-    q_idx = pl.program_id(2)
+    q_iter = pl.program_id(2)
+    q_idx = q_iter % n_qb
     block_k = k_ref.shape[1]
     block_q = q_ref.shape[1]
 
-    @pl.when(q_idx == 0)
+    @pl.when(q_iter == 0)
     def _init():
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
@@ -202,7 +206,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         _step()
 
-    @pl.when(q_idx == n_qb - 1)
+    @pl.when(q_iter == n_iters - 1)
     def _fini():
         dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
@@ -222,13 +226,19 @@ def _flash_bhsd(q, k, v, causal, scale, interpret):
 
 
 def _flash_fwd(q, k, v, causal, scale, interpret):
-    """q,k,v: [bh, s, d] -> (out [bh, s, d], lse [bh, s, _LANES]).
+    """q: [bh, s, d], k/v: [bh_kv, s, d] with bh % bh_kv == 0 (GQA: each
+    group of bh//bh_kv query heads shares one KV head — the K/V BlockSpec
+    index maps divide the bh program index, so grouped heads stream the
+    same KV blocks without materializing repeated KV, matching the
+    reference flash_attn kernel's num_heads_k support).
 
-    lse is returned lane-broadcast (last dim `_LANES`) so its BlockSpec
-    satisfies Mosaic's lane-divisibility rule; consumers read [..., :1].
+    Returns (out [bh, s, d], lse [bh, s, _LANES]) — lse lane-broadcast so
+    its BlockSpec satisfies Mosaic's lane-divisibility rule; consumers
+    read [..., :1].
     """
     bh, sq, d = q.shape
     sk = k.shape[1]
+    group = bh // k.shape[0]
     block_q = _pick_block(sq)
     block_k = _pick_block(sk)
     n_kb = sk // block_k
@@ -240,8 +250,10 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b // group, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -277,6 +289,8 @@ def _flash_bwd_rule(causal, scale, interpret, res, g):
     q, k, v, out, lse = res
     bh, sq, d = q.shape
     sk = k.shape[1]
+    bh_kv = k.shape[0]
+    group = bh // bh_kv
     block_q = _pick_block(sq)
     block_k = _pick_block(sk)
     n_qb = sq // block_q
@@ -296,8 +310,10 @@ def _flash_bwd_rule(causal, scale, interpret, res, g):
         grid=(bh, n_qb, n_kb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b // group, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
@@ -310,25 +326,36 @@ def _flash_bwd_rule(causal, scale, interpret, res, g):
         interpret=interpret,
     )(q, k, v, g, lse, delta)
 
+    # dkv grid runs per KV head; the minor dim sweeps group × q-blocks so
+    # grouped q heads accumulate into one dk/dv block (GQA)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
-                          offset=offset, n_qb=n_qb),
-        grid=(bh, n_kb, n_qb),
+                          offset=offset, n_qb=n_qb,
+                          n_iters=group * n_qb),
+        grid=(bh_kv, n_kb, group * n_qb),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, j, i: (b * group + i // n_qb,
+                                          i % n_qb, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, j, i: (b * group + i // n_qb,
+                                          i % n_qb, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda b, j, i: (b * group + i // n_qb,
+                                          i % n_qb, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda b, j, i: (b * group + i // n_qb,
+                                          i % n_qb, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((bh_kv, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh_kv, sk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -362,18 +389,21 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
         return fallback(dropout)
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    h_kv = k.shape[2]
     # d is never blocked, so any 8-multiple head_dim lowers (block dim ==
     # array dim); d=64 (BERT-base) engages the kernel, matching the
-    # reference flash_attn kernel's head_dim support. The seq blocks must
-    # be sublane-aligned when they tile the sequence.
+    # reference flash_attn kernel's head_dim support. GQA/MQA (h_kv < h)
+    # streams shared KV blocks via index-map division. The seq blocks
+    # must be sublane-aligned when they tile the sequence.
     bq, bk = _pick_block(sq), _pick_block(sk)
     ok_blocks = (bq == sq or bq % 8 == 0) and (bk == sk or bk % 8 == 0)
-    if sq < 16 or sk < 16 or d % 8 or k.shape[2] != h or not ok_blocks:
+    if (sq < 16 or sk < 16 or d % 8 or h % h_kv or v.shape[2] != h_kv
+            or not ok_blocks):
         return fallback(0.0)
     scale = 1.0 / math.sqrt(d)
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)
     out = _flash_bhsd(qt, kt, vt, causal, scale, interpret)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 

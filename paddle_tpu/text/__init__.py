@@ -10,7 +10,25 @@ from ..framework.core import Tensor
 from ..nn.layer.layers import Layer
 from ..ops.dispatch import apply_nondiff
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets", "Imdb",
+           "Imikolov", "UCIHousing", "Movielens", "Conll05st", "WMT14",
+           "WMT16"]
+
+
+def __getattr__(name):
+    # lazy: the dataset module pulls in io/tarfile machinery only on use
+    if name in ("datasets", "Imdb", "Imikolov", "UCIHousing", "Movielens",
+                "Conll05st", "WMT14", "WMT16"):
+        import importlib
+
+        # importlib (not `from . import`): the latter re-enters this
+        # __getattr__ through the parent-package getattr and recurses
+        _ds = importlib.import_module(".datasets", __name__)
+        globals()["datasets"] = _ds
+        for n in _ds.__all__:
+            globals()[n] = getattr(_ds, n)
+        return globals()[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

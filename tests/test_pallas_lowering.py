@@ -93,3 +93,19 @@ def test_entry_smoke_lowering_helper():
     from paddle_tpu.ops.pallas import check_tpu_lowering
 
     check_tpu_lowering()
+
+
+@pytest.mark.parametrize("group", [2, 4, 8])
+def test_gqa_lowers_for_tpu(group):
+    """GQA: bh % bh_kv == 0 — shared-KV index maps must Mosaic-lower."""
+    bh, s, d = 8, 1024, 128
+    q = jnp.zeros((bh, s, d), jnp.bfloat16)
+    kv = jnp.zeros((bh // group, s, d), jnp.bfloat16)
+    scale = 1.0 / math.sqrt(d)
+    _export_for_tpu(
+        lambda q, k, v: _flash_bhsd(q, k, v, True, scale, False), q, kv, kv)
+    _export_for_tpu(
+        lambda q, k, v: jax.grad(
+            lambda *a: _flash_bhsd(*a, True, scale, False)
+            .astype(jnp.float32).sum(), argnums=(0, 1, 2))(q, k, v),
+        q, kv, kv)

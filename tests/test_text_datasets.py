@@ -1,0 +1,145 @@
+"""paddle.text.datasets parsed against synthetic archives built in the
+reference's exact layouts (no-egress environment: data_file is required)."""
+import io
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.framework.errors import UnavailableError
+from paddle_tpu.text import datasets as D
+
+
+def _add_tar_bytes(tar, name, data: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tar.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture
+def imdb_tar(tmp_path):
+    p = tmp_path / "aclImdb_v1.tar.gz"
+    with tarfile.open(p, "w:gz") as tar:
+        docs = {
+            "aclImdb/train/pos/0.txt": b"A great, GREAT movie movie!",
+            "aclImdb/train/neg/0.txt": b"terrible movie. just terrible",
+            "aclImdb/test/pos/0.txt": b"great fun",
+            "aclImdb/test/neg/0.txt": b"boring movie",
+        }
+        for name, data in docs.items():
+            _add_tar_bytes(tar, name, data)
+    return str(p)
+
+
+class TestImdb:
+    def test_vocab_and_labels(self, imdb_tar):
+        ds = D.Imdb(data_file=imdb_tar, mode="train", cutoff=0)
+        # vocab sorted by (-freq, word): 'movie' freq 4 is first
+        assert ds.word_idx["movie"] == 0
+        assert "<unk>" in ds.word_idx
+        assert len(ds) == 2
+        doc0, label0 = ds[0]
+        assert label0[0] == 0  # pos first
+        _, label1 = ds[1]
+        assert label1[0] == 1
+        # punctuation was stripped: 'great,' tokenized as 'great'
+        assert "great," not in ds.word_idx and "great" in ds.word_idx
+
+    def test_test_mode(self, imdb_tar):
+        ds = D.Imdb(data_file=imdb_tar, mode="test", cutoff=0)
+        assert len(ds) == 2
+
+    def test_missing_file_raises_actionable(self):
+        with pytest.raises(UnavailableError):
+            D.Imdb(data_file=None)
+
+
+@pytest.fixture
+def ptb_tar(tmp_path):
+    p = tmp_path / "simple-examples.tgz"
+    train = b"the cat sat\nthe dog sat\n"
+    test = b"the cat ran\n"
+    with tarfile.open(p, "w:gz") as tar:
+        _add_tar_bytes(tar, "./simple-examples/data/ptb.train.txt", train)
+        _add_tar_bytes(tar, "./simple-examples/data/ptb.test.txt", test)
+    return str(p)
+
+
+class TestImikolov:
+    def test_ngram_windows(self, ptb_tar):
+        ds = D.Imikolov(data_file=ptb_tar, data_type="NGRAM", window_size=3,
+                        mode="train", min_word_freq=1)
+        # each 5-token line (<s> w w w <e>) gives 3 trigrams
+        assert len(ds) == 6
+        gram = ds[0]
+        assert len(gram) == 3
+        assert all(isinstance(g, np.ndarray) for g in gram)
+
+    def test_seq_mode_shifted(self, ptb_tar):
+        ds = D.Imikolov(data_file=ptb_tar, data_type="SEQ", mode="train",
+                        min_word_freq=1)
+        src, trg = ds[0]
+        assert src[0] == ds.word_idx["<s>"]
+        assert trg[-1] == ds.word_idx["<e>"]
+        np.testing.assert_array_equal(src[1:], trg[:-1])
+
+    def test_unk_in_test_mode(self, ptb_tar):
+        ds = D.Imikolov(data_file=ptb_tar, data_type="SEQ", mode="test",
+                        min_word_freq=1)
+        src, trg = ds[0]  # 'ran' unseen in train -> <unk>
+        assert ds.word_idx["<unk>"] in list(trg)
+
+
+class TestUCIHousing:
+    def test_split_and_normalization(self, tmp_path):
+        rng = np.random.default_rng(0)
+        rows = rng.uniform(1, 10, (20, 14))
+        p = tmp_path / "housing.data"
+        with open(p, "w") as f:
+            for r in rows:
+                f.write(" ".join(f"{v:.4f}" for v in r) + "\n")
+        tr = D.UCIHousing(data_file=str(p), mode="train")
+        te = D.UCIHousing(data_file=str(p), mode="test")
+        assert len(tr) == 16 and len(te) == 4
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        # features are normalized; the target column is untouched
+        assert np.abs(np.concatenate([t[0] for t in
+                                      [tr[i] for i in range(16)]])).max() < 1.5
+
+
+class TestMovielens:
+    def test_parse_and_split(self, tmp_path):
+        p = tmp_path / "ml-1m.zip"
+        with zipfile.ZipFile(p, "w") as zf:
+            zf.writestr("ml-1m/movies.dat",
+                        "1::Toy Story (1995)::Animation|Children\n"
+                        "2::Jumanji (1995)::Adventure\n")
+            zf.writestr("ml-1m/users.dat",
+                        "1::M::25::4::12345\n2::F::35::7::54321\n")
+            zf.writestr("ml-1m/ratings.dat",
+                        "1::1::5::964982703\n1::2::3::964982703\n"
+                        "2::1::4::964982703\n2::2::2::964982703\n")
+        tr = D.Movielens(data_file=str(p), mode="train", test_ratio=0.25,
+                         rand_seed=0)
+        te = D.Movielens(data_file=str(p), mode="test", test_ratio=0.25,
+                         rand_seed=0)
+        assert len(tr) + len(te) == 4
+        uid, gender, age, job, mid, title, cats, rating = tr[0]
+        assert gender in (0, 1)
+        assert rating in (2.0, 3.0, 4.0, 5.0)
+
+
+def test_gated_datasets_raise_actionable():
+    for cls in (D.Conll05st, D.WMT14, D.WMT16):
+        with pytest.raises(UnavailableError) as ei:
+            cls()
+        assert "egress" in str(ei.value)
+
+
+def test_text_namespace_exposes_datasets():
+    import paddle_tpu as paddle
+
+    assert paddle.text.Imdb is D.Imdb
+    assert paddle.text.datasets.UCIHousing is D.UCIHousing

@@ -240,3 +240,81 @@ class TestImageIO:
         assert np.dtype(raw.numpy().dtype) == np.uint8
         img = V.decode_jpeg(raw)
         assert img.shape == [3, 16, 16]
+
+
+class TestYoloLoss:
+    def _setup(self):
+        rng = np.random.default_rng(0)
+        n, s, cls, h = 2, 3, 4, 8
+        x = t(rng.standard_normal((n, s * (5 + cls), h, h)) * 0.1)
+        gt_box = np.zeros((n, 5, 4), "float32")
+        gt_box[0, 0] = [0.5, 0.5, 0.3, 0.4]
+        gt_box[0, 1] = [0.2, 0.3, 0.1, 0.1]
+        gt_box[1, 0] = [0.7, 0.2, 0.2, 0.2]
+        gt_label = np.zeros((n, 5), "int32")
+        gt_label[0, 0] = 1
+        gt_label[0, 1] = 3
+        gt_label[1, 0] = 2
+        anchors = [10, 13, 16, 30, 33, 23]
+        return x, t(gt_box), t(gt_label, "int32"), anchors, cls
+
+    def test_loss_finite_positive_per_image(self):
+        x, gb, gl, anchors, cls = self._setup()
+        loss = V.yolo_loss(x, gb, gl, anchors, [0, 1, 2], cls,
+                           ignore_thresh=0.7, downsample_ratio=32)
+        assert loss.shape == [2]
+        l = loss.numpy()
+        assert np.isfinite(l).all() and (l > 0).all()
+
+    def test_gradient_flows_and_matched_cells_matter(self):
+        import paddle_tpu as pd
+
+        x, gb, gl, anchors, cls = self._setup()
+        x.stop_gradient = False
+        V.yolo_loss(x, gb, gl, anchors, [0, 1, 2], cls,
+                    ignore_thresh=0.7, downsample_ratio=32).sum().backward()
+        g = x.grad.numpy()
+        assert np.abs(g).sum() > 0
+        # x/y/class grads concentrate on assigned cells: the cell of
+        # gt (0.5, 0.5) must receive gradient in some anchor slot
+        gv = g.reshape(2, 3, 9, 8, 8)
+        assert np.abs(gv[0, :, 0, 4, 4]).sum() > 0
+
+    def test_gt_score_scales_positive_losses(self):
+        x, gb, gl, anchors, cls = self._setup()
+        full = V.yolo_loss(x, gb, gl, anchors, [0, 1, 2], cls, 0.7, 32,
+                           gt_score=t(np.ones((2, 5), "float32")))
+        half = V.yolo_loss(x, gb, gl, anchors, [0, 1, 2], cls, 0.7, 32,
+                           gt_score=t(np.full((2, 5), 0.5, "float32")))
+        assert (half.numpy() != full.numpy()).any()
+
+    def test_no_gt_only_noobj_loss(self):
+        rng = np.random.default_rng(1)
+        x = t(rng.standard_normal((1, 3 * 9, 4, 4)) * 0.1)
+        gb = t(np.zeros((1, 2, 4), "float32"))
+        gl = t(np.zeros((1, 2), "int32"))
+        loss = V.yolo_loss(x, gb, gl, [10, 13, 16, 30, 33, 23], [0, 1, 2],
+                           4, 0.7, 32)
+        assert np.isfinite(loss.numpy()).all() and loss.numpy()[0] > 0
+
+    def test_scale_x_y_changes_ignore_decode(self):
+        # scale_x_y only affects the ignore-IoU decode, so the loss moves
+        # only when the wider decode flips a prediction across the
+        # threshold — a low threshold plus scale 2.0 guarantees flips
+        x, gb, gl, anchors, cls = self._setup()
+        l1 = V.yolo_loss(x, gb, gl, anchors, [0, 1, 2], cls, 0.05, 32,
+                         scale_x_y=1.0).numpy()
+        l2 = V.yolo_loss(x, gb, gl, anchors, [0, 1, 2], cls, 0.05, 32,
+                         scale_x_y=2.0).numpy()
+        assert (l1 != l2).any()
+
+    def test_mixup_score_weights_loss_not_target(self):
+        # score 0.5 must scale positive obj/cls losses linearly: with
+        # fixed predictions, loss(score=s) is affine in s for positives
+        x, gb, gl, anchors, cls = self._setup()
+        def with_score(s):
+            return V.yolo_loss(
+                x, gb, gl, anchors, [0, 1, 2], cls, 0.99, 32,
+                gt_score=t(np.full((2, 5), s, "float32"))).numpy()
+        l0, l5, l1 = with_score(0.0), with_score(0.5), with_score(1.0)
+        np.testing.assert_allclose(l5, (l0 + l1) / 2, rtol=1e-4)
