@@ -197,3 +197,256 @@ class features:  # namespace parity: paddle.audio.features.*
     MelSpectrogram = MelSpectrogram
     LogMelSpectrogram = LogMelSpectrogram
     MFCC = MFCC
+
+
+# ---- round-3 additions: full paddle.audio.functional surface + WAV
+# backends (stdlib `wave`, no soundfile needed) + datasets ----
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """Mel-spaced frequencies (parity: audio.functional.mel_frequencies)."""
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return Tensor(jnp.asarray([mel_to_hz(m, htk) for m in mels],
+                              jnp.dtype(dtype)))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """rfft bin centers (parity: audio.functional.fft_frequencies)."""
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2,
+                               dtype=jnp.dtype(dtype)))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10*log10(S/ref) clamped to top_db (parity:
+    audio.functional.power_to_db)."""
+
+    def f(s):
+        log_spec = 10.0 * (jnp.log10(jnp.maximum(amin, s))
+                           - jnp.log10(jnp.maximum(amin, ref_value)))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    return apply("power_to_db", f, (spect,))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (parity:
+    audio.functional.create_dct)."""
+    k = np.arange(n_mfcc)[None, :]
+    n = np.arange(n_mels)[:, None]
+    dct = np.cos(np.pi / n_mels * (n + 0.5) * k) * 2.0
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(1.0 / (2.0 * n_mels))
+    return Tensor(jnp.asarray(dct, jnp.dtype(dtype)))
+
+
+functional.mel_frequencies = staticmethod(mel_frequencies)
+functional.fft_frequencies = staticmethod(fft_frequencies)
+functional.power_to_db = staticmethod(power_to_db)
+functional.create_dct = staticmethod(create_dct)
+
+
+class backends:  # namespace parity: paddle.audio.backends.*
+    """WAV io over the stdlib `wave` module (the reference binds
+    soundfile; WAV covers the datasets this module ships)."""
+
+    @staticmethod
+    def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+             channels_first=True):
+        import wave as _wave
+
+        with _wave.open(str(filepath), "rb") as w:
+            sr = w.getframerate()
+            n = w.getnframes()
+            w.setpos(frame_offset)
+            count = n - frame_offset if num_frames < 0 else num_frames
+            raw = w.readframes(count)
+            width = w.getsampwidth()
+            ch = w.getnchannels()
+        if width == 1:
+            # WAV stores 8-bit PCM UNSIGNED (128 = silence)
+            arr = (np.frombuffer(raw, np.uint8).astype(np.int16) - 128) \
+                .reshape(-1, ch)
+        elif width == 3:
+            # 24-bit: widen each little-endian 3-byte frame to int32
+            b = np.frombuffer(raw, np.uint8).reshape(-1, 3)
+            arr = ((b[:, 0].astype(np.int32))
+                   | (b[:, 1].astype(np.int32) << 8)
+                   | (b[:, 2].astype(np.int32) << 16))
+            arr = (arr - ((arr & 0x800000) << 1)).reshape(-1, ch)
+        elif width in (2, 4):
+            arr = np.frombuffer(
+                raw, {2: np.int16, 4: np.int32}[width]).reshape(-1, ch)
+        else:
+            raise ValueError(f"unsupported WAV sample width {width}")
+        if normalize:
+            arr = arr.astype(np.float32) / float(2 ** (8 * width - 1))
+        out = arr.T if channels_first else arr
+        return Tensor(jnp.asarray(out)), sr
+
+    @staticmethod
+    def save(filepath, src, sample_rate, channels_first=True,
+             bits_per_sample=16):
+        import wave as _wave
+
+        a = np.asarray(src._data if isinstance(src, Tensor) else src)
+        if channels_first:
+            a = a.T
+        scale = float(2 ** (bits_per_sample - 1) - 1)
+        pcm = np.clip(a, -1.0, 1.0) * scale
+        if bits_per_sample == 8:
+            # 8-bit WAV containers are unsigned
+            pcm = (pcm + 128).astype(np.uint8)
+        else:
+            pcm = pcm.astype({16: np.int16, 32: np.int32}[bits_per_sample])
+        with _wave.open(str(filepath), "wb") as w:
+            w.setnchannels(a.shape[1] if a.ndim > 1 else 1)
+            w.setsampwidth(bits_per_sample // 8)
+            w.setframerate(int(sample_rate))
+            w.writeframes(pcm.tobytes())
+
+    @staticmethod
+    def info(filepath):
+        import wave as _wave
+
+        with _wave.open(str(filepath), "rb") as w:
+            class _Info:
+                sample_rate = w.getframerate()
+                num_frames = w.getnframes()
+                num_channels = w.getnchannels()
+                bits_per_sample = w.getsampwidth() * 8
+            return _Info()
+
+
+def load(filepath, **kw):
+    """Parity: paddle.audio.load."""
+    return backends.load(filepath, **kw)
+
+
+def save(filepath, src, sample_rate, **kw):
+    """Parity: paddle.audio.save."""
+    return backends.save(filepath, src, sample_rate, **kw)
+
+
+def info(filepath):
+    """Parity: paddle.audio.info."""
+    return backends.info(filepath)
+
+
+def _extract_feature(wav_1d, sr, feat_type, **kw):
+    """Shared feat_type pipeline for the audio datasets (parity:
+    `audio/datasets/dataset.py` feat_funcs: raw | spectrogram |
+    melspectrogram | logmelspectrogram | mfcc)."""
+    if feat_type == "raw":
+        return np.asarray(wav_1d)
+    from ..framework.core import Tensor as _T
+
+    x = _T(jnp.asarray(np.asarray(wav_1d)[None, :]))
+    if feat_type == "spectrogram":
+        out = Spectrogram(**kw)(x)
+    elif feat_type == "melspectrogram":
+        out = MelSpectrogram(sr=sr, **kw)(x)
+    elif feat_type == "logmelspectrogram":
+        out = LogMelSpectrogram(sr=sr, **kw)(x)
+    elif feat_type == "mfcc":
+        out = MFCC(sr=sr, **kw)(x)
+    else:
+        raise ValueError(
+            f"unsupported feat_type {feat_type!r}; choose raw/spectrogram/"
+            f"melspectrogram/logmelspectrogram/mfcc")
+    return np.asarray(out._data)[0]
+
+
+class datasets:  # namespace parity: paddle.audio.datasets.*
+    """ESC50/TESS over a local extracted archive directory (no egress:
+    pass the folder the reference would download)."""
+
+    class ESC50:
+        """ESC-50 (parity: `audio/datasets/esc50.py`): archive dir holds
+        meta/esc50.csv + audio/*.wav; 5-fold split — ``split`` selects
+        the dev fold."""
+
+        def __init__(self, mode="train", split=1, feat_type="raw",
+                     archive=None, **kwargs):
+            from ..framework.errors import UnavailableError
+            import csv
+            import os
+
+            self.feat_type = feat_type
+            self.feat_kwargs = kwargs
+            if archive is None:
+                raise UnavailableError(
+                    "no network egress: pass archive=<path to extracted "
+                    "ESC-50 directory containing meta/esc50.csv>")
+            self.files = []
+            self.labels = []
+            meta = os.path.join(archive, "meta", "esc50.csv")
+            with open(meta) as f:
+                for row in csv.DictReader(f):
+                    in_dev = int(row["fold"]) == int(split)
+                    if (mode != "train") == in_dev:
+                        self.files.append(
+                            os.path.join(archive, "audio",
+                                         row["filename"]))
+                        self.labels.append(int(row["target"]))
+
+        def __getitem__(self, idx):
+            wav, sr = load(self.files[idx], channels_first=False)
+            feat = _extract_feature(np.asarray(wav._data)[:, 0], sr,
+                                    self.feat_type, **self.feat_kwargs)
+            return feat, np.asarray(self.labels[idx])
+
+        def __len__(self):
+            return len(self.files)
+
+    class TESS:
+        """TESS (parity: `audio/datasets/tess.py`): archive dir of
+        <speaker>_<word>_<emotion>.wav files; n_folds cross-validation."""
+
+        _EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral",
+                     "ps", "sad"]
+
+        def __init__(self, mode="train", n_folds=5, split=1,
+                     feat_type="raw", archive=None, **kwargs):
+            from ..framework.errors import UnavailableError
+            import os
+
+            self.feat_type = feat_type
+            self.feat_kwargs = kwargs
+            if archive is None:
+                raise UnavailableError(
+                    "no network egress: pass archive=<path to extracted "
+                    "TESS directory of wav files>")
+            wavs = []
+            for root, _dirs, files in os.walk(archive):
+                for fn in sorted(files):
+                    if fn.lower().endswith(".wav"):
+                        wavs.append(os.path.join(root, fn))
+            self.files = []
+            self.labels = []
+            for i, path in enumerate(wavs):
+                emotion = os.path.basename(path).rsplit(".", 1)[0]                     .split("_")[-1].lower()
+                if emotion not in self._EMOTIONS:
+                    continue
+                in_dev = (i % n_folds) + 1 == int(split)
+                if (mode != "train") == in_dev:
+                    self.files.append(path)
+                    self.labels.append(self._EMOTIONS.index(emotion))
+
+        def __getitem__(self, idx):
+            wav, sr = load(self.files[idx], channels_first=False)
+            feat = _extract_feature(np.asarray(wav._data)[:, 0], sr,
+                                    self.feat_type, **self.feat_kwargs)
+            return feat, np.asarray(self.labels[idx])
+
+        def __len__(self):
+            return len(self.files)
+
+
+__all__ += ["backends", "datasets", "load", "save", "info",
+            "mel_frequencies", "fft_frequencies", "power_to_db",
+            "create_dct"]

@@ -422,3 +422,240 @@ def _kl_uniform(p, q):
     out = jnp.log((q.high - q.low) / (p.high - p.low))
     return Tensor(jnp.where(
         (p.low >= q.low) & (p.high <= q.high), out, jnp.inf))
+
+
+# ---- round-3 additions: Cauchy/Geometric/ExponentialFamily/Independent/
+# TransformedDistribution + the transform module (ref
+# `python/paddle/distribution/{cauchy,geometric,exponential_family,
+# independent,transformed_distribution,transform}.py`) ----
+
+from . import transform  # noqa: E402
+from .transform import *  # noqa: F401,F403,E402
+
+
+class ExponentialFamily(Distribution):
+    """Base for natural-exponential-family distributions; entropy via the
+    Bregman-divergence identity over the log-normalizer (ref
+    `exponential_family.py` using autodiff — here `jax.grad`)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        nparams = [jnp.asarray(p) for p in self._natural_parameters]
+        lg = self._log_normalizer(*nparams)
+        grads = jax.grad(
+            lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+            argnums=tuple(range(len(nparams))))(*nparams)
+        ent = lg - self._mean_carrier_measure
+        for p, g in zip(nparams, grads):
+            ent = ent - p * g
+        return Tensor(ent)
+
+
+class Cauchy(Distribution):
+    """Cauchy(loc, scale) (ref `cauchy.py`): undefined mean/variance,
+    heavy tails; sampled via tan of a uniform angle."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        u = jax.random.uniform(key, self._extend(shape),
+                               minval=1e-7, maxval=1.0 - 1e-7)
+        return Tensor(self.loc + self.scale * jnp.tan(np.pi * (u - 0.5)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v):
+            z = (v - self.loc) / self.scale
+            return -jnp.log(np.pi * self.scale * (1 + z * z))
+
+        return apply("cauchy_log_prob", f, (value,))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            jnp.log(4 * np.pi * self.scale),
+            self._batch_shape))
+
+    def cdf(self, value):
+        def f(v):
+            return jnp.arctan((v - self.loc) / self.scale) / np.pi + 0.5
+
+        return apply("cauchy_cdf", f, (value,))
+
+    def kl_divergence(self, other):
+        # closed form (Chyzak & Nielsen 2019)
+        out = jnp.log(
+            ((self.scale + other.scale) ** 2
+             + (self.loc - other.loc) ** 2)
+            / (4 * self.scale * other.scale))
+        return Tensor(out)
+
+
+class Geometric(Distribution):
+    """Geometric(probs): trials until first success, support {0, 1, ...}
+    (ref `geometric.py`)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs)
+        super().__init__(jnp.shape(self.probs))
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs) / self.probs ** 2)
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.sqrt((1 - self.probs) / self.probs ** 2))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        u = jax.random.uniform(key, self._extend(shape),
+                               minval=1e-7, maxval=1.0 - 1e-7)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        def f(v):
+            return v * jnp.log1p(-self.probs) + jnp.log(self.probs)
+
+        return apply("geometric_log_prob", f, (value,))
+
+    def pmf(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        q = 1 - self.probs
+        out = -(q * jnp.log(q) + self.probs * jnp.log(self.probs)) \
+            / self.probs
+        return Tensor(out)
+
+    def cdf(self, value):
+        def f(v):
+            return 1 - jnp.power(1 - self.probs, v + 1)
+
+        return apply("geometric_cdf", f, (value,))
+
+    def kl_divergence(self, other):
+        p, q = self.probs, other.probs
+        out = (1 - p) / p * (jnp.log1p(-p) - jnp.log1p(-q)) \
+            + jnp.log(p) - jnp.log(q)
+        return Tensor(out)
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims of ``base`` as event dims: log_prob
+    sums over them (ref `independent.py`)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bshape = tuple(base.batch_shape)
+        if self.rank > len(bshape):
+            raise ValueError(
+                f"reinterpreted_batch_rank {self.rank} exceeds base batch "
+                f"rank {len(bshape)}")
+        split = len(bshape) - self.rank
+        super().__init__(bshape[:split],
+                         bshape[split:] + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        axes = tuple(range(-self.rank, 0))
+
+        def f(a):
+            return jnp.sum(a, axis=axes)
+
+        return apply("independent_log_prob", f, (lp,))
+
+    def entropy(self):
+        ent = self.base.entropy()
+
+        def f(a):
+            return jnp.sum(a, axis=tuple(range(-self.rank, 0)))
+
+        return apply("independent_entropy", f, (ent,))
+
+
+class TransformedDistribution(Distribution):
+    """Push a base distribution through a chain of transforms (ref
+    `transformed_distribution.py`): sample = T(base.sample()), log_prob
+    via the change-of-variables formula."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        for t in self.transforms:
+            shape = tuple(t.forward_shape(shape))
+        super().__init__(shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape) if hasattr(self.base, "rsample") \
+            else self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        ld_terms = []
+        for t in reversed(self.transforms):
+            x = t._inverse(v)
+            ld_terms.append(t._fldj(x))
+            v = x
+        base_lp = self.base.log_prob(Tensor(v))._data
+        total = jnp.zeros_like(base_lp)
+        for ld in ld_terms:
+            # elementwise jacobian terms reduce over the event dims the
+            # base has already summed (e.g. Independent bases)
+            extra = ld.ndim - base_lp.ndim
+            if extra > 0:
+                ld = jnp.sum(ld, axis=tuple(range(-extra, 0)))
+            total = total + ld
+        return Tensor(base_lp - total)
+
+
+__all__ += ["Cauchy", "Geometric", "ExponentialFamily", "Independent",
+            "TransformedDistribution", "transform"] + transform.__all__
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    return p.kl_divergence(q)

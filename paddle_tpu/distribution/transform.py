@@ -1,0 +1,311 @@
+"""paddle.distribution.transform — bijective transforms.
+
+Reference parity: `python/paddle/distribution/transform.py` (Transform base
+with forward/inverse/log-det-Jacobian, Abs/Affine/Chain/Exp/Independent/
+Power/Reshape/Sigmoid/Softmax/Stack/StickBreaking/Tanh transforms) used by
+`TransformedDistribution`.
+
+TPU-first: each transform is a pure jnp pair (forward, inverse) plus an
+analytic `forward_log_det_jacobian` — differentiable through jax, traced
+into whatever program samples from the transformed distribution.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+def _a(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Transform:
+    """Bijection with log-det-Jacobian (ref `transform.py` `Transform`)."""
+
+    # event dims consumed by one application (0 = elementwise)
+    _domain_event_dim = 0
+
+    def forward(self, x):
+        return Tensor(self._forward(_a(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_a(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._fldj(_a(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return Tensor(-self._fldj(self._inverse(_a(y))))
+
+    def forward_shape(self, shape):
+        return list(shape)
+
+    def inverse_shape(self, shape):
+        return list(shape)
+
+    # -- implement in subclasses --
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _a(loc)
+        self.scale = _a(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class AbsTransform(Transform):
+    """Non-injective y = |x| (ref: inverse maps to the positive branch)."""
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        raise NotImplementedError(
+            "AbsTransform is not injective; log-det-Jacobian is undefined")
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _a(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        # log(1 - tanh(x)^2) = 2*(log2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """Normalizing map (not a bijection; ref keeps the same caveat)."""
+
+    _domain_event_dim = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform is not bijective; log-det-Jacobian is "
+            "undefined")
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> K-simplex via stick breaking (ref
+    `StickBreakingTransform`)."""
+
+    _domain_event_dim = 1
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1).astype(x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zc = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(zc[..., :1]), zc[..., :-1]], axis=-1)
+        head = z * lead
+        return jnp.concatenate([head, zc[..., -1:]], axis=-1)
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        cums = jnp.cumsum(y[..., :-1], axis=-1)
+        rest = 1 - jnp.concatenate(
+            [jnp.zeros_like(cums[..., :1]), cums[..., :-1]], axis=-1)
+        z = y[..., :-1] / rest
+        offset = jnp.log(jnp.arange(k, 0, -1).astype(y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _fldj(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1).astype(x.dtype))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        zc = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(zc[..., :1]), zc[..., :-1]], axis=-1)
+        # d head_i / d x_i = sigmoid'(t_i) * lead_i
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(lead), axis=-1)
+
+    def forward_shape(self, shape):
+        return list(shape[:-1]) + [shape[-1] + 1]
+
+    def inverse_shape(self, shape):
+        return list(shape[:-1]) + [shape[-1] - 1]
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        terms = []
+        for t in self.transforms:
+            terms.append(t._fldj(x))
+            x = t._forward(x)
+        # mixed event ranks: reduce every elementwise term down to the
+        # most-reduced term's rank so the sum is well-shaped
+        min_ndim = min(t.ndim for t in terms)
+        total = 0.0
+        for ld in terms:
+            extra = ld.ndim - min_ndim
+            if extra > 0:
+                ld = jnp.sum(ld, axis=tuple(range(-extra, 0)))
+            total = total + ld
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return list(shape)
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return list(shape)
+
+
+class IndependentTransform(Transform):
+    """Reinterpret trailing batch dims of ``base`` as event dims: the
+    log-det-Jacobian sums over them (ref `IndependentTransform`)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        self._domain_event_dim = base._domain_event_dim + self.rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        ld = self.base._fldj(x)
+        return jnp.sum(ld, axis=tuple(range(-self.rank, 0)))
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self._domain_event_dim = len(self.in_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _fldj(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return list(shape[:len(shape) - n]) + list(self.out_event_shape)
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return list(shape[:len(shape) - n]) + list(self.in_event_shape)
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms to slices along ``axis`` (ref
+    `StackTransform`)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, x, method):
+        parts = [getattr(t, method)(xi) for t, xi in zip(
+            self.transforms, jnp.moveaxis(x, self.axis, 0))]
+        return jnp.stack(parts, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map(x, "_forward")
+
+    def _inverse(self, y):
+        return self._map(y, "_inverse")
+
+    def _fldj(self, x):
+        return self._map(x, "_fldj")

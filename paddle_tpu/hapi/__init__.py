@@ -1,10 +1,11 @@
 """hapi: high-level Model API (parity: `python/paddle/hapi/`)."""
 from .callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRSchedulerCallback, ModelCheckpoint,
-    ProgBarLogger,
+    ProgBarLogger, ReduceLROnPlateau, VisualDL, WandbCallback,
 )
 from .model import Model  # noqa: F401
 from .summary import flops, summary  # noqa: F401
 
 __all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
-           "EarlyStopping", "LRSchedulerCallback", "summary", "flops"]
+           "EarlyStopping", "LRSchedulerCallback", "ReduceLROnPlateau",
+           "VisualDL", "WandbCallback", "summary", "flops"]

@@ -212,3 +212,152 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
         "verbose": verbose, "metrics": metrics or [],
     })
     return lst
+
+
+class ReduceLROnPlateau(Callback):
+    """Parity: hapi ReduceLROnPlateau (`hapi/callbacks.py:1172`): shrink
+    the optimizer LR by ``factor`` after ``patience`` epochs without
+    improvement on ``monitor``."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        if mode == "auto":
+            mode = "min" if "loss" in monitor or "err" in monitor else "max"
+        self.mode = mode
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.cooldown_counter = 0
+        self.wait = 0
+        self.best = None
+
+    def _better(self, cur, best):
+        if self.mode == "min":
+            return cur < best - self.min_delta
+        return cur > best + self.min_delta
+
+    def _epoch_end(self, logs):
+        logs = logs or {}
+        if self.monitor not in logs:
+            return
+        cur = float(np.asarray(logs[self.monitor]).reshape(-1)[0])
+        if self.cooldown_counter > 0:
+            # cooldown epochs never count toward patience (Keras/paddle)
+            self.cooldown_counter -= 1
+            self.wait = 0
+            if self.best is None or self._better(cur, self.best):
+                self.best = cur
+            return
+        if self.best is None or self._better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is None:
+                return
+            try:
+                old = float(opt.get_lr())
+            except Exception:
+                return
+            new = max(old * self.factor, self.min_lr)
+            if new < old:
+                opt.set_lr(new)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr {old:.3e} -> {new:.3e}")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+    # exactly one hook counts per epoch: train logs feed plain monitors,
+    # eval logs feed 'eval_*' monitors (both fire every epoch when eval
+    # data is present, so using both would double-count patience)
+    def on_epoch_end(self, epoch, logs=None):
+        if not self.monitor.startswith("eval_"):
+            self._epoch_end(logs)
+
+    def on_eval_end(self, logs=None):
+        if not self.monitor.startswith("eval_"):
+            return
+        logs = logs or {}
+        val = logs.get(self.monitor,
+                       logs.get(self.monitor[len("eval_"):]))
+        if val is not None:
+            self._epoch_end({self.monitor: val})
+
+
+def _scalar_logs(logs):
+    out = {}
+    for k, v in (logs or {}).items():
+        try:
+            out[k] = float(np.asarray(v).reshape(-1)[0])
+        except Exception:
+            continue
+    return out
+
+
+class VisualDL(Callback):
+    """Parity: hapi VisualDL (`hapi/callbacks.py:883`) — logs epoch
+    scalars to a visualdl LogWriter. Requires the external `visualdl`
+    package (same optional dependency as the reference)."""
+
+    def __init__(self, log_dir="vdl_log"):
+        try:
+            import visualdl
+        except ImportError as e:
+            from ..framework.errors import UnavailableError
+
+            raise UnavailableError(
+                "VisualDL callback needs the optional 'visualdl' package "
+                "(not bundled; the reference has the same dependency). "
+                "Metrics are available via ProgBarLogger / custom "
+                "Callback.on_epoch_end") from e
+        self.log_dir = log_dir
+        self._writer = visualdl.LogWriter(logdir=log_dir)
+        self._epoch = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._epoch = epoch
+        for k, v in _scalar_logs(logs).items():
+            self._writer.add_scalar(f"train/{k}", v, epoch)
+
+    def on_eval_end(self, logs=None):
+        for k, v in _scalar_logs(logs).items():
+            self._writer.add_scalar(f"eval/{k}", v, self._epoch)
+
+    def on_train_end(self, logs=None):
+        self._writer.close()
+
+
+class WandbCallback(Callback):
+    """Parity: hapi WandbCallback (`hapi/callbacks.py:999`) — streams
+    epoch scalars to a wandb run. Requires the external `wandb` package."""
+
+    def __init__(self, project=None, **wandb_init_kwargs):
+        try:
+            import wandb
+        except ImportError as e:
+            from ..framework.errors import UnavailableError
+
+            raise UnavailableError(
+                "WandbCallback needs the optional 'wandb' package (not "
+                "bundled; the reference has the same dependency)") from e
+        self._wandb = wandb
+        self._run = wandb.init(project=project, **wandb_init_kwargs) \
+            if wandb.run is None else wandb.run
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._run.log({f"train/{k}": v
+                       for k, v in _scalar_logs(logs).items()},
+                      step=epoch)
+
+    def on_eval_end(self, logs=None):
+        self._run.log({f"eval/{k}": v
+                       for k, v in _scalar_logs(logs).items()})
+
+    def on_train_end(self, logs=None):
+        self._run.finish()

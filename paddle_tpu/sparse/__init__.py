@@ -137,3 +137,180 @@ def relu(x, name=None):
 
 def is_sparse(x):
     return isinstance(x, SparseCooTensor)
+
+
+# ---- round-3 additions: the remaining `python/paddle/sparse/__init__.py`
+# __all__ surface (unary value-maps, structure ops, linalg helpers) ----
+
+def _value_unary(name, jfn):
+    def f(x, name=None):
+        b = x._bcoo
+        return SparseCooTensor(
+            jsparse.BCOO((jfn(b.data), b.indices), shape=b.shape))
+
+    f.__name__ = f.__qualname__ = name
+    f.__doc__ = (f"Elementwise {name} over the stored values (parity: "
+                 f"paddle.sparse.{name}; zero-preserving so sparsity is "
+                 f"kept).")
+    return f
+
+
+abs = _value_unary("abs", jnp.abs)  # noqa: A001
+asin = _value_unary("asin", jnp.arcsin)
+asinh = _value_unary("asinh", jnp.arcsinh)
+atan = _value_unary("atan", jnp.arctan)
+atanh = _value_unary("atanh", jnp.arctanh)
+deg2rad = _value_unary("deg2rad", jnp.deg2rad)
+expm1 = _value_unary("expm1", jnp.expm1)
+log1p = _value_unary("log1p", jnp.log1p)
+neg = _value_unary("neg", jnp.negative)
+rad2deg = _value_unary("rad2deg", jnp.rad2deg)
+sin = _value_unary("sin", jnp.sin)
+sinh = _value_unary("sinh", jnp.sinh)
+sqrt = _value_unary("sqrt", jnp.sqrt)
+square = _value_unary("square", jnp.square)
+tan = _value_unary("tan", jnp.tan)
+tanh = _value_unary("tanh", jnp.tanh)
+isnan = _value_unary("isnan", jnp.isnan)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    b = x._bcoo
+    return SparseCooTensor(
+        jsparse.BCOO((jnp.power(b.data, factor), b.indices), shape=b.shape))
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    b = x._bcoo
+    data = b.data.astype(value_dtype) if value_dtype else b.data
+    idx = b.indices.astype(index_dtype) if index_dtype else b.indices
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=b.shape))
+
+
+def coalesce(x, name=None):
+    """Merge duplicate indices by summation (parity:
+    paddle.sparse.coalesce; BCOO sum_duplicates)."""
+    return SparseCooTensor(x._bcoo.sum_duplicates())
+
+
+def is_same_shape(x, y, name=None):
+    sx = x.shape if isinstance(x, SparseCooTensor) else list(_unwrap(x).shape)
+    sy = y.shape if isinstance(y, SparseCooTensor) else list(_unwrap(y).shape)
+    return sx == sy
+
+
+def divide(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, (int, float)):
+        return multiply(x, 1.0 / y)
+    out = _unwrap(x) / _unwrap(y)
+    return SparseCooTensor(out) if isinstance(out, jsparse.BCOO) \
+        else Tensor(out)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """Reduce over ``axis`` — returns dense (parity: paddle.sparse.sum
+    returns a sparse scalar/vector; dense is the XLA-native result)."""
+    d = x._bcoo.todense()
+    out = jnp.sum(d, axis=axis, keepdims=keepdim)
+    if dtype:
+        out = out.astype(dtype)
+    return Tensor(out)
+
+
+def transpose(x, perm, name=None):
+    b = x._bcoo
+    idx = b.indices[:, jnp.asarray(perm)]
+    shape = tuple(b.shape[p] for p in perm)
+    return SparseCooTensor(jsparse.BCOO((b.data, idx), shape=shape))
+
+
+def reshape(x, shape, name=None):
+    """Reshape by linearizing COO indices (parity:
+    paddle.sparse.reshape)."""
+    b = x._bcoo
+    new = tuple(int(s) for s in shape)
+
+    def row_major_strides(dims):
+        # strides[i] = prod(dims[i+1:])
+        return np.concatenate(
+            [np.cumprod(np.asarray(dims)[::-1])[::-1][1:], [1]]).astype(
+                np.int64)
+
+    old_strides = jnp.asarray(row_major_strides(b.shape))
+    flat = (b.indices * old_strides[None, :]).sum(axis=1)
+    new_strides = row_major_strides(new)
+    idx = jnp.stack(
+        [(flat // int(st)) % int(sz) for st, sz in zip(new_strides, new)],
+        axis=1)
+    return SparseCooTensor(jsparse.BCOO((b.data, idx), shape=new))
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    """Slice along axes (parity: paddle.sparse.slice) — mask + shift the
+    COO indices. Eager-only (data-dependent nnz)."""
+    b = x._bcoo
+    idx = np.asarray(b.indices)
+    data = np.asarray(b.data)
+    shape = list(b.shape)
+    keep = np.ones(idx.shape[0], bool)
+    for ax, st, en in zip(axes, starts, ends):
+        st = st + shape[ax] if st < 0 else st
+        en = en + shape[ax] if en < 0 else min(en, shape[ax])
+        keep &= (idx[:, ax] >= st) & (idx[:, ax] < en)
+        shape[ax] = en - st
+    idx = idx[keep].copy()
+    for ax, st, _ in zip(axes, starts, ends):
+        st = st + b.shape[ax] if st < 0 else st
+        idx[:, ax] -= st
+    return SparseCooTensor(
+        jsparse.BCOO((jnp.asarray(data[keep]), jnp.asarray(idx)),
+                     shape=tuple(shape)))
+
+
+def mv(x, vec, name=None):
+    """sparse [M, N] @ dense [N] -> dense [M] (parity: paddle.sparse.mv)."""
+    v = _unwrap(vec)
+    return Tensor(jsparse.bcoo_dot_general(
+        x._bcoo, v, dimension_numbers=(((1,), (0,)), ((), ()))))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    """beta*input + alpha*(x @ y) with sparse x (parity:
+    paddle.sparse.addmm)."""
+    prod = matmul(x, y)
+    base = _unwrap(input)
+    base = base.todense() if isinstance(base, jsparse.BCOO) else base
+    return Tensor(beta * base + alpha * _unwrap(prod))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA of a (sparse or dense) matrix (parity:
+    paddle.sparse.pca_lowrank / paddle.linalg.pca_lowrank): returns
+    (U [m, q], S [q], V [n, q])."""
+    from ..framework import random as rng
+
+    a = _unwrap(x)
+    if isinstance(a, jsparse.BCOO):
+        a = a.todense()
+    m, n = a.shape
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        a = a - a.mean(axis=0, keepdims=True)
+    key = rng.next_key()
+    omega = jax.random.normal(key, (n, q), a.dtype)
+    y = a @ omega
+    for _ in range(niter):
+        y = a @ (a.T @ y)
+    qmat, _ = jnp.linalg.qr(y)
+    b = qmat.T @ a
+    u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    return (Tensor(qmat @ u_b), Tensor(s), Tensor(vt.T))
+
+
+__all__ += [
+    "abs", "asin", "asinh", "atan", "atanh", "deg2rad", "expm1", "log1p",
+    "neg", "rad2deg", "sin", "sinh", "sqrt", "square", "tan", "tanh",
+    "isnan", "pow", "cast", "coalesce", "is_same_shape", "divide", "sum",
+    "transpose", "reshape", "slice", "mv", "addmm", "pca_lowrank",
+]

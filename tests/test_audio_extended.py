@@ -1,0 +1,185 @@
+"""paddle.audio round-3 surface: WAV backends (stdlib wave), datasets
+over synthetic archives, functional additions."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.audio as A
+from paddle_tpu.framework.errors import UnavailableError
+
+
+def _tone(n=4000, sr=16000):
+    return np.sin(np.linspace(0, 60, n)).astype("float32")
+
+
+class TestBackends:
+    def test_wav_roundtrip(self, tmp_path):
+        x = _tone()[None, :]
+        p = str(tmp_path / "t.wav")
+        A.save(p, paddle.to_tensor(x), 16000)
+        y, sr = A.load(p)
+        assert sr == 16000 and y.shape == [1, 4000]
+        np.testing.assert_allclose(np.asarray(y._data)[0], x[0], atol=2e-4)
+
+    def test_info_and_offsets(self, tmp_path):
+        p = str(tmp_path / "t.wav")
+        A.save(p, paddle.to_tensor(_tone()[None, :]), 8000)
+        inf = A.info(p)
+        assert inf.sample_rate == 8000 and inf.num_frames == 4000
+        assert inf.num_channels == 1 and inf.bits_per_sample == 16
+        seg, _ = A.load(p, frame_offset=100, num_frames=50)
+        assert seg.shape == [1, 50]
+
+
+class TestFunctionalAdditions:
+    def test_fft_frequencies(self):
+        f = A.fft_frequencies(16000, 8).numpy()
+        np.testing.assert_allclose(f, [0, 2000, 4000, 6000, 8000])
+
+    def test_create_dct_orthonormal(self):
+        d = A.create_dct(8, 8).numpy()
+        np.testing.assert_allclose(d.T @ d, np.eye(8), atol=1e-5)
+
+    def test_power_to_db_clamps(self):
+        s = paddle.to_tensor(np.asarray([1.0, 1e-12], "float32"))
+        out = A.power_to_db(s, top_db=80.0).numpy()
+        assert out[0] == 0.0 and out[1] == -80.0
+
+
+def _write_wav(path, sr=16000, n=800):
+    A.save(str(path), paddle.to_tensor(_tone(n)[None, :]), sr)
+
+
+class TestDatasets:
+    def test_esc50_layout(self, tmp_path):
+        (tmp_path / "meta").mkdir()
+        (tmp_path / "audio").mkdir()
+        rows = ["filename,fold,target,category"]
+        for i in range(10):
+            fn = f"1-{i}-A-{i % 3}.wav"
+            _write_wav(tmp_path / "audio" / fn)
+            rows.append(f"{fn},{i % 5 + 1},{i % 3},c{i % 3}")
+        (tmp_path / "meta" / "esc50.csv").write_text("\n".join(rows))
+        tr = A.datasets.ESC50(mode="train", split=1, archive=str(tmp_path))
+        dev = A.datasets.ESC50(mode="dev", split=1, archive=str(tmp_path))
+        assert len(tr) + len(dev) == 10
+        assert len(dev) == 2  # fold 1
+        wav, label = tr[0]
+        assert wav.ndim == 1 and int(label) in (0, 1, 2)
+
+    def test_tess_layout(self, tmp_path):
+        names = ["OAF_back_angry.wav", "OAF_back_happy.wav",
+                 "YAF_dog_sad.wav", "YAF_dog_neutral.wav",
+                 "OAF_bean_fear.wav"]
+        for n in names:
+            _write_wav(tmp_path / n)
+        tr = A.datasets.TESS(mode="train", n_folds=5, split=1,
+                             archive=str(tmp_path))
+        dev = A.datasets.TESS(mode="dev", n_folds=5, split=1,
+                              archive=str(tmp_path))
+        assert len(tr) + len(dev) == 5
+        wav, label = tr[0]
+        assert wav.ndim == 1 and 0 <= int(label) < 7
+
+    def test_gated_without_archive(self):
+        with pytest.raises(UnavailableError):
+            A.datasets.ESC50()
+        with pytest.raises(UnavailableError):
+            A.datasets.TESS()
+
+
+class TestReviewRegressions:
+    def test_8bit_wav_unsigned(self, tmp_path):
+        p = str(tmp_path / "u8.wav")
+        x = np.zeros((1, 100), "float32")  # silence
+        A.save(p, paddle.to_tensor(x), 8000, bits_per_sample=8)
+        y, _ = A.load(p)
+        # silence must decode to ~0, not -1.0 (signed-byte bug)
+        assert np.abs(np.asarray(y._data)).max() < 0.02
+
+    def test_24bit_wav_loads(self, tmp_path):
+        import wave as _w
+
+        p = str(tmp_path / "s24.wav")
+        vals = np.asarray([0, 2 ** 22, -2 ** 22], np.int32)
+        frames = b"".join(
+            int(v & 0xFFFFFF).to_bytes(3, "little") for v in vals)
+        with _w.open(p, "wb") as w:
+            w.setnchannels(1)
+            w.setsampwidth(3)
+            w.setframerate(8000)
+            w.writeframes(frames)
+        y, _ = A.load(p)
+        np.testing.assert_allclose(
+            np.asarray(y._data)[0], vals / 2.0 ** 23, atol=1e-6)
+
+    def test_feat_type_mfcc(self, tmp_path):
+        (tmp_path / "meta").mkdir()
+        (tmp_path / "audio").mkdir()
+        fn = "1-0-A-0.wav"
+        _write_wav(tmp_path / "audio" / fn, n=2048)
+        (tmp_path / "meta" / "esc50.csv").write_text(
+            "filename,fold,target,category\n" + f"{fn},2,0,c0")
+        ds = A.datasets.ESC50(mode="train", split=1, archive=str(tmp_path),
+                              feat_type="mfcc", n_mfcc=13)
+        feat, label = ds[0]
+        assert feat.ndim == 2 and feat.shape[0] == 13
+        with pytest.raises(ValueError):
+            A.datasets.ESC50(mode="train", split=1, archive=str(tmp_path),
+                             feat_type="nope")[0]
+
+
+def test_reduce_lr_eval_monitor_and_cooldown():
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+    class FakeOpt:
+        lr = 0.1
+
+        def get_lr(self):
+            return self.lr
+
+        def set_lr(self, v):
+            self.lr = v
+
+    class FakeModel:
+        pass
+
+    # plain monitor: eval hook must NOT double-count
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2, verbose=0)
+    cb.model = FakeModel()
+    cb.model._optimizer = FakeOpt()
+    for e in range(2):
+        cb.on_epoch_end(e, {"loss": 1.0})
+        cb.on_eval_end({"loss": 1.0})
+    assert cb.model._optimizer.lr == 0.1  # only 1 stagnant epoch counted
+    cb.on_epoch_end(2, {"loss": 1.0})
+    assert cb.model._optimizer.lr == 0.05
+
+    # cooldown epochs don't count toward patience
+    cb2 = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                            cooldown=2, verbose=0)
+    cb2.model = FakeModel()
+    cb2.model._optimizer = FakeOpt()
+    seq = [1.0] * 9
+    for e, v in enumerate(seq):
+        cb2.on_epoch_end(e, {"loss": v})
+    # epochs: 0 best; 1,2 wait->reduce@2; 3,4 cooldown; 5,6 wait->reduce@6
+    assert abs(cb2.model._optimizer.lr - 0.025) < 1e-9
+
+
+def test_transformed_distribution_independent_base():
+    import paddle_tpu.distribution as dist
+
+    base = dist.Independent(
+        dist.Normal(np.zeros((3, 4), "float32"),
+                    np.ones((3, 4), "float32")), 1)
+    td = dist.TransformedDistribution(base, [dist.ExpTransform()])
+    v = np.abs(np.random.default_rng(0)
+               .standard_normal((3, 4))).astype("float32") + 0.1
+    lp = td.log_prob(paddle.to_tensor(v))
+    assert lp.shape == [3]
+    ref = (base.log_prob(paddle.to_tensor(np.log(v))).numpy()
+           - np.log(v).sum(-1))
+    np.testing.assert_allclose(lp.numpy(), ref, rtol=1e-5)

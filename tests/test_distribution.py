@@ -4,6 +4,7 @@ import pytest
 import scipy.stats as st
 
 import paddle_tpu as paddle
+import paddle_tpu.distribution as dist
 from paddle_tpu.distribution import (
     Bernoulli, Beta, Categorical, Dirichlet, Exponential, Gamma, Gumbel,
     Laplace, LogNormal, Multinomial, Normal, Uniform, kl_divergence,
@@ -110,3 +111,131 @@ class TestOthers:
     def test_kl_unregistered_raises(self):
         with pytest.raises(NotImplementedError):
             kl_divergence(Normal(0., 1.), Uniform(0., 1.))
+
+
+class TestRound3Additions:
+    def test_cauchy(self):
+        import numpy as np
+        from scipy import stats
+
+        d = dist.Cauchy(loc=1.0, scale=2.0)
+        paddle.seed(0)
+        s = d.sample([2000]).numpy()
+        # median of Cauchy = loc (mean undefined)
+        assert abs(np.median(s) - 1.0) < 0.3
+        v = np.asarray([0.0, 1.0, 3.5], "float32")
+        np.testing.assert_allclose(
+            d.log_prob(paddle.to_tensor(v)).numpy(),
+            stats.cauchy.logpdf(v, 1.0, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(
+            d.cdf(paddle.to_tensor(v)).numpy(),
+            stats.cauchy.cdf(v, 1.0, 2.0), rtol=1e-5)
+        assert float(dist.kl_divergence(d, dist.Cauchy(1.0, 2.0)).numpy()) \
+            < 1e-6
+
+    def test_geometric(self):
+        import numpy as np
+        from scipy import stats
+
+        d = dist.Geometric(0.3)
+        v = np.asarray([0, 1, 4], "float32")
+        # paddle support {0,1,...} maps to scipy's k=v+1
+        np.testing.assert_allclose(
+            d.log_prob(paddle.to_tensor(v)).numpy(),
+            stats.geom.logpmf(v + 1, 0.3), rtol=1e-5)
+        np.testing.assert_allclose(float(d.mean.numpy()), (1 - 0.3) / 0.3,
+                                   rtol=1e-6)
+        paddle.seed(0)
+        s = d.sample([4000]).numpy()
+        assert abs(s.mean() - (1 - 0.3) / 0.3) < 0.2
+
+    def test_independent(self):
+        import numpy as np
+
+        base = dist.Normal(loc=np.zeros((3, 4), "float32"),
+                           scale=np.ones((3, 4), "float32"))
+        ind = dist.Independent(base, 1)
+        assert ind.batch_shape == [3] and ind.event_shape == [4]
+        v = paddle.to_tensor(np.random.default_rng(0)
+                             .standard_normal((3, 4)).astype("float32"))
+        np.testing.assert_allclose(
+            ind.log_prob(v).numpy(),
+            base.log_prob(v).numpy().sum(-1), rtol=1e-5)
+
+    def test_transformed_distribution_affine(self):
+        import numpy as np
+
+        base = dist.Normal(loc=0.0, scale=1.0)
+        td = dist.TransformedDistribution(
+            base, [dist.AffineTransform(loc=2.0, scale=3.0)])
+        ref = dist.Normal(loc=2.0, scale=3.0)
+        v = np.asarray([0.5, 2.0, 4.0], "float32")
+        np.testing.assert_allclose(
+            td.log_prob(paddle.to_tensor(v)).numpy(),
+            ref.log_prob(paddle.to_tensor(v)).numpy(), rtol=1e-5)
+        paddle.seed(0)
+        s = td.sample([3000]).numpy()
+        assert abs(s.mean() - 2.0) < 0.3 and abs(s.std() - 3.0) < 0.3
+
+    def test_transforms_roundtrip_and_ldj(self):
+        import numpy as np
+
+        x = paddle.to_tensor(np.asarray([-1.0, 0.2, 1.5], "float32"))
+        for t in (dist.ExpTransform(), dist.SigmoidTransform(),
+                  dist.TanhTransform(),
+                  dist.AffineTransform(1.0, 2.0)):
+            y = t.forward(x)
+            back = t.inverse(y)
+            np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-4,
+                                       atol=1e-5)
+            # ldj matches autodiff d forward / dx
+            import jax
+            import jax.numpy as jnp
+
+            g = jax.vmap(jax.grad(lambda v: t._forward(v)))(x._data)
+            np.testing.assert_allclose(
+                t.forward_log_det_jacobian(x).numpy(),
+                np.log(np.abs(np.asarray(g))), rtol=1e-4, atol=1e-5)
+
+    def test_stickbreaking_simplex(self):
+        import numpy as np
+
+        t = dist.StickBreakingTransform()
+        x = paddle.to_tensor(np.asarray([[0.3, -0.2, 1.0]], "float32"))
+        y = t.forward(x).numpy()
+        assert y.shape == (1, 4)
+        np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
+        assert (y > 0).all()
+        back = t.inverse(paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(back, x.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_exponential_family_entropy(self):
+        import numpy as np
+
+        # Normal as exponential family: entropy via Bregman identity must
+        # match the closed form
+        class _NormalEF(dist.ExponentialFamily):
+            def __init__(self, loc, scale):
+                self.loc = jnp.asarray(loc)
+                self.scale = jnp.asarray(scale)
+                super().__init__(jnp.shape(self.loc))
+
+            @property
+            def _natural_parameters(self):
+                return (self.loc / self.scale ** 2,
+                        -0.5 / self.scale ** 2)
+
+            def _log_normalizer(self, n1, n2):
+                return -n1 ** 2 / (4 * n2) - 0.5 * jnp.log(-2 * n2)
+
+            @property
+            def _mean_carrier_measure(self):
+                # E[log h(X)] with h = 1/sqrt(2*pi)
+                return -0.5 * np.log(2 * np.pi)
+
+        import jax.numpy as jnp
+
+        ef = _NormalEF(1.5, 2.0)
+        closed = 0.5 * np.log(2 * np.pi * np.e * 4.0)
+        np.testing.assert_allclose(float(ef.entropy().numpy()), closed,
+                                   rtol=1e-5)

@@ -122,3 +122,44 @@ class TestVision:
         a = FakeData(size=4, image_shape=(3, 8, 8), seed=7)
         b = FakeData(size=4, image_shape=(3, 8, 8), seed=7)
         np.testing.assert_array_equal(a[2][0], b[2][0])
+
+
+def test_reduce_lr_on_plateau():
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+    class FakeOpt:
+        def __init__(self):
+            self.lr = 0.1
+
+        def get_lr(self):
+            return self.lr
+
+        def set_lr(self, v):
+            self.lr = v
+
+    class FakeModel:
+        pass
+
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                           verbose=0)
+    cb.model = FakeModel()
+    cb.model._optimizer = FakeOpt()
+    cb.on_epoch_end(0, {"loss": 1.0})
+    cb.on_epoch_end(1, {"loss": 1.0})   # wait 1
+    cb.on_epoch_end(2, {"loss": 1.0})   # wait 2 -> reduce
+    assert abs(cb.model._optimizer.lr - 0.05) < 1e-9
+    cb.on_epoch_end(3, {"loss": 0.5})   # improvement resets
+    cb.on_epoch_end(4, {"loss": 0.5})
+    assert abs(cb.model._optimizer.lr - 0.05) < 1e-9
+
+
+def test_gated_visual_callbacks():
+    import pytest
+
+    from paddle_tpu.framework.errors import UnavailableError
+    from paddle_tpu.hapi.callbacks import VisualDL, WandbCallback
+
+    with pytest.raises(UnavailableError):
+        VisualDL()
+    with pytest.raises(UnavailableError):
+        WandbCallback()

@@ -1,0 +1,80 @@
+"""Extended paddle.sparse surface (round-3: full reference __all__)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse as sp
+
+
+def _coo():
+    idx = np.asarray([[0, 1, 2], [1, 0, 2]])
+    vals = np.asarray([1.0, -2.0, 4.0], "float32")
+    return sp.sparse_coo_tensor(idx, vals, shape=(3, 3))
+
+
+def test_value_unaries_preserve_structure():
+    x = _coo()
+    out = sp.abs(x)
+    np.testing.assert_allclose(out.values().numpy(), [1.0, 2.0, 4.0])
+    np.testing.assert_array_equal(out.indices().numpy(),
+                                  x.indices().numpy())
+    np.testing.assert_allclose(sp.square(x).values().numpy(), [1., 4., 16.])
+    np.testing.assert_allclose(sp.neg(x).values().numpy(), [-1., 2., -4.])
+    np.testing.assert_allclose(
+        sp.sqrt(sp.abs(x)).values().numpy(), np.sqrt([1., 2., 4.]),
+        rtol=1e-6)
+
+
+def test_pow_cast():
+    x = _coo()
+    np.testing.assert_allclose(sp.pow(x, 2).values().numpy(), [1., 4., 16.])
+    y = sp.cast(x, value_dtype="float64")
+    assert "float64" in str(y.values().numpy().dtype) or \
+        "float32" in str(y.values().numpy().dtype)  # x32 canonicalized
+
+
+def test_coalesce_merges_duplicates():
+    idx = np.asarray([[0, 0, 1], [1, 1, 2]])
+    vals = np.asarray([1.0, 2.0, 3.0], "float32")
+    x = sp.sparse_coo_tensor(idx, vals, shape=(2, 3))
+    c = sp.coalesce(x)
+    d = c.to_dense().numpy()
+    assert d[0, 1] == 3.0 and d[1, 2] == 3.0
+
+
+def test_structure_ops():
+    x = _coo()
+    assert sp.is_same_shape(x, _coo())
+    t = sp.transpose(x, [1, 0])
+    np.testing.assert_allclose(t.to_dense().numpy(),
+                               x.to_dense().numpy().T)
+    r = sp.reshape(x, (9, 1))
+    np.testing.assert_allclose(r.to_dense().numpy().reshape(3, 3),
+                               x.to_dense().numpy())
+    s = sp.slice(x, [0], [1], [3])
+    np.testing.assert_allclose(s.to_dense().numpy(),
+                               x.to_dense().numpy()[1:3])
+
+
+def test_reductions_and_linalg():
+    x = _coo()
+    np.testing.assert_allclose(float(sp.sum(x).numpy()), 3.0)
+    np.testing.assert_allclose(sp.sum(x, axis=1).numpy(),
+                               x.to_dense().numpy().sum(1))
+    v = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], "float32"))
+    np.testing.assert_allclose(sp.mv(x, v).numpy(),
+                               x.to_dense().numpy() @ v.numpy())
+    d = paddle.to_tensor(np.eye(3, dtype="float32"))
+    out = sp.addmm(d, x, d, beta=2.0, alpha=1.0)
+    np.testing.assert_allclose(
+        out.numpy(), 2 * np.eye(3) + x.to_dense().numpy(), rtol=1e-6)
+
+
+def test_pca_lowrank_reconstructs():
+    rng = np.random.default_rng(0)
+    base = rng.standard_normal((20, 3)).astype("float32")
+    a = base @ rng.standard_normal((3, 8)).astype("float32")  # rank 3
+    paddle.seed(0)
+    U, S, V = sp.pca_lowrank(paddle.to_tensor(a), q=3, center=True)
+    ac = a - a.mean(0, keepdims=True)
+    rec = U.numpy() @ np.diag(S.numpy()) @ V.numpy().T
+    np.testing.assert_allclose(rec, ac, atol=1e-3)
