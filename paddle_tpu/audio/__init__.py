@@ -44,8 +44,9 @@ def hz_to_mel(freq, htk=False):
     min_log_hz = 1000.0
     min_log_mel = (min_log_hz - f_min) / f_sp
     logstep = math.log(6.4) / 27.0
-    return np.where(f >= min_log_hz,
-                    min_log_mel + np.log(f / min_log_hz) / logstep, mels)
+    with np.errstate(divide="ignore"):  # f=0 falls to the linear branch
+        return np.where(f >= min_log_hz,
+                        min_log_mel + np.log(f / min_log_hz) / logstep, mels)
 
 
 def mel_to_hz(mel, htk=False):
@@ -208,8 +209,7 @@ def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
     lo = hz_to_mel(f_min, htk)
     hi = hz_to_mel(f_max, htk)
     mels = np.linspace(lo, hi, n_mels)
-    return Tensor(jnp.asarray([mel_to_hz(m, htk) for m in mels],
-                              jnp.dtype(dtype)))
+    return Tensor(jnp.asarray(mel_to_hz(mels, htk), jnp.dtype(dtype)))
 
 
 def fft_frequencies(sr, n_fft, dtype="float32"):
@@ -301,8 +301,17 @@ class backends:  # namespace parity: paddle.audio.backends.*
         if bits_per_sample == 8:
             # 8-bit WAV containers are unsigned
             pcm = (pcm + 128).astype(np.uint8)
-        else:
+        elif bits_per_sample == 24:
+            # 3-byte frames: little-endian int32 with the top byte dropped
+            i32 = np.ascontiguousarray(pcm.astype(np.int32))
+            pcm = np.ascontiguousarray(
+                i32.view(np.uint8).reshape(-1, 4)[:, :3])
+        elif bits_per_sample in (16, 32):
             pcm = pcm.astype({16: np.int16, 32: np.int32}[bits_per_sample])
+        else:
+            raise ValueError(
+                f"unsupported WAV bits_per_sample: {bits_per_sample} "
+                "(expected 8, 16, 24 or 32)")
         with _wave.open(str(filepath), "wb") as w:
             w.setnchannels(a.shape[1] if a.ndim > 1 else 1)
             w.setsampwidth(bits_per_sample // 8)
@@ -429,7 +438,8 @@ class datasets:  # namespace parity: paddle.audio.datasets.*
             self.files = []
             self.labels = []
             for i, path in enumerate(wavs):
-                emotion = os.path.basename(path).rsplit(".", 1)[0]                     .split("_")[-1].lower()
+                emotion = (os.path.basename(path).rsplit(".", 1)[0]
+                           .split("_")[-1].lower())
                 if emotion not in self._EMOTIONS:
                     continue
                 in_dev = (i % n_folds) + 1 == int(split)

@@ -72,7 +72,7 @@ class ElasticManager:
         for r in range(self.np):
             try:
                 ts = float(self.store.get(f"{self.job_id}/nodes/{r}"))
-            except KeyError:
+            except (KeyError, ValueError):
                 continue
             if now - ts <= self.timeout:
                 alive.append(r)

@@ -45,6 +45,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from .... import env as env_mod
 from .....autograd.tape import no_grad
+from .....framework import random as rng
 from .....framework.core import EagerParamBase, Tensor
 from .....nn.layer.layers import Layer
 from .....ops.dispatch import apply
@@ -347,47 +348,60 @@ class PipelineLayer(Layer):
         chunks, enters, exits = self._make_schedule(n_micro, pp, v)
         sched = (jnp.asarray(chunks, jnp.int32),
                  jnp.asarray(enters, jnp.int32),
-                 jnp.asarray(exits, jnp.int32))
+                 jnp.asarray(exits, jnp.int32),
+                 jnp.arange(len(chunks), dtype=jnp.int32))
 
-        def kernel(xa, *stacked):
+        def kernel(xa, key_data, *stacked):
             B = xa.shape[0]
             if B % n_micro:
                 raise ValueError(
                     f"batch {B} not divisible into {n_micro} microbatches")
             mb = B // n_micro
             xs = xa.reshape(n_micro, mb, *xa.shape[1:])
+            base_key = jax.random.wrap_key_data(key_data)
             # [n_blocks, ...] -> [pp, v, bpc, ...] (storage order is
             # (device, chunk, intra) — see __init__); dim0 stays 'pp'-sharded
             staged = [s.reshape(pp, v, bpc, *s.shape[1:]) for s in stacked]
 
-            def stage_fn(params_stage, chunk_idx, state):
+            def stage_fn(params_stage, chunk_idx, state, stage_key):
                 chunk = [
                     jax.lax.dynamic_index_in_dim(p, chunk_idx, 0,
                                                  keepdims=False)
                     for p in params_stage
                 ]
+                block_keys = jax.random.split(
+                    jax.random.fold_in(stage_key, chunk_idx), bpc)
 
-                def body(carry, params_i):
+                def body(carry, inp):
+                    params_i, k = inp
                     fn = block_apply
                     if remat:
                         fn = jax.checkpoint(fn)
-                    return fn(list(params_i), carry), None
+                    # block dropout etc. draws from the per-block key so
+                    # masks are independent across blocks/stages/ticks and
+                    # reproducible under remat
+                    with rng.rng_scope(k):
+                        out = fn(list(params_i), carry)
+                    return out, None
 
-                out, _ = jax.lax.scan(body, state, tuple(chunk))
+                out, _ = jax.lax.scan(body, state,
+                                      (tuple(chunk), block_keys))
                 return out
 
-            vstage = jax.vmap(stage_fn, in_axes=(0, 0, 0))
+            vstage = jax.vmap(stage_fn, in_axes=(0, 0, 0, 0))
 
             def tick(carry, sch):
                 states, outputs = carry
-                chunk_idx, enter_id, exit_id = sch
+                chunk_idx, enter_id, exit_id, t = sch
+                stage_keys = jax.random.split(
+                    jax.random.fold_in(base_key, t), pp)
                 x_in = jax.lax.dynamic_index_in_dim(
                     xs, jnp.maximum(enter_id, 0), 0, keepdims=False)
                 states = states.at[0].set(
                     jnp.where(enter_id >= 0, x_in, states[0]))
                 states = jax.lax.with_sharding_constraint(
                     states, stage_sharding)
-                states = vstage(staged, chunk_idx, states)
+                states = vstage(staged, chunk_idx, states, stage_keys)
                 oi = jnp.maximum(exit_id, 0)
                 cur = jax.lax.dynamic_index_in_dim(
                     outputs, oi, 0, keepdims=False)
@@ -405,7 +419,9 @@ class PipelineLayer(Layer):
                 body, (states, outputs), sched)
             return outputs.reshape(B, *outputs.shape[2:])
 
-        return apply("pipeline", kernel, (x, *self._stacked))
+        key_data = Tensor(
+            jax.random.key_data(rng.next_key()), stop_gradient=True)
+        return apply("pipeline", kernel, (x, key_data, *self._stacked))
 
     def _default_schedule_1f1b(self):
         from ... import get_strategy

@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
+import time
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -65,6 +67,9 @@ class TCPStore:
         self._lib = lib
         self._server = None
         self._timeout_ms = int(timeout * 1000)
+        # One socket per client: request/response frames must not interleave
+        # when several threads (e.g. an elastic heartbeat) share the store.
+        self._lock = threading.Lock()
         if is_master:
             self._server = lib.tcp_store_server_start(port)
             if not self._server:
@@ -81,15 +86,17 @@ class TCPStore:
     # -- KV API (paddle/torch-shaped) --
     def set(self, key, value):
         data = value if isinstance(value, bytes) else str(value).encode()
-        rc = self._lib.tcp_store_set(self._client, key.encode(), data,
-                                     len(data))
+        with self._lock:
+            rc = self._lib.tcp_store_set(self._client, key.encode(), data,
+                                         len(data))
         if rc < 0:
             raise RuntimeError("TCPStore.set failed")
 
     def get(self, key):
         buf = ctypes.create_string_buffer(1 << 20)
-        n = self._lib.tcp_store_get(self._client, key.encode(), buf,
-                                    len(buf))
+        with self._lock:
+            n = self._lib.tcp_store_get(self._client, key.encode(), buf,
+                                        len(buf))
         if n == -1:
             raise KeyError(key)
         if n < 0:
@@ -97,7 +104,8 @@ class TCPStore:
         return buf.raw[:n]
 
     def add(self, key, amount=1):
-        res = self._lib.tcp_store_add(self._client, key.encode(), amount)
+        with self._lock:
+            res = self._lib.tcp_store_add(self._client, key.encode(), amount)
         if res < 0 and amount >= 0:
             raise RuntimeError("TCPStore.add failed")
         return int(res)
@@ -108,15 +116,25 @@ class TCPStore:
         to = int((timeout or self._timeout_ms / 1000) * 1000)
         buf = ctypes.create_string_buffer(1 << 20)
         for k in keys:
-            n = self._lib.tcp_store_wait(self._client, k.encode(), to, buf,
-                                         len(buf))
-            if n == -1:
-                raise TimeoutError(f"TCPStore.wait timed out on {k!r}")
-            if n < -1:
-                raise RuntimeError("TCPStore.wait failed")
+            # poll in short slices so the lock is released between probes —
+            # a blocking hold would starve other threads (e.g. the elastic
+            # heartbeat) for the whole wait timeout
+            deadline = time.monotonic() + to / 1000.0
+            while True:
+                with self._lock:
+                    n = self._lib.tcp_store_wait(self._client, k.encode(),
+                                                 100, buf, len(buf))
+                if n >= 0:
+                    break
+                if n < -1:
+                    raise RuntimeError("TCPStore.wait failed")
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(f"TCPStore.wait timed out on {k!r}")
 
     def delete_key(self, key):
-        return self._lib.tcp_store_delete(self._client, key.encode()) >= 0
+        with self._lock:
+            return self._lib.tcp_store_delete(self._client,
+                                              key.encode()) >= 0
 
     def _shutdown_server(self):
         if self._server:

@@ -606,6 +606,9 @@ class TransformedDistribution(Distribution):
     via the change-of-variables formula."""
 
     def __init__(self, base, transforms):
+        from .transform import chain_codomain_event_dim, \
+            chain_domain_event_dim
+
         self.base = base
         if isinstance(transforms, Transform):
             transforms = [transforms]
@@ -613,7 +616,14 @@ class TransformedDistribution(Distribution):
         shape = tuple(base.batch_shape) + tuple(base.event_shape)
         for t in self.transforms:
             shape = tuple(t.forward_shape(shape))
-        super().__init__(shape)
+        # output event rank (torch TransformedDistribution): the chain's
+        # codomain event rank, plus base event dims the chain left alone
+        base_ev = len(base.event_shape)
+        dom = chain_domain_event_dim(self.transforms)
+        out_ev = chain_codomain_event_dim(self.transforms) \
+            + max(base_ev - dom, 0)
+        super().__init__(shape[:len(shape) - out_ev],
+                         shape[len(shape) - out_ev:])
 
     def sample(self, shape=()):
         x = self.base.sample(shape)
@@ -629,22 +639,28 @@ class TransformedDistribution(Distribution):
         return x
 
     def log_prob(self, value):
+        from .transform import _sum_rightmost
+
         v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
-        ld_terms = []
+        # Event-rank bookkeeping (torch TransformedDistribution.log_prob):
+        # walking back to the base, `event_dim` tracks how many trailing
+        # dims of the running value are event dims of the density. Each
+        # fldj has already reduced its transform's own domain event dims;
+        # what remains above that — and any base log-prob event dims the
+        # base emitted elementwise — is summed. Batch dims are never
+        # touched, so broadcasting a low-rank value keeps the batch shape.
+        event_dim = len(self.event_shape)
+        total = 0.0
         for t in reversed(self.transforms):
             x = t._inverse(v)
-            ld_terms.append(t._fldj(x))
+            event_dim += t._domain_event_dim - t._codomain_event_dim
+            total = total + _sum_rightmost(
+                t._fldj(x), event_dim - t._domain_event_dim)
             v = x
         base_lp = self.base.log_prob(Tensor(v))._data
-        total = jnp.zeros_like(base_lp)
-        for ld in ld_terms:
-            # elementwise jacobian terms reduce over the event dims the
-            # base has already summed (e.g. Independent bases)
-            extra = ld.ndim - base_lp.ndim
-            if extra > 0:
-                ld = jnp.sum(ld, axis=tuple(range(-extra, 0)))
-            total = total + ld
-        return Tensor(base_lp - total)
+        lp = _sum_rightmost(base_lp,
+                            event_dim - len(self.base.event_shape)) - total
+        return Tensor(lp)
 
 
 __all__ += ["Cauchy", "Geometric", "ExponentialFamily", "Independent",

@@ -31,8 +31,10 @@ def _a(x):
 class Transform:
     """Bijection with log-det-Jacobian (ref `transform.py` `Transform`)."""
 
-    # event dims consumed by one application (0 = elementwise)
+    # event dims consumed / produced by one application (0 = elementwise).
+    # _fldj is expected to have already summed over the domain event dims.
     _domain_event_dim = 0
+    _codomain_event_dim = 0
 
     def forward(self, x):
         return Tensor(self._forward(_a(x)))
@@ -144,6 +146,7 @@ class SoftmaxTransform(Transform):
     """Normalizing map (not a bijection; ref keeps the same caveat)."""
 
     _domain_event_dim = 1
+    _codomain_event_dim = 1
 
     def _forward(self, x):
         return jax.nn.softmax(x, axis=-1)
@@ -162,6 +165,7 @@ class StickBreakingTransform(Transform):
     `StickBreakingTransform`)."""
 
     _domain_event_dim = 1
+    _codomain_event_dim = 1
 
     def _forward(self, x):
         k = x.shape[-1]
@@ -200,9 +204,34 @@ class StickBreakingTransform(Transform):
         return list(shape[:-1]) + [shape[-1] - 1]
 
 
+def _sum_rightmost(a, n):
+    """Sum an array's n rightmost dims (no-op for n <= 0)."""
+    return jnp.sum(a, axis=tuple(range(-n, 0))) if n > 0 else a
+
+
+def chain_domain_event_dim(transforms):
+    """Event rank a chain consumes (torch ComposeTransform.domain walk)."""
+    ev = 0
+    for t in reversed(list(transforms)):
+        ev += t._domain_event_dim - t._codomain_event_dim
+        ev = max(ev, t._domain_event_dim)
+    return ev
+
+
+def chain_codomain_event_dim(transforms):
+    """Event rank a chain produces (torch ComposeTransform.codomain walk)."""
+    ev = 0
+    for t in transforms:
+        ev += t._codomain_event_dim - t._domain_event_dim
+        ev = max(ev, t._codomain_event_dim)
+    return ev
+
+
 class ChainTransform(Transform):
     def __init__(self, transforms):
         self.transforms = list(transforms)
+        self._domain_event_dim = chain_domain_event_dim(self.transforms)
+        self._codomain_event_dim = chain_codomain_event_dim(self.transforms)
 
     def _forward(self, x):
         for t in self.transforms:
@@ -215,19 +244,18 @@ class ChainTransform(Transform):
         return y
 
     def _fldj(self, x):
-        terms = []
-        for t in self.transforms:
-            terms.append(t._fldj(x))
-            x = t._forward(x)
-        # mixed event ranks: reduce every elementwise term down to the
-        # most-reduced term's rank so the sum is well-shaped
-        min_ndim = min(t.ndim for t in terms)
+        # event-rank bookkeeping: relative to the chain's domain, the
+        # running value carries `ev` event dims; each part's fldj has
+        # already reduced that part's own domain event dims, and any
+        # REMAINING event dims of the running value must be summed — but
+        # batch dims are never touched (they broadcast).
+        ev = self._domain_event_dim
         total = 0.0
-        for ld in terms:
-            extra = ld.ndim - min_ndim
-            if extra > 0:
-                ld = jnp.sum(ld, axis=tuple(range(-extra, 0)))
-            total = total + ld
+        for t in self.transforms:
+            total = total + _sum_rightmost(t._fldj(x),
+                                           ev - t._domain_event_dim)
+            ev += t._codomain_event_dim - t._domain_event_dim
+            x = t._forward(x)
         return total
 
     def forward_shape(self, shape):
@@ -249,6 +277,7 @@ class IndependentTransform(Transform):
         self.base = base
         self.rank = int(reinterpreted_batch_rank)
         self._domain_event_dim = base._domain_event_dim + self.rank
+        self._codomain_event_dim = base._codomain_event_dim + self.rank
 
     def _forward(self, x):
         return self.base._forward(x)
@@ -266,6 +295,7 @@ class ReshapeTransform(Transform):
         self.in_event_shape = tuple(in_event_shape)
         self.out_event_shape = tuple(out_event_shape)
         self._domain_event_dim = len(self.in_event_shape)
+        self._codomain_event_dim = len(self.out_event_shape)
 
     def _forward(self, x):
         batch = x.shape[:x.ndim - len(self.in_event_shape)]

@@ -1,9 +1,13 @@
 """Flagship model families (the reference ships these via PaddleNLP/PaddleClas;
 the benchmark configs in BASELINE.md name Llama, BERT, ResNet, ERNIE —
 they live in-tree here so the framework is benchmarkable standalone)."""
-from . import bert, llama  # noqa: F401
+from . import bert, ernie, llama  # noqa: F401
 from .bert import (  # noqa: F401
     BertConfig, BertForMaskedLM, BertForSequenceClassification, BertModel,
+)
+from .ernie import (  # noqa: F401
+    ErnieConfig, ErnieForPretraining, ErnieForPretrainingPipe,
+    ErnieForSequenceClassification, ErnieModel,
 )
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaForCausalLMPipe, LlamaModel,
@@ -14,4 +18,6 @@ __all__ = [
     "LlamaForCausalLMPipe",
     "bert", "BertConfig", "BertModel", "BertForMaskedLM",
     "BertForSequenceClassification",
+    "ernie", "ErnieConfig", "ErnieModel", "ErnieForPretraining",
+    "ErnieForPretrainingPipe", "ErnieForSequenceClassification",
 ]

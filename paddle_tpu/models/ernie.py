@@ -1,0 +1,426 @@
+"""ERNIE 3.0 family — BASELINE config 5's workload (ERNIE-3.0 10B,
+semi-auto shard + pipeline).
+
+Architecture (ERNIE 3.0 paper; the reference trains it with the
+auto-parallel pass stack, e.g. `python/paddle/distributed/passes/
+auto_parallel_pipeline.py`, over a PaddleNLP model): a large *universal
+representation* transformer trunk shared by all tasks, plus two small
+*task-specific* transformer branches — NLU and NLG — each reading the
+trunk output. The trunk's attention mask is TASK-SPECIFIC: bidirectional
+when feeding the NLU branch, unidirectional (causal) when feeding NLG —
+shared parameters, different mask. Pretraining is joint: knowledge-masked
+LM on the NLU branch + doc language modeling on the NLG branch.
+
+TPU-first mapping:
+- The trunk is the FLOPs mass -> it is the pipelined repeated run in
+  `ErnieForPretrainingPipe` (stage-stacked `lax.scan` blocks), while the
+  lightweight branches ride the tail, ZeRO-sharded over the pp axis.
+- TP via Column/RowParallelLinear + VocabParallelEmbedding ('mp' axis);
+  semi-auto via `distributed.auto_parallel.Engine` works on the non-pipe
+  model unchanged (GSPMD propagates the annotated shardings).
+- Branch width may differ from trunk width (768 vs 4096 at 10B scale); a
+  projection bridges them when they differ.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import tensor as T
+from ..distributed import shard
+from ..distributed.fleet.meta_parallel import (
+    ColumnParallelLinear, LayerDesc, PipelineLayer, RowParallelLinear,
+    VocabParallelEmbedding, masked_token_mean,
+)
+from ..framework.core import Tensor
+from ..nn import functional as F
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+
+__all__ = [
+    "ErnieConfig", "ErnieModel", "ErnieForPretraining",
+    "ErnieForPretrainingPipe", "ErnieForSequenceClassification",
+]
+
+
+class ErnieConfig:
+    def __init__(self, vocab_size=40000, hidden_size=4096,
+                 num_hidden_layers=48, num_attention_heads=64,
+                 intermediate_size=16384,
+                 task_hidden_size=768, num_task_layers=12,
+                 num_task_attention_heads=12, task_intermediate_size=3072,
+                 hidden_act="gelu", hidden_dropout_prob=0.1,
+                 attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=2048, type_vocab_size=4,
+                 layer_norm_eps=1e-12, pad_token_id=0, dtype="float32",
+                 recompute=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.task_hidden_size = task_hidden_size
+        self.num_task_layers = num_task_layers
+        self.num_task_attention_heads = num_task_attention_heads
+        self.task_intermediate_size = task_intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.layer_norm_eps = layer_norm_eps
+        self.pad_token_id = pad_token_id
+        self.dtype = dtype
+        self.recompute = recompute
+
+    @classmethod
+    def ernie3_10b(cls, **kw):
+        """The 10B config from the ERNIE 3.0 paper (trunk 48x4096/64h,
+        task branches 12x768)."""
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 128)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("num_hidden_layers", 4)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("task_hidden_size", 32)
+        kw.setdefault("num_task_layers", 2)
+        kw.setdefault("num_task_attention_heads", 2)
+        kw.setdefault("task_intermediate_size", 64)
+        kw.setdefault("max_position_embeddings", 64)
+        return cls(**kw)
+
+
+class ErnieSelfAttention(Layer):
+    """Post-norm multi-head attention; TP over the head dimension. The
+    task-specific mask arrives as `causal` (unidirectional NLG) so the
+    flash path engages instead of a materialized s x s bias."""
+
+    def __init__(self, hidden, heads, dropout):
+        super().__init__()
+        self.num_heads = heads
+        self.head_dim = hidden // heads
+        self.qkv = ColumnParallelLinear(hidden, 3 * hidden,
+                                        gather_output=False)
+        self.out = RowParallelLinear(hidden, hidden,
+                                     input_is_parallel=True)
+        self.dropout_p = dropout
+
+    def forward(self, x, attn_bias=None, causal=False):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv(x)
+        q, k, v = T.split(qkv, 3, axis=-1)
+        q = q.reshape([b, s, self.num_heads, self.head_dim])
+        k = k.reshape([b, s, self.num_heads, self.head_dim])
+        v = v.reshape([b, s, self.num_heads, self.head_dim])
+        q = shard.sharding_constraint(q, None, None, "mp", None)
+        k = shard.sharding_constraint(k, None, None, "mp", None)
+        v = shard.sharding_constraint(v, None, None, "mp", None)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_bias, self.dropout_p, is_causal=causal,
+            training=self.training)
+        return self.out(out.reshape([b, s, self.num_heads * self.head_dim]))
+
+
+class ErnieBlock(Layer):
+    """One post-norm transformer block (BERT/ERNIE style). Identical
+    structure across the trunk so the pipeline scheduler can stack it."""
+
+    def __init__(self, hidden, heads, inter, act, dropout, attn_dropout,
+                 eps):
+        super().__init__()
+        self.attention = ErnieSelfAttention(hidden, heads, attn_dropout)
+        self.attn_norm = LayerNorm(hidden, epsilon=eps)
+        self.inter = ColumnParallelLinear(hidden, inter,
+                                          gather_output=False)
+        self.output = RowParallelLinear(inter, hidden,
+                                        input_is_parallel=True)
+        self.out_norm = LayerNorm(hidden, epsilon=eps)
+        self.dropout = Dropout(dropout)
+        self.act = getattr(F, act)
+
+    def forward(self, x, attn_bias=None, causal=False):
+        a = self.attn_norm(
+            x + self.dropout(self.attention(x, attn_bias, causal)))
+        f = self.output(self.act(self.inter(a)))
+        return self.out_norm(a + self.dropout(f))
+
+
+class ErnieTrunkBlock(ErnieBlock):
+    """Universal-representation block; a distinct class so PipelineLayer
+    recognizes the trunk as the repeated (stage-stacked) run.
+
+    `causal=True` bakes the unidirectional mask into the block itself —
+    needed under PP, where the stacked block scan carries only the hidden
+    state (the non-pipe model instead passes the task mask per call, so
+    one set of trunk parameters serves both masks)."""
+
+    def __init__(self, config: ErnieConfig, causal=False):
+        super().__init__(config.hidden_size, config.num_attention_heads,
+                         config.intermediate_size, config.hidden_act,
+                         config.hidden_dropout_prob,
+                         config.attention_probs_dropout_prob,
+                         config.layer_norm_eps)
+        self.causal = causal
+
+    def forward(self, x, attn_bias=None, causal=None):
+        # per-call mask (non-pipe: one trunk, two masks) overrides the
+        # baked-in one (pipe: mask fixed per task at construction)
+        return super().forward(
+            x, attn_bias, causal=self.causal if causal is None else causal)
+
+
+def _task_block(config: ErnieConfig):
+    return ErnieBlock(config.task_hidden_size,
+                      config.num_task_attention_heads,
+                      config.task_intermediate_size, config.hidden_act,
+                      config.hidden_dropout_prob,
+                      config.attention_probs_dropout_prob,
+                      config.layer_norm_eps)
+
+
+class ErnieEmbeddings(Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size)
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = Embedding(
+            config.type_vocab_size, config.hidden_size)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        s = input_ids.shape[1]
+        pos = Tensor(np.arange(s, dtype=np.int32)[None, :])
+        emb = self.word_embeddings(input_ids) \
+            + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        emb = shard.sharding_constraint(emb, "dp", None, None)
+        return self.dropout(self.layer_norm(emb))
+
+
+class ErnieTaskBranch(Layer):
+    """Task-specific representation module. `causal=True` gives the NLG
+    branch its unidirectional attention."""
+
+    def __init__(self, config: ErnieConfig, causal: bool):
+        super().__init__()
+        self.causal = causal
+        self.config = config
+        if config.task_hidden_size != config.hidden_size:
+            self.proj = Linear(config.hidden_size, config.task_hidden_size)
+        else:
+            self.proj = None
+        self.layers = []
+        for i in range(config.num_task_layers):
+            blk = _task_block(config)
+            self.add_sublayer(f"layer.{i}", blk)
+            self.layers.append(blk)
+
+    def forward(self, trunk_out, attn_bias=None):
+        x = trunk_out if self.proj is None else self.proj(trunk_out)
+        for blk in self.layers:
+            x = blk(x, attn_bias, causal=self.causal)
+        return x
+
+
+class ErnieModel(Layer):
+    """Trunk + both task branches. The trunk runs once per required task
+    mask (shared parameters): bidirectional for NLU, causal for NLG.
+    Returns (nlu_out, nlg_out, trunk_bidir_out)."""
+
+    def __init__(self, config: ErnieConfig, tasks=("nlu", "nlg")):
+        super().__init__()
+        self.config = config
+        self.tasks = tuple(tasks)
+        self.embeddings = ErnieEmbeddings(config)
+        self.layers = []
+        for i in range(config.num_hidden_layers):
+            blk = ErnieTrunkBlock(config)
+            self.add_sublayer(f"encoder.{i}", blk)
+            self.layers.append(blk)
+        self.nlu_branch = (ErnieTaskBranch(config, causal=False)
+                           if "nlu" in self.tasks else None)
+        self.nlg_branch = (ErnieTaskBranch(config, causal=True)
+                           if "nlg" in self.tasks else None)
+
+    def _trunk(self, x, attn_bias, causal=False):
+        for blk in self.layers:
+            x = blk(x, attn_bias, causal=causal)
+        return x
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        attn_bias = None
+        if attention_mask is not None:
+            m = attention_mask.astype(self.config.dtype)
+            attn_bias = (m.unsqueeze(1).unsqueeze(1) - 1.0) * 1e4
+        x = self.embeddings(input_ids, token_type_ids)
+        nlu = nlg = trunk_bidir = None
+        if self.nlu_branch is not None:
+            trunk_bidir = self._trunk(x, attn_bias)
+            nlu = self.nlu_branch(trunk_bidir, attn_bias)
+        if self.nlg_branch is not None:
+            trunk_causal = self._trunk(x, attn_bias, causal=True)
+            nlg = self.nlg_branch(trunk_causal, attn_bias)
+        return nlu, nlg, trunk_bidir
+
+
+class _MLMHead(Layer):
+    """Transform + vocab projection for the NLU (masked LM) objective."""
+
+    def __init__(self, hidden, vocab, eps, act):
+        super().__init__()
+        self.transform = Linear(hidden, hidden)
+        self.norm = LayerNorm(hidden, epsilon=eps)
+        self.decoder = ColumnParallelLinear(hidden, vocab, has_bias=True)
+        self.act = getattr(F, act)
+
+    def forward(self, h):
+        return self.decoder(self.norm(self.act(self.transform(h))))
+
+
+class ErnieForPretraining(Layer):
+    """Joint pretraining: masked LM on the NLU branch + causal LM on the
+    NLG branch (next-token). Loss = mlm + lm (when labels given)."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.ernie = ErnieModel(config)
+        c = config
+        self.mlm_head = _MLMHead(c.task_hidden_size, c.vocab_size,
+                                 c.layer_norm_eps, c.hidden_act)
+        self.lm_head = ColumnParallelLinear(
+            c.task_hidden_size, c.vocab_size, has_bias=False)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                mlm_labels=None, lm_labels=None, ignore_index=-100):
+        nlu, nlg, _ = self.ernie(input_ids, token_type_ids, attention_mask)
+        mlm_logits = self.mlm_head(nlu)
+        lm_logits = self.lm_head(nlg)
+        if mlm_labels is None and lm_labels is None:
+            return mlm_logits, lm_logits
+        loss = None
+        if mlm_labels is not None:
+            per = F.cross_entropy(mlm_logits.astype("float32"),
+                                  mlm_labels.unsqueeze(-1),
+                                  ignore_index=ignore_index,
+                                  reduction="none")
+            loss = masked_token_mean(per, mlm_labels, ignore_index)
+        if lm_labels is not None:
+            # next-token: shift logits left / labels right
+            lg = lm_logits[:, :-1]
+            lb = lm_labels[:, 1:]
+            per = F.cross_entropy(lg.astype("float32"), lb.unsqueeze(-1),
+                                  ignore_index=ignore_index,
+                                  reduction="none")
+            lm_loss = masked_token_mean(per, lb, ignore_index)
+            loss = lm_loss if loss is None else loss + lm_loss
+        return loss
+
+    def flops_per_token(self, seq_len):
+        """Dense training FLOPs/token (6ND rule + attention term), for MFU
+        accounting — trunk plus both branches."""
+        c = self.config
+
+        def layer_flops(h, inter, layers):
+            per_layer = 6 * (4 * h * h + 2 * h * inter) \
+                + 12 * seq_len * h
+            return layers * per_layer
+
+        # joint pretraining runs the trunk once per task mask
+        trunk = 2 * layer_flops(c.hidden_size, c.intermediate_size,
+                                c.num_hidden_layers)
+        task = 2 * layer_flops(c.task_hidden_size, c.task_intermediate_size,
+                               c.num_task_layers)
+        heads = 6 * 2 * c.task_hidden_size * c.vocab_size
+        return trunk + task + heads
+
+
+class ErnieForSequenceClassification(Layer):
+    """Fine-tune head on the NLU branch's [CLS]."""
+
+    def __init__(self, config: ErnieConfig, num_classes=2):
+        super().__init__()
+        self.ernie = ErnieModel(config, tasks=("nlu",))
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.task_hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        nlu, _, _ = self.ernie(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(nlu[:, 0]))
+
+
+class _ErnieEmbeddingStage(Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = ErnieEmbeddings(config)
+
+    def forward(self, input_ids):
+        x = self.embeddings(input_ids)
+        return x.astype(self.config.dtype)
+
+
+class _ErnieHeadStage(Layer):
+    """Tail stage: the active task's branch + pretraining head."""
+
+    def __init__(self, config: ErnieConfig, task="nlg"):
+        super().__init__()
+        self.config = config
+        self.task = task
+        if task == "nlu":
+            self.branch = ErnieTaskBranch(config, causal=False)
+            self.head = _MLMHead(config.task_hidden_size, config.vocab_size,
+                                 config.layer_norm_eps, config.hidden_act)
+        else:
+            self.branch = ErnieTaskBranch(config, causal=True)
+            self.head = ColumnParallelLinear(
+                config.task_hidden_size, config.vocab_size, has_bias=False)
+
+    def forward(self, x):
+        return self.head(self.branch(x))
+
+
+class ErnieForPretrainingPipe(PipelineLayer):
+    """Pipeline-parallel ERNIE: the trunk is the stage-stacked repeated
+    run; embeddings/head ride the pp-sharded head/tail (semi-auto +
+    pipeline, BASELINE config 5).
+
+    `task` selects the trunk mask and objective — "nlg" (causal doc-LM,
+    the 10B scale workload) or "nlu" (masked LM). The paper's joint loop
+    alternates task batches under ONE mask-switchable trunk; under PP the
+    mask is baked into the stacked block scan, so joint pretraining uses
+    the non-pipe `ErnieForPretraining` (which runs the trunk under both
+    masks) — per-task pipes cover the scale-out path. Labels: [b, s]."""
+
+    def __init__(self, config: ErnieConfig, task="nlg", **kwargs):
+        if task not in ("nlu", "nlg"):
+            raise ValueError(f"task must be 'nlu' or 'nlg', got {task!r}")
+        self.config = config
+        self.task = task
+
+        def loss_fn(logits, labels):
+            if task == "nlg":  # next-token shift
+                logits = logits[:, :-1]
+                labels = labels[:, 1:]
+            per = F.cross_entropy(logits.astype("float32"),
+                                  labels.unsqueeze(-1), reduction="none")
+            return masked_token_mean(per, labels, -100)
+
+        descs = (
+            [LayerDesc(_ErnieEmbeddingStage, config)]
+            + [LayerDesc(ErnieTrunkBlock, config, causal=(task == "nlg"))
+               for _ in range(config.num_hidden_layers)]
+            + [LayerDesc(_ErnieHeadStage, config, task)]
+        )
+        super().__init__(
+            layers=descs, loss_fn=loss_fn,
+            recompute_interval=1 if config.recompute else 0, **kwargs)

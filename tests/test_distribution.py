@@ -177,6 +177,44 @@ class TestRound3Additions:
         s = td.sample([3000]).numpy()
         assert abs(s.mean() - 2.0) < 0.3 and abs(s.std() - 3.0) < 0.3
 
+    def test_transformed_event_rank_bookkeeping(self):
+        """Regression (round-3 review): event-reducing transforms over
+        elementwise bases must sum the base log-prob over the event dim;
+        broadcasting a low-rank value must NOT collapse batch dims."""
+        import numpy as np
+        import scipy.stats as st
+
+        # StickBreaking over elementwise Normal -> scalar density
+        td = dist.TransformedDistribution(
+            dist.Normal(np.zeros(3, "float32"), np.ones(3, "float32")),
+            dist.StickBreakingTransform())
+        assert list(td.event_shape) == [4]
+        s = td.sample()
+        t = dist.StickBreakingTransform()
+        x = t._inverse(s._data)
+        manual = st.norm.logpdf(np.asarray(x)).sum() - float(t._fldj(x))
+        lp = td.log_prob(s)
+        assert lp.shape in ([], ())
+        np.testing.assert_allclose(float(lp.numpy()), manual, atol=1e-4)
+
+        # scalar value against a batched base keeps the batch shape
+        td2 = dist.TransformedDistribution(
+            dist.Normal(np.zeros(5, "float32"), np.ones(5, "float32")),
+            [dist.ExpTransform()])
+        lp2 = td2.log_prob(paddle.to_tensor(2.0))
+        expect = st.norm.logpdf(np.log(2.0)) - np.log(2.0)
+        assert list(lp2.shape) == [5]
+        np.testing.assert_allclose(lp2.numpy(), expect, atol=1e-5)
+
+        # chain with mixed event ranks resolves ranks per term
+        ch = dist.ChainTransform([dist.AffineTransform(0.5, 2.0),
+                                  dist.StickBreakingTransform()])
+        assert ch._domain_event_dim == 1 and ch._codomain_event_dim == 1
+        td3 = dist.TransformedDistribution(
+            dist.Normal(np.zeros(3, "float32"), np.ones(3, "float32")), ch)
+        v3 = td3.sample()
+        assert td3.log_prob(v3).shape in ([], ())
+
     def test_transforms_roundtrip_and_ldj(self):
         import numpy as np
 

@@ -1,0 +1,151 @@
+"""ERNIE 3.0 family tests — BASELINE config 5 (semi-auto shard + pipeline)
+on the virtual 8-device CPU mesh (dp=2 x mp=2 x pp=2).
+
+Mirrors the reference's auto-parallel GPT/ERNIE fixtures
+(`test/auto_parallel/get_gpt_model.py`, ERNIE passes in
+`python/paddle/distributed/passes/auto_parallel_pipeline.py` tests).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_parallel import PipelineParallel
+from paddle_tpu.jit.train_step import TrainStep
+from paddle_tpu.models import (
+    ErnieConfig, ErnieForPretraining, ErnieForPretrainingPipe,
+    ErnieForSequenceClassification,
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _mesh():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}
+    strategy.pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    yield
+    from paddle_tpu.distributed import env as env_mod
+
+    env_mod.reset_env()
+
+
+def _batch(cfg, b=4, s=16, mask_frac=0.2):
+    rng = np.random.RandomState(0)
+    ids = rng.randint(4, cfg.vocab_size, (b, s))
+    mlm_labels = np.where(rng.rand(b, s) < mask_frac, ids, -100)
+    lm_labels = ids.copy()
+    return (pt.to_tensor(ids), pt.to_tensor(mlm_labels),
+            pt.to_tensor(lm_labels))
+
+
+class TestErnie:
+    def test_forward_shapes_and_loss(self):
+        cfg = ErnieConfig.tiny()
+        model = ErnieForPretraining(cfg)
+        ids, mlm, lm = _batch(cfg)
+        mlm_logits, lm_logits = model(ids)
+        assert mlm_logits.shape == [4, 16, cfg.vocab_size]
+        assert lm_logits.shape == [4, 16, cfg.vocab_size]
+        loss = model(ids, mlm_labels=mlm, lm_labels=lm)
+        # two joint CE objectives at random init: each ~= ln(vocab)
+        assert abs(float(loss.numpy()) - 2 * np.log(cfg.vocab_size)) < 1.5
+
+    def test_branch_masks_differ(self):
+        """NLG branch must be causal: flipping a late token changes an
+        early NLU position but not an early NLG position."""
+        cfg = ErnieConfig.tiny(hidden_dropout_prob=0.0,
+                               attention_probs_dropout_prob=0.0)
+        pt.seed(11)
+        model = ErnieForPretraining(cfg)
+        model.eval()
+        ids, _, _ = _batch(cfg)
+        mlm1, lm1 = model(ids)
+        ids2 = ids.numpy().copy()
+        ids2[:, -1] = (ids2[:, -1] + 1) % cfg.vocab_size
+        mlm2, lm2 = model(pt.to_tensor(ids2))
+        assert not np.allclose(mlm1.numpy()[:, 0], mlm2.numpy()[:, 0])
+        np.testing.assert_allclose(lm1.numpy()[:, 0], lm2.numpy()[:, 0],
+                                   atol=1e-5)
+
+    def test_train_step_compiled_loss_decreases(self):
+        cfg = ErnieConfig.tiny()
+        model = ErnieForPretraining(cfg)
+        opt = pt.optimizer.AdamW(
+            learning_rate=1e-3, parameters=model.parameters(),
+            grad_clip=pt.nn.ClipGradByGlobalNorm(1.0))
+        step = TrainStep(model, opt,
+                         lambda m, i, a, b: m(i, mlm_labels=a, lm_labels=b))
+        ids, mlm, lm = _batch(cfg)
+        losses = [float(step(ids, mlm, lm).numpy()) for _ in range(6)]
+        assert losses[-1] < losses[0]
+        assert step.compiled_count == 1
+
+    def test_pipe_train_batch_nlg(self):
+        cfg = ErnieConfig.tiny()
+        m = ErnieForPretrainingPipe(cfg, task="nlg")
+        assert m._pipelined and m._n_blocks == cfg.num_hidden_layers
+        pp_model = fleet.distributed_model(m)
+        assert isinstance(pp_model, PipelineParallel)
+        opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+        ids, _, lm = _batch(cfg)
+        losses = [float(pp_model.train_batch((ids, lm), opt).numpy())
+                  for _ in range(4)]
+        assert losses[-1] < losses[0]
+
+    def test_pipe_train_batch_nlu(self):
+        cfg = ErnieConfig.tiny()
+        m = ErnieForPretrainingPipe(cfg, task="nlu")
+        pp_model = fleet.distributed_model(m)
+        opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+        ids, mlm, _ = _batch(cfg, mask_frac=0.5)
+        losses = [float(pp_model.train_batch((ids, mlm), opt).numpy())
+                  for _ in range(4)]
+        assert losses[-1] < losses[0]
+
+    def test_sequence_classification(self):
+        cfg = ErnieConfig.tiny()
+        model = ErnieForSequenceClassification(cfg, num_classes=3)
+        ids, _, _ = _batch(cfg)
+        assert model(ids).shape == [4, 3]
+
+    def test_10b_config_flops(self):
+        cfg = ErnieConfig.ernie3_10b()
+        assert cfg.hidden_size == 4096 and cfg.num_hidden_layers == 48
+        assert cfg.task_hidden_size == 768
+        shell = ErnieForPretraining.__new__(ErnieForPretraining)
+        shell.config = cfg
+        per_tok = ErnieForPretraining.flops_per_token(shell, 2048)
+        # trunk dominates: 6 * 2 * N_trunk params is the right ballpark
+        n_trunk = cfg.num_hidden_layers * (
+            4 * cfg.hidden_size ** 2
+            + 2 * cfg.hidden_size * cfg.intermediate_size)
+        assert per_tok > 6 * n_trunk
+
+
+def test_engine_semi_auto_finetune(tmp_path):
+    """Semi-auto: the Engine shards data-parallel over the mesh and GSPMD
+    propagates the model's mp annotations (BASELINE config 5's strategy on
+    the non-pipe model)."""
+    from paddle_tpu.distributed import auto_parallel as ap
+
+    cfg = ErnieConfig.tiny(num_hidden_layers=2, num_task_layers=1)
+    model = ErnieForSequenceClassification(cfg, num_classes=2)
+    opt = pt.optimizer.AdamW(learning_rate=5e-4,
+                             parameters=model.parameters())
+
+    class DS(pt.io.Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            ids = rng.randint(4, cfg.vocab_size, (16,))
+            return ids.astype(np.int64), np.array([i % 2], np.int64)
+
+    eng = ap.Engine(model=model, loss=pt.nn.CrossEntropyLoss(),
+                    optimizer=opt)
+    hist = eng.fit(DS(), batch_size=8, epochs=8, log_freq=4)
+    assert hist[-1] < hist[0]
