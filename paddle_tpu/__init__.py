@@ -81,12 +81,20 @@ _LAZY = {
     "inference": ".inference",
     "geometric": ".geometric",
     "signal": ".signal",
+    "callbacks": ".callbacks",
+    "regularizer": ".regularizer",
+    "sysconfig": ".sysconfig",
+    "hub": ".hub",
+    "reader": ".reader",
+    "dataset": ".dataset",
+    "cost_model": ".cost_model",
 }
 
 
 _LAZY_ATTRS = {
     "Model": (".hapi.model", "Model"),
     "DataParallel": (".distributed.parallel", "DataParallel"),
+    "batch": (".batch", "batch"),
 }
 
 

@@ -1,0 +1,26 @@
+"""`paddle.batch` parity (reference `python/paddle/batch.py`): wrap an
+item-level reader (generator factory) into a batched reader."""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Create a batched reader from ``reader`` (a no-arg callable yielding
+    samples). Yields lists of ``batch_size`` samples; the trailing partial
+    batch is kept unless ``drop_last``."""
+    if batch_size <= 0:
+        raise ValueError(
+            f"batch_size should be a positive integer, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

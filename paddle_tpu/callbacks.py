@@ -1,0 +1,12 @@
+"""`paddle.callbacks` parity (reference `python/paddle/callbacks.py`):
+the hapi training callbacks re-exported at the top level."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRSchedulerCallback, ModelCheckpoint,
+    ProgBarLogger, ReduceLROnPlateau, VisualDL,
+)
+
+# the reference exports the LR callback as `LRScheduler`
+LRScheduler = LRSchedulerCallback
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping", "ReduceLROnPlateau", "VisualDL"]

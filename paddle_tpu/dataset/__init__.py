@@ -1,0 +1,165 @@
+"""`paddle.dataset` parity (reference `python/paddle/dataset/`): the
+legacy creator-style dataset API (`paddle.dataset.mnist.train()` returns a
+reader). Bridges to the map-style datasets in `vision.datasets` /
+`text.datasets`.
+
+No-egress environment: the reference auto-downloads into
+`~/.cache/paddle/dataset`; this build reads from the same cache layout (or
+an explicit path) and raises a clear error when the files are absent.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import types
+
+__all__ = ["common", "mnist", "cifar", "uci_housing", "imdb", "imikolov",
+           "movielens"]
+
+
+# -- common (reference `dataset/common.py`) --
+common = types.ModuleType("paddle_tpu.dataset.common")
+common.DATA_HOME = os.path.join(os.path.expanduser("~"), ".cache",
+                                "paddle", "dataset")
+
+
+def _download(url, module_name, md5sum=None, save_name=None):
+    raise RuntimeError(
+        f"paddle.dataset cannot download {url!r}: this build has no "
+        f"network egress. Place the file under "
+        f"{os.path.join(common.DATA_HOME, module_name)} manually.")
+
+
+common.download = _download
+common.must_mkdirs = lambda path: os.makedirs(path, exist_ok=True)
+
+
+def _module(name, **funcs):
+    m = types.ModuleType(f"paddle_tpu.dataset.{name}")
+    for k, v in funcs.items():
+        setattr(m, k, v)
+    # register so `import paddle_tpu.dataset.mnist` (the reference's
+    # canonical form) resolves, not only attribute access
+    sys.modules[m.__name__] = m
+    return m
+
+
+sys.modules[common.__name__] = common
+
+
+def _mnist_reader(mode):
+    def reader():
+        from ..vision.datasets import MNIST
+
+        ds = MNIST(mode=mode, backend="numpy",
+                   root=os.path.join(common.DATA_HOME, "mnist"))
+        for i in range(len(ds)):
+            img, label = ds[i]
+            # legacy API: flat [784] floats in [-1, 1] + int label
+            yield (img.reshape(-1).astype("float32") / 127.5 - 1.0,
+                   int(label))
+
+    return reader
+
+
+mnist = _module(
+    "mnist",
+    train=lambda: _mnist_reader("train"),
+    test=lambda: _mnist_reader("test"),
+)
+
+
+def _cifar_reader(cls_name, mode):
+    def reader():
+        from ..vision import datasets as vd
+
+        ds = getattr(vd, cls_name)(
+            mode=mode, backend="numpy",
+            data_file=os.path.join(common.DATA_HOME, "cifar"))
+        for i in range(len(ds)):
+            img, label = ds[i]
+            yield img.reshape(-1).astype("float32") / 255.0, int(label)
+
+    return reader
+
+
+cifar = _module(
+    "cifar",
+    train10=lambda: _cifar_reader("Cifar10", "train"),
+    test10=lambda: _cifar_reader("Cifar10", "test"),
+    train100=lambda: _cifar_reader("Cifar100", "train"),
+    test100=lambda: _cifar_reader("Cifar100", "test"),
+)
+
+
+def _uci_reader(mode):
+    def reader():
+        from ..text.datasets import UCIHousing
+
+        ds = UCIHousing(mode=mode)
+        for i in range(len(ds)):
+            yield tuple(ds[i])
+
+    return reader
+
+
+uci_housing = _module(
+    "uci_housing",
+    train=lambda: _uci_reader("train"),
+    test=lambda: _uci_reader("test"),
+)
+
+
+def _imdb_reader(mode, cutoff=150):
+    def reader():
+        from ..text.datasets import Imdb
+
+        ds = Imdb(mode=mode, cutoff=cutoff)
+        for i in range(len(ds)):
+            doc, label = ds[i]
+            yield doc, int(label)
+
+    return reader
+
+
+imdb = _module(
+    "imdb",
+    train=lambda word_idx=None: _imdb_reader("train"),
+    test=lambda word_idx=None: _imdb_reader("test"),
+)
+
+
+def _imikolov_reader(data_type, window_size):
+    def reader():
+        from ..text.datasets import Imikolov
+
+        ds = Imikolov(data_type=data_type, window_size=window_size)
+        for i in range(len(ds)):
+            yield tuple(ds[i])
+
+    return reader
+
+
+imikolov = _module(
+    "imikolov",
+    train=lambda word_idx=None, n=5: _imikolov_reader("NGRAM", n),
+    test=lambda word_idx=None, n=5: _imikolov_reader("NGRAM", n),
+)
+
+
+def _movielens_reader(mode):
+    def reader():
+        from ..text.datasets import Movielens
+
+        ds = Movielens(mode=mode)
+        for i in range(len(ds)):
+            yield tuple(ds[i])
+
+    return reader
+
+
+movielens = _module(
+    "movielens",
+    train=lambda: _movielens_reader("train"),
+    test=lambda: _movielens_reader("test"),
+)

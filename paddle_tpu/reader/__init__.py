@@ -1,0 +1,12 @@
+"""`paddle.reader` parity (reference `python/paddle/reader/decorator.py`):
+composable reader (generator-factory) decorators from the fluid data
+lineage. Kept for API completeness — `paddle_tpu.io.DataLoader` is the
+TPU-era path (threaded ordered prefetch feeding the compiled step).
+"""
+from .decorator import (  # noqa: F401
+    buffered, cache, chain, compose, firstn, map_readers,
+    multiprocess_reader, shuffle, xmap_readers,
+)
+
+__all__ = ["buffered", "cache", "chain", "compose", "firstn", "map_readers",
+           "multiprocess_reader", "shuffle", "xmap_readers"]

@@ -1,0 +1,239 @@
+"""Reader decorators (reference `python/paddle/reader/decorator.py`).
+
+A "reader" is a no-arg callable returning an iterable of samples; a
+"reader creator" returns a reader. These combinators compose readers the
+way the reference's fluid data pipelines did.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    """Cache the first full pass in memory; later passes replay it."""
+    all_data = tuple(reader())
+
+    def cached_reader():
+        yield from all_data
+
+    return cached_reader
+
+
+def map_readers(func, *readers):
+    """Yield func(*items) over readers drawn in lockstep."""
+
+    def reader():
+        rs = [r() for r in readers]
+        yield from map(func, *rs)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Pool `buf_size` samples, yield them in random order (reservoir
+    windows, matching the reference's buffered shuffle)."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers back to back."""
+
+    def reader():
+        yield from itertools.chain(*[r() for r in readers])
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into tuples per sample; single-item outputs flatten.
+    check_alignment=True (default) raises if readers run out unevenly."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ValueError(
+                        "outputs of readers are not aligned (different "
+                        "lengths with check_alignment=True)")
+                yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader
+
+
+class _End:
+    pass
+
+
+class _Raised:
+    """Carries a worker-thread exception to the consuming generator — a
+    silently-dead daemon worker would otherwise hang the pipeline."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def buffered(reader, size):
+    """Read ahead up to `size` samples on a background thread."""
+
+    def data_reader():
+        r = reader()
+        q = queue.Queue(maxsize=size)
+
+        def read_worker():
+            try:
+                for d in r:
+                    q.put(d)
+                q.put(_End)
+            except Exception as exc:  # noqa: BLE001 — relayed to consumer
+                q.put(_Raised(exc))
+
+        t = threading.Thread(target=read_worker, daemon=True)
+        t.start()
+        e = q.get()
+        while e is not _End:
+            if isinstance(e, _Raised):
+                raise e.exc
+            yield e
+            e = q.get()
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """Limit the reader to its first n samples."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Map `mapper` over the reader with `process_num` worker THREADS
+    (reference uses threads here too). With order=True output order
+    matches input order."""
+
+    def thread_reader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feed():
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+                for _ in range(process_num):
+                    in_q.put(_End)
+            except Exception as exc:  # noqa: BLE001 — relayed to consumer
+                out_q.put(_Raised(exc))
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is _End:
+                        out_q.put(_End)
+                        return
+                    i, sample = item
+                    out_q.put((i, mapper(sample)))
+            except Exception as exc:  # noqa: BLE001 — relayed to consumer
+                out_q.put(_Raised(exc))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if not order:
+            while finished < process_num:
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                elif isinstance(item, _Raised):
+                    raise item.exc
+                else:
+                    yield item[1]
+        else:
+            pending = {}
+            next_i = 0
+            while finished < process_num or pending:
+                if next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+                    continue
+                if finished == process_num:
+                    # all workers done but the next index never arrived
+                    raise RuntimeError("xmap_readers: missing sample "
+                                       f"index {next_i}")
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                elif isinstance(item, _Raised):
+                    raise item.exc
+                else:
+                    pending[item[0]] = item[1]
+
+    return thread_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers concurrently. The reference forks
+    processes; sample producers here are python generators (often closures
+    over jax/numpy state that do not survive a fork), so worker THREADS
+    provide the same API with safe semantics."""
+
+    def combined():
+        q = queue.Queue(queue_size)
+
+        def work(r):
+            try:
+                for sample in r():
+                    q.put(sample)
+                q.put(_End)
+            except Exception as exc:  # noqa: BLE001 — relayed to consumer
+                q.put(_Raised(exc))
+
+        for r in readers:
+            threading.Thread(target=work, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            item = q.get()
+            if item is _End:
+                finished += 1
+            elif isinstance(item, _Raised):
+                raise item.exc
+            else:
+                yield item
+
+    return combined

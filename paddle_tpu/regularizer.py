@@ -1,0 +1,11 @@
+"""`paddle.regularizer` parity (reference `python/paddle/regularizer.py`):
+weight-decay regularizers consumed by the optimizers. The implementations
+live with the optimizer (`optimizer/optimizer.py` applies them inside the
+compiled update rule); this module is the public namespace."""
+from .optimizer import L1Decay, L2Decay  # noqa: F401
+
+# reference aliases kept by paddle.fluid lineage
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay
+
+__all__ = ["L1Decay", "L2Decay"]

@@ -34,8 +34,19 @@ __all__ = [
     "Program", "program_guard", "data", "Executor", "default_main_program",
     "default_startup_program", "InputSpec", "save_inference_model",
     "load_inference_model", "name_scope", "global_scope", "scope_guard",
-    "cpu_places", "device_guard", "amp",
+    "cpu_places", "device_guard", "amp", "nn",
 ]
+
+
+def __getattr__(name):
+    # lazy: static.nn builders import the full nn package
+    if name == "nn":
+        import importlib
+
+        mod = importlib.import_module(".nn", __name__)
+        globals()["nn"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class _StaticOp:

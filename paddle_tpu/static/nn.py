@@ -1,0 +1,293 @@
+"""`paddle.static.nn` parity (reference `python/paddle/static/nn/common.py`
+and `control_flow.py`): the functional static-graph layer builders.
+
+TPU-first: each builder instantiates the corresponding `paddle_tpu.nn`
+layer once at build time — its parameters are persistable, so the recorded
+Program replays against the live (trained) weights — and the op stream is
+captured by `program_guard` exactly like any dygraph call. Control flow
+(`cond`, `case`, `switch_case`, `while_loop`) lowers to `jax.lax`
+primitives so the compiled program keeps a single trace.
+
+Excluded (documented, reference-legacy): the LoD `sequence_*` family,
+`nce`, `row_conv`, `deform_conv2d`, `sparse_embedding`, `data_norm` —
+LoD-tensor / parameter-server machinery with no TPU meaning (see
+README "Scope").
+"""
+from __future__ import annotations
+
+import jax
+
+from ..framework.core import Tensor
+from ..ops.dispatch import apply
+
+__all__ = [
+    "fc", "embedding", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "batch_norm", "layer_norm", "instance_norm",
+    "group_norm", "prelu", "spectral_norm", "bilinear_tensor_product",
+    "cond", "case", "switch_case", "while_loop", "py_func",
+]
+
+
+def _act(x, activation):
+    if activation is None:
+        return x
+    from ..nn import functional as F
+
+    return getattr(F, activation)(x)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Reference contract: dims [num_flatten_dims:] are flattened into the
+    feature axis; output shape = x.shape[:num_flatten_dims] + [size]."""
+    from .. import nn
+
+    nfd = num_flatten_dims % x.ndim if num_flatten_dims < 0 \
+        else num_flatten_dims
+    lead = list(x.shape[:nfd])
+    in_features = 1
+    for d in x.shape[nfd:]:
+        in_features *= d
+    if list(x.shape[nfd:]) != [in_features]:
+        x = x.reshape(lead + [in_features])
+    layer = nn.Linear(in_features, size, weight_attr=weight_attr,
+                      bias_attr=bias_attr)
+    return _act(layer(x), activation)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,  # noqa: A002
+              param_attr=None, dtype="float32"):
+    from .. import nn
+
+    layer = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                         weight_attr=param_attr)
+    return layer(input)
+
+
+def _conv(cls, x, num_filters, filter_size, stride, padding, dilation,
+          groups, param_attr, bias_attr, activation, **extra):
+    in_ch = x.shape[1]
+    layer = cls(in_ch, num_filters, filter_size, stride=stride,
+                padding=padding, dilation=dilation, groups=groups or 1,
+                weight_attr=param_attr, bias_attr=bias_attr, **extra)
+    return _act(layer(x), activation)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    from .. import nn
+
+    return _conv(nn.Conv2D, input, num_filters, filter_size, stride,
+                 padding, dilation, groups, param_attr, bias_attr, act)
+
+
+def conv2d_transpose(input, num_filters, filter_size=None,  # noqa: A002
+                     stride=1, padding=0, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, act=None, name=None,
+                     output_size=None, data_format="NCHW"):
+    from .. import nn
+
+    return _conv(nn.Conv2DTranspose, input, num_filters, filter_size,
+                 stride, padding, dilation, groups, param_attr, bias_attr,
+                 act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCDHW"):
+    from .. import nn
+
+    return _conv(nn.Conv3D, input, num_filters, filter_size, stride,
+                 padding, dilation, groups, param_attr, bias_attr, act)
+
+
+def conv3d_transpose(input, num_filters, filter_size=None,  # noqa: A002
+                     stride=1, padding=0, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, act=None, name=None,
+                     output_size=None, data_format="NCDHW"):
+    from .. import nn
+
+    return _conv(nn.Conv3DTranspose, input, num_filters, filter_size,
+                 stride, padding, dilation, groups, param_attr, bias_attr,
+                 act)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,  # noqa: A002
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, name=None):
+    from .. import nn
+
+    ch = input.shape[1] if data_layout.startswith("NC") else input.shape[-1]
+    cls = {5: nn.BatchNorm3D, 4: nn.BatchNorm2D}.get(input.ndim,
+                                                     nn.BatchNorm1D)
+    layer = cls(ch, momentum=momentum, epsilon=epsilon)
+    if is_test:
+        layer.eval()
+    return _act(layer(input), act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,  # noqa: A002
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from .. import nn
+
+    shape = list(input.shape[begin_norm_axis:])
+    layer = nn.LayerNorm(shape, epsilon=epsilon)
+    return _act(layer(input), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None,  # noqa: A002
+                  bias_attr=None, name=None):
+    from .. import nn
+
+    cls = {3: nn.InstanceNorm1D, 4: nn.InstanceNorm2D,
+           5: nn.InstanceNorm3D}[input.ndim]
+    return cls(input.shape[1], epsilon=epsilon)(input)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,  # noqa: A002
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from .. import nn
+
+    layer = nn.GroupNorm(groups, input.shape[1], epsilon=epsilon)
+    return _act(layer(input), act)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from .. import nn
+
+    num = 1 if mode == "all" else (
+        x.shape[1] if mode == "channel" else int(
+            __import__("numpy").prod(x.shape[1:])))
+    return nn.PReLU(num_parameters=num)(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from .. import nn
+
+    layer = nn.SpectralNorm(weight.shape, dim=dim, power_iters=power_iters,
+                            eps=eps)
+    return layer(weight)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from .. import nn
+
+    layer = nn.Bilinear(x.shape[-1], y.shape[-1], size,
+                        weight_attr=param_attr, bias_attr=bias_attr)
+    return _act(layer(x, y), act)
+
+
+# -- control flow (reference `static/nn/control_flow.py`) --
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Single-trace conditional via `lax.cond`: both branches compile,
+    the predicate selects at run time."""
+    def kernel(p):
+        return jax.lax.cond(
+            p.astype(bool).reshape(()),
+            lambda: _strip(true_fn()),
+            lambda: _strip(false_fn()),
+        )
+
+    return apply("cond", kernel, (pred,))
+
+
+def _strip(out):
+    if isinstance(out, Tensor):
+        return out._data
+    if isinstance(out, (tuple, list)):
+        return tuple(_strip(o) for o in out)
+    return out
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First matching predicate wins (reference semantics), built as a
+    nested `lax.cond` chain."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    preds = [p for p, _ in pred_fn_pairs]
+    fns = [f for _, f in pred_fn_pairs]
+    tail = default or fns[-1]
+
+    def kernel(*ps):
+        def build(i):
+            if i == len(fns):
+                return lambda: _strip(tail())
+            return lambda: jax.lax.cond(
+                ps[i].astype(bool).reshape(()),
+                lambda: _strip(fns[i]()), build(i + 1))
+
+        return build(0)()
+
+    return apply("case", kernel, tuple(preds))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Integer dispatch via `lax.switch`."""
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        if keys != list(range(len(keys))):
+            # sparse keys: chain through case()
+            pairs = [(branch_index == k, fn) for k, fn in
+                     sorted(branch_fns.items())]
+            return case(pairs, default=default)
+        fns = [branch_fns[k] for k in keys]
+    else:
+        fns = list(branch_fns)
+    n_real = len(fns)
+    if default is not None:
+        fns = fns + [default]
+
+    def kernel(idx):
+        i = idx.reshape(()).astype("int32")
+        if default is not None:
+            # any out-of-range index (negative included) runs default
+            i = jax.numpy.where((i < 0) | (i >= n_real), n_real, i)
+        else:
+            i = jax.numpy.clip(i, 0, n_real - 1)
+        return jax.lax.switch(i, [lambda f=f: _strip(f()) for f in fns])
+
+    return apply("switch_case", kernel, (branch_index,))
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):  # noqa: A002
+    """`lax.while_loop` with paddle's (cond, body, loop_vars) contract."""
+    def kernel(*vs):
+        def c(state):
+            return cond(*[Tensor(s, stop_gradient=True)
+                          for s in state])._data.reshape(()).astype(bool)
+
+        def b(state):
+            out = body(*[Tensor(s, stop_gradient=True) for s in state])
+            out = out if isinstance(out, (tuple, list)) else (out,)
+            return tuple(_strip(o) for o in out)
+
+        return jax.lax.while_loop(c, b, tuple(vs))
+
+    out = apply("while_loop", kernel, tuple(loop_vars))
+    return out if isinstance(out, (tuple, list)) else (out,)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-callback op (reference `py_func_op`): runs `func` on the host
+    via `jax.pure_callback`, shaped by the `out` template tensor(s)."""
+    import numpy as np
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), np.dtype(str(o._data.dtype)))
+              for o in outs]
+
+    def kernel(*arrs):
+        def host(*np_arrs):
+            r = func(*np_arrs)
+            rs = r if isinstance(r, (tuple, list)) else [r]
+            return tuple(np.asarray(v) for v in rs)
+
+        res = jax.pure_callback(host, tuple(shapes), *arrs)
+        return res if len(res) > 1 else res[0]
+
+    return apply("py_func", kernel, tuple(xs))

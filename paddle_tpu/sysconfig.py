@@ -1,0 +1,23 @@
+"""`paddle.sysconfig` parity (reference `python/paddle/sysconfig.py`):
+paths for building extensions against the framework."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory containing framework headers for custom native extensions
+    (the `cpp_extension` build includes it by default)."""
+    return os.path.join(_ROOT, "include")
+
+
+def get_lib():
+    """Directory containing compiled native libraries (the
+    `cpp_extension.load` build cache)."""
+    from .utils.cpp_extension import get_build_directory
+
+    return get_build_directory()

@@ -1,0 +1,115 @@
+"""paddle.static.nn builders + control flow (reference
+`python/paddle/static/nn/{common,control_flow}.py`)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import static
+
+
+class TestBuilders:
+    def test_fc_program(self):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("X", [None, 8], "float32")
+            h = static.nn.fc(x, 16, activation="relu")
+            h = static.nn.layer_norm(h)
+            out = static.nn.fc(h, 4)
+        exe = static.Executor()
+        res = exe.run(main,
+                      feed={"X": np.random.randn(5, 8).astype(np.float32)},
+                      fetch_list=[out])
+        assert res[0].shape == (5, 4)
+
+    def test_conv_and_norms(self):
+        x = pt.to_tensor(np.random.randn(2, 3, 8, 8).astype(np.float32))
+        y = static.nn.conv2d(x, 6, 3, padding=1, act="relu")
+        assert y.shape == [2, 6, 8, 8] and float(y.min().numpy()) >= 0
+        y = static.nn.batch_norm(y)
+        assert y.shape == [2, 6, 8, 8]
+        y = static.nn.group_norm(y, groups=2)
+        assert y.shape == [2, 6, 8, 8]
+        y = static.nn.instance_norm(y)
+        assert y.shape == [2, 6, 8, 8]
+        up = static.nn.conv2d_transpose(x, 4, 2, stride=2)
+        assert up.shape[1] == 4 and up.shape[2] == 16
+        v = pt.to_tensor(np.random.randn(2, 3, 4, 4, 4).astype(np.float32))
+        assert static.nn.conv3d(v, 5, 3, padding=1).shape == [2, 5, 4, 4, 4]
+
+    def test_fc_flatten_dims(self):
+        x = pt.to_tensor(np.random.randn(2, 3, 4).astype(np.float32))
+        # nfd=1: trailing dims flatten into features -> [2, 5]
+        assert static.nn.fc(x, 5).shape == [2, 5]
+        # nfd=2: leading [2, 3] preserved -> [2, 3, 5]
+        assert static.nn.fc(x, 5, num_flatten_dims=2).shape == [2, 3, 5]
+
+    def test_batch_norm_3d(self):
+        v = pt.to_tensor(np.random.randn(2, 3, 4, 4, 4).astype(np.float32))
+        y = static.nn.batch_norm(static.nn.conv3d(v, 5, 3, padding=1))
+        assert y.shape == [2, 5, 4, 4, 4]
+
+    def test_embedding_prelu_bilinear(self):
+        ids = pt.to_tensor(np.array([[1, 2], [3, 0]], np.int64))
+        e = static.nn.embedding(ids, size=[10, 6])
+        assert e.shape == [2, 2, 6]
+        x = pt.to_tensor(np.random.randn(2, 4).astype(np.float32))
+        assert static.nn.prelu(x).shape == [2, 4]
+        a = pt.to_tensor(np.random.randn(3, 4).astype(np.float32))
+        b = pt.to_tensor(np.random.randn(3, 5).astype(np.float32))
+        assert static.nn.bilinear_tensor_product(a, b, 7).shape == [3, 7]
+
+
+class TestControlFlow:
+    def test_cond(self):
+        p = pt.to_tensor(np.array(1.0, np.float32))
+        t = lambda: pt.to_tensor(np.float32(2.0)) * 3  # noqa: E731
+        f = lambda: pt.to_tensor(np.float32(-1.0))  # noqa: E731
+        assert float(static.nn.cond(p > 0, t, f).numpy()) == 6.0
+        assert float(static.nn.cond(p < 0, t, f).numpy()) == -1.0
+
+    def test_case_first_match_wins(self):
+        p = pt.to_tensor(np.array(1.0, np.float32))
+        got = static.nn.case(
+            [(p > 0, lambda: pt.to_tensor(np.float32(1.0))),
+             (p > -1, lambda: pt.to_tensor(np.float32(2.0)))],
+            default=lambda: pt.to_tensor(np.float32(9.0)))
+        assert float(got.numpy()) == 1.0
+        got = static.nn.case(
+            [(p < 0, lambda: pt.to_tensor(np.float32(1.0)))],
+            default=lambda: pt.to_tensor(np.float32(9.0)))
+        assert float(got.numpy()) == 9.0
+        with pytest.raises(ValueError):
+            static.nn.case([])
+
+    def test_switch_case(self):
+        fns = [lambda: pt.to_tensor(np.float32(10.0)),
+               lambda: pt.to_tensor(np.float32(20.0)),
+               lambda: pt.to_tensor(np.float32(30.0))]
+        idx = pt.to_tensor(np.array(1, np.int32))
+        assert float(static.nn.switch_case(idx, fns).numpy()) == 20.0
+        # dict with sparse keys goes through the case() chain
+        got = static.nn.switch_case(
+            pt.to_tensor(np.array(7, np.int32)),
+            {2: fns[0], 7: fns[1]},
+            default=lambda: pt.to_tensor(np.float32(0.0)))
+        assert float(got.numpy()) == 20.0
+        # out-of-range (incl. negative) index dispatches to default
+        neg = static.nn.switch_case(
+            pt.to_tensor(np.array(-1, np.int32)), fns[:2],
+            default=lambda: pt.to_tensor(np.float32(99.0)))
+        assert float(neg.numpy()) == 99.0
+
+    def test_while_loop(self):
+        i = pt.to_tensor(np.array(0, np.int32))
+        s = pt.to_tensor(np.array(0.0, np.float32))
+        iv, sv = static.nn.while_loop(
+            lambda i, s: i < 5,
+            lambda i, s: (i + 1, s + i.astype("float32")), (i, s))
+        assert int(iv.numpy()) == 5 and float(sv.numpy()) == 10.0
+
+    def test_py_func(self):
+        out = pt.zeros([3], "float32")
+        got = static.nn.py_func(
+            lambda a: a * 2 + 1,
+            pt.to_tensor(np.arange(3, dtype=np.float32)), out)
+        np.testing.assert_allclose(got.numpy(), [1, 3, 5])
