@@ -39,6 +39,39 @@ for _name in dir(tensor):
         globals().setdefault(_name, _fn)
 globals()["einsum"] = tensor.einsum
 
+# places / static-mode toggles / dtype + misc shims (reference top-level
+# long tail)
+from .framework.compat import (  # noqa: F401
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace, LazyGuard,
+    enable_static, disable_static, in_dynamic_mode, in_static_mode,
+    set_printoptions, finfo, iinfo, shape, rank, tolist,
+    is_floating_point, is_integer, is_complex, create_parameter,
+    get_cuda_rng_state, set_cuda_rng_state, check_shape,
+    disable_signal_handler,
+)
+def _make_inplace(_base):
+    from .tensor.manipulation import _adopt_inplace
+
+    def g(x, *args, **kwargs):
+        return _adopt_inplace(x, _base(x, *args, **kwargs))
+
+    g.__name__ = _base.__name__ + "_"
+    g.__doc__ = f"In-place variant of paddle.{_base.__name__}."
+    return g
+
+
+# module-level trailing-underscore inplace API (paddle convention); the
+# Tensor-method variants are bound by tensor.attach
+for _name in [
+    "abs", "acos", "addmm", "atan", "cos", "digamma", "erf", "expm1",
+    "frac", "i0", "index_add", "index_put", "lgamma", "log", "log10",
+    "log2", "logit", "neg", "polygamma", "pow", "sin", "sinh", "square",
+    "tan", "tanh", "tril", "triu", "trunc", "add", "subtract", "multiply",
+    "divide", "clip", "scale", "exp", "sqrt", "rsqrt", "ceil", "floor",
+    "round", "reciprocal", "sigmoid",
+]:
+    globals().setdefault(_name + "_", _make_inplace(getattr(tensor, _name)))
+
 rand = tensor.random.rand
 randn = tensor.random.randn
 randint = tensor.random.randint
@@ -95,6 +128,7 @@ _LAZY_ATTRS = {
     "Model": (".hapi.model", "Model"),
     "DataParallel": (".distributed.parallel", "DataParallel"),
     "batch": (".batch", "batch"),
+    "ParamAttr": (".nn.layer.layers", "ParamAttr"),
 }
 
 

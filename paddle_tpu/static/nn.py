@@ -121,7 +121,12 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,  # noqa: A002
     ch = input.shape[1] if data_layout.startswith("NC") else input.shape[-1]
     cls = {5: nn.BatchNorm3D, 4: nn.BatchNorm2D}.get(input.ndim,
                                                      nn.BatchNorm1D)
-    layer = cls(ch, momentum=momentum, epsilon=epsilon)
+    fmt = data_layout
+    if input.ndim == 5 and not data_layout.startswith("NC"):
+        fmt = "NDHWC"
+    layer = cls(ch, momentum=momentum, epsilon=epsilon,
+                weight_attr=param_attr, bias_attr=bias_attr,
+                data_format=fmt)
     if is_test:
         layer.eval()
     return _act(layer(input), act)
@@ -150,7 +155,10 @@ def group_norm(input, groups, epsilon=1e-5, param_attr=None,  # noqa: A002
                bias_attr=None, act=None, data_layout="NCHW", name=None):
     from .. import nn
 
-    layer = nn.GroupNorm(groups, input.shape[1], epsilon=epsilon)
+    ch = input.shape[1] if data_layout.startswith("NC") else input.shape[-1]
+    layer = nn.GroupNorm(groups, ch, epsilon=epsilon,
+                         weight_attr=param_attr, bias_attr=bias_attr,
+                         data_format=data_layout)
     return _act(layer(input), act)
 
 
@@ -243,11 +251,10 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
     def kernel(idx):
         i = idx.reshape(()).astype("int32")
-        if default is not None:
-            # any out-of-range index (negative included) runs default
-            i = jax.numpy.where((i < 0) | (i >= n_real), n_real, i)
-        else:
-            i = jax.numpy.clip(i, 0, n_real - 1)
+        # reference contract: an unmatched index runs `default`, or the
+        # largest-index branch when no default was given
+        fallback = n_real if default is not None else n_real - 1
+        i = jax.numpy.where((i < 0) | (i >= n_real), fallback, i)
         return jax.lax.switch(i, [lambda f=f: _strip(f()) for f in fns])
 
     return apply("switch_case", kernel, (branch_index,))

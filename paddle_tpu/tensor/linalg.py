@@ -258,3 +258,31 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
     l, u = apply("lu_unpack", split_lu, (x,))
     pmat = apply_nondiff("lu_unpack_pivots", perm, (y,))
     return pmat, l, u
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Batched pairwise p-norm distance between row sets (parity:
+    paddle.cdist): x [*, P, M], y [*, R, M] -> [*, P, R].
+
+    TPU note: for p=2 the squared-expansion form rides the MXU as one
+    batched matmul (the reference's use_mm_for_euclid_dist path); other p
+    use the broadcast |diff|^p reduction."""
+
+    def f(a, b):
+        if p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist":
+            a2 = jnp.sum(a * a, axis=-1)[..., :, None]
+            b2 = jnp.sum(b * b, axis=-1)[..., None, :]
+            ab = jnp.einsum("...pm,...rm->...pr", a, b)
+            sq = jnp.maximum(a2 + b2 - 2.0 * ab, 0.0)
+            return jnp.sqrt(sq)
+        import math as _math
+
+        diff = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 0.0:
+            return jnp.sum((diff != 0).astype(a.dtype), axis=-1)
+        if _math.isinf(p):
+            return jnp.max(diff, axis=-1)
+        return jnp.sum(diff ** p, axis=-1) ** (1.0 / p)
+
+    return apply("cdist", f, (x, y))

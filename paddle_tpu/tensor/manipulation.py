@@ -579,3 +579,56 @@ def fill(x, value, name=None):
 def fill_(x, value, name=None):
     """In-place variant (parity: Tensor.fill_)."""
     return _adopt_inplace(x, fill(x, value))
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather over x.flatten() (parity: paddle.take).
+    mode: 'raise' validates on the host when possible, 'wrap' wraps
+    negative/overflowing indices, 'clip' clamps to the valid range.
+    Under jit 'raise' behaves like 'clip' (no data-dependent errors in a
+    compiled program)."""
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(f"take mode must be raise|wrap|clip, got {mode!r}")
+
+    def f(a, idx):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        i = idx.astype(jnp.int64) if idx.dtype == jnp.int64 \
+            else idx.astype(jnp.int32)
+        if mode == "wrap":
+            i = jnp.mod(i, n)
+        else:
+            i = jnp.clip(jnp.where(i < 0, i + n, i), 0, n - 1)
+        return jnp.take(flat, i)
+
+    return apply("take", f, (x, index))
+
+
+def unflatten(x, axis, shape, name=None):
+    """Split dim ``axis`` into ``shape`` (parity: paddle.unflatten).
+    One entry of shape may be -1 (inferred)."""
+    from .. import tensor as _t  # noqa: F401 — keep import style uniform
+
+    shape = list(int(s) for s in (shape.tolist()
+                                  if hasattr(shape, "tolist") else shape))
+    ax = axis % x.ndim
+    dim = x.shape[ax]
+    if shape.count(-1) > 1:
+        raise ValueError("unflatten shape can have at most one -1")
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape[shape.index(-1)] = dim // known
+
+    def f(a):
+        return a.reshape(tuple(a.shape[:ax]) + tuple(shape)
+                         + tuple(a.shape[ax + 1:]))
+
+    return apply("unflatten", f, (x,))
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of flip (parity: paddle.reverse -> flip)."""
+    return flip(x, axis)

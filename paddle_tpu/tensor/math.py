@@ -484,3 +484,79 @@ def frobenius_norm(x, axis=None, keepdim=False, name=None):
         return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
 
     return apply("frobenius_norm", f, (x,))
+
+
+def sgn(x, name=None):
+    """Sign for real inputs; x/|x| (unit phasor, 0 at 0) for complex
+    (parity: paddle.sgn, `sgn` op)."""
+    return apply("sgn", jnp.sign, (x,))
+
+
+def frexp(x, name=None):
+    """Decompose into mantissa in [0.5, 1) and integer exponent so that
+    x = m * 2**e (parity: paddle.frexp)."""
+
+    def f(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(jnp.int32)
+
+    return apply("frexp", f, (x,))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Trapezoidal-rule integral along ``axis`` (parity: paddle.trapezoid)."""
+    if x is not None and dx is not None:
+        raise ValueError("trapezoid accepts x or dx, not both")
+    operands = (y,) if x is None else (y, x)
+    d = 1.0 if dx is None else dx
+
+    def f(ya, *rest):
+        if rest:
+            xa = rest[0]
+            return jnp.trapezoid(ya, x=xa, axis=axis)
+        return jnp.trapezoid(ya, dx=d, axis=axis)
+
+    return apply("trapezoid", f, operands)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Cumulative trapezoidal integral along ``axis`` (parity:
+    paddle.cumulative_trapezoid): out[i] = integral of y[..:i+1]."""
+    if x is not None and dx is not None:
+        raise ValueError("cumulative_trapezoid accepts x or dx, not both")
+    operands = (y,) if x is None else (y, x)
+    d = 1.0 if dx is None else dx
+
+    def f(ya, *rest):
+        ax = axis % ya.ndim
+
+        def take_slice(a, sl):
+            idx = [slice(None)] * a.ndim
+            idx[ax] = sl
+            return a[tuple(idx)]
+
+        pair = (take_slice(ya, slice(1, None))
+                + take_slice(ya, slice(None, -1))) / 2.0
+        if rest:
+            xa = rest[0]
+            if xa.ndim == 1:
+                shape = [1] * ya.ndim
+                shape[ax] = -1
+                xa = xa.reshape(shape)
+            step = (take_slice(xa, slice(1, None))
+                    - take_slice(xa, slice(None, -1)))
+        else:
+            step = d
+        return jnp.cumsum(pair * step, axis=ax)
+
+    return apply("cumulative_trapezoid", f, operands)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """Vandermonde matrix of a 1-D tensor (parity: paddle.vander)."""
+    cols = x.shape[0] if n is None else int(n)
+
+    def f(a):
+        return jnp.vander(a, N=cols, increasing=increasing)
+
+    return apply("vander", f, (x,))

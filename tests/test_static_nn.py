@@ -48,6 +48,15 @@ class TestBuilders:
         y = static.nn.batch_norm(static.nn.conv3d(v, 5, 3, padding=1))
         assert y.shape == [2, 5, 4, 4, 4]
 
+    def test_batch_norm_nhwc(self):
+        x = pt.to_tensor(np.random.randn(2, 8, 8, 3).astype(np.float32))
+        y = static.nn.batch_norm(x, data_layout="NHWC")
+        assert y.shape == [2, 8, 8, 3]
+        g = static.nn.group_norm(
+            pt.to_tensor(np.random.randn(2, 8, 8, 4).astype(np.float32)),
+            groups=2, data_layout="NHWC")
+        assert g.shape == [2, 8, 8, 4]
+
     def test_embedding_prelu_bilinear(self):
         ids = pt.to_tensor(np.array([[1, 2], [3, 0]], np.int64))
         e = static.nn.embedding(ids, size=[10, 6])
@@ -98,6 +107,10 @@ class TestControlFlow:
             pt.to_tensor(np.array(-1, np.int32)), fns[:2],
             default=lambda: pt.to_tensor(np.float32(99.0)))
         assert float(neg.numpy()) == 99.0
+        # no default: unmatched index runs the largest-index branch
+        nd = static.nn.switch_case(
+            pt.to_tensor(np.array(-1, np.int32)), fns)
+        assert float(nd.numpy()) == 30.0
 
     def test_while_loop(self):
         i = pt.to_tensor(np.array(0, np.int32))
