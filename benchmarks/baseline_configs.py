@@ -35,7 +35,9 @@ def bench_resnet50(smoke):
     if smoke:
         batch, hw, steps, warmup, depth_kw = 4, 32, 2, 1, {"num_classes": 10}
     else:
-        batch, hw, steps, warmup, depth_kw = 256, 224, 10, 2, {}
+        # b128 keeps the remote-tunnel compile tractable (b256 exceeded
+        # the tunnel's compile budget in round-3 runs)
+        batch, hw, steps, warmup, depth_kw = 128, 224, 10, 2, {}
     model = resnet50(**depth_kw)
     model = pt.amp.decorate(model, level="O2", dtype="bfloat16")
     opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,

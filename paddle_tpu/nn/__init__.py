@@ -10,6 +10,7 @@ from .layer.layers import (  # noqa: F401
 )
 from .layer.common import (  # noqa: F401
     Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
+    Unflatten,
     Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, Pad1D, Pad2D, Pad3D,
     ZeroPad2D, PixelShuffle, PixelUnshuffle, ChannelShuffle, Bilinear,
     CosineSimilarity, PairwiseDistance, Unfold, Fold,
@@ -26,18 +27,20 @@ from .layer.pooling import (  # noqa: F401
     MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
     AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, LPPool2D,
+    MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
 )
 from .layer.activation import (  # noqa: F401
     ReLU, ReLU6, Sigmoid, LogSigmoid, Tanh, Tanhshrink, GELU, SiLU, Swish,
     Mish, LeakyReLU, ELU, SELU, CELU, Hardtanh, Hardshrink, Softshrink,
     Hardsigmoid, Hardswish, Softplus, Softsign, Softmax, LogSoftmax, Maxout,
-    GLU, RReLU, PReLU,
+    GLU, RReLU, PReLU, Silu, ThresholdedReLU, Softmax2D,
 )
 from .layer.loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     SmoothL1Loss, KLDivLoss, MarginRankingLoss, HingeEmbeddingLoss,
     CosineEmbeddingLoss, TripletMarginLoss, MultiLabelSoftMarginLoss,
-    SoftMarginLoss, CTCLoss,
+    SoftMarginLoss, CTCLoss, PoissonNLLLoss, GaussianNLLLoss,
+    MultiMarginLoss, TripletMarginWithDistanceLoss, RNNTLoss, HSigmoidLoss,
 )
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
@@ -45,6 +48,7 @@ from .layer.transformer import (  # noqa: F401
 )
 from .layer.rnn import (  # noqa: F401
     SimpleRNNCell, LSTMCell, GRUCell, SimpleRNN, LSTM, GRU, RNN, BiRNN,
+    RNNCellBase, BeamSearchDecoder, dynamic_decode,
 )
 
 from . import utils  # noqa: F401

@@ -13,6 +13,7 @@ from .common import (  # noqa: F401
     pairwise_distance, pixel_shuffle, pixel_unshuffle, channel_shuffle,
     interpolate, upsample, unfold, fold, bilinear, grid_sample, affine_grid,
     sequence_mask, class_center_sample, gather_tree, temporal_shift,
+    diag_embed, sparse_attention,
 )
 from .conv import (  # noqa: F401
     conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
@@ -34,7 +35,9 @@ from .loss import (  # noqa: F401
     kl_div, margin_ranking_loss, hinge_embedding_loss, cosine_embedding_loss,
     triplet_margin_loss, multi_label_soft_margin_loss, soft_margin_loss,
     square_error_cost, log_loss, ctc_loss, sigmoid_focal_loss, huber_loss,
-    edit_distance, hsigmoid_loss,
+    edit_distance, hsigmoid_loss, poisson_nll_loss, gaussian_nll_loss,
+    multi_margin_loss, triplet_margin_with_distance_loss, dice_loss,
+    npair_loss, rnnt_loss, margin_cross_entropy,
 )
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, ring_flash_attention,

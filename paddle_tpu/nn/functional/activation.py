@@ -232,3 +232,24 @@ def thresholded_relu(x, threshold=1.0, name=None):
     return apply("thresholded_relu",
                  lambda a: jnp.where(a > threshold, a, jnp.zeros((), a.dtype)),
                  (x,))
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    """In-place variant of hardtanh."""
+    from ...tensor.manipulation import _adopt_inplace
+
+    return _adopt_inplace(x, hardtanh(x, min, max))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    """In-place variant of leaky_relu."""
+    from ...tensor.manipulation import _adopt_inplace
+
+    return _adopt_inplace(x, leaky_relu(x, negative_slope))
+
+
+def thresholded_relu_(x, threshold=1.0, name=None):
+    """In-place variant of thresholded_relu."""
+    from ...tensor.manipulation import _adopt_inplace
+
+    return _adopt_inplace(x, thresholded_relu(x, threshold))

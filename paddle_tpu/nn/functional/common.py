@@ -512,3 +512,58 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
         return out
 
     return apply("temporal_shift", fn, (x,))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):  # noqa: A002
+    """Alias of paddle.diag_embed at the functional namespace (parity:
+    paddle.nn.functional.diag_embed)."""
+    from ...tensor.manipulation import diag_embed as _de
+
+    return _de(input, offset=offset, dim1=dim1, dim2=dim2)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention with a CSR-described visibility pattern
+    (parity: paddle.nn.functional.sparse_attention, a CUDA-only op in the
+    reference). TPU build: the CSR pattern becomes an additive mask and
+    the matmuls stay dense on the MXU — at the sparsity levels this API
+    targets the MXU's dense throughput beats a gather-based kernel.
+    query/key/value: [B, H, T, D]; offset: [B, H, T+1]; columns [B, H, nnz].
+    """
+    import jax
+
+    has_kp = key_padding_mask is not None
+
+    def f(q, k, v, off, cols, *rest):
+        b, h, t, d = q.shape
+        nnz = cols.shape[-1]
+        # row id of each nnz entry: searchsorted over the offset vector
+        row_of = jax.vmap(jax.vmap(
+            lambda o, c: jnp.searchsorted(o, jnp.arange(nnz), side="right")
+            - 1))(off, cols)
+        mask = jnp.zeros((b, h, t, t), bool)
+        b_idx = jnp.arange(b)[:, None, None]
+        h_idx = jnp.arange(h)[None, :, None]
+        mask = mask.at[b_idx, h_idx, row_of, cols.astype(jnp.int32)].set(True)
+        bias = jnp.where(mask, 0.0, -1e30).astype(q.dtype)
+        i = 0
+        if has_kp:
+            kp = rest[i]  # [B, T] 0/1 key padding
+            i += 1
+            bias = bias + (kp[:, None, None, :] - 1.0) * 1e30
+        if i < len(rest):
+            am = rest[i]  # additive [.., T, T] attention mask
+            bias = bias + jnp.broadcast_to(am, bias.shape).astype(q.dtype)
+        logits = jnp.einsum("bhtd,bhsd->bhts", q, k) / jnp.sqrt(
+            jnp.asarray(d, q.dtype))
+        p = jax.nn.softmax(logits + bias, axis=-1)
+        return jnp.einsum("bhts,bhsd->bhtd", p, v)
+
+    operands = (query, key, value, sparse_csr_offset, sparse_csr_columns)
+    if key_padding_mask is not None:
+        operands = operands + (key_padding_mask,)
+    if attn_mask is not None:
+        operands = operands + (attn_mask,)
+    return apply("sparse_attention", f, operands)

@@ -485,3 +485,226 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
 
     operands = (input, weight) + ((bias,) if bias is not None else ())
     return apply("hsigmoid_loss", fn, operands)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,  # noqa: A002
+                     epsilon=1e-8, reduction="mean", name=None):
+    """Poisson NLL (parity: paddle.nn.functional.poisson_nll_loss)."""
+
+    def f(x, t):
+        if log_input:
+            out = jnp.exp(x) - t * x
+        else:
+            out = x - t * jnp.log(x + epsilon)
+        if full:
+            # Stirling approximation for log(t!) at t > 1
+            stirling = t * jnp.log(t) - t + 0.5 * jnp.log(2 * jnp.pi * t)
+            out = out + jnp.where(t > 1, stirling, jnp.zeros((), x.dtype))
+        return _reduce(out, reduction)
+
+    return apply("poisson_nll_loss", f, (input, label))
+
+
+def gaussian_nll_loss(input, label, variance, full=False,  # noqa: A002
+                      epsilon=1e-6, reduction="mean", name=None):
+    """Gaussian NLL with predicted variance (parity:
+    paddle.nn.functional.gaussian_nll_loss)."""
+
+    def f(mu, t, var):
+        var = jnp.maximum(var, epsilon)
+        out = 0.5 * (jnp.log(var) + (t - mu) ** 2 / var)
+        if full:
+            out = out + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, mu.dtype))
+        return _reduce(out, reduction)
+
+    return apply("gaussian_nll_loss", f, (input, label, variance))
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,  # noqa: A002
+                      reduction="mean", name=None):
+    """Multi-class margin (hinge) loss (parity:
+    paddle.nn.functional.multi_margin_loss). input [N, C], label [N]."""
+    operands = (input, label) + ((weight,) if weight is not None else ())
+
+    def f(x, t, *rest):
+        n, c = x.shape
+        t = t.reshape(-1).astype(jnp.int32)
+        x_t = jnp.take_along_axis(x, t[:, None], axis=1)
+        m = jnp.maximum(margin - x_t + x, 0.0) ** p
+        if rest:
+            m = m * rest[0][t][:, None]
+        # the target class itself contributes 0
+        m = m * (1 - jax.nn.one_hot(t, c, dtype=x.dtype))
+        out = jnp.sum(m, axis=1) / c
+        return _reduce(out, reduction)
+
+    return apply("multi_margin_loss", f, operands)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,  # noqa: A002
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Triplet loss with a custom distance callable (parity:
+    paddle.nn.functional.triplet_margin_with_distance_loss)."""
+    if distance_function is None:
+        def distance_function(a, b):
+            return ((a - b) ** 2).sum(-1).sqrt()
+
+    d_ap = distance_function(input, positive)
+    d_an = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        d_an = apply("minimum", jnp.minimum, (d_an, d_pn))
+    hinge = apply("relu", jax.nn.relu, (d_ap - d_an + margin,))
+    if reduction == "none":
+        return hinge
+    return apply("reduce_" + reduction,
+                 (jnp.mean if reduction == "mean" else jnp.sum), (hinge,))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    """Dice loss over the last (class-prob) axis (parity:
+    paddle.nn.functional.dice_loss): input [..., C] probs, label [..., 1]."""
+
+    def f(x, t):
+        c = x.shape[-1]
+        t1 = jax.nn.one_hot(t.squeeze(-1).astype(jnp.int32), c,
+                            dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * t1, axis=red)
+        union = jnp.sum(x, axis=red) + jnp.sum(t1, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+    return apply("dice_loss", f, (input, label))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair loss (parity: paddle.nn.functional.npair_loss)."""
+
+    def f(a, p, lab):
+        lab = lab.reshape(-1)
+        sim = a @ p.T  # [N, N]
+        tgt = (lab[:, None] == lab[None, :]).astype(a.dtype)
+        tgt = tgt / jnp.maximum(jnp.sum(tgt, axis=1, keepdims=True), 1.0)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        xent_r = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        logp_c = jax.nn.log_softmax(sim.T, axis=1)
+        xent_c = -jnp.mean(jnp.sum(tgt * logp_c, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1))
+                        + jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        return (xent_r + xent_c) / 2.0 + reg
+
+    return apply("npair_loss", f, (anchor, positive, labels))
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,  # noqa: A002
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (parity: paddle.nn.functional.rnnt_loss; the
+    reference links warprnnt — here the (T, U) lattice runs as a pure XLA
+    program: scan over T, and the within-row recurrence
+    alpha(t,u) = logaddexp(b(u), alpha(t,u-1) + emit(u-1)) is solved in
+    closed form with an associative log-cumsum-exp, so each row is
+    parallel over U on the VPU instead of a sequential loop).
+
+    input: [B, T, U+1, C] logits; label: [B, U].
+    """
+
+    def f(logits, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        B, T, U1, _ = lp.shape
+        lab = lab.astype(jnp.int32)
+        blank_lp = lp[..., blank]                      # [B, T, U+1]
+        emit_lp = jnp.take_along_axis(
+            lp[:, :, :U1 - 1, :],
+            jnp.broadcast_to(lab[:, None, :, None], (B, T, U1 - 1, 1)),
+            axis=-1)[..., 0]                           # [B, T, U]
+        if fastemit_lambda:
+            # FastEmit (arXiv:2010.11148) as arc scaling: emit transitions
+            # weighted up by (1+lambda) in log space (the k2-style loss
+            # form of the paper's gradient blending), biasing alignments
+            # toward earlier emissions
+            emit_lp = emit_lp + float(np.log1p(fastemit_lambda))
+        neg_inf = jnp.asarray(-1e30, jnp.float32)
+
+        def logcumsumexp(z):
+            # streaming logsumexp as an associative (max, scaled-sum) pair
+            # — the flash-attention running-max trick, scan-parallel
+            def comb(a, b):
+                m1, s1 = a
+                m2, s2 = b
+                m = jnp.maximum(m1, m2)
+                return m, s1 * jnp.exp(m1 - m) + s2 * jnp.exp(m2 - m)
+
+            m, s = jax.lax.associative_scan(
+                comb, (z, jnp.ones_like(z)), axis=-1)
+            return m + jnp.log(s)
+
+        def row_solve(b_row, e_row):
+            # a(u) = logaddexp(b(u), a(u-1) + e(u-1)) solved as
+            # a = Ecum + logcumsumexp(b - Ecum), Ecum(u) = sum_{w<u} e(w)
+            ecum = jnp.concatenate(
+                [jnp.zeros_like(e_row[..., :1]),
+                 jnp.cumsum(e_row, axis=-1)], axis=-1)  # [B, U+1]
+            return ecum + logcumsumexp(b_row - ecum)
+
+        # t = 0 row: alpha(0,u) = cumsum of emit(0, :u)
+        first_b = jnp.concatenate(
+            [jnp.zeros((B, 1), jnp.float32),
+             jnp.full((B, U1 - 1), neg_inf)], axis=-1)
+        alpha0 = row_solve(first_b, emit_lp[:, 0])
+
+        def step(alpha_prev, te):
+            blank_t, emit_t = te
+            b_row = alpha_prev + blank_t
+            alpha_t = row_solve(b_row, emit_t)
+            return alpha_t, alpha_t
+
+        _, rows = jax.lax.scan(
+            step, alpha0,
+            (jnp.swapaxes(blank_lp[:, :-1], 0, 1),
+             jnp.swapaxes(emit_lp[:, 1:], 0, 1)))
+        alphas = jnp.concatenate([alpha0[None], rows], axis=0)  # [T, B, U+1]
+        t_idx = in_len.astype(jnp.int32) - 1
+        u_idx = lab_len.astype(jnp.int32)
+        last = alphas[t_idx, jnp.arange(B)]                     # [B, U+1]
+        a_end = jnp.take_along_axis(last, u_idx[:, None], axis=1)[:, 0]
+        b_end = blank_lp[jnp.arange(B), t_idx, u_idx]
+        loss = -(a_end + b_end)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply("rnnt_loss", f,
+                 (input, label, input_lengths, label_lengths))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """Combined-margin softmax CE (ArcFace family; parity:
+    paddle.nn.functional.margin_cross_entropy). The target-class cosine
+    cos(theta) becomes cos(margin1*theta + margin2) - margin3, everything
+    scaled by `scale`. Under model parallelism the sharded-logits variant
+    is GSPMD's job: annotate the logits sharding and the same math
+    compiles to the collective form the reference hand-writes."""
+
+    def f(x, t):
+        n, c = x.shape
+        t = t.reshape(-1).astype(jnp.int32)
+        cos_t = jnp.clip(jnp.take_along_axis(x, t[:, None], axis=1),
+                         -1.0, 1.0)
+        theta = jnp.arccos(cos_t)
+        cos_m = jnp.cos(margin1 * theta + margin2) - margin3
+        oh = jax.nn.one_hot(t, c, dtype=x.dtype)
+        adj = x + oh * (cos_m - cos_t)
+        z = adj * scale
+        logp = jax.nn.log_softmax(z, axis=1)
+        loss = -jnp.take_along_axis(logp, t[:, None], axis=1)[:, 0]
+        sm = jnp.exp(logp)
+        loss = _reduce(loss, reduction)
+        return (loss, sm) if return_softmax else loss
+
+    return apply("margin_cross_entropy", f, (logits, label))

@@ -77,3 +77,23 @@ class PReLU(Layer):
 
     def forward(self, x):
         return F.prelu(x, self.weight, self._data_format)
+
+
+# reference exports both spellings; ThresholdedReLU rides the factory
+Silu = SiLU
+ThresholdedReLU = _act_layer("ThresholdedReLU", F.thresholded_relu,
+                             threshold=1.0)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW input (parity:
+    paddle.nn.Softmax2D)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(
+                f"Softmax2D expects 3D/4D input, got ndim={x.ndim}")
+        return F.softmax(x, axis=-3)

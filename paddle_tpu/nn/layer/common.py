@@ -259,3 +259,17 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, *self.args)
+
+
+class Unflatten(Layer):
+    """Split one dim into several (parity: paddle.nn.Unflatten)."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = shape
+
+    def forward(self, x):
+        from ...tensor.manipulation import unflatten
+
+        return unflatten(x, self.axis, self.shape)

@@ -104,3 +104,39 @@ class LPPool2D(_Pool):
                          kernel_size=kernel_size, stride=stride,
                          padding=padding, ceil_mode=ceil_mode,
                          data_format=data_format)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self._kw = dict(kernel_size=kernel_size, stride=stride,
+                        padding=padding, output_size=output_size,
+                        data_format=data_format)
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, **self._kw)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._kw = dict(kernel_size=kernel_size, stride=stride,
+                        padding=padding, output_size=output_size,
+                        data_format=data_format)
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, **self._kw)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._kw = dict(kernel_size=kernel_size, stride=stride,
+                        padding=padding, output_size=output_size,
+                        data_format=data_format)
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, **self._kw)

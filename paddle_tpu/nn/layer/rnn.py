@@ -8,6 +8,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...framework.core import Tensor
 from ...ops.dispatch import apply
@@ -323,3 +324,163 @@ class BiRNN(Layer):
         out_fw, st_fw = self.rnn_fw(inputs, fw_states)
         out_bw, st_bw = self.rnn_bw(inputs, bw_states)
         return M.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+# public alias (parity: paddle.nn.RNNCellBase)
+RNNCellBase = _RNNCellBase
+
+
+class BeamSearchDecoder(Layer):
+    """Beam-search decoding over an RNN cell (parity:
+    paddle.nn.BeamSearchDecoder, ref `nn/decode.py`).
+
+    The decoder contract is initialize() -> (inputs, states, finished) and
+    step(time, inputs, states) -> (outputs, states, next_inputs, finished),
+    driven by :func:`dynamic_decode`. Beams ride the batch axis ([B*K, ...])
+    so every step is one batched matmul on the MXU.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- tree helpers over (possibly nested) cell states --
+    @staticmethod
+    def _tree_map(fn, obj):
+        if isinstance(obj, (tuple, list)):
+            return tuple(BeamSearchDecoder._tree_map(fn, o) for o in obj)
+        return fn(obj)
+
+    def _tile_beam(self, t):
+        # [B, ...] -> [B*K, ...]
+        def f(a):
+            k = self.beam_size
+            return jnp.repeat(a, k, axis=0)
+
+        return apply("beam_tile", f, (t,))
+
+    def _gather_beam(self, t, parent):
+        # t: [B*K, ...], parent: [B, K] beam ids -> regathered [B*K, ...]
+        def f(a, p):
+            bk = a.shape[0]
+            b = p.shape[0]
+            k = self.beam_size
+            flat = (jnp.arange(b)[:, None] * k + p).reshape(-1)
+            del bk
+            return a[flat]
+
+        return apply("beam_gather", f, (t, parent))
+
+    def initialize(self, initial_states, batch_size=None, dtype="float32"):
+        from ...tensor import creation
+
+        states = self._tree_map(self._tile_beam, initial_states)
+        flat = states
+        while isinstance(flat, (tuple, list)):
+            flat = flat[0]
+        bk = flat.shape[0]
+        b = bk // self.beam_size
+        ids = creation.full([bk], self.start_token, "int64")
+        # log-prob state: beam 0 live, the rest muted so step 1 expands
+        # only one start beam per batch row
+        lp = np.full((b, self.beam_size), -1e9, np.float32)
+        lp[:, 0] = 0.0
+        self._log_probs = Tensor(jnp.asarray(lp))
+        self._seqs = None
+        finished = creation.zeros([b, self.beam_size], "bool")
+        return ids, states, finished
+
+    def step(self, time, inputs, states, **kwargs):
+        from ...tensor import creation  # noqa: F401
+
+        emb = self.embedding_fn(inputs) if self.embedding_fn else inputs
+        cell_out, next_states = self.cell(emb, states)
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+
+        k = self.beam_size
+        end = self.end_token
+
+        def f(lg, lp, fin):
+            bk, v = lg.shape
+            b = bk // k
+            logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+            logp = logp.reshape(b, k, v)
+            # a finished beam only extends with end_token at no cost
+            end_oh = jnp.where(jnp.arange(v) == end, 0.0, -1e30)
+            logp = jnp.where(fin[:, :, None], end_oh[None, None, :], logp)
+            scores = lp[:, :, None] + logp
+            top, idx = jax.lax.top_k(scores.reshape(b, k * v), k)
+            parent = (idx // v).astype(jnp.int32)
+            token = (idx % v).astype(jnp.int64)
+            fin_next = jnp.take_along_axis(fin, parent, axis=1) \
+                | (token == end)
+            return top, parent, token, fin_next
+
+        top, parent, token, fin_next = apply(
+            "beam_step", f, (logits, self._log_probs, kwargs["finished"]))
+        self._log_probs = top
+        next_states = self._tree_map(
+            lambda s: self._gather_beam(s, parent), next_states)
+        # sequence bookkeeping: regather history by parent, append token
+        def app(seq_or_none):
+            def g(tok, par, *rest):
+                tk = tok.reshape(-1, k)
+                if rest:
+                    prev = jnp.take_along_axis(rest[0], par[:, :, None],
+                                               axis=1)
+                    return jnp.concatenate([prev, tk[:, :, None]], axis=2)
+                return tk[:, :, None]
+
+            ops = (token, parent) + (() if seq_or_none is None
+                                     else (seq_or_none,))
+            return apply("beam_append", g, ops)
+
+        self._seqs = app(self._seqs)
+        next_inputs = token.reshape([-1])
+        return token, next_states, next_inputs, fin_next
+
+    def finalize(self):
+        """Returns predicted ids [B, T, K] (beam-major last, paddle
+        layout) and their scores [B, K]."""
+        from ...tensor import manipulation as M
+
+        return M.transpose(self._seqs, [0, 2, 1]), self._log_probs
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Drive a decoder until every beam finishes or ``max_step_num``
+    (parity: paddle.nn.dynamic_decode). Decoding is autoregressive and
+    length-dynamic, so the loop is host-driven; each step body is one
+    compiled batched program."""
+    from ...tensor import logic as tlogic
+
+    max_steps = int(max_step_num or 100)
+    inputs, states, finished = decoder.initialize(inits)
+    lengths = None
+    for t in range(max_steps):
+        _, states, inputs, finished = decoder.step(t, inputs, states,
+                                                   finished=finished)
+        if bool(tlogic.all(finished.reshape([-1])).numpy()):
+            break
+    ids, scores = decoder.finalize()
+    if output_time_major:
+        from ...tensor import manipulation as M
+
+        ids = M.transpose(ids, [1, 0, 2])
+    if return_length:
+        def f(s):
+            # time axis: 1 in [B, T, K] batch-major, 0 in [T, B, K]
+            return jnp.sum((s != decoder.end_token).astype(jnp.int32),
+                           axis=1 if not output_time_major else 0)
+
+        lengths = apply("beam_lengths", f, (ids,))
+        return ids, scores, lengths
+    return ids, scores

@@ -286,3 +286,93 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
         return jnp.sum(diff ** p, axis=-1) ** (1.0 / p)
 
     return apply("cdist", f, (x, y))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    """Covariance matrix (parity: paddle.linalg.cov)."""
+    operands = (x,) + ((fweights,) if fweights is not None else ()) \
+        + ((aweights,) if aweights is not None else ())
+
+    def f(a, *rest):
+        obs = a if rowvar else a.T
+        if obs.ndim == 1:
+            obs = obs[None]
+        fw = aw = None
+        idx = 0
+        if fweights is not None:
+            fw = rest[idx].astype(jnp.float32)
+            idx += 1
+        if aweights is not None:
+            aw = rest[idx].astype(jnp.float32)
+        w = None
+        if fw is not None:
+            w = fw
+        if aw is not None:
+            w = aw if w is None else w * aw
+        x32 = obs.astype(jnp.float32)
+        if w is None:
+            n = x32.shape[1]
+            mean = jnp.mean(x32, axis=1, keepdims=True)
+            xc = x32 - mean
+            denom = n - (1 if ddof else 0)
+            out = xc @ xc.T / jnp.maximum(denom, 1)
+        else:
+            wsum = jnp.sum(w)
+            mean = jnp.sum(x32 * w, axis=1, keepdims=True) / wsum
+            xc = x32 - mean
+            if ddof and aw is not None:
+                denom = wsum - jnp.sum(w * aw) / wsum
+            elif ddof:
+                denom = wsum - 1
+            else:
+                denom = wsum
+            out = (xc * w) @ xc.T / jnp.maximum(denom, 1e-12)
+        return out.astype(a.dtype)
+
+    return apply("cov", f, operands)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    """Pearson correlation matrix (parity: paddle.linalg.corrcoef)."""
+
+    def f(a):
+        obs = a if rowvar else a.T
+        if obs.ndim == 1:
+            obs = obs[None]
+        x32 = obs.astype(jnp.float32)
+        xc = x32 - jnp.mean(x32, axis=1, keepdims=True)
+        c = xc @ xc.T / jnp.maximum(x32.shape[1] - 1, 1)
+        d = jnp.sqrt(jnp.clip(jnp.diag(c), 1e-30, None))
+        out = jnp.clip(c / d[:, None] / d[None, :], -1.0, 1.0)
+        return out.astype(a.dtype)
+
+    return apply("corrcoef", f, (x,))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized low-rank PCA (parity: paddle.linalg.pca_lowrank;
+    Halko et al. randomized range finder — q x n matmuls ride the MXU,
+    the tiny QR/SVD run on the [*, q, q] core)."""
+    from ..framework import random as rng_mod
+
+    m, n = x.shape[-2], x.shape[-1]
+    rank = q if q is not None else min(6, m, n)
+    key = rng_mod.next_key()
+
+    def f(a):
+        xf = a.astype(jnp.float32)
+        c = jnp.mean(xf, axis=-2, keepdims=True) if center else 0.0
+        xc = xf - c
+        xt = jnp.swapaxes(xc, -1, -2)
+        g = jax.random.normal(key, (n, rank), jnp.float32)
+        y = xc @ g                                  # [*, m, q]
+        for _ in range(max(int(niter), 0)):
+            y, _ = jnp.linalg.qr(xc @ (xt @ y))
+        qmat, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qmat, -1, -2) @ xc         # [*, q, n]
+        u_small, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        u = qmat @ u_small
+        return (u.astype(a.dtype), s.astype(a.dtype),
+                jnp.swapaxes(vt, -1, -2).astype(a.dtype))
+
+    return apply("pca_lowrank", f, (x,))

@@ -1,0 +1,232 @@
+"""Round-3 nn surface completions: new losses (incl. RNN-T vs naive DP),
+beam search decode, unpool/unflatten layers, linalg cov/corrcoef/pca,
+sparse_attention (reference `python/paddle/nn/**`, `paddle/linalg.py`)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+
+def _t(a):
+    return pt.to_tensor(np.asarray(a))
+
+
+class TestNewLosses:
+    def test_poisson_nll(self):
+        x = _t(np.array([0.5, 1.0], np.float32))
+        y = _t(np.array([1.0, 2.0], np.float32))
+        got = float(F.poisson_nll_loss(x, y).numpy())
+        want = np.mean(np.exp([0.5, 1.0]) - np.array([1.0, 2.0])
+                       * np.array([0.5, 1.0]))
+        assert abs(got - want) < 1e-5
+
+    def test_gaussian_nll(self):
+        mu = _t(np.zeros(4, np.float32))
+        t = _t(np.ones(4, np.float32))
+        var = _t(np.full(4, 2.0, np.float32))
+        got = float(F.gaussian_nll_loss(mu, t, var).numpy())
+        want = 0.5 * (np.log(2.0) + 1.0 / 2.0)
+        assert abs(got - want) < 1e-5
+
+    def test_multi_margin(self):
+        x = _t(np.array([[0.1, 0.9, 0.2]], np.float32))
+        lab = _t(np.array([1]))
+        got = float(F.multi_margin_loss(x, lab).numpy())
+        # sum over j != t of max(0, 1 - x_t + x_j) / C
+        want = (max(0, 1 - 0.9 + 0.1) + max(0, 1 - 0.9 + 0.2)) / 3
+        assert abs(got - want) < 1e-5
+
+    def test_triplet_with_distance(self):
+        a = _t(np.zeros((2, 3), np.float32))
+        p = _t(np.ones((2, 3), np.float32) * 0.1)
+        n = _t(np.ones((2, 3), np.float32))
+        loss = float(F.triplet_margin_with_distance_loss(a, p, n).numpy())
+        d_ap = np.sqrt(3 * 0.01)
+        d_an = np.sqrt(3.0)
+        assert abs(loss - max(0, d_ap - d_an + 1.0)) < 1e-4
+        l1 = F.triplet_margin_with_distance_loss(
+            a, p, n, distance_function=lambda u, v: (u - v).abs().sum(-1))
+        assert abs(float(l1.numpy()) - max(0, 0.3 - 3.0 + 1.0)) < 1e-4
+
+    def test_dice_npair_finite(self):
+        probs = _t(np.random.RandomState(0).dirichlet(
+            np.ones(4), size=(2, 5)).astype(np.float32))
+        lab = _t(np.random.RandomState(1).randint(0, 4, (2, 5, 1)))
+        d = float(F.dice_loss(probs, lab).numpy())
+        assert 0.0 <= d <= 1.0
+        anchor = _t(np.random.RandomState(2).randn(4, 8).astype(np.float32))
+        pos = _t(np.random.RandomState(3).randn(4, 8).astype(np.float32))
+        labels = _t(np.array([0, 1, 0, 2]))
+        n = float(F.npair_loss(anchor, pos, labels).numpy())
+        assert np.isfinite(n) and n > 0
+
+    def test_rnnt_loss_vs_naive_dp(self):
+        rng = np.random.RandomState(0)
+        B, T, U, C = 2, 4, 3, 5
+        logits = rng.randn(B, T, U + 1, C).astype(np.float32)
+        label = rng.randint(1, C, (B, U))
+        in_len = np.array([4, 3], np.int32)
+        lab_len = np.array([3, 2], np.int32)
+
+        def naive(b):
+            lp = logits[b] - np.log(
+                np.exp(logits[b]).sum(-1, keepdims=True))
+            tl, ul = in_len[b], lab_len[b]
+            alpha = np.full((tl, ul + 1), -np.inf)
+            alpha[0, 0] = 0.0
+            for t in range(tl):
+                for u in range(ul + 1):
+                    if t == 0 and u == 0:
+                        continue
+                    terms = []
+                    if t > 0:
+                        terms.append(alpha[t - 1, u] + lp[t - 1, u, 0])
+                    if u > 0:
+                        terms.append(alpha[t, u - 1]
+                                     + lp[t, u - 1, label[b, u - 1]])
+                    alpha[t, u] = np.logaddexp.reduce(terms)
+            return -(alpha[tl - 1, ul] + lp[tl - 1, ul, 0])
+
+        want = np.array([naive(0), naive(1)])
+        got = F.rnnt_loss(_t(logits), _t(label), _t(in_len), _t(lab_len),
+                          blank=0, fastemit_lambda=0.0,
+                          reduction="none").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+        # FastEmit arc scaling strictly lowers the loss (emit arcs gain
+        # log1p(lambda) mass)
+        fe = F.rnnt_loss(_t(logits), _t(label), _t(in_len), _t(lab_len),
+                         blank=0, fastemit_lambda=0.1,
+                         reduction="none").numpy()
+        assert (fe < want).all()
+
+    def test_margin_cross_entropy(self):
+        rng = np.random.RandomState(0)
+        feat = rng.randn(4, 6).astype(np.float32)
+        feat /= np.linalg.norm(feat, axis=1, keepdims=True)
+        lab = _t(np.array([0, 1, 2, 3]))
+        loss = F.margin_cross_entropy(_t(feat), lab)
+        # margins make the target harder: loss above plain scaled CE
+        plain = F.cross_entropy(_t(feat * 64.0), lab.unsqueeze(-1))
+        assert float(loss.numpy()) > float(plain.numpy())
+        loss2, sm = F.margin_cross_entropy(_t(feat), lab,
+                                           return_softmax=True)
+        np.testing.assert_allclose(sm.numpy().sum(-1), 1.0, rtol=1e-4)
+
+
+class TestLayersAndDecode:
+    def test_new_layers_forward(self):
+        x = _t(np.random.randn(2, 3, 8, 8).astype(np.float32))
+        assert pt.nn.Silu()(x).shape == [2, 3, 8, 8]
+        assert pt.nn.ThresholdedReLU()(x).shape == [2, 3, 8, 8]
+        sm = pt.nn.Softmax2D()(x)
+        np.testing.assert_allclose(sm.numpy().sum(1), 1.0, rtol=1e-5)
+        u = pt.nn.Unflatten(1, [3, 1])(_t(np.zeros((2, 3), np.float32)))
+        assert u.shape == [2, 3, 1]
+        loss = pt.nn.RNNTLoss()(
+            _t(np.random.randn(1, 3, 3, 4).astype(np.float32)),
+            _t(np.array([[1, 2]])), _t(np.array([3], np.int32)),
+            _t(np.array([2], np.int32)))
+        assert np.isfinite(float(loss.numpy()))
+        h = pt.nn.HSigmoidLoss(8, 6)
+        out = h(_t(np.random.randn(3, 8).astype(np.float32)),
+                _t(np.random.randint(0, 6, (3, 1))))
+        assert np.isfinite(float(out.numpy().sum()))
+
+    def test_max_unpool_roundtrip(self):
+        x = _t(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        pooled, idx = F.max_pool2d(x, 2, return_mask=True)
+        un = pt.nn.MaxUnPool2D(2)(pooled, idx)
+        assert un.shape == [1, 1, 4, 4]
+        # max positions hold their value, everything else zero
+        assert float(un.numpy().sum()) == float(pooled.numpy().sum())
+
+    def test_beam_search_decode(self):
+        """A rigged cell that always prefers a fixed token until EOS:
+        beam search must find that sequence and stop early."""
+        V, H = 6, 6
+        eos = 5
+
+        class RiggedCell(pt.nn.Layer):
+            def forward(self, inputs, states):
+                # favor token (prev + 1), then eos after token 3
+                prev = inputs.astype("int64")
+                nxt = pt.minimum(prev + 1, _t(np.int64(eos)))
+                logits = F.one_hot(nxt, V) * 10.0
+                return logits, states
+
+        dec = pt.nn.BeamSearchDecoder(RiggedCell(), start_token=0,
+                                      end_token=eos, beam_size=2)
+        init_states = _t(np.zeros((2, H), np.float32))
+        ids, scores = pt.nn.dynamic_decode(dec, inits=init_states,
+                                           max_step_num=10)
+        b, t, k = ids.shape
+        assert k == 2 and t <= 10
+        best = ids.numpy()[:, :, 0]
+        # expected: 1 2 3 4 5(eos)
+        np.testing.assert_array_equal(best[0][:5], [1, 2, 3, 4, 5])
+        assert scores.shape == [2, 2]
+        ids2, _, lengths = pt.nn.dynamic_decode(
+            dec, inits=init_states, max_step_num=10, return_length=True)
+        assert lengths.shape == [2, 2]  # per-beam lengths, batch-major
+        assert int(lengths.numpy()[0, 0]) == 4  # 1 2 3 4 before eos
+
+
+class TestLinalgAdditions:
+    def test_cov_corrcoef(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(3, 50).astype(np.float32)
+        np.testing.assert_allclose(pt.linalg.cov(_t(x)).numpy(),
+                                   np.cov(x), rtol=1e-4)
+        np.testing.assert_allclose(pt.linalg.corrcoef(_t(x)).numpy(),
+                                   np.corrcoef(x), rtol=1e-4, atol=1e-5)
+        fw = np.array([1, 2] * 25, np.int32)
+        np.testing.assert_allclose(
+            pt.linalg.cov(_t(x), fweights=_t(fw)).numpy(),
+            np.cov(x, fweights=fw), rtol=1e-4)
+
+    def test_pca_lowrank(self):
+        rng = np.random.RandomState(0)
+        base = rng.randn(40, 3).astype(np.float32)
+        x = base @ rng.randn(3, 20).astype(np.float32)
+        u, s, v = pt.linalg.pca_lowrank(_t(x), q=3)
+        assert u.shape == [40, 3] and s.shape == [3] and v.shape == [20, 3]
+        xc = x - x.mean(0)
+        recon = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(recon, xc, atol=1e-2)
+
+    def test_sparse_attention_matches_dense_mask(self):
+        rng = np.random.RandomState(0)
+        B, H, T, D = 1, 2, 4, 8
+        q = rng.randn(B, H, T, D).astype(np.float32)
+        k = rng.randn(B, H, T, D).astype(np.float32)
+        v = rng.randn(B, H, T, D).astype(np.float32)
+        # lower-triangular (causal) CSR pattern
+        rows = [[c for c in range(r + 1)] for r in range(T)]
+        cols = np.array([c for r in rows for c in r], np.int32)
+        offs = np.cumsum([0] + [len(r) for r in rows]).astype(np.int32)
+        off_b = np.broadcast_to(offs, (B, H, T + 1)).copy()
+        col_b = np.broadcast_to(cols, (B, H, len(cols))).copy()
+        got = F.sparse_attention(_t(q), _t(k), _t(v), _t(off_b),
+                                 _t(col_b)).numpy()
+        # dense reference with causal mask
+        logits = np.einsum("bhtd,bhsd->bhts", q, k) / np.sqrt(D)
+        mask = np.tril(np.ones((T, T), bool))
+        logits = np.where(mask, logits, -1e30)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("bhts,bhsd->bhtd", p, v)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        # an additive attn_mask further restricts visibility
+        am = np.zeros((T, T), np.float32)
+        am[:, 0] = -1e30  # forbid attending to position 0
+        got2 = F.sparse_attention(_t(q), _t(k), _t(v), _t(off_b),
+                                  _t(col_b), attn_mask=_t(am)).numpy()
+        logits2 = np.where(mask, np.einsum("bhtd,bhsd->bhts", q, k)
+                           / np.sqrt(D), -1e30) + am
+        p2 = np.exp(logits2 - logits2.max(-1, keepdims=True))
+        p2 /= p2.sum(-1, keepdims=True)
+        want2 = np.einsum("bhts,bhsd->bhtd", p2, v)
+        # row 0 attends to nothing valid -> compare rows 1.. only
+        np.testing.assert_allclose(got2[:, :, 1:], want2[:, :, 1:],
+                                   rtol=1e-4, atol=1e-5)
