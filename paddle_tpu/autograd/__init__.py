@@ -4,3 +4,39 @@ from .tape import (  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext, once_differentiable  # noqa: F401
 from . import functional  # noqa: F401
 from .functional import Jacobian, hessian, jacobian, jvp, vhp, vjp  # noqa: F401
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Parity: paddle.autograd.backward — run backward from several roots
+    in ONE sweep (roots sharing intermediates must not consume the graph
+    twice), accumulating into .grad."""
+    from .tape import run_backward
+
+    run_backward(tensors, grad_tensors, retain_graph)
+
+
+class saved_tensors_hooks:  # noqa: N801 — reference spelling
+    """Parity: paddle.autograd.saved_tensors_hooks(pack, unpack) — rewrite
+    tensors as the tape saves them for backward (e.g. offload/compress).
+
+    The tape stores forward operands on each GradNode; inside this scope
+    every saved operand is passed through ``pack_hook`` at save time and
+    ``unpack_hook`` when the backward pass reads it.
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from . import tape
+
+        tape._saved_tensor_hooks.append(
+            (self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        from . import tape
+
+        tape._saved_tensor_hooks.pop()
+        return False

@@ -351,3 +351,13 @@ def grad(
         else:
             out.append(Tensor(r, stop_gradient=True))
     return out[0] if single_in else out
+
+
+# -- saved-tensor hooks (paddle.autograd.saved_tensors_hooks) --
+_saved_tensor_hooks: list = []
+
+
+def saved_tensor_hooks():
+    """The active (pack, unpack) pair, or None (read by ops.dispatch at
+    record time)."""
+    return _saved_tensor_hooks[-1] if _saved_tensor_hooks else None

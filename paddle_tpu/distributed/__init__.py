@@ -18,8 +18,14 @@ from .env import (  # noqa: F401
 )
 from .collective import (  # noqa: F401
     Group, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
-    alltoall, barrier, broadcast, broadcast_object_list, get_group, new_group,
-    ppermute, recv, reduce, reduce_scatter, scatter, send, wait,
+    alltoall, alltoall_single, barrier, broadcast, broadcast_object_list,
+    destroy_process_group, gather, get_backend, get_group, irecv,
+    is_available, isend, new_group, ppermute, recv, reduce, reduce_scatter,
+    scatter, scatter_object_list, send, wait,
+)
+from .compat import (  # noqa: F401
+    CountFilterEntry, DistAttr, InMemoryDataset, ParallelMode,
+    ProbabilityEntry, QueueDataset, ShowClickEntry, split,
 )
 from .parallel import (  # noqa: F401
     DataParallel, ParallelEnv, get_rank, get_world_size, init_parallel_env,
@@ -31,16 +37,43 @@ from .shard import (  # noqa: F401
 )
 
 from . import fleet  # noqa: F401
+from . import io  # noqa: F401
+from . import launch  # noqa: F401
+from .auto_parallel import ProcessMesh  # noqa: F401
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Parity shim: the reference spins a gloo ring for CPU barriers; the
+    coordination service + TCPStore covers that role here."""
+    from .env import ensure_env
+
+    ensure_env()
+    return None
+
+
+def gloo_barrier():
+    return barrier()
+
+
+def gloo_release():
+    return None
+
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "is_initialized",
     "ParallelEnv", "DataParallel", "spawn",
     "ReduceOp", "Group", "new_group", "get_group",
-    "all_reduce", "all_gather", "all_to_all", "alltoall", "broadcast",
-    "reduce", "scatter", "reduce_scatter", "barrier", "wait", "send", "recv",
-    "ppermute", "all_gather_object", "broadcast_object_list",
+    "all_reduce", "all_gather", "all_to_all", "alltoall", "alltoall_single",
+    "broadcast", "reduce", "scatter", "reduce_scatter", "barrier", "wait",
+    "send", "recv", "isend", "irecv", "gather", "ppermute",
+    "all_gather_object", "broadcast_object_list", "scatter_object_list",
+    "destroy_process_group", "get_backend", "is_available",
     "shard_tensor", "sharding_constraint", "shard_parameter", "replicate",
-    "get_sharding", "PartitionSpec",
+    "get_sharding", "PartitionSpec", "ProcessMesh", "DistAttr",
+    "ParallelMode", "split",
     "init_mesh", "get_mesh", "get_env", "AXIS_ORDER",
-    "fleet",
+    "fleet", "io", "launch",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+    "InMemoryDataset", "QueueDataset", "CountFilterEntry",
+    "ProbabilityEntry", "ShowClickEntry",
 ]

@@ -486,3 +486,82 @@ def all_gather_object(object_list, obj, group=None):
 
 def broadcast_object_list(object_list, src=0, group=None):
     return object_list
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Parity: paddle.distributed.alltoall_single — single-tensor
+    all-to-all with optional uneven splits. Equal splits ride the XLA
+    AllToAll HLO; uneven splits are unsupported under SPMD static shapes
+    (same constraint the reference documents for its equal-split fast
+    path)."""
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "alltoall_single with uneven split sizes needs dynamic shapes, "
+            "which a compiled SPMD program cannot express; pad to equal "
+            "splits (the reference's fast path has the same requirement)")
+    res = all_to_all(in_tensor, group=group, split_axis=0, concat_axis=0)
+    if isinstance(out_tensor, Tensor):
+        out_tensor._data = res._data
+        return out_tensor
+    return res
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Parity: paddle.distributed.gather. Single-controller SPMD holds one
+    logical value per mesh: gather materializes the per-shard slices the
+    way all_gather does, delivered on every host (dst is advisory)."""
+    g = get_group(group)
+    if gather_list is None:
+        gather_list = []
+    parts = []
+    all_gather(parts, tensor, group=group)
+    gather_list.extend(parts)
+    return gather_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Parity: paddle.distributed.scatter_object_list (single-controller:
+    every rank sees the same object graph, so rank r's slot is
+    in_object_list[r] — with one logical process that is slot 0)."""
+    g = get_group(group)
+    if in_object_list is None:
+        raise ValueError("scatter_object_list needs in_object_list")
+    if len(in_object_list) != g.nranks:
+        raise ValueError(
+            f"in_object_list must have nranks={g.nranks} entries")
+    out_object_list.append(in_object_list[g.rank])
+    return out_object_list
+
+
+def isend(tensor, dst=0, group=None):
+    """Parity: paddle.distributed.isend — same TPU constraint as send."""
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    """Parity: paddle.distributed.irecv — same TPU constraint as recv."""
+    return recv(tensor, src, group)
+
+
+def destroy_process_group(group=None):
+    """Parity: paddle.distributed.destroy_process_group. Mesh-axis groups
+    hold no OS resources (they are sharding annotations); world teardown
+    resets the mesh env."""
+    if group is None:
+        from . import env as _env
+
+        _env.reset_env()
+    return None
+
+
+def get_backend(group=None):
+    """Parity: paddle.distributed.get_backend — this build's collectives
+    are XLA HLOs over the PJRT runtime."""
+    return "XLA"
+
+
+def is_available():
+    """Parity: paddle.distributed.is_available."""
+    return True

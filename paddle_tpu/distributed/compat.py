@@ -1,0 +1,100 @@
+"""Distributed namespace long tail (reference
+`python/paddle/distributed/__init__.py` exports that predate the
+collective/auto-parallel APIs): ParallelMode, split, DistAttr, and the
+parameter-server dataset shims.
+
+The PS dataset classes (InMemoryDataset/QueueDataset and the *Entry
+configs) belong to the excluded parameter-server stack (see README
+"Scope: deliberate exclusions") — they raise with that rationale instead
+of being silently absent.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "ParallelMode", "split", "DistAttr", "InMemoryDataset", "QueueDataset",
+    "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
+]
+
+
+class ParallelMode:
+    """Parity: paddle.distributed.ParallelMode (hybrid-parallel mode ids)."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Parity: paddle.distributed.split — build-and-apply a
+    model-parallel linear/embedding over the 'mp' mesh axis. The
+    reference hand-places per-rank shards; here the meta-parallel layers
+    annotate shardings and GSPMD splits the matmul."""
+    from .fleet.meta_parallel import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    )
+
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = ColumnParallelLinear(in_f, out_f,
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        elif axis == 0:
+            layer = RowParallelLinear(in_f, out_f,
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False)
+        else:
+            raise ValueError(f"linear split axis must be 0 or 1, got {axis}")
+        return layer(x)
+    if operation == "embedding":
+        num_emb, emb_dim = size
+        layer = VocabParallelEmbedding(num_emb, emb_dim,
+                                       weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(
+        f"split operation must be 'linear' or 'embedding', got {operation!r}")
+
+
+class DistAttr:
+    """Parity: paddle.distributed.DistAttr(mesh, sharding_specs) — the
+    pre-Placement shard_tensor annotation form."""
+
+    def __init__(self, mesh, sharding_specs):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs)
+
+    def placements(self):
+        from .auto_parallel import Replicate, Shard
+
+        out = []
+        for dim_name in self.process_mesh.dim_names:
+            if dim_name in self.sharding_specs:
+                out.append(Shard(self.sharding_specs.index(dim_name)))
+            else:
+                out.append(Replicate())
+        return out
+
+
+def _ps_excluded(name):
+    class _Excluded:
+        def __init__(self, *a, **k):
+            raise RuntimeError(
+                f"paddle.distributed.{name} belongs to the parameter-server "
+                "stack, which this TPU build deliberately excludes (see "
+                "README 'Scope: deliberate exclusions'); sharded embedding "
+                "tables over the mesh (VocabParallelEmbedding) cover the "
+                "large-embedding use case")
+
+    _Excluded.__name__ = _Excluded.__qualname__ = name
+    return _Excluded
+
+
+InMemoryDataset = _ps_excluded("InMemoryDataset")
+QueueDataset = _ps_excluded("QueueDataset")
+CountFilterEntry = _ps_excluded("CountFilterEntry")
+ProbabilityEntry = _ps_excluded("ProbabilityEntry")
+ShowClickEntry = _ps_excluded("ShowClickEntry")

@@ -102,3 +102,88 @@ def barrier_worker():
     from ..collective import barrier
 
     barrier()
+
+
+# -- reference-shaped class surface (`fleet.Fleet`, role makers, util) --
+
+from .base.role_maker import (  # noqa: F401,E402
+    PaddleCloudRoleMaker, Role, UserDefinedRoleMaker,
+)
+from .data_generator import (  # noqa: F401,E402
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)
+
+
+class UtilBase:
+    """Parity: `fleet.UtilBase` (`fleet/base/util_factory.py`) — host-side
+    helpers over the collective layer."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):  # noqa: A002
+        import numpy as np
+
+        from ..collective import ReduceOp, all_reduce
+        from ...framework.core import Tensor
+
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode]
+        out = all_reduce(Tensor(np.asarray(input)), op=op)
+        return np.asarray(out.numpy())
+
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier
+
+        barrier()
+
+    def all_gather(self, input, comm_world="worker"):  # noqa: A002
+        import numpy as np
+
+        from ..collective import all_gather
+        from ...framework.core import Tensor
+
+        outs: list = []
+        all_gather(outs, Tensor(np.asarray(input)))
+        return [np.asarray(o.numpy()) for o in outs]
+
+    def get_file_shard(self, files):
+        """Split a file list evenly over workers (reference semantics:
+        earlier workers take the remainder)."""
+        n = worker_num()
+        i = worker_index()
+        base, rem = divmod(len(files), n)
+        start = i * base + min(i, rem)
+        return files[start:start + base + (1 if i < rem else 0)]
+
+    def print_on_rank(self, message, rank_id=0):
+        if worker_index() == rank_id:
+            print(message)
+
+
+class Fleet:
+    """Parity: the `fleet.Fleet` facade class — the module-level functions
+    bound as methods (the reference instantiates one global `fleet`; this
+    module IS that singleton, and `Fleet()` returns a view of it)."""
+
+    init = staticmethod(init)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    worker_index = staticmethod(worker_index)
+    worker_num = staticmethod(worker_num)
+    is_first_worker = staticmethod(is_first_worker)
+    barrier_worker = staticmethod(barrier_worker)
+    get_hybrid_communicate_group = staticmethod(
+        get_hybrid_communicate_group)
+
+    @property
+    def util(self):
+        return UtilBase()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+
+__all__ += ["Fleet", "Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+            "UtilBase", "MultiSlotDataGenerator",
+            "MultiSlotStringDataGenerator"]

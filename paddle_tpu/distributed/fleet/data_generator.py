@@ -1,0 +1,84 @@
+"""Slot data generators (reference
+`fleet/data_generator/data_generator.py`): user subclasses implement
+`generate_sample(line)`; the generator formats samples into the slot text
+protocol. The format itself is runtime-agnostic (plain text lines), so it
+works here even though the PS training tier is excluded — use it to
+produce files any slot-format consumer reads.
+"""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = int(batch_size)
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclasses implement generate_sample(line) returning a "
+            "no-arg iterator over (slot_name, values) tuples")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            line_iter = self.generate_sample(line)
+            for parsed in line_iter():
+                if parsed is None:
+                    continue
+                sys.stdout.write(self._gen_str(parsed))
+
+    def run_from_memory(self):
+        batch_samples = []
+        line_iter = self.generate_sample(None)
+        for parsed in line_iter():
+            if parsed is None:
+                continue
+            batch_samples.append(parsed)
+            if len(batch_samples) == self.batch_size_:
+                batch_iter = self.generate_batch(batch_samples)
+                for sample in batch_iter():
+                    sys.stdout.write(self._gen_str(sample))
+                batch_samples = []
+        if batch_samples:
+            batch_iter = self.generate_batch(batch_samples)
+            for sample in batch_iter():
+                sys.stdout.write(self._gen_str(sample))
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Output line: `slot_count v v ... slot_count v v ...` per sample
+    (ints/floats), the reference's MultiSlot proto text form."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "generate_sample must yield a list/tuple of "
+                "(slot_name, values) pairs")
+        out = []
+        for name, values in line:
+            del name
+            out.append(str(len(values)))
+            out.extend(str(v) for v in values)
+        return " ".join(out) + "\n"
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-slot variant: values pass through as raw strings (the text
+    form is identical — numbers are stringified the same way)."""

@@ -1,0 +1,53 @@
+"""paddle.distributed.io parity (reference
+`python/paddle/distributed/io.py`): persistable save/load helpers for
+distributed programs. Sharded arrays are gathered/resharded by the
+checkpoint layer (`distributed/checkpoint.py`), so these are thin
+front-doors over the framework io with the reference's signatures."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables",
+           "load_inference_model_distributed", "is_persistable"]
+
+
+def is_persistable(var):
+    return bool(getattr(var, "persistable", False)
+                or getattr(var, "is_parameter", False))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save every persistable parameter the program references."""
+    from ..framework.io import save
+
+    if main_program is None:
+        from ..static import default_main_program
+
+        main_program = default_main_program()
+    params = {p.name or f"param_{i}": p
+              for i, p in enumerate(main_program.all_parameters())}
+    os.makedirs(dirname, exist_ok=True)
+    save({k: v for k, v in params.items()},
+         os.path.join(dirname, filename or "__model__.pdparams"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from ..framework.io import load
+
+    if main_program is None:
+        from ..static import default_main_program
+
+        main_program = default_main_program()
+    state = load(os.path.join(dirname, filename or "__model__.pdparams"))
+    by_name = {p.name or f"param_{i}": p
+               for i, p in enumerate(main_program.all_parameters())}
+    for k, v in state.items():
+        if k in by_name:
+            by_name[k].set_value(v)
+    return state
+
+
+def load_inference_model_distributed(dirname, executor, **kwargs):
+    from ..static import load_inference_model
+
+    return load_inference_model(dirname, executor, **kwargs)

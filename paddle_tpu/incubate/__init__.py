@@ -1,5 +1,116 @@
 """paddle.incubate parity (`python/paddle/incubate/`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..geometric import (  # noqa: F401 — incubate's graph API predates
+    reindex_graph as graph_reindex,  # paddle.geometric; same kernels
+    sample_neighbors as graph_sample_neighbors,
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+from ..ops.dispatch import apply
 from . import asp, distributed, nn  # noqa: F401
 from .model_average import ModelAverage  # noqa: F401
+from .optimizer import LookAhead  # noqa: F401
 
-__all__ = ["nn", "distributed", "asp", "ModelAverage"]
+__all__ = ["nn", "distributed", "asp", "ModelAverage", "LookAhead",
+           "segment_sum", "segment_mean", "segment_min", "segment_max",
+           "graph_reindex", "graph_sample_neighbors", "graph_send_recv",
+           "graph_khop_sampler", "identity_loss", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle"]
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Legacy name for geometric.send_u_recv (parity:
+    paddle.incubate.graph_send_recv)."""
+    from ..geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def _np1(t):
+    import numpy as np
+
+    return np.asarray(t.numpy()).reshape(-1)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (parity:
+    paddle.incubate.graph_khop_sampler): chains per-hop sample_neighbors
+    and reindexes the union."""
+    import numpy as np
+
+    from ..framework.core import Tensor
+    from ..geometric import sample_neighbors
+
+    if return_eids:
+        raise NotImplementedError(
+            "graph_khop_sampler(return_eids=True) needs sorted_eids "
+            "plumbing; sample without eids on this build")
+    frontier = input_nodes
+    all_neighbors = []
+    all_counts = []
+    all_sources = []  # per-edge source node, aligned with neighbors
+    for size in sample_sizes:
+        out = sample_neighbors(row, colptr, frontier, sample_size=size)
+        neigh, cnt = _np1(out[0]), _np1(out[1])
+        all_neighbors.append(neigh)
+        all_counts.append(cnt)
+        all_sources.append(np.repeat(_np1(frontier), cnt))
+        frontier = out[0]
+    merged_n = np.concatenate(all_neighbors)
+    merged_c = np.concatenate(all_counts)
+    merged_s = np.concatenate(all_sources)
+    # compact ids: input nodes first, then new nodes in first-seen order
+    xs = _np1(input_nodes)
+    seen = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(xs)
+    for v in merged_n:
+        if int(v) not in seen:
+            seen[int(v)] = len(out_nodes)
+            out_nodes.append(int(v))
+    reindex_src = np.asarray([seen[int(v)] for v in merged_n], xs.dtype)
+    reindex_dst = np.asarray([seen[int(v)] for v in merged_s], xs.dtype)
+    return (Tensor(merged_n), Tensor(merged_c), Tensor(reindex_src),
+            Tensor(reindex_dst), Tensor(np.asarray(out_nodes, xs.dtype)))
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as the loss without changing it (parity:
+    paddle.incubate.identity_loss; the reference uses it to anchor IPU
+    graphs — here it is the reduction only)."""
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("mean", 1):
+        return x.mean()
+    if reduction in ("sum", 0):
+        return x.sum()
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused softmax(x + mask) (parity: paddle.incubate.softmax_mask_fuse,
+    `fused_softmax_mask` CUDA kernel — XLA fuses the composite on TPU)."""
+
+    def f(a, m):
+        return jax.nn.softmax(a + m.astype(a.dtype), axis=-1)
+
+    return apply("softmax_mask_fuse", f, (x, mask))
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Fused causal-masked softmax (parity:
+    paddle.incubate.softmax_mask_fuse_upper_triangle): positions above
+    the diagonal are masked out."""
+
+    def f(a):
+        s = a.shape[-1]
+        cm = jnp.tril(jnp.ones((a.shape[-2], s), bool), k=s - a.shape[-2])
+        z = jnp.where(cm, a, jnp.asarray(-1e30, a.dtype))
+        return jax.nn.softmax(z, axis=-1)
+
+    return apply("softmax_mask_fuse_upper_triangle", f, (x,))

@@ -1,0 +1,83 @@
+"""paddle.incubate.optimizer (reference
+`python/paddle/incubate/optimizer/lookahead.py`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd.tape import no_grad
+
+__all__ = ["LookAhead"]
+
+
+class LookAhead:
+    """Lookahead wrapper (Zhang et al. 2019; parity:
+    paddle.incubate.LookAhead): the inner optimizer takes k fast steps,
+    then slow weights move alpha of the way toward the fast weights and
+    the fast weights reset to the slow ones.
+
+    Wraps any of this package's optimizers; the slow-weight state lives
+    host-side per parameter (same placement as the parameter array)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be a positive int, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        self._slow: dict[int, object] = {}
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k:
+            return
+        with no_grad():
+            for p in self.inner_optimizer._parameter_list:
+                slow = self._slow.get(id(p))
+                if slow is None:
+                    # first sync point: slow weights start at the fast ones
+                    self._slow[id(p)] = p._data
+                    continue
+                slow = slow + self.alpha * (p._data - slow)
+                self._slow[id(p)] = slow
+                p._data = slow.astype(p._data.dtype)
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        # slow weights keyed by position in the parameter list (id() is
+        # process-local and useless for checkpoint resume)
+        params = self.inner_optimizer._parameter_list
+        slow = [None if id(p) not in self._slow
+                else jnp.asarray(self._slow[id(p)]) for p in params]
+        return {"inner": self.inner_optimizer.state_dict()
+                if hasattr(self.inner_optimizer, "state_dict") else {},
+                "step_num": self._step_num,
+                "slow": slow}
+
+    def set_state_dict(self, state):
+        self._step_num = int(state.get("step_num", 0))
+        slow = state.get("slow")
+        if slow is not None:
+            params = self.inner_optimizer._parameter_list
+            self._slow = {id(p): jnp.asarray(s)
+                          for p, s in zip(params, slow) if s is not None}
+        if hasattr(self.inner_optimizer, "set_state_dict"):
+            self.inner_optimizer.set_state_dict(state.get("inner", {}))
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()

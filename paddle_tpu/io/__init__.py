@@ -39,3 +39,28 @@ __all__ = [
     "BatchSampler", "DistributedBatchSampler",
     "DataLoader", "default_collate_fn",
 ]
+
+
+class WorkerInfo:
+    """Parity: paddle.io.get_worker_info's result object."""
+
+    def __init__(self, id, num_workers, seed, dataset):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Parity: paddle.io.get_worker_info — None outside a worker. The
+    loader's producers are threads of this process; each sets its slot
+    (thread-local) while materializing samples."""
+    from .reader import current_worker_info
+
+    return current_worker_info()
+
+
+__all__ += ["get_worker_info", "WorkerInfo"]

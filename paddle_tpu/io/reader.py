@@ -94,13 +94,22 @@ class _PrefetchIter:
             self._task_q.put((i, idxs))
         for _ in self._threads:
             self._task_q.put(None)
-        for t in self._threads:
+        for wid, t in enumerate(self._threads):
+            t._pt_worker_id = wid
             t.start()
 
     def _worker(self):
+        import threading as _th
+
+        from . import WorkerInfo
+
+        wid = getattr(_th.current_thread(), "_pt_worker_id", 0)
+        _worker_info_tls.info = WorkerInfo(
+            wid, self._loader.num_workers, 0, self._loader.dataset)
         while not self._stop.is_set():
             task = self._task_q.get()
             if task is None:
+                _worker_info_tls.info = None
                 return
             i, indices = task
             try:
@@ -218,3 +227,12 @@ class DataLoader:
 
     def __call__(self):
         return self.__iter__()
+
+
+_worker_info_tls = threading.local()
+
+
+def current_worker_info():
+    """Thread-local WorkerInfo set inside loader worker threads (backs
+    paddle.io.get_worker_info)."""
+    return getattr(_worker_info_tls, "info", None)

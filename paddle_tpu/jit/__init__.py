@@ -196,3 +196,34 @@ def load(path, **configs):
         with open(path + ".pdspec.json") as f:
             meta = json.load(f)
     return TranslatedLayer(exported, params, meta)
+
+
+# -- dy2static debug/config flags (reference jit/api.py + logging_utils) --
+_to_static_enabled = [True]
+_verbosity = [0]
+_code_level = [0]
+
+
+def enable_to_static(enable=True):
+    """Parity: paddle.jit.enable_to_static — globally disable @to_static
+    (decorated functions run eagerly when off)."""
+    _to_static_enabled[0] = bool(enable)
+
+
+def _is_to_static_enabled():
+    return _to_static_enabled[0]
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Parity: paddle.jit.set_verbosity — transform-logging verbosity."""
+    _verbosity[0] = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Parity: paddle.jit.set_code_level — which transformed code to
+    print. The tracing JIT has no source transform passes; at level > 0
+    the traced program repr prints instead."""
+    _code_level[0] = int(level)
+
+
+__all__ += ["enable_to_static", "set_verbosity", "set_code_level"]

@@ -187,6 +187,14 @@ class StaticFunction:
 
     def __call__(self, *args, **kwargs):
         from ..amp.auto_cast import amp_state
+        from . import _is_to_static_enabled
+
+        if not _is_to_static_enabled():
+            # paddle.jit.enable_to_static(False): run the python eagerly.
+            # _function is already bound when a layer owns it (to_static
+            # wraps f.forward; __get__ binds the instance), so no layer
+            # argument is re-passed.
+            return self._function(*args, **kwargs)
 
         diff_params, aux_state = self._state()
         leaves: list[Tensor] = []

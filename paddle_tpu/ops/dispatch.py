@@ -177,6 +177,16 @@ def _deferred_vjp(bwd, arrays, g):
     return bwd(arrays, g)
 
 
+def _hooked_deferred_vjp(bwd, packed, unpack, g):
+    arrays = tuple(unpack(p) for p in packed)
+    return bwd(arrays, g)
+
+
+def _recompute_bwd(pure, arrs, g):
+    _, pullback = jax.vjp(pure, *arrs)
+    return pullback(g)
+
+
 def apply(op_name, fn, operands, n_outputs=None, **static):
     """Execute ``fn(*arrays, **static)`` with autograd recording.
 
@@ -223,16 +233,36 @@ def apply(op_name, fn, operands, n_outputs=None, **static):
     prim = _get_primitive(op_name, fn, static)
 
     if record:
+        # paddle.autograd.saved_tensors_hooks: primals saved for backward
+        # pass through pack at record time and unpack at backward time
+        # (offload/compress). Residual-free form only — under hooks the
+        # uncached path recomputes the vjp from the unpacked primals.
+        hooks = tape.saved_tensor_hooks()
         if prim is not None:
             fwd, bwd = prim
             out = fwd(*arrays)
-            vjp_fn = functools.partial(_deferred_vjp, bwd, tuple(arrays))
+            if hooks:
+                pack, unpack = hooks
+                packed = tuple(pack(a) for a in arrays)
+                vjp_fn = functools.partial(_hooked_deferred_vjp, bwd,
+                                           packed, unpack)
+            else:
+                vjp_fn = functools.partial(_deferred_vjp, bwd,
+                                           tuple(arrays))
         else:
             def pure(*arrs):
                 out = fn(*arrs, **static)
                 return tuple(out) if isinstance(out, (tuple, list)) else out
 
-            out, vjp_fn = jax.vjp(pure, *arrays)
+            if hooks:
+                pack, unpack = hooks
+                out = pure(*arrays)
+                packed = tuple(pack(a) for a in arrays)
+                vjp_fn = functools.partial(
+                    _hooked_deferred_vjp,
+                    functools.partial(_recompute_bwd, pure), packed, unpack)
+            else:
+                out, vjp_fn = jax.vjp(pure, *arrays)
         multi = isinstance(out, tuple)
         outs = out if multi else (out,)
         # ops whose outputs are all non-inexact (argmax, comparisons, int

@@ -1,5 +1,6 @@
 """`paddle.optimizer` (parity: `python/paddle/optimizer/__init__.py`)."""
 from . import lr  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
 from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adagrad, Adam, AdamW, Adamax, RMSProp, Lamb,
     Adadelta, L2Decay, L1Decay,

@@ -30,22 +30,41 @@ from ..framework.core import Tensor
 from ..jit.program import InputSpec  # noqa: F401  (paddle.static.InputSpec)
 from ..ops import dispatch as _dispatch
 
+_COMPAT_NAMES = (
+    "Variable", "CompiledProgram", "BuildStrategy", "ExecutionStrategy",
+    "ExponentialMovingAverage", "Print", "WeightNormParamAttr", "accuracy",
+    "auc", "append_backward", "gradients", "create_global_var",
+    "create_parameter", "cuda_places", "xpu_places", "exponential_decay",
+    "py_func", "save", "load", "save_to_file", "load_from_file",
+    "serialize_program", "deserialize_program", "serialize_persistables",
+    "deserialize_persistables", "normalize_program", "load_program_state",
+    "set_program_state", "ipu_shard_guard", "set_ipu_shard",
+    "IpuCompiledProgram", "IpuStrategy", "ctr_metric_bundle",
+)
+
 __all__ = [
     "Program", "program_guard", "data", "Executor", "default_main_program",
     "default_startup_program", "InputSpec", "save_inference_model",
     "load_inference_model", "name_scope", "global_scope", "scope_guard",
-    "cpu_places", "device_guard", "amp", "nn",
+    "cpu_places", "device_guard", "amp", "nn", *_COMPAT_NAMES,
 ]
 
 
 def __getattr__(name):
-    # lazy: static.nn builders import the full nn package
+    # lazy: static.nn builders / compat pull in the full nn package
     if name == "nn":
         import importlib
 
         mod = importlib.import_module(".nn", __name__)
         globals()["nn"] = mod
         return mod
+    if name in _COMPAT_NAMES:
+        import importlib
+
+        mod = importlib.import_module(".compat", __name__)
+        for n in _COMPAT_NAMES:
+            globals()[n] = getattr(mod, n)
+        return globals()[name]
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

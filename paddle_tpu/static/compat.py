@@ -1,0 +1,382 @@
+"""Static-graph namespace long tail (reference `python/paddle/static/
+__init__.py`): program serialization, EMA, compiled-program shells,
+gradient helpers, metrics, and vendor-specific guards.
+
+IPU-specific APIs (IpuStrategy, IpuCompiledProgram, ipu_shard_guard,
+set_ipu_shard) and the PS `ctr_metric_bundle` belong to excluded vendor/PS
+stacks (README "Scope") and raise with that rationale.
+"""
+from __future__ import annotations
+
+import contextlib
+import pickle
+
+import numpy as np
+
+from ..framework.core import EagerParamBase, Tensor
+from ..nn.layer.layers import ParamAttr
+from ..ops.dispatch import apply
+
+__all__ = [
+    "Variable", "CompiledProgram", "BuildStrategy", "ExecutionStrategy",
+    "ExponentialMovingAverage", "Print", "WeightNormParamAttr", "accuracy",
+    "auc", "append_backward", "gradients", "create_global_var",
+    "create_parameter", "cuda_places", "xpu_places", "exponential_decay",
+    "py_func", "save", "load", "save_to_file", "load_from_file",
+    "serialize_program", "deserialize_program", "serialize_persistables",
+    "deserialize_persistables", "normalize_program", "load_program_state",
+    "set_program_state", "ipu_shard_guard", "set_ipu_shard",
+    "IpuCompiledProgram", "IpuStrategy", "ctr_metric_bundle",
+]
+
+# a static Variable IS a Tensor here (one tensor type, two modes)
+Variable = Tensor
+
+
+class BuildStrategy:
+    """Parity: paddle.static.BuildStrategy — fusion/memory knobs. XLA owns
+    fusion on TPU, so the knobs record intent; attributes are free-form
+    like the reference's."""
+
+    def __init__(self):
+        self.__dict__["_opts"] = {}
+
+    def __setattr__(self, k, v):
+        self._opts[k] = v
+
+    def __getattr__(self, k):
+        try:
+            return self.__dict__["_opts"][k]
+        except KeyError:
+            return None
+
+
+class ExecutionStrategy(BuildStrategy):
+    """Parity: paddle.static.ExecutionStrategy."""
+
+
+class CompiledProgram:
+    """Parity: paddle.static.CompiledProgram — the Executor already
+    jit-compiles every program, so this is the annotation shell the
+    reference API expects."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy
+
+    def with_data_parallel(self, *a, **k):
+        return self
+
+    # Executor.run(program=CompiledProgram(...)) unwraps transparently
+    def __getattr__(self, name):
+        return getattr(self.program, name)
+
+
+class ExponentialMovingAverage:
+    """Parity: paddle.static.ExponentialMovingAverage — shadow parameters
+    ema = decay*ema + (1-decay)*param, with apply()/restore()."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = float(decay)
+        self._ema: dict[int, object] = {}
+        self._backup: dict[int, object] = {}
+        self._params: list = []
+        self._step = 0
+
+    def _tracked(self, parameters=None):
+        if parameters is not None:
+            return list(parameters)
+        if self._params:
+            return self._params
+        raise ValueError(
+            "ExponentialMovingAverage needs parameters: call "
+            "update(parameters=...) first")
+
+    def update(self, parameters=None):
+        params = self._tracked(parameters)
+        self._params = params
+        self._step += 1
+        # dynamic decay min(decay, (1+steps)/(10+steps)): reference rule
+        d = min(self.decay, (1 + self._step) / (10 + self._step))
+        for p in params:
+            prev = self._ema.get(id(p))
+            cur = np.asarray(p._data, np.float32)
+            self._ema[id(p)] = cur if prev is None \
+                else d * prev + (1 - d) * cur
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        params = self._tracked()
+        for p in params:
+            self._backup[id(p)] = p._data
+            if id(p) in self._ema:
+                import jax.numpy as jnp
+
+                p._data = jnp.asarray(self._ema[id(p)], p._data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002,N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Parity: paddle.static.Print — a debug-print op that passes the
+    tensor through (jax.debug.print fires when the compiled program
+    runs)."""
+    import jax
+
+    msg = message or "Print"
+
+    def f(a):
+        jax.debug.print(msg + " {x}", x=a)
+        return a
+
+    return apply("print", f, (input,))
+
+
+class WeightNormParamAttr(ParamAttr):
+    """Parity: paddle.static.WeightNormParamAttr — marks a parameter for
+    weight-norm reparameterization (`nn.utils.weight_norm` applies it)."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A002
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,  # noqa: A002
+        slide_steps=1, ins_tag_weight=None):
+    """Parity: paddle.static.auc — returns (auc_value, batch_auc, states).
+    Computed exactly from the scores host-side (the reference's
+    thresholded-bucket approximation exists for streaming; one-shot exact
+    AUC dominates it)."""
+    from ..metric import Auc
+
+    m = Auc(num_thresholds=num_thresholds)
+    m.update(preds=input, labels=label)
+    val = m.accumulate()
+    out = Tensor(np.asarray(val, np.float32))
+    return out, out, [out]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Parity: paddle.static.append_backward — in the record/replay model
+    gradients come from the tape: runs backward and returns the
+    (param, grad) pairs the fluid API promises."""
+    loss.backward()
+    params = parameter_list
+    if params is None:
+        from . import default_main_program
+
+        params = default_main_program().all_parameters()
+    return [(p, p.grad) for p in params if p.grad is not None]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None,
+              name=None):
+    """Parity: paddle.static.gradients over the eager tape."""
+    from ..autograd.tape import grad as _grad
+
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    outs = _grad(targets, inputs, grad_outputs=target_gradients,
+                 allow_unused=True)
+    return list(outs)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import jax.numpy as jnp
+
+    from ..framework.dtype import convert_dtype
+
+    t = Tensor(jnp.full(list(shape), value, convert_dtype(dtype)),
+               stop_gradient=True, name=name)
+    t.persistable = persistable
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..framework.compat import create_parameter as _cp
+
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def cuda_places(device_ids=None):
+    """The accelerator places (TPU chips here; the reference name is kept
+    so device-list code ports unchanged)."""
+    import jax
+
+    from ..framework.compat import TPUPlace
+
+    n = len(jax.devices())
+    ids = range(n) if device_ids is None else device_ids
+    return [TPUPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    raise RuntimeError(
+        "XPU (Kunlun) devices are not part of this TPU build; use "
+        "cuda_places()/static.cpu_places()")
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """Parity: the fluid-era schedule builder —
+    lr = base * rate^(step / decay_steps), floored per window when
+    staircase."""
+    from ..optimizer.lr import LRScheduler
+
+    class _FluidExponentialDecay(LRScheduler):
+        def get_lr(self):
+            exp = self.last_epoch / float(decay_steps)
+            if staircase:
+                exp = float(int(exp))
+            return self.base_lr * decay_rate ** exp
+
+    return _FluidExponentialDecay(learning_rate=learning_rate)
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from .nn import py_func as _pf
+
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+# -- program/persistable serialization (reference io.py) --
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """Bytes form of the op-recorded program (the save_inference_model
+    `.pdmodel` payload)."""
+    import tempfile
+
+    from . import default_main_program, save_inference_model
+
+    program = program or default_main_program()
+    with tempfile.TemporaryDirectory() as td:
+        prefix = td + "/prog"
+        save_inference_model(prefix, feed_vars, fetch_vars,
+                             program=program)
+        with open(prefix + ".pdmodel", "rb") as f:
+            return f.read()
+
+
+def deserialize_program(data):
+    import pickle as _p
+
+    meta = _p.loads(data)
+    from . import Program
+
+    prog = Program()
+    prog.feed_vars = dict(meta["feeds"])
+    prog._feed_meta = dict(meta["feed_meta"])
+    prog._serialized_meta = meta
+    return prog
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    from . import default_main_program
+
+    program = program or default_main_program()
+    blobs = {}
+    for i, p in enumerate(program.all_parameters()):
+        blobs[p.name or f"param_{i}"] = np.asarray(p._data)
+    return pickle.dumps(blobs)
+
+
+def deserialize_persistables(program, data, executor=None):
+    blobs = pickle.loads(data)
+    by_name = {p.name or f"param_{i}": p
+               for i, p in enumerate(program.all_parameters())}
+    for k, v in blobs.items():
+        if k in by_name:
+            by_name[k].set_value(v)
+    return blobs
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """The record/replay program is already minimal (only executed ops are
+    recorded), so normalization is the identity — returned for API
+    parity."""
+    return program
+
+
+def save(program, model_path, protocol=4, **kwargs):
+    state = {}
+    for i, p in enumerate(program.all_parameters()):
+        state[p.name or f"param_{i}"] = np.asarray(p._data)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    set_program_state(program, state)
+    return state
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    by_name = {p.name or f"param_{i}": p
+               for i, p in enumerate(program.all_parameters())}
+    for k, v in state_dict.items():
+        if k in by_name:
+            by_name[k].set_value(v)
+
+
+# -- excluded vendor/PS guards --
+
+def _ipu_excluded(name):
+    def raiser(*a, **k):
+        raise RuntimeError(
+            f"paddle.static.{name} targets Graphcore IPUs; this build "
+            "compiles for TPU via XLA (see README 'Scope: deliberate "
+            "exclusions' for the vendor-runtime policy)")
+
+    raiser.__name__ = name
+    return raiser
+
+
+ipu_shard_guard = _ipu_excluded("ipu_shard_guard")
+set_ipu_shard = _ipu_excluded("set_ipu_shard")
+IpuCompiledProgram = _ipu_excluded("IpuCompiledProgram")
+IpuStrategy = _ipu_excluded("IpuStrategy")
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):  # noqa: A002
+    raise RuntimeError(
+        "ctr_metric_bundle belongs to the excluded parameter-server CTR "
+        "stack (README 'Scope'); use paddle.metric.Auc / paddle.static.auc")

@@ -2,4 +2,47 @@
 from . import datasets, models, ops, transforms  # noqa: F401
 from .models import *  # noqa: F401,F403
 
-__all__ = ["datasets", "models", "ops", "transforms"]
+__all__ = ["datasets", "models", "ops", "transforms", "set_image_backend",
+           "get_image_backend", "image_load"]
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """Select the loader used by datasets/image_load (parity:
+    paddle.vision.set_image_backend). 'cv2' is not bundled in this build;
+    'pil' and 'numpy' are supported."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "numpy", "tensor"):
+        raise ValueError(
+            f"image backend must be pil|cv2|numpy|tensor, got {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file with the configured backend (parity:
+    paddle.vision.image_load)."""
+    import numpy as np
+
+    backend = backend or _image_backend
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover — Pillow ships in-image
+        raise RuntimeError(
+            "image_load needs Pillow (cv2 is not bundled)") from e
+    if backend in ("numpy", "tensor", "cv2"):
+        arr = np.asarray(Image.open(path))
+        if backend == "cv2" and arr.ndim == 3 and arr.shape[-1] == 3:
+            # cv2 contract is BGR channel order — honor it even though the
+            # decode goes through PIL, so ported per-channel code is right
+            arr = arr[..., ::-1]
+        if backend == "tensor":
+            from ..framework.core import Tensor
+
+            return Tensor(arr)
+        return arr
+    return Image.open(path)

@@ -836,3 +836,59 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return Tensor(jnp.asarray(arr))
+
+
+from ..nn.layer.layers import Layer as _Layer  # noqa: E402
+
+
+class DeformConv2D(_Layer):
+    """Layer wrapper over :func:`deform_conv2d` (parity:
+    paddle.vision.ops.DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size, kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr)
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, self.stride, self.padding,
+            self.dilation, self.deformable_groups, self.groups, mask)
+
+
+class _RoILayer(_Layer):
+    _fn = None
+
+    def __init__(self, output_size, spatial_scale=1.0, **kw):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+        self._kw = kw
+
+    def forward(self, x, boxes, boxes_num):
+        return type(self)._fn(x, boxes, boxes_num, self.output_size,
+                              self.spatial_scale, **self._kw)
+
+
+class RoIAlign(_RoILayer):
+    _fn = staticmethod(roi_align)
+
+
+class RoIPool(_RoILayer):
+    _fn = staticmethod(roi_pool)
+
+
+class PSRoIPool(_RoILayer):
+    _fn = staticmethod(psroi_pool)

@@ -226,3 +226,433 @@ def vflip(img):
 
 def center_crop(img, output_size):
     return CenterCrop(output_size)(img)
+
+
+# ---- round-3 completions: color ops, geometric warps, random transforms
+# (parity: `python/paddle/vision/transforms/functional.py`) ----
+
+def _as_float(img):
+    arr = _hwc(img)
+    was_uint8 = arr.dtype == np.uint8
+    return arr.astype(np.float32), was_uint8
+
+
+def _restore(arr, was_uint8):
+    if was_uint8:
+        return np.clip(np.round(arr), 0, 255).astype(np.uint8)
+    return arr
+
+
+def adjust_brightness(img, brightness_factor):
+    arr, u8 = _as_float(img)
+    return _restore(arr * brightness_factor, u8)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr, u8 = _as_float(img)
+    # blend with the mean of the grayscale image (torchvision/paddle rule)
+    gray = arr @ np.array([0.299, 0.587, 0.114], np.float32) \
+        if arr.shape[-1] == 3 else arr[..., 0]
+    mean = gray.mean()
+    return _restore(mean + contrast_factor * (arr - mean), u8)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr, u8 = _as_float(img)
+    gray = (arr @ np.array([0.299, 0.587, 0.114], np.float32))[..., None]
+    return _restore(gray + saturation_factor * (arr - gray), u8)
+
+
+def _rgb_to_hsv(a):
+    r, g, b = a[..., 0], a[..., 1], a[..., 2]
+    mx = np.max(a, axis=-1)
+    mn = np.min(a, axis=-1)
+    d = mx - mn
+    h = np.zeros_like(mx)
+    nz = d > 1e-12
+    idx = nz & (mx == r)
+    h[idx] = ((g - b)[idx] / d[idx]) % 6
+    idx = nz & (mx == g)
+    h[idx] = (b - r)[idx] / d[idx] + 2
+    idx = nz & (mx == b)
+    h[idx] = (r - g)[idx] / d[idx] + 4
+    h = h / 6.0
+    s = np.where(mx > 1e-12, d / np.maximum(mx, 1e-12), 0.0)
+    return h, s, mx
+
+
+def _hsv_to_rgb(h, s, v):
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(np.int32) % 6
+    out = np.zeros(h.shape + (3,), np.float32)
+    triples = [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v),
+               (v, p, q)]
+    for k, trip in enumerate(triples):
+        sel = i == k
+        for ch in range(3):
+            out[..., ch][sel] = trip[ch][sel]
+    return out
+
+
+def adjust_hue(img, hue_factor):
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr, u8 = _as_float(img)
+    scale = 255.0 if u8 else 1.0
+    h, s, v = _rgb_to_hsv(arr / scale)
+    h = (h + hue_factor) % 1.0
+    return _restore(_hsv_to_rgb(h, s, v) * scale, u8)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr, u8 = _as_float(img)
+    gray = arr @ np.array([0.299, 0.587, 0.114], np.float32) \
+        if arr.shape[-1] == 3 else arr[..., 0]
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return _restore(out, u8)
+
+
+def crop(img, top, left, height, width):
+    arr = _hwc(img)
+    return arr[top:top + height, left:left + width]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _hwc(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt_ = pb = int(padding)
+    elif len(padding) == 2:
+        pl = pr = int(padding[0])
+        pt_ = pb = int(padding[1])
+    else:
+        pl, pt_, pr, pb = (int(p) for p in padding)
+    widths = ((pt_, pb), (pl, pr), (0, 0))
+    if padding_mode == "constant":
+        return np.pad(arr, widths, mode="constant", constant_values=fill)
+    mode = {"reflect": "reflect", "edge": "edge",
+            "symmetric": "symmetric"}[padding_mode]
+    return np.pad(arr, widths, mode=mode)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Fill region [i:i+h, j:j+w] with v (parity: F.erase; works on HWC
+    numpy or CHW tensors the paddle way — ndarray here)."""
+    arr = np.asarray(img)
+    out = arr if inplace else arr.copy()
+    if out.ndim == 3 and out.shape[0] in (1, 3) and out.shape[-1] > 4:
+        out[:, i:i + h, j:j + w] = v  # CHW
+    else:
+        out[i:i + h, j:j + w] = v     # HWC
+    return out
+
+
+def _warp(img, inv_m, out_hw=None, interpolation="bilinear", fill=0):
+    """Inverse-map warp: out(y, x) = img(inv_m @ (x, y, 1)). inv_m: 3x3."""
+    arr, u8 = _as_float(img)
+    h, w = arr.shape[:2]
+    oh, ow = out_hw or (h, w)
+    ys, xs = np.meshgrid(np.arange(oh, dtype=np.float32),
+                         np.arange(ow, dtype=np.float32), indexing="ij")
+    ones = np.ones_like(xs)
+    pts = np.stack([xs, ys, ones], axis=-1) @ inv_m.T.astype(np.float32)
+    sx = pts[..., 0] / np.maximum(pts[..., 2], 1e-12)
+    sy = pts[..., 1] / np.maximum(pts[..., 2], 1e-12)
+    if interpolation == "nearest":
+        ix = np.round(sx).astype(np.int64)
+        iy = np.round(sy).astype(np.int64)
+        valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        out = np.full((oh, ow, arr.shape[2]), float(fill), np.float32)
+        out[valid] = arr[iy[valid], ix[valid]]
+        return _restore(out, u8)
+    x0 = np.floor(sx).astype(np.int64)
+    y0 = np.floor(sy).astype(np.int64)
+    dx = (sx - x0)[..., None]
+    dy = (sy - y0)[..., None]
+    out = np.zeros((oh, ow, arr.shape[2]), np.float32)
+    wsum = np.zeros((oh, ow, 1), np.float32)
+    for oy, ox, wgt in [(0, 0, (1 - dy) * (1 - dx)), (0, 1, (1 - dy) * dx),
+                        (1, 0, dy * (1 - dx)), (1, 1, dy * dx)]:
+        yy = y0 + oy
+        xx = x0 + ox
+        valid = (xx >= 0) & (xx < w) & (yy >= 0) & (yy < h)
+        vals = np.zeros_like(out)
+        vals[valid] = arr[yy[valid], xx[valid]]
+        out += wgt * np.where(valid[..., None], vals, 0.0)
+        wsum += wgt * valid[..., None].astype(np.float32)
+    out = np.where(wsum > 1e-6, out / np.maximum(wsum, 1e-6), float(fill))
+    return _restore(out, u8)
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    rot = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in shear)
+    cx, cy = center
+    tx, ty = translate
+    # forward matrix M = T(center) R S Sh T(-center) + translate
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a, b, 0.0], [c, d, 0.0], [0, 0, 1.0]], np.float64) * 1.0
+    m[:2, :2] *= scale
+    m[0, 2] = cx + tx - m[0, 0] * cx - m[0, 1] * cy
+    m[1, 2] = cy + ty - m[1, 0] * cx - m[1, 1] * cy
+    return m
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="bilinear", fill=0, center=None):
+    arr = _hwc(img)
+    h, w = arr.shape[:2]
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    ctr = center or ((w - 1) * 0.5, (h - 1) * 0.5)
+    m = _affine_matrix(angle, translate, scale, shear, ctr)
+    return _warp(img, np.linalg.inv(m), None, interpolation, fill)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    arr = _hwc(img)
+    h, w = arr.shape[:2]
+    ctr = center or ((w - 1) * 0.5, (h - 1) * 0.5)
+    m = _affine_matrix(angle, (0, 0), 1.0, (0.0, 0.0), ctr)
+    out_hw = None
+    if expand:
+        corners = np.array([[0, 0, 1], [w - 1, 0, 1], [0, h - 1, 1],
+                            [w - 1, h - 1, 1]], np.float64) @ m.T
+        xs, ys = corners[:, 0], corners[:, 1]
+        ow = int(np.ceil(xs.max() - xs.min() + 1))
+        oh = int(np.ceil(ys.max() - ys.min() + 1))
+        shift = np.eye(3)
+        shift[0, 2] = -xs.min()
+        shift[1, 2] = -ys.min()
+        m = shift @ m
+        out_hw = (oh, ow)
+    return _warp(img, np.linalg.inv(m), out_hw, interpolation, fill)
+
+
+def _homography(src, dst):
+    """Solve the 3x3 perspective transform mapping src -> dst (4 points)."""
+    a = []
+    b = []
+    for (x, y), (u, v) in zip(src, dst):
+        a.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        a.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+        b += [u, v]
+    sol = np.linalg.solve(np.asarray(a, np.float64),
+                          np.asarray(b, np.float64))
+    return np.append(sol, 1.0).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    m = _homography(startpoints, endpoints)
+    return _warp(img, np.linalg.inv(m), None, interpolation, fill)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.transforms = [
+            BrightnessTransform(brightness), ContrastTransform(contrast),
+            SaturationTransform(saturation), HueTransform(hue),
+        ]
+
+    def _apply_image(self, img):
+        order = list(range(4))
+        random.shuffle(order)
+        for i in order:
+            img = self.transforms[i](img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        h, w = arr.shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = random.uniform(*self.scale) if self.scale else 1.0
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            s = self.shear
+            if isinstance(s, numbers.Number):
+                sh = (random.uniform(-s, s), 0.0)
+            elif len(s) == 2:
+                sh = (random.uniform(s[0], s[1]), 0.0)
+            else:
+                sh = (random.uniform(s[0], s[1]), random.uniform(s[2], s[3]))
+        return affine(img, angle, (tx, ty), sc, sh, self.interpolation,
+                      self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        arr = _hwc(img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        half_h, half_w = int(h * d / 2), int(w * d / 2)
+        tl = (random.randint(0, half_w), random.randint(0, half_h))
+        tr = (w - 1 - random.randint(0, half_w), random.randint(0, half_h))
+        br = (w - 1 - random.randint(0, half_w),
+              h - 1 - random.randint(0, half_h))
+        bl = (random.randint(0, half_w), h - 1 - random.randint(0, half_h))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        return perspective(img, start, [tl, tr, br, bl],
+                           self.interpolation, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[-1] > 4
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            aspect = np.exp(random.uniform(np.log(self.ratio[0]),
+                                           np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * aspect)))
+            ew = int(round(np.sqrt(target / aspect)))
+            if eh < h and ew < w:
+                i = random.randint(0, h - eh)
+                j = random.randint(0, w - ew)
+                v = self.value
+                if v == "random":
+                    v = np.random.rand(
+                        *( (arr.shape[0], eh, ew) if chw
+                           else (eh, ew, arr.shape[-1]) )).astype(np.float32)
+                return erase(arr, i, j, eh, ew, v, self.inplace)
+        return img

@@ -1,0 +1,399 @@
+"""Round-3 namespace completions behavior: vision transforms/ops layers,
+incubate graph+fused ops, distributed comm additions, static compat,
+fleet role makers, LBFGS, saved_tensors_hooks, jit flags, worker info."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.vision import transforms as T
+
+
+def _t(a):
+    return pt.to_tensor(np.asarray(a))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _mesh():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    yield
+    from paddle_tpu.distributed import env as env_mod
+
+    env_mod.reset_env()
+
+
+class TestTransforms:
+    def test_color_ops(self):
+        img = (np.random.RandomState(0).rand(8, 8, 3) * 255) \
+            .astype(np.uint8)
+        assert T.adjust_brightness(img, 1.0).dtype == np.uint8
+        np.testing.assert_array_equal(T.adjust_brightness(img, 1.0), img)
+        dark = T.adjust_brightness(img, 0.5)
+        assert dark.mean() < img.mean()
+        flat = T.adjust_contrast(img, 0.0)
+        assert flat.std() < 2  # collapses toward the gray mean
+        np.testing.assert_array_equal(T.adjust_hue(img, 0.0), img)
+        g = T.to_grayscale(img, 3)
+        assert g.shape == (8, 8, 3)
+        assert np.abs(g[..., 0].astype(int) - g[..., 1].astype(int)).max() \
+            == 0
+        with pytest.raises(ValueError):
+            T.adjust_hue(img, 0.7)
+
+    def test_hue_roundtrip(self):
+        img = (np.random.RandomState(1).rand(6, 6, 3) * 255).astype(np.uint8)
+        back = T.adjust_hue(T.adjust_hue(img, 0.25), -0.25)
+        assert np.abs(back.astype(int) - img.astype(int)).max() <= 3
+
+    def test_crop_pad_erase(self):
+        img = np.arange(64, dtype=np.uint8).reshape(8, 8, 1)
+        c = T.crop(img, 2, 3, 4, 5)
+        assert c.shape == (4, 5, 1) and c[0, 0, 0] == 2 * 8 + 3
+        p = T.pad(img, 2)
+        assert p.shape == (12, 12, 1) and p[0, 0, 0] == 0
+        pr = T.pad(img, (1, 2), padding_mode="reflect")
+        assert pr.shape == (12, 10, 1)
+        e = T.erase(img, 1, 1, 3, 3, 7)
+        assert (e[1:4, 1:4] == 7).all() and img[1, 1, 0] != 7
+
+    def test_rotate_affine_perspective(self):
+        img = np.zeros((9, 9, 1), np.float32)
+        img[4, 6] = 1.0
+        # 90-degree rotation moves (r=4, c=6) around center (4, 4)
+        r = T.rotate(img, 90, interpolation="nearest")
+        assert r.shape == (9, 9, 1) and r.sum() == 1.0
+        ident = T.affine(img, 0.0, (0, 0), 1.0, (0.0, 0.0),
+                         interpolation="bilinear")
+        np.testing.assert_allclose(ident, img, atol=1e-4)
+        shift = T.affine(img, 0.0, (1, 0), 1.0, (0.0, 0.0),
+                         interpolation="nearest")
+        assert shift[4, 7] == 1.0
+        pts = [(0, 0), (8, 0), (8, 8), (0, 8)]
+        np.testing.assert_allclose(
+            T.perspective(img, pts, pts, interpolation="bilinear"), img,
+            atol=1e-4)
+
+    def test_random_transform_classes(self):
+        img = (np.random.RandomState(2).rand(16, 16, 3) * 255) \
+            .astype(np.uint8)
+        for tr in [T.ColorJitter(0.2, 0.2, 0.2, 0.1), T.Grayscale(3),
+                   T.Pad(2), T.RandomRotation(10),
+                   T.RandomAffine(5, translate=(0.1, 0.1)),
+                   T.RandomPerspective(prob=1.0),
+                   T.RandomErasing(prob=1.0)]:
+            out = tr(img)
+            assert out is not None and np.asarray(out).ndim == 3
+
+    def test_vision_backend_helpers(self):
+        assert pt.vision.get_image_backend() == "pil"
+        pt.vision.set_image_backend("numpy")
+        try:
+            from PIL import Image
+
+            im = Image.fromarray(np.zeros((4, 4, 3), np.uint8))
+            im.save("/tmp/_pt_img.png")
+            arr = pt.vision.image_load("/tmp/_pt_img.png")
+            assert arr.shape == (4, 4, 3)
+        finally:
+            pt.vision.set_image_backend("pil")
+        with pytest.raises(ValueError):
+            pt.vision.set_image_backend("bogus")
+
+
+class TestVisionOpsLayers:
+    def test_roi_layers(self):
+        x = _t(np.random.randn(1, 4, 16, 16).astype(np.float32))
+        boxes = _t(np.array([[2.0, 2.0, 10.0, 10.0]], np.float32))
+        bnum = _t(np.array([1], np.int32))
+        for cls in [pt.vision.ops.RoIAlign, pt.vision.ops.RoIPool]:
+            layer = cls(output_size=4)
+            out = layer(x, boxes, bnum)
+            assert out.shape[0] == 1 and out.shape[2] == 4
+        ps = pt.vision.ops.PSRoIPool(output_size=2)(x, boxes, bnum)
+        assert ps.shape[2] == 2
+
+    def test_deform_conv_layer(self):
+        layer = pt.vision.ops.DeformConv2D(3, 6, 3, padding=1)
+        x = _t(np.random.randn(2, 3, 8, 8).astype(np.float32))
+        offset = _t(np.zeros((2, 18, 8, 8), np.float32))
+        assert layer(x, offset).shape == [2, 6, 8, 8]
+
+
+class TestIncubate:
+    def test_segment_and_graph_aliases(self):
+        data = _t(np.arange(6, dtype=np.float32).reshape(3, 2))
+        seg = _t(np.array([0, 0, 1]))
+        s = pt.incubate.segment_sum(data, seg)
+        np.testing.assert_allclose(s.numpy(), [[2, 4], [4, 5]])
+        out = pt.incubate.graph_send_recv(
+            data, _t(np.array([0, 1, 2])), _t(np.array([1, 2, 0])))
+        assert out.shape == [3, 2]
+
+    def test_softmax_mask_fuse(self):
+        x = _t(np.random.randn(2, 4, 4).astype(np.float32))
+        m = _t(np.zeros((2, 4, 4), np.float32))
+        out = pt.incubate.softmax_mask_fuse(x, m)
+        np.testing.assert_allclose(out.numpy().sum(-1), 1.0, rtol=1e-5)
+        tri = pt.incubate.softmax_mask_fuse_upper_triangle(x)
+        t = tri.numpy()
+        assert np.allclose(t.sum(-1), 1.0, rtol=1e-5)
+        assert np.allclose(t[:, 0, 1:], 0.0, atol=1e-6)  # causal row 0
+
+    def test_identity_loss_and_lookahead(self):
+        x = _t(np.array([1.0, 3.0], np.float32))
+        assert float(pt.incubate.identity_loss(x, "sum").numpy()) == 4.0
+        p = pt.to_tensor(np.zeros(2, np.float32))
+        p.stop_gradient = False
+        p.is_parameter = True
+        inner = pt.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        la = pt.incubate.LookAhead(inner, alpha=0.5, k=2)
+        tgt = _t(np.array([1.0, 1.0], np.float32))
+        for _ in range(4):
+            loss = ((p - tgt) ** 2).sum()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+        assert float(((p - tgt) ** 2).sum().numpy()) < 2.0
+
+    def test_khop_sampler(self):
+        # chain graph 0->1->2->3 in CSC
+        row = _t(np.array([1, 2, 3, 0], np.int64))
+        colptr = _t(np.array([0, 1, 2, 3, 4], np.int64))
+        nodes = _t(np.array([0], np.int64))
+        n, c, src, dst, out_nodes = pt.incubate.graph_khop_sampler(
+            row, colptr, nodes, [1, 1])
+        assert len(out_nodes.numpy()) >= 2
+        assert len(src.numpy()) == len(dst.numpy())
+
+
+class TestDistributedAdditions:
+    def test_gather_and_alltoall_single(self):
+        x = _t(np.arange(16, dtype=np.float32))
+        parts = pt.distributed.gather(x)
+        assert len(parts) >= 1
+        y = pt.distributed.alltoall_single(None, x)
+        assert y.shape == [16]
+        with pytest.raises(NotImplementedError):
+            pt.distributed.alltoall_single(None, x, in_split_sizes=[6, 10])
+
+    def test_object_and_introspection(self):
+        out = []
+        pt.distributed.scatter_object_list(
+            out, [{"a": 1}] * pt.distributed.get_group().nranks)
+        assert out[0] == {"a": 1}
+        assert pt.distributed.get_backend() == "XLA"
+        assert pt.distributed.is_available()
+        assert pt.distributed.ParallelMode.DATA_PARALLEL == 0
+
+    def test_split_linear(self):
+        x = _t(np.random.randn(4, 8).astype(np.float32))
+        out = pt.distributed.split(x, (8, 6), "linear", axis=1,
+                                   num_partitions=2)
+        assert out.shape == [4, 6]
+        emb = pt.distributed.split(_t(np.array([[1, 2]])), (10, 4),
+                                   "embedding", num_partitions=2)
+        assert emb.shape == [1, 2, 4]
+
+    def test_ps_shims_raise(self):
+        with pytest.raises(RuntimeError, match="parameter-server"):
+            pt.distributed.InMemoryDataset()
+
+    def test_fleet_surface(self):
+        f = fleet.Fleet()
+        assert f.is_worker() and not f.is_server()
+        assert f.util.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]
+        rm = fleet.PaddleCloudRoleMaker()
+        assert rm.worker_index() == 0 and rm.is_worker()
+        rm2 = fleet.UserDefinedRoleMaker(current_id=1, worker_num=4)
+        assert rm2.worker_index() == 1 and rm2.worker_num() == 4
+
+    def test_data_generator(self, capsys):
+        class G(fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    yield [("words", [1, 2, 3]), ("label", [0])]
+
+                return it
+
+        g = G()
+        g.set_batch(1)
+        g.run_from_memory()
+        out = capsys.readouterr().out
+        assert out.strip() == "3 1 2 3 1 0"
+
+
+class TestStaticCompat:
+    def test_ema(self):
+        p = pt.create_parameter([2])
+        p.set_value(np.ones(2, np.float32))
+        ema = pt.static.ExponentialMovingAverage(0.5)
+        ema.update([p])
+        p.set_value(np.zeros(2, np.float32))
+        ema.update([p])
+        with ema.apply():
+            applied = p.numpy().copy()
+        assert 0 < applied[0] < 1  # the EMA value
+        np.testing.assert_allclose(p.numpy(), 0.0)
+
+    def test_gradients_and_append_backward(self):
+        x = _t(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        y = (x ** 2).sum()
+        (g,) = pt.static.gradients(y, x)
+        np.testing.assert_allclose(g.numpy(), [4.0])
+
+    def test_program_state_roundtrip(self, tmp_path):
+        main = pt.static.Program()
+        with pt.static.program_guard(main, pt.static.Program()):
+            xv = pt.static.data("X", [None, 4], "float32")
+            out = pt.static.nn.fc(xv, 2)  # noqa: F841
+        path = str(tmp_path / "m")
+        pt.static.save(main, path)
+        state = pt.static.load_program_state(path)
+        assert state
+        pt.static.set_program_state(main, state)
+        blob = pt.static.serialize_persistables([], [], program=main)
+        pt.static.deserialize_persistables(main, blob)
+
+    def test_compiled_program_and_strategies(self):
+        bs = pt.static.BuildStrategy()
+        bs.fuse_elewise_add_act_ops = True
+        assert bs.fuse_elewise_add_act_ops is True
+        assert bs.nonexistent_flag is None
+        prog = pt.static.Program()
+        cp = pt.static.CompiledProgram(prog, build_strategy=bs)
+        assert cp.program is prog
+        assert isinstance(pt.static.Variable, type)
+
+    def test_excluded_raise(self):
+        with pytest.raises(RuntimeError, match="IPU"):
+            pt.static.IpuStrategy()
+        with pytest.raises(RuntimeError, match="parameter-server"):
+            pt.static.ctr_metric_bundle(None, None)
+
+    def test_places(self):
+        places = pt.static.cpu_places()
+        assert places
+        with pytest.raises(RuntimeError):
+            pt.static.xpu_places()
+
+    def test_exponential_decay_steps(self):
+        s = pt.static.exponential_decay(0.1, decay_steps=100,
+                                        decay_rate=0.96)
+        for _ in range(50):
+            s.step()
+        assert abs(s.get_lr() - 0.1 * 0.96 ** 0.5) < 1e-6
+        s2 = pt.static.exponential_decay(0.1, 100, 0.96, staircase=True)
+        for _ in range(50):
+            s2.step()
+        assert s2.get_lr() == 0.1
+
+
+class TestReviewFixRegressions:
+    def test_eager_fallback_bound_layer(self):
+        class Net(pt.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = pt.nn.Linear(2, 2)
+
+            @pt.jit.to_static
+            def forward(self, x):
+                return self.fc(x)
+
+        net = Net()
+        x = _t(np.ones((1, 2), np.float32))
+        y1 = net(x).numpy()
+        pt.jit.enable_to_static(False)
+        try:
+            y2 = net(x).numpy()
+        finally:
+            pt.jit.enable_to_static(True)
+        np.testing.assert_allclose(y1, y2, rtol=1e-6)
+
+    def test_multi_root_backward_shared_graph(self):
+        a = _t(np.ones(3, np.float32))
+        a.stop_gradient = False
+        h = a * 2
+        pt.autograd.backward([h.sum(), (h * 3).sum()])
+        np.testing.assert_allclose(a.grad.numpy(), 8.0)
+
+    def test_saved_tensors_hooks(self):
+        packed = []
+
+        def pack(arr):
+            packed.append(arr.shape)
+            return np.asarray(arr)
+
+        def unpack(p):
+            import jax.numpy as jnp
+
+            return jnp.asarray(p)
+
+        x = _t(np.random.randn(4, 3).astype(np.float32))
+        x.stop_gradient = False
+        w = _t(np.random.randn(3, 2).astype(np.float32))
+        w.stop_gradient = False
+        with pt.autograd.saved_tensors_hooks(pack, unpack):
+            y = pt.matmul(x, w).sum()
+        y.backward()
+        assert packed
+        x2 = _t(x.numpy())
+        x2.stop_gradient = False
+        w2 = _t(w.numpy())
+        w2.stop_gradient = False
+        pt.matmul(x2, w2).sum().backward()
+        np.testing.assert_allclose(w.grad.numpy(), w2.grad.numpy(),
+                                   rtol=1e-5)
+
+    def test_jit_all_clean(self):
+        bad = [n for n in pt.jit.__all__
+               if n in ("json", "os", "np", "jax", "annotations")]
+        assert not bad
+
+    def test_lbfgs_quadratic(self):
+        target = np.array([1.0, -2.0, 3.0], np.float32)
+        p = pt.to_tensor(np.zeros(3, np.float32))
+        p.stop_gradient = False
+        p.is_parameter = True
+        opt = pt.optimizer.LBFGS(parameters=[p], max_iter=30)
+
+        def closure():
+            loss = ((p - _t(target)) ** 2).sum()
+            loss.backward()
+            return loss
+
+        loss = opt.step(closure)
+        assert float(loss.numpy()) < 1e-6
+        np.testing.assert_allclose(p.numpy(), target, atol=1e-3)
+
+    def test_lookahead_state_roundtrip(self):
+        p = pt.to_tensor(np.zeros(2, np.float32))
+        p.stop_gradient = False
+        p.is_parameter = True
+        la = pt.incubate.LookAhead(
+            pt.optimizer.SGD(0.1, parameters=[p]), k=1)
+        ((p - 1.0) ** 2).sum().backward()
+        la.step()
+        la.clear_grad()
+        sd = la.state_dict()
+        la2 = pt.incubate.LookAhead(
+            pt.optimizer.SGD(0.1, parameters=[p]), k=1)
+        la2.set_state_dict(sd)
+        assert la2._step_num == 1 and la2._slow
+
+    def test_worker_info(self):
+        class DS(pt.io.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                wi = pt.io.get_worker_info()
+                assert wi is not None and wi.num_workers == 2
+                return np.float32(i)
+
+        dl = pt.io.DataLoader(DS(), batch_size=4, num_workers=2)
+        total = sum(float(b.numpy().sum()) for b in dl)
+        assert total == 28.0
+        assert pt.io.get_worker_info() is None
