@@ -400,6 +400,13 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
     if (sq < 16 or sk < 16 or d % 8 or h % h_kv or v.shape[2] != h_kv
             or not ok_blocks):
         return fallback(0.0)
+    # measured crossover (PERF.md, TPU v5e wall-clock): at short sequences
+    # with wide heads XLA's fused composite beats the kernel (0.73x at
+    # s=1024 d=128 fwd+bwd); the kernel wins from s>=2048 at any d, and at
+    # every length for d<=64. Engage it only where it wins — O(s^2) memory
+    # of the composite is fine at s<2048.
+    if max(sq, sk) < 2048 and d > 64 and not interpret:
+        return fallback(0.0)
     scale = 1.0 / math.sqrt(d)
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)

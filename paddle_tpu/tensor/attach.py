@@ -123,8 +123,67 @@ for base_name in [
     fn = getattr(math, base_name)
     _METHODS.setdefault(base_name + "_", _inplace(fn))
 
+# the full reference inplace-method list; bases live across the tensor
+# submodules, all present in _METHODS by now
+for base_name in [
+    "addmm", "acos", "asin", "atan", "cos", "cosh", "sin", "sinh", "tan",
+    "digamma", "erf", "erfinv", "expm1", "flatten", "frac", "i0",
+    "index_add", "index_put", "lerp", "lgamma", "log", "log10", "log1p",
+    "log2", "logit", "neg", "polygamma", "pow", "put_along_axis",
+    "remainder", "trunc", "square", "tril", "triu",
+    "greater_equal", "greater_than", "less_equal", "less_than",
+    "not_equal", "equal",
+]:
+    if base_name in _METHODS:
+        _METHODS.setdefault(base_name + "_", _inplace(_METHODS[base_name]))
+
 _METHODS.setdefault("fill_", _inplace(lambda self, v: creation.full_like(self, v)))
 _METHODS.setdefault("zero_", _inplace(lambda self: creation.zeros_like(self)))
+
+
+def _tensor_is_floating_point(self):
+    from ..framework import compat as _compat
+
+    return _compat.is_floating_point(self)
+
+
+def _tensor_is_integer(self):
+    from ..framework import compat as _compat
+
+    return _compat.is_integer(self)
+
+
+def _tensor_is_complex(self):
+    from ..framework import compat as _compat
+
+    return _compat.is_complex(self)
+
+
+def _tensor_rank(self):
+    from ..framework import compat as _compat
+
+    return _compat.rank(self)
+
+
+def _tensor_create_tensor(self, dtype=None):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.zeros((), dtype or self._data.dtype))
+
+
+def _tensor_create_parameter(self, shape, dtype=None, **kwargs):
+    from ..framework import compat as _compat
+
+    return _compat.create_parameter(
+        shape, dtype or str(self._data.dtype), **kwargs)
+
+
+_METHODS.setdefault("is_floating_point", _tensor_is_floating_point)
+_METHODS.setdefault("is_integer", _tensor_is_integer)
+_METHODS.setdefault("is_complex", _tensor_is_complex)
+_METHODS.setdefault("rank", _tensor_rank)
+_METHODS.setdefault("create_tensor", _tensor_create_tensor)
+_METHODS.setdefault("create_parameter", _tensor_create_parameter)
 _METHODS.setdefault(
     "mean_all", lambda self: math.mean(self)
 )

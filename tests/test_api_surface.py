@@ -77,6 +77,43 @@ def test_every_namespace_all_covered():
     assert not problems, f"missing namespace members: {problems}"
 
 
+@pytest.mark.skipif(not os.path.exists(_R), reason="reference not mounted")
+def test_tensor_method_surface_covered():
+    """Every name in the reference's tensor_method_func registry exists
+    on a Tensor instance."""
+    tree = ast.parse(open(_R + "tensor/__init__.py").read())
+    names = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in (
+                        "tensor_method_func", "magic_method_func"):
+                    for e in node.value.elts:
+                        try:
+                            v = ast.literal_eval(e)
+                        except Exception:  # noqa: BLE001
+                            continue
+                        if isinstance(v, str):
+                            names.append(v)
+    assert names
+    t = pt.to_tensor(np.ones((2, 2), np.float32))
+    missing = sorted(n for n in set(names) if not hasattr(t, n))
+    assert not missing, f"missing Tensor methods: {missing}"
+
+
+def test_inplace_tensor_methods_behave():
+    x = pt.to_tensor(np.array([1.5, 2.5], np.float32))
+    assert x.log_() is x
+    np.testing.assert_allclose(x.numpy(), np.log([1.5, 2.5]), rtol=1e-6)
+    y = pt.to_tensor(np.array([4.0, 9.0], np.float32))
+    y.pow_(0.5)
+    np.testing.assert_allclose(y.numpy(), [2.0, 3.0], rtol=1e-6)
+    t = pt.to_tensor(np.ones((2, 2), np.float32))
+    assert t.is_floating_point() and not t.is_complex()
+    assert int(t.rank().numpy()) == 2
+    assert t.create_parameter([3, 3]).is_parameter
+
+
 class TestNewMathOps:
     def test_inplace_module_fns(self):
         x = pt.to_tensor(np.array([3.0, -1.0], np.float32))
