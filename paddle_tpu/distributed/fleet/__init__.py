@@ -106,6 +106,7 @@ def barrier_worker():
 
 # -- reference-shaped class surface (`fleet.Fleet`, role makers, util) --
 
+from . import utils  # noqa: F401,E402
 from .base.role_maker import (  # noqa: F401,E402
     PaddleCloudRoleMaker, Role, UserDefinedRoleMaker,
 )
@@ -184,6 +185,6 @@ class Fleet:
         return False
 
 
-__all__ += ["Fleet", "Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+__all__ += ["utils", "Fleet", "Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
             "UtilBase", "MultiSlotDataGenerator",
             "MultiSlotStringDataGenerator"]

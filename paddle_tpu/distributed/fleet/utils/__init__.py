@@ -1,3 +1,123 @@
 from .recompute import recompute, recompute_sequential  # noqa: F401
 
 __all__ = ["recompute", "recompute_sequential"]
+
+
+class LocalFS:
+    """Local filesystem client (parity: fleet.utils.LocalFS — the
+    reference's fs abstraction over local disk)."""
+
+    def ls_dir(self, path):
+        import os
+
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name))
+             else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, path):
+        import os
+
+        os.makedirs(path, exist_ok=True)
+
+    def is_dir(self, path):
+        import os
+
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        import os
+
+        return os.path.isfile(path)
+
+    def is_exist(self, path):
+        import os
+
+        return os.path.exists(path)
+
+    def delete(self, path):
+        import os
+        import shutil
+
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def rename(self, src, dst):
+        import os
+
+        os.rename(src, dst)
+
+    def mv(self, src, dst, overwrite=False):
+        import os
+
+        if os.path.exists(dst):
+            if not overwrite:
+                raise FileExistsError(
+                    f"mv destination exists: {dst!r} (reference "
+                    "FSFileExistsError semantics; pass overwrite=True)")
+            self.delete(dst)
+        os.rename(src, dst)
+
+    def upload(self, local_path, fs_path):
+        import shutil
+
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        import shutil
+
+        shutil.copy(fs_path, local_path)
+
+    def touch(self, path, exist_ok=True):
+        import os
+
+        if os.path.exists(path) and not exist_ok:
+            raise FileExistsError(path)
+        open(path, "a").close()
+
+    def cat(self, path):
+        with open(path, "rb") as f:
+            return f.read()
+
+    def list_dirs(self, path):
+        return self.ls_dir(path)[0]
+
+
+class HDFSClient:
+    """Parity: fleet.utils.HDFSClient. HDFS needs the hadoop CLI, which
+    this image does not bundle; the constructor verifies the binary and
+    raises with that rationale otherwise (silent absence would hide the
+    gap)."""
+
+    def __init__(self, hadoop_home=None, configs=None, **kwargs):
+        import os
+        import shutil
+
+        cand = (os.path.join(hadoop_home, "bin", "hadoop")
+                if hadoop_home else shutil.which("hadoop"))
+        if not cand or not os.path.exists(cand):
+            raise RuntimeError(
+                "HDFSClient requires the hadoop CLI, which is not present "
+                "in this TPU image; mount it and pass hadoop_home, or use "
+                "LocalFS / gcsfuse-style mounts for TPU-pod storage")
+        self._hadoop = cand
+        self._configs = configs or {}
+
+
+class DistributedInfer:
+    """Parity shim: fleet.utils.DistributedInfer rebuilds a PS program for
+    distributed inference; the PS tier is excluded (README 'Scope'), and
+    GSPMD inference needs no program rewrite — `inference.Predictor` runs
+    the sharded program directly."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        raise RuntimeError(
+            "DistributedInfer is part of the excluded parameter-server "
+            "stack (README 'Scope'); use paddle_tpu.inference.Predictor — "
+            "GSPMD-sharded programs serve without a rewrite pass")
+
+
+__all__ += ["LocalFS", "HDFSClient", "DistributedInfer"]

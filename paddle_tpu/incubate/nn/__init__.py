@@ -158,3 +158,151 @@ class functional:
         if y is None:
             x, y = T.split(x, 2, axis=-1)
         return F.silu(x) * y
+
+
+class FusedLinear(Layer):
+    """Parity: incubate.nn.FusedLinear — one matmul+bias epilogue; XLA
+    already emits the fused form, so this is Linear with the fused-op
+    name (and the same transpose_weight knob)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        if transpose_weight:
+            self.weight = self.create_parameter(
+                [out_features, in_features], attr=weight_attr)
+        else:
+            self.weight = self.create_parameter(
+                [in_features, out_features], attr=weight_attr)
+        self.bias = self.create_parameter([out_features], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        w = self.weight
+        y = T.matmul(x, w, transpose_y=self.transpose_weight)
+        return y + self.bias if self.bias is not None else y
+
+
+class FusedDropoutAdd(Layer):
+    """Parity: incubate.nn.FusedDropoutAdd — dropout(x) + y as one fused
+    epilogue."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return F.dropout(x, self.p, mode=self.mode,
+                         training=self.training) + y
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """Parity: incubate.nn.FusedBiasDropoutResidualLayerNorm —
+    LN(residual + dropout(x + bias)) in one fusion region."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.linear_bias = self.create_parameter([embed_dim],
+                                                 attr=bias_attr,
+                                                 is_bias=True)
+        self.norm = LayerNorm(embed_dim, epsilon=epsilon,
+                              weight_attr=weight_attr)
+        self.dropout_rate = dropout_rate
+
+    def forward(self, x, residual):
+        h = F.dropout(x + self.linear_bias, self.dropout_rate,
+                      training=self.training)
+        return self.norm(residual + h)
+
+
+class FusedEcMoe(Layer):
+    """Parity: incubate.nn.FusedEcMoe — expert-choice MoE (experts pick
+    their top-k tokens; Zhou et al. 2022) as batched expert einsums, the
+    layout GSPMD shards over the ep axis."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type="gelu",
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.w1 = self.create_parameter(
+            [num_experts, hidden_size, inter_size], attr=weight_attr)
+        self.b1 = self.create_parameter([num_experts, 1, inter_size],
+                                        attr=bias_attr, is_bias=True)
+        self.w2 = self.create_parameter(
+            [num_experts, inter_size, hidden_size], attr=weight_attr)
+        self.b2 = self.create_parameter([num_experts, 1, hidden_size],
+                                        attr=bias_attr, is_bias=True)
+        if act_type not in ("gelu", "relu"):
+            raise ValueError(f"act_type must be gelu|relu, got {act_type!r}")
+        self.act_type = act_type
+
+    def forward(self, x, gate):
+        """x: [b, s, h]; gate: gate LOGITS [b, s, e] from the caller's
+        gate layer (reference signature, `incubate/nn/layer/
+        fused_ec_moe.py`)."""
+        import jax
+
+        from ...ops.dispatch import apply
+
+        e = self.num_experts
+        act = jax.nn.gelu if self.act_type == "gelu" else jax.nn.relu
+
+        def f(xa, gate_logits, w1, b1, w2, b2):
+            b, s, h = xa.shape
+            tokens = xa.reshape(b * s, h)
+            n = tokens.shape[0]
+            # expert choice: each expert takes capacity = n/e tokens
+            cap = max(n // e, 1)
+            scores = jax.nn.softmax(
+                gate_logits.reshape(n, e).astype(jnp.float32), axis=-1)
+            g, idx = jax.lax.top_k(scores.T, cap)            # [e, cap]
+            picked = tokens[idx]                             # [e, cap, h]
+            hmid = act(jnp.einsum("ech,ehi->eci", picked, w1) + b1)
+            out_e = jnp.einsum("eci,eih->ech", hmid, w2) + b2
+            out = jnp.zeros_like(tokens)
+            flat_idx = idx.reshape(-1)
+            contrib = (out_e * g[..., None].astype(out_e.dtype)) \
+                .reshape(-1, h)
+            out = out.at[flat_idx].add(contrib)
+            return out.reshape(b, s, h)
+
+        return apply("fused_ec_moe", f,
+                     (x, gate, self.w1, self.b1, self.w2, self.b2))
+
+
+class FusedMultiTransformer(Layer):
+    """Parity: incubate.nn.FusedMultiTransformer — an N-layer decoder
+    stack with pre-LN attention + FFN, the inference-serving workhorse.
+    Per-layer weights are held as lists (the reference's layout); the
+    whole stack compiles into one program under jit, which is the TPU
+    form of the reference's fused CUDA pipeline."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 num_layers=1, **kwargs):
+        super().__init__()
+        if not normalize_before:
+            raise ValueError(
+                "FusedMultiTransformer is pre-LN only (same constraint as "
+                "the reference kernel)")
+        self.layers = []
+        for i in range(num_layers):
+            blk = FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=True)
+            self.add_sublayer(f"layer.{i}", blk)
+            self.layers.append(blk)
+
+    def forward(self, x, attn_mask=None, caches=None, **kwargs):
+        for blk in self.layers:
+            x = blk(x, attn_mask)
+        return x
+
+
+__all__ += ["FusedLinear", "FusedDropoutAdd",
+            "FusedBiasDropoutResidualLayerNorm", "FusedEcMoe",
+            "FusedMultiTransformer"]

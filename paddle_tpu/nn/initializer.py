@@ -215,3 +215,34 @@ def set_global_initializer(weight_init, bias_init=None):
 
 
 _GLOBAL = [None, None]
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed-conv upsampling
+    (parity: paddle.nn.initializer.Bilinear): weight [C_out, C_in, K, K]
+    gets the standard bilinear upsampling stencil per channel pair's
+    diagonal."""
+
+    def __call__(self, shape, dtype=None, key=None):
+        import numpy as np
+
+        d = dtype_mod.convert_dtype(dtype) if dtype \
+            else dtype_mod.get_default_dtype()
+        if len(shape) != 4:
+            raise ValueError(
+                f"Bilinear initializer expects a 4-D conv weight, got "
+                f"shape {list(shape)}")
+        if shape[2] != shape[3]:
+            raise ValueError(
+                "Bilinear initializer requires a square kernel "
+                f"(got {shape[2]}x{shape[3]})")
+        kh, kw = shape[2], shape[3]
+        f_h = (kh + 1) // 2
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        og = np.ogrid[:kh, :kw]
+        filt = ((1 - abs(og[0] / f_h - c_h))
+                * (1 - abs(og[1] / f_h - c_h)))
+        # reference fills EVERY channel pair with the stencil
+        # (`nn/initializer/Bilinear.py:108`)
+        w = np.broadcast_to(filt, tuple(shape)).astype(np.float32)
+        return jnp.asarray(w, d)

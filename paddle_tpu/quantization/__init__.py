@@ -169,3 +169,40 @@ class PTQ:
                         fq.eval()
                         setattr(sub, attr, fq)
         return model
+
+
+class BaseObserver(Layer):
+    """Parity: paddle.quantization.BaseObserver — subclass and implement
+    forward() to collect statistics and scales()."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+
+class BaseQuanter(Layer):
+    """Parity: paddle.quantization.BaseQuanter — a trainable fake-quant
+    layer base (FakeQuanterWithAbsMax is the in-tree subclass)."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+
+def quanter(name):
+    """Parity: paddle.quantization.quanter — class decorator registering a
+    quanter under `name` so QuantConfig can refer to it by string."""
+    def deco(cls):
+        _QUANTER_REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+_QUANTER_REGISTRY: dict = {}
+
+__all__ += ["BaseObserver", "BaseQuanter", "quanter"]

@@ -314,3 +314,7 @@ __all__ += [
     "isnan", "pow", "cast", "coalesce", "is_same_shape", "divide", "sum",
     "transpose", "reshape", "slice", "mv", "addmm", "pca_lowrank",
 ]
+
+
+from . import nn  # noqa: F401,E402  (sparse.nn layer namespace)
+__all__ = __all__ + ["nn"] if "nn" not in __all__ else __all__

@@ -1,0 +1,219 @@
+"""`paddle.sparse.nn` parity (reference `python/paddle/sparse/nn/`):
+layers over sparse COO tensors (point-cloud style NDHWC actives).
+
+TPU-first design note: XLA has no sparse-gather convolution kernel, and
+at the densities these layers see in practice the MXU's dense conv
+throughput wins over host-side gather orchestration — so each layer
+densifies the COO input, runs the dense TPU kernel, and re-sparsifies.
+Submanifold variants (SubmConv*) keep the reference semantics exactly:
+outputs exist only at input active sites (the dense result is masked to
+the input's activity pattern). `sparse_coo_tensor.to_dense()` and the
+mask live on device, so the round-trip stays inside XLA.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+from . import SparseCooTensor, relu as _sp_relu, sparse_coo_tensor
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D",
+           "MaxPool3D"]
+
+
+def _dense(x):
+    return x.to_dense() if isinstance(x, SparseCooTensor) else x
+
+
+def _resparsify(dense_t):
+    """Dense Tensor -> COO with the nonzero pattern of its values."""
+    arr = dense_t._data
+    nz = jnp.nonzero(jnp.any(arr != 0, axis=-1) if arr.ndim > 1
+                     else arr != 0)
+    idx = jnp.stack(nz)
+    vals = arr[nz]
+    return sparse_coo_tensor(Tensor(idx), Tensor(vals),
+                             shape=list(arr.shape))
+
+
+class _ValueAct(Layer):
+    """Activations act on the stored values only (zero maps to zero for
+    all of these, so the activity pattern is preserved — same contract as
+    the reference's sparse activations)."""
+
+    _fn_name = None
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        fn = getattr(F, self._fn_name)
+        if isinstance(x, SparseCooTensor):
+            return sparse_coo_tensor(x.indices(), fn(x.values()), x.shape)
+        return fn(x)
+
+
+class ReLU(_ValueAct):
+    _fn_name = "relu"
+
+    def forward(self, x):
+        return _sp_relu(x)
+
+
+class ReLU6(_ValueAct):
+    _fn_name = "relu6"
+
+
+class LeakyReLU(_ValueAct):
+    _fn_name = "leaky_relu"
+
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        if isinstance(x, SparseCooTensor):
+            return sparse_coo_tensor(
+                x.indices(), F.leaky_relu(x.values(), self.negative_slope),
+                x.shape)
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    """Softmax over the last dense axis of the values (reference: softmax
+    over each row's stored entries for CSR; COO here normalizes the
+    trailing channel axis of the values)."""
+
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        if isinstance(x, SparseCooTensor):
+            return sparse_coo_tensor(x.indices(),
+                                     F.softmax(x.values(), axis=self.axis),
+                                     x.shape)
+        return F.softmax(x, axis=self.axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the channel (last) axis of the active values
+    (parity: paddle.sparse.nn.BatchNorm — statistics over actives only)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ..nn import BatchNorm1D
+
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr, data_format="NLC")
+
+    def forward(self, x):
+        if isinstance(x, SparseCooTensor):
+            out = self._bn(x.values().unsqueeze(0)).squeeze(0)
+            return sparse_coo_tensor(x.indices(), out, x.shape)
+        return self._bn(x)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Under GSPMD the batch statistics are already global across the dp
+    mesh axis (the reduction compiles to a cross-replica all-reduce), so
+    Sync == BatchNorm here, like the dense SyncBatchNorm."""
+
+
+def _to_channels_first(arr, nd):
+    # NDHWC -> NCDHW (dense kernels are NC-first)
+    perm = (0, nd + 1) + tuple(range(1, nd + 1))
+    return jnp.transpose(arr, perm)
+
+
+def _to_channels_last(arr, nd):
+    perm = (0,) + tuple(range(2, nd + 2)) + (1,)
+    return jnp.transpose(arr, perm)
+
+
+class _SparseConvNd(Layer):
+    _nd = 3
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None,
+                 key=None):
+        super().__init__()
+        from ..nn import Conv2D as DenseConv2D, Conv3D as DenseConv3D
+
+        cls = DenseConv3D if self._nd == 3 else DenseConv2D
+        # submanifold conv preserves the active set; 'same' padding keeps
+        # spatial dims so the input mask applies
+        if self._subm:
+            ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+                else [kernel_size] * self._nd
+            padding = [k // 2 for k in ks]
+            stride = 1
+        self._conv = cls(in_channels, out_channels, kernel_size,
+                         stride=stride, padding=padding, dilation=dilation,
+                         groups=groups, weight_attr=weight_attr,
+                         bias_attr=bias_attr)
+
+    def forward(self, x):
+        sparse_in = isinstance(x, SparseCooTensor)
+        dense = _dense(x)
+        arr = dense._data
+        cf = Tensor(_to_channels_first(arr, self._nd))
+        out = self._conv(cf)
+        out_arr = _to_channels_last(out._data, self._nd)
+        if self._subm and sparse_in:
+            # submanifold: only input-active sites stay active
+            mask = jnp.any(arr != 0, axis=-1, keepdims=True)
+            out_arr = jnp.where(mask, out_arr, 0.0)
+        result = Tensor(out_arr)
+        return _resparsify(result) if sparse_in else result
+
+
+class Conv3D(_SparseConvNd):
+    _nd = 3
+    _subm = False
+
+
+class SubmConv3D(_SparseConvNd):
+    _nd = 3
+    _subm = True
+
+
+class Conv2D(_SparseConvNd):
+    _nd = 2
+    _subm = False
+
+
+class SubmConv2D(_SparseConvNd):
+    _nd = 2
+    _subm = True
+
+
+class MaxPool3D(Layer):
+    """Sparse max pool (parity: paddle.sparse.nn.MaxPool3D) — dense TPU
+    max_pool over the densified NDHWC actives."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        from ..nn import MaxPool3D as DenseMaxPool3D
+
+        self._pool = DenseMaxPool3D(kernel_size, stride=stride,
+                                    padding=padding)
+
+    def forward(self, x):
+        sparse_in = isinstance(x, SparseCooTensor)
+        arr = _dense(x)._data
+        cf = Tensor(_to_channels_first(arr, 3))
+        out = self._pool(cf)
+        result = Tensor(_to_channels_last(out._data, 3))
+        return _resparsify(result) if sparse_in else result

@@ -1,11 +1,6 @@
 """paddle.utils parity (`python/paddle/utils/`)."""
 from . import cpp_extension  # noqa: F401
 
-try:  # optional helpers
-    from .lazy_import import try_import  # noqa: F401
-except ImportError:
-    pass
-
 
 def run_check():
     """Parity: `paddle.utils.run_check()` — verifies the framework can
@@ -20,3 +15,61 @@ def run_check():
     n = len(jax.devices())
     print(f"PaddleTPU works well on {n} device(s) "
           f"({jax.default_backend()}).")
+
+
+def try_import(module_name, err_msg=None):
+    """Parity: paddle.utils.try_import — import or raise a clear error."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed; "
+            f"this build has no network egress — vendor the package "
+            f"into the environment") from e
+
+
+def require_version(min_version, max_version=None):
+    """Parity: paddle.utils.require_version — check the framework version
+    against [min_version, max_version]."""
+    from .. import __version__
+
+    def key(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = key(__version__)
+    if key(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and key(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Parity: paddle.utils.deprecated — decorator emitting a
+    DeprecationWarning on call."""
+    import functools
+    import warnings
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            msg = (f"API '{fn.__module__}.{fn.__name__}' is deprecated "
+                   f"since {since or 'an earlier release'}"
+                   + (f"; use {update_to} instead" if update_to else "")
+                   + (f". Reason: {reason}" if reason else ""))
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap
+
+
+__all__ = ["cpp_extension", "run_check", "try_import", "require_version",
+           "deprecated"]

@@ -149,3 +149,164 @@ class Cifar100(Cifar10):
             d = pickle.load(f, encoding="bytes")
         self.images = np.asarray(d[b"data"], np.uint8).reshape(-1, 3, 32, 32)
         self.labels = np.asarray(d[b"fine_labels"], np.int64)
+
+
+def _default_loader(path):
+    from . import image_load
+
+    return image_load(path, backend="numpy")
+
+
+_IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                   ".tif", ".tiff", ".webp")
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory image dataset (parity:
+    paddle.vision.datasets.DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or _IMG_EXTENSIONS))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders found in {root!r}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for base, _dirs, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    path = os.path.join(base, fn)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fn.lower().endswith(exts))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(
+                f"found no valid files under {root!r} (extensions {exts})")
+        self.targets = [s[1] for s in self.samples]
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+
+class ImageFolder(Dataset):
+    """flat/recursive image list, no labels (parity:
+    paddle.vision.datasets.ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or _IMG_EXTENSIONS))
+        self.samples = []
+        for base, _dirs, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                path = os.path.join(base, fn)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fn.lower().endswith(exts))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"found no valid files under {root!r}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (parity: paddle.vision.datasets.Flowers).
+    No-egress: reads the standard local files (102flowers.tgz extracted
+    to jpg/, imagelabels.mat, setid.mat via scipy)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend="numpy"):
+        _require_no_download(download and data_file is None, "Flowers")
+        import scipy.io as sio
+
+        root = data_file or "flowers-102"
+        self.transform = transform
+        self.backend = backend
+        labels = sio.loadmat(label_file
+                             or os.path.join(root, "imagelabels.mat"))
+        setid = sio.loadmat(setid_file or os.path.join(root, "setid.mat"))
+        # reference MODE_FLAG_MAP deliberately swaps trn/tst: tstid is the
+        # large split, used for training (`vision/datasets/flowers.py:38`)
+        key = {"train": "tstid", "valid": "valid", "test": "trnid"}[mode]
+        self.indexes = setid[key].reshape(-1)
+        self.labels = labels["labels"].reshape(-1)
+        self.jpg_dir = os.path.join(root, "jpg")
+
+    def __len__(self):
+        return len(self.indexes)
+
+    def __getitem__(self, idx):
+        i = int(self.indexes[idx])
+        img = _default_loader(
+            os.path.join(self.jpg_dir, f"image_{i:05d}.jpg"))
+        if self.transform is not None:
+            img = self.transform(img)
+        # reference returns the raw 1-based label wrapped in an array
+        return img, np.array([int(self.labels[i - 1])])
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (parity:
+    paddle.vision.datasets.VOC2012). No-egress: reads the extracted
+    VOCdevkit layout."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="numpy"):
+        _require_no_download(download and data_file is None, "VOC2012")
+        root = data_file or "VOCdevkit/VOC2012"
+        if os.path.isdir(os.path.join(root, "VOCdevkit")):
+            root = os.path.join(root, "VOCdevkit", "VOC2012")
+        self.transform = transform
+        # reference MODE_FLAG_MAP (`vision/datasets/voc2012.py:36`):
+        # train -> trainval (the full labeled pool), test -> train
+        split = {"train": "trainval", "valid": "val", "test": "train",
+                 "trainval": "trainval"}[mode]
+        list_file = os.path.join(root, "ImageSets", "Segmentation",
+                                 split + ".txt")
+        with open(list_file) as f:
+            names = [ln.strip() for ln in f if ln.strip()]
+        self.images = [os.path.join(root, "JPEGImages", n + ".jpg")
+                       for n in names]
+        self.masks = [os.path.join(root, "SegmentationClass", n + ".png")
+                      for n in names]
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = _default_loader(self.images[idx])
+        mask = _default_loader(self.masks[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+
+if "__all__" not in globals():
+    __all__ = ["FakeData", "MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+__all__ += ["DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]

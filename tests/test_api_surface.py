@@ -67,6 +67,19 @@ def test_every_namespace_all_covered():
         ("nn/__init__.py", lambda: pt.nn),
         ("nn/functional/__init__.py", lambda: pt.nn.functional),
         ("linalg.py", lambda: pt.linalg),
+        ("nn/initializer/__init__.py", lambda: pt.nn.initializer),
+        ("nn/utils/__init__.py", lambda: pt.nn.utils),
+        ("profiler/__init__.py", lambda: pt.profiler),
+        ("incubate/nn/__init__.py", lambda: pt.incubate.nn),
+        ("sparse/nn/__init__.py", lambda: pt.sparse.nn),
+        ("distribution/transform.py",
+         lambda: pt.distribution.transform),
+        ("vision/datasets/__init__.py", lambda: pt.vision.datasets),
+        ("utils/__init__.py", lambda: pt.utils),
+        ("distributed/fleet/utils/__init__.py",
+         lambda: pt.distributed.fleet.utils),
+        ("audio/functional/__init__.py", lambda: pt.audio.functional),
+        ("quantization/__init__.py", lambda: pt.quantization),
     ]
     problems = {}
     for rel, get in pairs:

@@ -1,0 +1,190 @@
+"""Round-3 second-level namespace completions: sparse.nn layers,
+incubate.nn fused layers, folder datasets, fleet.utils fs clients,
+utils helpers, Bilinear initializer, profiler enums."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _t(a):
+    return pt.to_tensor(np.asarray(a))
+
+
+class TestSparseNN:
+    def _sample(self):
+        dense = np.zeros((1, 4, 4, 4, 3), np.float32)
+        dense[0, 1, 1, 1] = [1.0, -2.0, 3.0]
+        dense[0, 2, 3, 0] = [0.5, 0.5, 0.5]
+        idx = np.array(np.nonzero(np.any(dense != 0, axis=-1)))
+        vals = dense[tuple(idx)]
+        sp = pt.sparse.sparse_coo_tensor(_t(idx), _t(vals),
+                                         shape=list(dense.shape))
+        return dense, sp
+
+    def test_value_activations(self):
+        _, sp = self._sample()
+        r = pt.sparse.nn.ReLU()(sp)
+        assert r.is_sparse() and float(r.values().numpy().min()) >= 0
+        lr = pt.sparse.nn.LeakyReLU(0.1)(sp)
+        assert lr.is_sparse()
+        sm = pt.sparse.nn.Softmax()(sp)
+        np.testing.assert_allclose(sm.values().numpy().sum(-1), 1.0,
+                                   rtol=1e-5)
+
+    def test_batch_norm(self):
+        dense, sp = self._sample()
+        out = pt.sparse.nn.BatchNorm(3)(sp)
+        assert out.is_sparse() and out.shape == list(dense.shape)
+        sync = pt.sparse.nn.SyncBatchNorm(3)(sp)
+        assert sync.is_sparse()
+
+    def test_conv_and_subm(self):
+        dense, sp = self._sample()
+        y = pt.sparse.nn.Conv3D(3, 5, 3, padding=1)(sp)
+        assert y.shape[-1] == 5
+        ys = pt.sparse.nn.SubmConv3D(3, 5, 3)(sp)
+        active = np.any(ys.to_dense().numpy() != 0, axis=-1)
+        orig = np.any(dense != 0, axis=-1)
+        assert (active <= orig).all()  # subm never grows the active set
+        m = pt.sparse.nn.MaxPool3D(2)(sp)
+        assert m.shape[1] == 2
+
+
+class TestFusedLayers:
+    def test_fused_linear(self):
+        x = _t(np.random.randn(2, 4).astype(np.float32))
+        fl = pt.incubate.nn.FusedLinear(4, 6)
+        assert fl(x).shape == [2, 6]
+        flt = pt.incubate.nn.FusedLinear(4, 6, transpose_weight=True)
+        assert flt.weight.shape == [6, 4] and flt(x).shape == [2, 6]
+
+    def test_fused_dropout_residual(self):
+        x = _t(np.random.randn(2, 4).astype(np.float32))
+        fd = pt.incubate.nn.FusedDropoutAdd(0.0)
+        np.testing.assert_allclose(fd(x, x).numpy(), 2 * x.numpy(),
+                                   rtol=1e-6)
+        fb = pt.incubate.nn.FusedBiasDropoutResidualLayerNorm(4, 0.0)
+        out = fb(x, x)
+        assert out.shape == [2, 4]
+        np.testing.assert_allclose(out.numpy().mean(-1), 0.0, atol=1e-5)
+
+    def test_fused_ec_moe_and_stack(self):
+        h = _t(np.random.randn(2, 8, 8).astype(np.float32))
+        moe = pt.incubate.nn.FusedEcMoe(8, 16, 4)
+        gate = pt.nn.Linear(8, 4)
+        assert moe(h, gate(h)).shape == [2, 8, 8]
+        fmt = pt.incubate.nn.FusedMultiTransformer(
+            8, 2, 16, num_layers=2, normalize_before=True)
+        assert fmt(h).shape == [2, 8, 8]
+        with pytest.raises(ValueError):
+            pt.incubate.nn.FusedMultiTransformer(8, 2, 16,
+                                                 normalize_before=False)
+
+
+class TestFolderDatasets:
+    @pytest.fixture()
+    def folder(self, tmp_path):
+        from PIL import Image
+
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(2):
+                Image.fromarray(
+                    np.full((4, 4, 3), i * 40, np.uint8)
+                ).save(str(d / f"{cls}{i}.png"))
+        return str(tmp_path)
+
+    def test_dataset_folder(self, folder):
+        ds = pt.vision.datasets.DatasetFolder(folder)
+        assert len(ds) == 4 and ds.classes == ["cat", "dog"]
+        img, label = ds[0]
+        assert img.shape == (4, 4, 3) and label == 0
+        assert ds.targets.count(1) == 2
+
+    def test_image_folder_and_transform(self, folder):
+        calls = []
+
+        def tf(img):
+            calls.append(1)
+            return img
+
+        ds = pt.vision.datasets.ImageFolder(folder, transform=tf)
+        assert len(ds) == 4
+        (img,) = ds[1]
+        assert img.shape == (4, 4, 3) and calls
+
+    def test_empty_folder_raises(self, tmp_path):
+        (tmp_path / "empty").mkdir()
+        with pytest.raises(RuntimeError):
+            pt.vision.datasets.DatasetFolder(str(tmp_path))
+
+
+class TestSmallCompletions:
+    def test_utils(self):
+        mod = pt.utils.try_import("math")
+        assert mod.sqrt(4) == 2
+        with pytest.raises(ImportError):
+            pt.utils.try_import("definitely_not_a_module_xyz")
+        assert pt.utils.require_version("0.0.1")
+        with pytest.raises(Exception, match="required"):
+            pt.utils.require_version("999.0.0")
+
+        @pt.utils.deprecated(update_to="paddle.new_api", since="2.0")
+        def old():
+            return 42
+
+        with pytest.warns(DeprecationWarning):
+            assert old() == 42
+
+    def test_bilinear_initializer(self):
+        init = pt.nn.initializer.Bilinear()
+        w = np.asarray(init([2, 2, 4, 4], "float32"))
+        assert w.shape == (2, 2, 4, 4)
+        # symmetric stencil, peak at center, every channel pair filled
+        np.testing.assert_allclose(w[0, 0], w[0, 0][::-1, ::-1], atol=1e-6)
+        assert w[0, 0].max() == w[0, 0][1:3, 1:3].max()
+        np.testing.assert_allclose(w[1, 1], w[0, 0])
+        np.testing.assert_allclose(w[0, 1], w[0, 0])
+        with pytest.raises(ValueError):
+            init([4, 4], "float32")
+        with pytest.raises(ValueError, match="square"):
+            init([2, 2, 3, 5], "float32")
+
+    def test_profiler_enums(self):
+        assert pt.profiler.SortedKeys.CPUTotal == 0
+        assert pt.profiler.SummaryView.OverView == 1
+        with pytest.raises(ValueError):
+            pt.profiler.export_protobuf(None)
+
+    def test_quantization_shells(self):
+        assert issubclass(pt.quantization.FakeQuanterWithAbsMax, pt.nn.Layer)
+
+        @pt.quantization.quanter("MyQ")
+        class MyQ(pt.quantization.BaseQuanter):
+            pass
+
+        from paddle_tpu.quantization import _QUANTER_REGISTRY
+
+        assert _QUANTER_REGISTRY["MyQ"] is MyQ
+
+    def test_fleet_localfs(self, tmp_path):
+        fs = pt.distributed.fleet.utils.LocalFS()
+        d = str(tmp_path / "sub")
+        fs.mkdirs(d)
+        fs.touch(os.path.join(d, "f.txt"))
+        dirs, files = fs.ls_dir(str(tmp_path))
+        assert dirs == ["sub"] and files == []
+        assert fs.is_dir(d) and not fs.is_file(d)
+        # mv refuses to clobber unless overwrite=True (reference contract)
+        fs.touch(os.path.join(d, "g.txt"))
+        with pytest.raises(FileExistsError):
+            fs.mv(os.path.join(d, "f.txt"), os.path.join(d, "g.txt"))
+        fs.mv(os.path.join(d, "f.txt"), os.path.join(d, "g.txt"),
+              overwrite=True)
+        assert not fs.is_exist(os.path.join(d, "f.txt"))
+        fs.delete(d)
+        assert not fs.is_exist(d)
