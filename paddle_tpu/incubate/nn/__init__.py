@@ -298,6 +298,12 @@ class FusedMultiTransformer(Layer):
             self.layers.append(blk)
 
     def forward(self, x, attn_mask=None, caches=None, **kwargs):
+        if caches is not None:
+            raise NotImplementedError(
+                "FusedMultiTransformer KV caches (incremental decoding) "
+                "are not wired in this build — silently ignoring them "
+                "would produce wrong generations; run full-sequence "
+                "forward, or drive decode via nn.BeamSearchDecoder")
         for blk in self.layers:
             x = blk(x, attn_mask)
         return x

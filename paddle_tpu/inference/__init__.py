@@ -148,7 +148,11 @@ class Predictor:
                 if (precision is not None
                         and np.issubdtype(dt, np.floating)):
                     a = a.astype(precision)
-                    a = a.astype(dt) if str(dt) != str(precision) else a
+                # the exported program's input contract is exact: users
+                # commonly feed fp32 into a bf16-exported model — cast at
+                # the boundary instead of failing the aval check
+                if str(a.dtype) != str(dt):
+                    a = a.astype(dt)
                 cast.append(a)
             out = call(*cast)
             return out if isinstance(out, (list, tuple)) else (out,)

@@ -78,7 +78,12 @@ def batch_norm(
             i += 1
         if has_b:
             out = out + rest[i].reshape(shape)
-        return out
+        # normalize in promoted precision, return the INPUT dtype: under
+        # AMP O2 the running buffers stay fp32 while activations are bf16;
+        # without the cast-back a bf16 network leaks fp32 activations out
+        # of every BN (the reference's O2 batch_norm kernel computes in
+        # fp32 and emits the input dtype)
+        return out.astype(a.dtype)
 
     return apply("batch_norm", f, tuple(operands))
 

@@ -369,8 +369,6 @@ def export_protobuf(profiler_result=None, file_name="profiler.pb"):
     writes (`jax.profiler`); this writes the chrome-trace JSON to
     ``file_name`` so the call site still produces an artifact, and says
     so rather than emitting a paddle-proto nobody here can read."""
-    import json as _json
-
     if profiler_result is None or not hasattr(profiler_result, "export"):
         raise ValueError(
             "export_protobuf needs the Profiler object (this build "

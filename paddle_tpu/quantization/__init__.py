@@ -104,9 +104,24 @@ class QuantConfig:
     quanter factories."""
 
     def __init__(self, activation=None, weight=None):
-        self.activation = activation or (lambda: FakeQuanterWithAbsMax())
-        self.weight = weight or (lambda: FakeQuanterWithAbsMax())
+        self.activation = self._resolve(activation) \
+            or (lambda: FakeQuanterWithAbsMax())
+        self.weight = self._resolve(weight) \
+            or (lambda: FakeQuanterWithAbsMax())
         self._types = (Linear, Conv2D)
+
+    @staticmethod
+    def _resolve(q):
+        """Accept a factory callable or a name registered via
+        @quanter(name)."""
+        if isinstance(q, str):
+            try:
+                return _QUANTER_REGISTRY[q]
+            except KeyError:
+                raise ValueError(
+                    f"no quanter registered under {q!r}; register with "
+                    "@paddle.quantization.quanter(name)") from None
+        return q
 
     def add_type_config(self, layer_types, activation=None, weight=None):
         if not isinstance(layer_types, (list, tuple)):

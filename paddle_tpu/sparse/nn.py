@@ -27,11 +27,15 @@ def _dense(x):
     return x.to_dense() if isinstance(x, SparseCooTensor) else x
 
 
-def _resparsify(dense_t):
-    """Dense Tensor -> COO with the nonzero pattern of its values."""
+def _resparsify(dense_t, mask=None):
+    """Dense Tensor -> COO. ``mask`` ([*site dims] bool) names the active
+    sites explicitly (kernel-reachable sites for sparse conv — a biased
+    conv makes every VALUE nonzero, so value-nonzeroness alone would
+    densify); defaults to the nonzero pattern."""
     arr = dense_t._data
-    nz = jnp.nonzero(jnp.any(arr != 0, axis=-1) if arr.ndim > 1
-                     else arr != 0)
+    if mask is None:
+        mask = jnp.any(arr != 0, axis=-1) if arr.ndim > 1 else arr != 0
+    nz = jnp.nonzero(mask)
     idx = jnp.stack(nz)
     vals = arr[nz]
     return sparse_coo_tensor(Tensor(idx), Tensor(vals),
@@ -151,31 +155,76 @@ class _SparseConvNd(Layer):
         from ..nn import Conv2D as DenseConv2D, Conv3D as DenseConv3D
 
         cls = DenseConv3D if self._nd == 3 else DenseConv2D
-        # submanifold conv preserves the active set; 'same' padding keeps
-        # spatial dims so the input mask applies
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else [kernel_size] * self._nd
+        self._ks = list(ks)
         if self._subm:
-            ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
-                else [kernel_size] * self._nd
-            padding = [k // 2 for k in ks]
+            # submanifold semantics fix the site lattice: outputs live
+            # exactly at input active sites, which requires stride 1 and
+            # same-padding (enforced, not silently overridden)
+            strides = stride if isinstance(stride, (list, tuple)) \
+                else [stride] * self._nd
+            if any(s != 1 for s in strides):
+                raise ValueError(
+                    "SubmConv requires stride 1 (the active-site lattice "
+                    "is preserved); use the non-submanifold Conv for "
+                    "strided downsampling")
+            pads = padding if isinstance(padding, (list, tuple)) \
+                else [padding] * self._nd
+            if any(p != 0 for p in pads):
+                raise ValueError(
+                    "SubmConv manages its own same-padding; pass "
+                    "padding=0 (the default)")
             stride = 1
+            padding = 0  # padded manually (even kernels need asymmetric)
+        self._stride = stride if isinstance(stride, (list, tuple)) \
+            else [stride] * self._nd
+        self._padding = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * self._nd
+        self._dilation = dilation if isinstance(dilation, (list, tuple)) \
+            else [dilation] * self._nd
         self._conv = cls(in_channels, out_channels, kernel_size,
                          stride=stride, padding=padding, dilation=dilation,
                          groups=groups, weight_attr=weight_attr,
                          bias_attr=bias_attr)
 
+    def _reachable_mask(self, in_mask_cf):
+        """Output active sites = sites any input active reaches through
+        the kernel window (the reference's sparse-conv rulebook), computed
+        as a conv of the 0/1 mask with a ones kernel at this layer's
+        geometry."""
+        import jax
+
+        ones = jnp.ones((1, 1) + tuple(self._ks), in_mask_cf.dtype)
+        pads = [(p, p) for p in self._padding]
+        hit = jax.lax.conv_general_dilated(
+            in_mask_cf, ones, tuple(self._stride), pads,
+            rhs_dilation=tuple(self._dilation))
+        return hit > 0
+
     def forward(self, x):
+        import jax
+
         sparse_in = isinstance(x, SparseCooTensor)
         dense = _dense(x)
         arr = dense._data
-        cf = Tensor(_to_channels_first(arr, self._nd))
-        out = self._conv(cf)
+        cf_arr = _to_channels_first(arr, self._nd)
+        if self._subm:
+            # manual same-padding (asymmetric halves for even kernels)
+            pads = [(0, 0), (0, 0)] + [((k - 1) // 2, k // 2)
+                                       for k in self._ks]
+            cf_arr = jnp.pad(cf_arr, pads)
+        out = self._conv(Tensor(cf_arr))
         out_arr = _to_channels_last(out._data, self._nd)
-        if self._subm and sparse_in:
-            # submanifold: only input-active sites stay active
-            mask = jnp.any(arr != 0, axis=-1, keepdims=True)
-            out_arr = jnp.where(mask, out_arr, 0.0)
-        result = Tensor(out_arr)
-        return _resparsify(result) if sparse_in else result
+        if not sparse_in:
+            return Tensor(out_arr)
+        in_mask = jnp.any(arr != 0, axis=-1)[:, None].astype(arr.dtype)
+        if self._subm:
+            mask = jnp.moveaxis(in_mask, 1, -1) > 0  # [n, *spatial, 1]
+        else:
+            mask = jnp.moveaxis(self._reachable_mask(in_mask), 1, -1)
+        out_arr = jnp.where(mask, out_arr, 0.0)
+        return _resparsify(Tensor(out_arr), mask=mask[..., 0])
 
 
 class Conv3D(_SparseConvNd):

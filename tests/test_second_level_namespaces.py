@@ -43,12 +43,26 @@ class TestSparseNN:
 
     def test_conv_and_subm(self):
         dense, sp = self._sample()
-        y = pt.sparse.nn.Conv3D(3, 5, 3, padding=1)(sp)
+        conv = pt.sparse.nn.Conv3D(3, 5, 3, padding=1)
+        y = conv(sp)
         assert y.shape[-1] == 5
+        # active set = kernel-REACHABLE sites, not value-nonzero sites: a
+        # biased conv must not densify the COO (round-3 review)
+        conv._conv.bias.set_value(np.full(5, 0.1, np.float32))
+        yb = conv(sp)
+        n_sites = int(np.prod(dense.shape[:-1]))
+        assert yb.nnz < n_sites, "bias densified the sparse output"
         ys = pt.sparse.nn.SubmConv3D(3, 5, 3)(sp)
         active = np.any(ys.to_dense().numpy() != 0, axis=-1)
         orig = np.any(dense != 0, axis=-1)
         assert (active <= orig).all()  # subm never grows the active set
+        # even kernels work (asymmetric same-padding keeps input dims)
+        ye = pt.sparse.nn.SubmConv3D(3, 4, 2)(sp)
+        assert ye.shape[:-1] == list(dense.shape[:-1])
+        with pytest.raises(ValueError, match="stride"):
+            pt.sparse.nn.SubmConv3D(3, 4, 3, stride=2)
+        with pytest.raises(ValueError, match="padding"):
+            pt.sparse.nn.SubmConv3D(3, 4, 3, padding=1)
         m = pt.sparse.nn.MaxPool3D(2)(sp)
         assert m.shape[1] == 2
 
@@ -82,6 +96,8 @@ class TestFusedLayers:
         with pytest.raises(ValueError):
             pt.incubate.nn.FusedMultiTransformer(8, 2, 16,
                                                  normalize_before=False)
+        with pytest.raises(NotImplementedError, match="cache"):
+            fmt(h, caches=[None, None])
 
 
 class TestFolderDatasets:
@@ -170,6 +186,11 @@ class TestSmallCompletions:
         from paddle_tpu.quantization import _QUANTER_REGISTRY
 
         assert _QUANTER_REGISTRY["MyQ"] is MyQ
+        # string configs resolve through the registry
+        cfg = pt.quantization.QuantConfig(activation="MyQ", weight="MyQ")
+        assert cfg.activation is MyQ and cfg.weight is MyQ
+        with pytest.raises(ValueError, match="registered"):
+            pt.quantization.QuantConfig(activation="NoSuchQ")
 
     def test_fleet_localfs(self, tmp_path):
         fs = pt.distributed.fleet.utils.LocalFS()

@@ -1,0 +1,137 @@
+"""End-to-end reference-user journey: the workflow a PaddlePaddle user
+follows, executed start to finish through this framework's public API —
+dataset + transforms -> DataLoader -> model-zoo model -> AMP training with
+LR schedule + regularizer + grad clip -> metrics -> checkpoint ->
+resume -> @to_static export -> inference Predictor."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class _TinyImages(paddle.io.Dataset):
+    """Synthetic HWC uint8 images through the real transform stack."""
+
+    def __init__(self, n=32, transform=None):
+        self.n = n
+        self.transform = transform
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(i)
+        img = (rng.rand(16, 16, 3) * 255).astype(np.uint8)
+        label = i % 4
+        if self.transform:
+            img = self.transform(img)
+        return img.astype(np.float32), np.int64(label)
+
+
+def test_full_training_journey(tmp_path):
+    T = paddle.vision.transforms
+    transform = T.Compose([
+        T.RandomHorizontalFlip(),
+        T.ColorJitter(0.1, 0.1, 0.1, 0.05),
+        T.ToTensor(),  # HWC uint8 -> CHW float [0,1]
+    ])
+    ds = _TinyImages(transform=transform)
+    loader = paddle.io.DataLoader(ds, batch_size=8, shuffle=True,
+                                  num_workers=2, drop_last=True)
+
+    net = paddle.vision.models.resnet18(num_classes=4)
+    sched = paddle.optimizer.lr.CosineAnnealingDecay(
+        learning_rate=1e-3, T_max=8)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=sched, parameters=net.parameters(),
+        weight_decay=paddle.regularizer.L2Decay(1e-4),
+        grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+    net, opt = paddle.amp.decorate(net, opt, level="O2", dtype="bfloat16")
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    metric = paddle.metric.Accuracy()
+
+    losses = []
+    for epoch in range(2):
+        for imgs, labels in loader:
+            with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+                logits = net(imgs.astype("bfloat16"))
+                loss = loss_fn(logits.astype("float32"),
+                               labels.unsqueeze(-1))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            metric.update(
+                metric.compute(logits.astype("float32"),
+                               labels.unsqueeze(-1)))
+            losses.append(float(loss.numpy()))
+        sched.step()
+    assert np.isfinite(losses).all()
+    assert 0.0 <= metric.accumulate() <= 1.0
+
+    # checkpoint -> fresh model -> resume
+    ckpt = str(tmp_path / "model.pdparams")
+    paddle.save(net.state_dict(), ckpt)
+    net2 = paddle.vision.models.resnet18(num_classes=4)
+    net2 = paddle.amp.decorate(net2, level="O2", dtype="bfloat16")
+    net2.set_state_dict(paddle.load(ckpt))
+    for (k1, v1), (k2, v2) in zip(sorted(net.state_dict().items()),
+                                  sorted(net2.state_dict().items())):
+        assert k1 == k2
+        np.testing.assert_array_equal(np.asarray(v1._data),
+                                      np.asarray(v2._data))
+
+    # @to_static export -> jit.save -> inference Predictor
+    net.eval()
+    spec = [paddle.static.InputSpec([None, 3, 16, 16], "bfloat16", "x")]
+    static_net = paddle.jit.to_static(net, input_spec=spec)
+    prefix = str(tmp_path / "inference" / "model")
+    os.makedirs(os.path.dirname(prefix), exist_ok=True)
+    paddle.jit.save(static_net, prefix)
+
+    config = paddle.inference.Config(prefix + ".pdmodel",
+                                     prefix + ".pdiparams")
+    predictor = paddle.inference.create_predictor(config)
+    x = np.random.rand(2, 3, 16, 16).astype(np.float32)
+    in_names = predictor.get_input_names()
+    h = predictor.get_input_handle(in_names[0])
+    h.copy_from_cpu(x.astype(np.float32))
+    predictor.run()
+    out = predictor.get_output_handle(
+        predictor.get_output_names()[0]).copy_to_cpu()
+    assert out.shape == (2, 4)
+    # predictor output matches eager eval
+    eager = static_net(paddle.to_tensor(x).astype("bfloat16"))
+    np.testing.assert_allclose(out.astype(np.float32),
+                               np.asarray(eager.numpy(), np.float32),
+                               atol=0.1)
+
+
+def test_hapi_journey(tmp_path):
+    ds = _TinyImages(n=16)
+    model = paddle.Model(paddle.vision.models.LeNet(num_classes=4))
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(1e-3,
+                                        parameters=model.network.parameters()),
+        loss=paddle.nn.CrossEntropyLoss(),
+        metrics=paddle.metric.Accuracy())
+
+    class _Gray(paddle.io.Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            return (rng.rand(1, 28, 28).astype(np.float32),
+                    np.int64(i % 4))
+
+    gds = _Gray()
+    model.fit(gds, epochs=1, batch_size=8, verbose=0,
+              callbacks=[paddle.callbacks.EarlyStopping(
+                  monitor="loss", patience=3)])
+    ev = model.evaluate(gds, batch_size=8, verbose=0)
+    assert "loss" in ev
+    preds = model.predict(gds, batch_size=8, verbose=0)
+    assert np.asarray(preds[0][0]).shape[-1] == 4
+    model.save(str(tmp_path / "hapi_ckpt"))
