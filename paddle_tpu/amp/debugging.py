@@ -95,3 +95,61 @@ def disable_tensor_checker():
     from ..framework import flags
 
     flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """Parity: paddle.amp.debugging.compare_accuracy — diff two
+    operator-stats dumps (the workflow: run fp32 and amp with
+    collect_operator_stats, dump, compare). Reads the two dumps (JSON
+    lines of per-op stats), joins on op name, and writes an Excel-free
+    CSV report of mismatches."""
+    import csv
+    import json
+    import os
+
+    def load(path):
+        out: dict = {}
+        if os.path.isdir(path):
+            files = [os.path.join(path, f) for f in sorted(os.listdir(path))
+                     if os.path.isfile(os.path.join(path, f))]
+        else:
+            files = [path]
+        for fp in files:
+            with open(fp) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    op = rec.get("op", rec.get("name", "?"))
+                    # AGGREGATE all records per op (dumps hold one line
+                    # per call/step): numeric fields sum, so no step's
+                    # NaN count is silently dropped
+                    agg = out.setdefault(op, {"calls": 0})
+                    agg["calls"] += 1
+                    for k, v in rec.items():
+                        if k in ("op", "name"):
+                            continue
+                        if isinstance(v, (int, float)):
+                            agg[k] = agg.get(k, 0) + v
+                        else:
+                            agg[k] = v
+        return out
+
+    a = load(dump_path)
+    b = load(another_dump_path)
+    with open(output_filename, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["op", "metric", "run_a", "run_b"])
+        for op in sorted(set(a) | set(b)):
+            ra, rb = a.get(op, {}), b.get(op, {})
+            keys = (set(ra) | set(rb)) - {"op", "name"}
+            for k in sorted(keys):
+                va, vb = ra.get(k), rb.get(k)
+                if va != vb:
+                    w.writerow([op, k, va, vb])
+    return output_filename
+
+
+__all__ += ["compare_accuracy"]

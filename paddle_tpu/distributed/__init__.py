@@ -36,6 +36,10 @@ from .shard import (  # noqa: F401
     sharding_constraint,
 )
 
+from . import checkpoint  # noqa: F401
+from . import passes  # noqa: F401
+from . import rpc  # noqa: F401
+from . import sharding  # noqa: F401
 from . import fleet  # noqa: F401
 from . import io  # noqa: F401
 from . import launch  # noqa: F401
@@ -72,7 +76,7 @@ __all__ = [
     "get_sharding", "PartitionSpec", "ProcessMesh", "DistAttr",
     "ParallelMode", "split",
     "init_mesh", "get_mesh", "get_env", "AXIS_ORDER",
-    "fleet", "io", "launch",
+    "fleet", "io", "launch", "checkpoint", "sharding", "rpc", "passes",
     "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
     "InMemoryDataset", "QueueDataset", "CountFilterEntry",
     "ProbabilityEntry", "ShowClickEntry",

@@ -1,0 +1,107 @@
+"""paddle.distributed.passes parity (reference
+`python/paddle/distributed/passes/pass_base.py`): the named-pass registry
+and PassManager.
+
+TPU-first note: the reference's pass zoo (auto_parallel_fp16,
+fused_attention, pipeline scheduling, ...) rewrites ProgramDesc graphs;
+here those capabilities are XLA's (fusion, AMP recording, scan-based
+pipeline). The pass *framework* still carries user/third-party program
+rewrites: a pass is a callable over the recorded `static.Program`,
+registered by name, applied through PassManager — same surface, operating
+on the op-record form.
+"""
+from __future__ import annotations
+
+__all__ = ["new_pass", "PassManager", "PassContext", "register_pass"]
+
+_PASS_REGISTRY: dict = {}
+
+
+def register_pass(name):
+    """Decorator: register a pass class/factory under ``name`` (parity:
+    @register_pass in pass_base.py)."""
+    def deco(cls):
+        _PASS_REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+class PassContext:
+    """Carries attributes between passes (parity: PassContext)."""
+
+    def __init__(self):
+        self._attrs: dict = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+
+class _PassBase:
+    def __init__(self, name, attrs=None):
+        self.name = name
+        self._attrs = dict(attrs or {})
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        raise NotImplementedError(
+            f"pass {self.name!r} was created without an implementation; "
+            "register one with @register_pass or subclass and override "
+            "apply()")
+
+
+def new_pass(name, pass_attrs=None):
+    """Instantiate a registered pass by name (parity: new_pass). Unknown
+    names raise with the registry contents — the reference's C++ pass zoo
+    has no graph form here to silently no-op on."""
+    cls = _PASS_REGISTRY.get(name)
+    if cls is None:
+        raise ValueError(
+            f"no pass registered under {name!r} (registered: "
+            f"{sorted(_PASS_REGISTRY)}); the reference's built-in graph "
+            "passes are XLA's job on TPU — register custom program "
+            "passes with @register_pass")
+    p = cls() if isinstance(cls, type) else cls
+    if not isinstance(p, _PassBase):
+        base = _PassBase(name, pass_attrs)
+        if hasattr(p, "apply") and callable(p.apply):
+            # duck-typed pass object: honor its apply()
+            base.apply = p.apply
+        elif callable(p):
+            base.apply = p
+        p = base
+    for k, v in (pass_attrs or {}).items():
+        p.set_attr(k, v)
+    return p
+
+
+class PassManager:
+    """Apply a pass list in order (parity: PassManager)."""
+
+    def __init__(self, passes):
+        self._passes = list(passes)
+        self.context = PassContext()
+
+    @property
+    def names(self):
+        return [getattr(p, "name", type(p).__name__) for p in self._passes]
+
+    def apply(self, main_programs, startup_programs=None):
+        main_programs = main_programs if isinstance(main_programs, list) \
+            else [main_programs]
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, self.context)
+        return main_programs
+
+
+PassBase = _PassBase
+__all__ += ["PassBase"]

@@ -1,0 +1,42 @@
+"""paddle.distributed.rpc — EXCLUDED capability, importable surface.
+
+The reference's user-level brpc RPC exists to build parameter-server and
+actor-style systems. This TPU build's README ("Scope: deliberate
+exclusions") documents why that tier is out: the single-controller JAX
+model plus mesh collectives cover the in-scope distribution patterns, and
+control-plane needs are met by the coordination service + TCPStore. The
+functions exist so `import paddle.distributed.rpc` ports don't crash at
+import time; CALLING them states the design decision instead of failing
+mysteriously.
+"""
+from __future__ import annotations
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info"]
+
+_MSG = (
+    "paddle.distributed.rpc is deliberately excluded from this TPU build "
+    "(README 'Scope: deliberate exclusions'): the single-controller model "
+    "plus XLA collectives replace actor-style RPC; for host-side "
+    "coordination use distributed.store.TCPStore or the jax.distributed "
+    "coordination service"
+)
+
+
+def _excluded(name):
+    def fn(*args, **kwargs):
+        raise RuntimeError(f"{name}: {_MSG}")
+
+    fn.__name__ = name
+    fn.__doc__ = _MSG
+    return fn
+
+
+init_rpc = _excluded("init_rpc")
+rpc_sync = _excluded("rpc_sync")
+rpc_async = _excluded("rpc_async")
+shutdown = _excluded("shutdown")
+get_worker_info = _excluded("get_worker_info")
+get_all_worker_infos = _excluded("get_all_worker_infos")
+get_current_worker_info = _excluded("get_current_worker_info")

@@ -10,11 +10,11 @@ from ..geometric import (  # noqa: F401 — incubate's graph API predates
     segment_max, segment_mean, segment_min, segment_sum,
 )
 from ..ops.dispatch import apply
-from . import asp, distributed, nn  # noqa: F401
+from . import asp, autograd, distributed, nn  # noqa: F401
 from .model_average import ModelAverage  # noqa: F401
 from .optimizer import LookAhead  # noqa: F401
 
-__all__ = ["nn", "distributed", "asp", "ModelAverage", "LookAhead",
+__all__ = ["nn", "distributed", "asp", "autograd", "ModelAverage", "LookAhead",
            "segment_sum", "segment_mean", "segment_min", "segment_max",
            "graph_reindex", "graph_sample_neighbors", "graph_send_recv",
            "graph_khop_sampler", "identity_loss", "softmax_mask_fuse",

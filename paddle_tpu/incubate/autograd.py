@@ -1,0 +1,66 @@
+"""paddle.incubate.autograd parity (reference
+`python/paddle/incubate/autograd/`): functional differentiation API plus
+the prim-mode flags.
+
+TPU-first: Jacobian/Hessian/jvp/vjp delegate to `autograd.functional`
+(jax-native transforms). The reference's "prim" mode lowers ops to
+primitive ops so composite transforms can differentiate them — jax traces
+to primitives always, so the flag records intent and `enabled_prim`
+reports it; numerics are identical either way.
+"""
+from __future__ import annotations
+
+from ..autograd.functional import (  # noqa: F401
+    Jacobian, hessian, jvp, vjp,
+)
+from ..autograd.tape import grad  # noqa: F401
+
+
+class Hessian:
+    """Parity: incubate.autograd.Hessian — lazy Hessian of a scalar
+    function at ``xs`` (evaluated via the jax-native hessian transform,
+    materialized on first index)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        if is_batched:
+            raise NotImplementedError(
+                "batched Hessian: vmap the scalar form "
+                "(autograd.functional covers the unbatched contract)")
+        self._h = hessian(func, xs)
+
+    def __getitem__(self, idx):
+        return self._h[idx]
+
+    @property
+    def shape(self):
+        return self._h.shape
+
+__all__ = ["Jacobian", "Hessian", "jvp", "vjp", "grad", "forward_grad",
+           "enable_prim", "disable_prim", "prim_enabled"]
+
+_prim = [False]
+
+
+def enable_prim():
+    _prim[0] = True
+
+
+def disable_prim():
+    _prim[0] = False
+
+
+def prim_enabled():
+    return _prim[0]
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Reference `incubate/autograd/primapi.py:forward_grad` computes
+    forward-mode derivatives over a static prim-lowered graph. Forward
+    mode needs the defining FUNCTION (jax jvp), and the eager tape records
+    reverse-mode only — use `incubate.autograd.jvp(func, xs, tangents)`;
+    this name exists so ported imports resolve and the redirect is
+    explicit."""
+    raise NotImplementedError(
+        "forward_grad over already-computed outputs is a static-prim-mode "
+        "API; call paddle.incubate.autograd.jvp(func, xs, v) with the "
+        "defining function instead")

@@ -51,4 +51,5 @@ from .layer.rnn import (  # noqa: F401
     RNNCellBase, BeamSearchDecoder, dynamic_decode,
 )
 
+from . import quant  # noqa: F401
 from . import utils  # noqa: F401

@@ -24,6 +24,11 @@ def batch_norm(
     running = momentum * running + (1 - momentum) * batch."""
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     use_batch_stats = training and not use_global_stats
+    # capture the caller's activation dtype BEFORE dispatch: the AMP hook
+    # (batch_norm is black-listed) casts the traced input to fp32, so
+    # `a.dtype` inside the kernel is fp32 under autocast — the cast-back
+    # must target the original dtype for bf16 nets to stay bf16
+    orig_dtype = (x._data if hasattr(x, "_data") else jnp.asarray(x)).dtype
 
     def stats_axes(a):
         if channel_last:
@@ -78,12 +83,12 @@ def batch_norm(
             i += 1
         if has_b:
             out = out + rest[i].reshape(shape)
-        # normalize in promoted precision, return the INPUT dtype: under
-        # AMP O2 the running buffers stay fp32 while activations are bf16;
-        # without the cast-back a bf16 network leaks fp32 activations out
-        # of every BN (the reference's O2 batch_norm kernel computes in
-        # fp32 and emits the input dtype)
-        return out.astype(a.dtype)
+        # normalize in promoted precision, return the CALLER's dtype:
+        # under AMP O2 the running buffers stay fp32 while activations
+        # are bf16; without the cast-back a bf16 network leaks fp32
+        # activations out of every BN (the reference's O2 batch_norm
+        # kernel computes in fp32 and emits the input dtype)
+        return out.astype(orig_dtype)
 
     return apply("batch_norm", f, tuple(operands))
 

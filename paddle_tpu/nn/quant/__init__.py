@@ -1,0 +1,23 @@
+"""paddle.nn.quant parity (reference `python/paddle/nn/quant/stub.py`)."""
+from __future__ import annotations
+
+from ..layer.layers import Layer
+
+__all__ = ["Stub"]
+
+
+class Stub(Layer):
+    """Placeholder layer swapped for an observer/quanter before PTQ/QAT
+    (parity: paddle.nn.quant.Stub). Until the quantizer replaces it, the
+    forward is the identity; QAT/PTQ (`paddle.quantization`) substitutes
+    the configured quanter here the way it swaps Linear/Conv layers."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+        self._layer = None  # set by the quantizer
+
+    def forward(self, x):
+        if self._layer is not None:
+            return self._layer(x)
+        return x

@@ -128,9 +128,9 @@ class QuantConfig:
             layer_types = [layer_types]
         self._types = tuple(set(self._types) | set(layer_types))
         if activation:
-            self.activation = activation
+            self.activation = self._resolve(activation)
         if weight:
-            self.weight = weight
+            self.weight = self._resolve(weight)
 
 
 def _swap_layers(model, config, act_factory, w_factory):

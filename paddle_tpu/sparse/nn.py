@@ -177,12 +177,12 @@ class _SparseConvNd(Layer):
                     "padding=0 (the default)")
             stride = 1
             padding = 0  # padded manually (even kernels need asymmetric)
-        self._stride = stride if isinstance(stride, (list, tuple)) \
-            else [stride] * self._nd
-        self._padding = padding if isinstance(padding, (list, tuple)) \
-            else [padding] * self._nd
-        self._dilation = dilation if isinstance(dilation, (list, tuple)) \
-            else [dilation] * self._nd
+        # keep the USER's forms — _reachable_mask feeds them through the
+        # same functional conv as the dense path, so 'same'/pairs/ints all
+        # resolve identically
+        self._stride_arg = stride
+        self._padding_arg = padding
+        self._dilation_arg = dilation
         self._conv = cls(in_channels, out_channels, kernel_size,
                          stride=stride, padding=padding, dilation=dilation,
                          groups=groups, weight_attr=weight_attr,
@@ -192,19 +192,19 @@ class _SparseConvNd(Layer):
         """Output active sites = sites any input active reaches through
         the kernel window (the reference's sparse-conv rulebook), computed
         as a conv of the 0/1 mask with a ones kernel at this layer's
-        geometry."""
-        import jax
+        EXACT geometry — routed through the same functional conv as the
+        dense path so every padding form ('same', pairs, ints) resolves
+        identically."""
+        from ..nn import functional as F
 
-        ones = jnp.ones((1, 1) + tuple(self._ks), in_mask_cf.dtype)
-        pads = [(p, p) for p in self._padding]
-        hit = jax.lax.conv_general_dilated(
-            in_mask_cf, ones, tuple(self._stride), pads,
-            rhs_dilation=tuple(self._dilation))
-        return hit > 0
+        ones = Tensor(jnp.ones((1, 1) + tuple(self._ks), jnp.float32))
+        conv_fn = F.conv3d if self._nd == 3 else F.conv2d
+        hit = conv_fn(Tensor(in_mask_cf.astype(jnp.float32)), ones, None,
+                      stride=self._stride_arg, padding=self._padding_arg,
+                      dilation=self._dilation_arg)
+        return hit._data > 0.5
 
     def forward(self, x):
-        import jax
-
         sparse_in = isinstance(x, SparseCooTensor)
         dense = _dense(x)
         arr = dense._data

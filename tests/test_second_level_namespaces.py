@@ -209,3 +209,127 @@ class TestSmallCompletions:
         assert not fs.is_exist(os.path.join(d, "f.txt"))
         fs.delete(d)
         assert not fs.is_exist(d)
+
+
+class TestDistributedSubNamespaces:
+    def test_exposed_modules(self):
+        assert pt.distributed.checkpoint is not None
+        assert callable(pt.distributed.sharding.group_sharded_parallel)
+        assert pt.amp.debugging.DebugMode is not None
+        assert pt.nn.quant.Stub()(pt.ones([2])).shape == [2]
+
+    def test_rpc_excluded(self):
+        with pytest.raises(RuntimeError, match="excluded"):
+            pt.distributed.rpc.init_rpc("worker0")
+
+    def test_pass_framework(self):
+        from paddle_tpu.distributed.passes import (
+            PassBase, PassManager, new_pass, register_pass,
+        )
+
+        @register_pass("tag_program_test")
+        class TagPass(PassBase):
+            def __init__(self):
+                super().__init__("tag_program_test")
+
+            def apply(self, mains, startups=None, context=None):
+                for m in mains:
+                    m.random_seed = 1234
+                context.set_attr("tagged", True)
+
+        prog = pt.static.Program()
+        pm = PassManager([new_pass("tag_program_test")])
+        pm.apply(prog)
+        assert prog.random_seed == 1234
+        assert pm.context.get_attr("tagged")
+        assert pm.names == ["tag_program_test"]
+        with pytest.raises(ValueError, match="registered"):
+            new_pass("no_such_pass")
+
+    def test_compare_accuracy(self, tmp_path):
+        import json
+
+        a = tmp_path / "a.jsonl"
+        b = tmp_path / "b.jsonl"
+        a.write_text(json.dumps({"op": "matmul", "num_nan": 0}) + "\n")
+        b.write_text(json.dumps({"op": "matmul", "num_nan": 3}) + "\n")
+        out = pt.amp.debugging.compare_accuracy(
+            str(a), str(b), str(tmp_path / "cmp.csv"))
+        body = open(out).read()
+        assert "matmul" in body and "num_nan" in body
+
+    def test_incubate_autograd(self):
+        assert not pt.incubate.autograd.prim_enabled()
+        pt.incubate.autograd.enable_prim()
+        try:
+            assert pt.incubate.autograd.prim_enabled()
+        finally:
+            pt.incubate.autograd.disable_prim()
+        H = pt.incubate.autograd.Hessian(
+            lambda x: (x ** 2).sum(),
+            pt.to_tensor(np.ones(3, np.float32)))
+        h = H[:, :]
+        np.testing.assert_allclose(np.asarray(h.numpy()), 2 * np.eye(3),
+                                   atol=1e-5)
+        with pytest.raises(NotImplementedError):
+            pt.incubate.autograd.forward_grad(None, None)
+
+
+class TestReviewRound2Fixes:
+    def test_sparse_conv_same_padding(self):
+        dense = np.zeros((1, 4, 4, 4, 3), np.float32)
+        dense[0, 1, 1, 1] = [1.0, 1.0, 1.0]
+        idx = np.array(np.nonzero(np.any(dense != 0, axis=-1)))
+        sp = pt.sparse.sparse_coo_tensor(
+            _t(idx), _t(dense[tuple(idx)]), shape=list(dense.shape))
+        y = pt.sparse.nn.Conv3D(3, 2, 3, padding="same")(sp)
+        assert y.shape == [1, 4, 4, 4, 2]
+        y2 = pt.sparse.nn.Conv3D(
+            3, 2, 3, padding=[[1, 1], [1, 1], [1, 1]])(sp)
+        assert y2.shape[-1] == 2
+
+    def test_pass_duck_typing(self):
+        from paddle_tpu.distributed.passes import (
+            PassManager, new_pass, register_pass,
+        )
+
+        @register_pass("duck_pass")
+        class Duck:  # no PassBase subclassing
+            def apply(self, mains, startups=None, context=None):
+                for m in mains:
+                    m.random_seed = 77
+
+        prog = pt.static.Program()
+        PassManager([new_pass("duck_pass")]).apply(prog)
+        assert prog.random_seed == 77
+
+    def test_bn_keeps_bf16_under_autocast(self):
+        bn = pt.nn.BatchNorm2D(3)
+        x = _t(np.random.randn(2, 3, 4, 4).astype(np.float32)) \
+            .astype("bfloat16")
+        with pt.amp.auto_cast(level="O2", dtype="bfloat16"):
+            out = bn(x)
+        assert "bfloat16" in str(out.dtype), out.dtype
+
+    def test_quant_add_type_config_string(self):
+        @pt.quantization.quanter("MyQ2")
+        class MyQ2(pt.quantization.BaseQuanter):
+            pass
+
+        cfg = pt.quantization.QuantConfig()
+        cfg.add_type_config(pt.nn.Conv2D, activation="MyQ2")
+        assert cfg.activation is MyQ2
+
+    def test_compare_accuracy_aggregates(self, tmp_path):
+        import json
+
+        a = tmp_path / "a.jsonl"
+        b = tmp_path / "b.jsonl"
+        a.write_text(json.dumps({"op": "matmul", "num_nan": 1}) + "\n"
+                     + json.dumps({"op": "matmul", "num_nan": 2}) + "\n")
+        b.write_text(json.dumps({"op": "matmul", "num_nan": 0}) + "\n")
+        out = pt.amp.debugging.compare_accuracy(
+            str(a), str(b), str(tmp_path / "c.csv"))
+        body = open(out).read()
+        # aggregated: run_a num_nan == 3 (1+2), not just the last record
+        assert "3" in body and "matmul" in body
