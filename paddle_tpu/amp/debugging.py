@@ -102,8 +102,13 @@ def compare_accuracy(dump_path, another_dump_path, output_filename,
     """Parity: paddle.amp.debugging.compare_accuracy — diff two
     operator-stats dumps (the workflow: run fp32 and amp with
     collect_operator_stats, dump, compare). Reads the two dumps (JSON
-    lines of per-op stats), joins on op name, and writes an Excel-free
-    CSV report of mismatches."""
+    lines of per-op stats), joins on op name with per-op aggregation, and
+    writes an Excel-free CSV report of mismatches.
+
+    ``loss_scale`` and ``dump_all_tensors`` are accepted for signature
+    parity and ignored: this build's dumps carry op statistics only (the
+    reference's full-tensor GPU dumps have no counterpart here), and no
+    scale adjustment applies to count-based stats."""
     import csv
     import json
     import os

@@ -71,6 +71,16 @@ def new_pass(name, pass_attrs=None):
             "passes are XLA's job on TPU — register custom program "
             "passes with @register_pass")
     p = cls() if isinstance(cls, type) else cls
+    if not isinstance(p, _PassBase) and not hasattr(p, "apply") \
+            and callable(p):
+        # registered zero-arg FACTORY: call it to produce the pass object
+        try:
+            produced = p()
+        except TypeError:
+            produced = None  # not a factory: treat p itself as apply()
+        if produced is not None and (hasattr(produced, "apply")
+                                     or callable(produced)):
+            p = produced
     if not isinstance(p, _PassBase):
         base = _PassBase(name, pass_attrs)
         if hasattr(p, "apply") and callable(p.apply):

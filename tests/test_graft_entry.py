@@ -30,3 +30,22 @@ def test_entry_jits():
 def test_dryrun_multichip_8():
     g = _graft()
     g.dryrun_multichip(8)
+
+
+def test_bench_smoke_emits_one_json_line():
+    """Driver contract: bench.py prints exactly one parseable JSON line
+    with the required keys, even in CPU smoke mode."""
+    import json
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "/root/repo/bench.py"], env=env, cwd="/root/repo",
+        capture_output=True, text=True, timeout=900)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, proc.stdout + proc.stderr
+    rec = json.loads(lines[0])
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    assert rec["value"] > 0
