@@ -89,26 +89,36 @@ def init_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1,
     global _global_env
     degrees = {"dp": dp, "pp": pp, "sharding": sharding, "sep": sep, "mp": mp}
     devs = list(devices) if devices is not None else _devices_for_mesh()
+    from ..framework.errors import InvalidArgumentError,         PreconditionNotMetError
+
     known = 1
     wild = None
     for ax, d in degrees.items():
+        if d == 0 or d < -1:
+            raise InvalidArgumentError(
+                f"mesh degree {ax}={d}: degrees must be positive "
+                "(or -1 on one axis to absorb the remaining devices)")
         if d == -1:
             if wild is not None:
-                raise ValueError("only one axis may be -1")
+                raise InvalidArgumentError(
+                    "only one mesh axis may be -1 "
+                    f"(both {wild!r} and {ax!r} are)")
             wild = ax
         else:
             known *= d
     if wild is not None:
         if len(devs) % known:
-            raise ValueError(
-                f"cannot infer {wild}: {len(devs)} devices not divisible by {known}"
-            )
+            raise PreconditionNotMetError(
+                f"cannot infer {wild}: {len(devs)} devices not divisible "
+                f"by the {known} explicitly requested")
         degrees[wild] = len(devs) // known
     total = int(np.prod([degrees[a] for a in AXIS_ORDER]))
     if total > len(devs):
-        raise ValueError(
-            f"mesh of {total} devices requested but only {len(devs)} available"
-        )
+        raise PreconditionNotMetError(
+            f"mesh of {total} devices requested "
+            f"({'*'.join(AXIS_ORDER)} = "
+            f"{'*'.join(str(degrees[a]) for a in AXIS_ORDER)}) but only "
+            f"{len(devs)} devices are available")
     devs = devs[:total]
     arr = np.array(devs).reshape([degrees[a] for a in AXIS_ORDER])
     mesh = Mesh(arr, AXIS_ORDER)

@@ -73,14 +73,19 @@ def new_pass(name, pass_attrs=None):
     p = cls() if isinstance(cls, type) else cls
     if not isinstance(p, _PassBase) and not hasattr(p, "apply") \
             and callable(p):
-        # registered zero-arg FACTORY: call it to produce the pass object
+        # a registered callable is a FACTORY only when it declares no
+        # parameters at all — an apply-style function (even with defaulted
+        # params) must never be executed at construction time
+        import inspect
+
         try:
+            is_factory = not inspect.signature(p).parameters
+        except (TypeError, ValueError):
+            is_factory = False
+        if is_factory:
             produced = p()
-        except TypeError:
-            produced = None  # not a factory: treat p itself as apply()
-        if produced is not None and (hasattr(produced, "apply")
-                                     or callable(produced)):
-            p = produced
+            if hasattr(produced, "apply") or callable(produced):
+                p = produced
     if not isinstance(p, _PassBase):
         base = _PassBase(name, pass_attrs)
         if hasattr(p, "apply") and callable(p.apply):

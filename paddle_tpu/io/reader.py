@@ -192,6 +192,18 @@ class DataLoader:
         worker_init_fn=None,
         persistent_workers=False,
     ):
+        from ..framework.errors import enforce_ge
+
+        enforce_ge(int(num_workers), 0,
+                   "paddle.io.DataLoader: num_workers must be >= 0")
+        enforce_ge(int(prefetch_factor), 1,
+                   "paddle.io.DataLoader: prefetch_factor must be >= 1")
+        if batch_size is not None and int(batch_size) <= 0:
+            from ..framework.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                "paddle.io.DataLoader: batch_size must be a positive int "
+                f"or None (got {batch_size})")
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn

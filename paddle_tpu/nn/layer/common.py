@@ -20,6 +20,12 @@ class Linear(Layer):
     def __init__(self, in_features, out_features, weight_attr=None,
                  bias_attr=None, name=None):
         super().__init__()
+        from ...framework.errors import enforce_gt
+
+        enforce_gt(in_features, 0,
+                   "paddle.nn.Linear: in_features must be positive")
+        enforce_gt(out_features, 0,
+                   "paddle.nn.Linear: out_features must be positive")
         self._in_features = in_features
         self._out_features = out_features
         self.weight = self.create_parameter(
