@@ -1,7 +1,7 @@
 """Flagship model families (the reference ships these via PaddleNLP/PaddleClas;
 the benchmark configs in BASELINE.md name Llama, BERT, ResNet, ERNIE —
 they live in-tree here so the framework is benchmarkable standalone)."""
-from . import bert, ernie, llama  # noqa: F401
+from . import bert, ernie, generation, llama  # noqa: F401
 from .bert import (  # noqa: F401
     BertConfig, BertForMaskedLM, BertForSequenceClassification, BertModel,
 )
@@ -9,6 +9,7 @@ from .ernie import (  # noqa: F401
     ErnieConfig, ErnieForPretraining, ErnieForPretrainingPipe,
     ErnieForSequenceClassification, ErnieModel,
 )
+from .generation import generate  # noqa: F401
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaForCausalLMPipe, LlamaModel,
 )
@@ -18,6 +19,7 @@ __all__ = [
     "LlamaForCausalLMPipe",
     "bert", "BertConfig", "BertModel", "BertForMaskedLM",
     "BertForSequenceClassification",
+    "generation", "generate",
     "ernie", "ErnieConfig", "ErnieModel", "ErnieForPretraining",
     "ErnieForPretrainingPipe", "ErnieForSequenceClassification",
 ]

@@ -1,0 +1,259 @@
+"""Autoregressive generation for the Llama family — the TPU-native decode
+loop (the reference serves generation through PaddleNLP's
+`model.generate`; here it ships in-tree so the framework is servable
+standalone).
+
+TPU-first design: generation is ONE compiled program per (batch, prompt
+bucket, max_new_tokens) — prefill fills a preallocated KV cache
+[layers, b, max_len, kv_heads, head_dim], then a `lax.scan` over decode
+steps runs the single-token forward against the cache with a length mask.
+Static shapes throughout (the cache is max_len from the start), no host
+round-trips inside the loop, early EOS handled by masking rather than
+dynamic exit so the program stays trace-stable. GQA attends with grouped
+KV via reshape (no repeat materialization). Weights ride as jit operands,
+so the same compiled loop serves updated checkpoints without retracing.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["generate"]
+
+
+def _collect_params(model):
+    """Pull the Llama weight pytree out of the Layer graph (stacked per
+    layer so the decode program scans over layers, O(1) compile in
+    depth). Cached on the model keyed by the parameter array identities,
+    so repeated generate() calls don't re-copy the weights; any weight
+    update (new arrays) invalidates the cache."""
+    core = model.model
+    key = tuple(id(p._data) for _, p in model.named_parameters())
+    cached = getattr(model, "_generation_params_cache", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+
+    def arr(p):
+        return p._data
+
+    per_layer = {
+        "ln1": [], "qkv": [], "o": [], "ln2": [], "gate_up": [], "down": [],
+    }
+    for blk in core.layers:
+        per_layer["ln1"].append(arr(blk.input_layernorm.weight))
+        per_layer["qkv"].append(arr(blk.self_attn.qkv_proj.weight))
+        per_layer["o"].append(arr(blk.self_attn.o_proj.weight))
+        per_layer["ln2"].append(arr(blk.post_attention_layernorm.weight))
+        per_layer["gate_up"].append(arr(blk.mlp.gate_up_proj.weight))
+        per_layer["down"].append(arr(blk.mlp.down_proj.weight))
+    params = {k: jnp.stack(v) for k, v in per_layer.items()}
+    params["embed"] = arr(core.embed_tokens.weight)
+    params["norm"] = arr(core.norm.weight)
+    params["lm_head"] = arr(model.lm_head.weight)
+    model._generation_params_cache = (key, params)
+    return params
+
+
+def _rms(x, w, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                   keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)).astype(
+        x.dtype) * w
+
+
+def _rope_at(q, k, pos, theta):
+    """RoPE for [b, s, h, d] q/k with per-token absolute positions
+    ``pos`` [b, s]."""
+    d = q.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = pos.astype(jnp.float32)[..., None] * inv  # [b, s, d/2]
+    cos = jnp.cos(freqs)[:, :, None, :]
+    sin = jnp.sin(freqs)[:, :, None, :]
+
+    def rot(x):
+        xf = x.astype(jnp.float32)
+        x1, x2 = xf[..., 0::2], xf[..., 1::2]
+        out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                        axis=-1).reshape(x.shape)
+        return out.astype(q.dtype)
+
+    return rot(q), rot(k)
+
+
+def _attend(q, kc, vc, valid_len, nh, nkv):
+    """q [b, sq, nh, d] against cached kc/vc [b, L, nkv, d], masked to
+    positions < valid_len (+ causal within the query block)."""
+    b, sq, _, d = q.shape
+    L = kc.shape[1]
+    g = nh // nkv
+    qg = q.reshape(b, sq, nkv, g, d)
+    logits = jnp.einsum("bskgd,blkd->bskgl", qg.astype(jnp.float32),
+                        kc.astype(jnp.float32)) / np.sqrt(d)
+    # key position l is visible to query token t (absolute pos
+    # valid_len - sq + t) iff l <= that position
+    q_pos = valid_len - sq + jnp.arange(sq)  # [sq]
+    vis = jnp.arange(L)[None, :] <= q_pos[:, None]  # [sq, L]
+    logits = jnp.where(vis[None, :, None, None, :], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bskgl,blkd->bskgd", p, vc.astype(jnp.float32))
+    return out.reshape(b, sq, nh, d).astype(q.dtype)
+
+
+def _block(x, layer_p, cache_k, cache_v, li, pos, valid_len, cfg):
+    """One decoder layer over a [b, s] slice, reading/writing the cache at
+    ``pos``. Returns (x_out, new_cache_k, new_cache_v)."""
+    nh = cfg.num_attention_heads
+    nkv = cfg.num_key_value_heads or nh
+    d = cfg.hidden_size // nh
+    h = _rms(x, layer_p["ln1"], cfg.rms_norm_eps)
+    qkv = h @ layer_p["qkv"]
+    q, k, v = jnp.split(qkv, [nh * d, nh * d + nkv * d], axis=-1)
+    b, s = x.shape[0], x.shape[1]
+    q = q.reshape(b, s, nh, d)
+    k = k.reshape(b, s, nkv, d)
+    v = v.reshape(b, s, nkv, d)
+    q, k = _rope_at(q, k, pos, cfg.rope_theta)
+    ck = cache_k.at[li].set(
+        jax.lax.dynamic_update_slice_in_dim(cache_k[li], k,
+                                            valid_len - s, 1))
+    cv = cache_v.at[li].set(
+        jax.lax.dynamic_update_slice_in_dim(cache_v[li], v,
+                                            valid_len - s, 1))
+    out = _attend(q, ck[li], cv[li], valid_len, nh, nkv)
+    out = out.reshape(b, s, nh * d) @ layer_p["o"]
+    x = x + out
+    h2 = _rms(x, layer_p["ln2"], cfg.rms_norm_eps)
+    gu = h2 @ layer_p["gate_up"]
+    gate, up = jnp.split(gu, 2, axis=-1)
+    x = x + (jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype)
+             * up) @ layer_p["down"]
+    return x, ck, cv
+
+
+def _forward(params, ids, cache_k, cache_v, valid_len, cfg):
+    """Forward [b, s] token ids at absolute positions
+    [valid_len - s, valid_len), attending over the cache. Returns
+    (last-position logits, cache_k, cache_v)."""
+    b, s = ids.shape
+    x = params["embed"][ids].astype(jnp.dtype(cfg.dtype))
+    pos = (valid_len - s + jnp.arange(s))[None, :].repeat(b, axis=0)
+    n_layers = params["qkv"].shape[0]
+
+    def body(carry, li):
+        x, ck, cv = carry
+        layer_p = {k: params[k][li] for k in
+                   ("ln1", "qkv", "o", "ln2", "gate_up", "down")}
+        x, ck, cv = _block(x, layer_p, ck, cv, li, pos, valid_len, cfg)
+        return (x, ck, cv), None
+
+    (x, cache_k, cache_v), _ = jax.lax.scan(
+        body, (x, cache_k, cache_v), jnp.arange(n_layers))
+    x = _rms(x, params["norm"], cfg.rms_norm_eps)
+    logits = x[:, -1] @ params["lm_head"]
+    return logits.astype(jnp.float32), cache_k, cache_v
+
+
+def _sample(logits, key, do_sample, temperature, top_k, top_p):
+    """do_sample/top_k are static (they change program structure);
+    temperature/top_p ride as traced scalars so per-request values never
+    retrace the decode program."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    # top-p computed unconditionally, applied only when top_p < 1 (traced)
+    sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_l, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(cum < top_p, axis=-1)  # first index past p
+    cutoff = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], axis=-1)
+    filtered = jnp.where(logits < cutoff, -1e30, logits)
+    logits = jnp.where(top_p < 1.0, filtered, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "do_sample", "top_k",
+                     "eos_token_id"))
+def _generate_jit(params, ids, key, temperature, top_p, *, cfg,
+                  max_new_tokens, do_sample, top_k, eos_token_id):
+    b, prompt_len = ids.shape
+    nh = cfg.num_attention_heads
+    nkv = cfg.num_key_value_heads or nh
+    d = cfg.hidden_size // nh
+    max_len = prompt_len + max_new_tokens
+    dt = jnp.dtype(cfg.dtype)
+    cache_k = jnp.zeros((params["qkv"].shape[0], b, max_len, nkv, d), dt)
+    cache_v = jnp.zeros_like(cache_k)
+
+    # prefill: the whole prompt in one batched pass
+    logits, cache_k, cache_v = _forward(params, ids, cache_k, cache_v,
+                                        jnp.asarray(prompt_len), cfg)
+    key, sub = jax.random.split(key)
+    next_tok = _sample(logits, sub, do_sample, temperature, top_k, top_p)
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+    finished = next_tok == eos
+
+    def step(carry, i):
+        tok, ck, cv, fin, key = carry
+        valid = prompt_len + 1 + i
+        logits, ck, cv = _forward(params, tok[:, None], ck, cv, valid, cfg)
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits, sub, do_sample, temperature, top_k, top_p)
+        # after EOS keep emitting EOS (masking, not dynamic exit)
+        nxt = jnp.where(fin, eos, nxt)
+        fin = fin | (nxt == eos)
+        return (nxt, ck, cv, fin, key), tok
+
+    (last, *_rest), toks = jax.lax.scan(
+        step, (next_tok, cache_k, cache_v, finished, key),
+        jnp.arange(max_new_tokens - 1))
+    # toks holds tokens emitted BEFORE each step; append the final one
+    out = jnp.concatenate([toks.T, last[:, None]], axis=1)
+    return out
+
+
+def generate(model, input_ids, max_new_tokens=32, do_sample=False,
+             temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+             seed=0):
+    """Generate ``max_new_tokens`` continuations of ``input_ids``
+    ([b, prompt_len] int tensor) with the compiled KV-cache decode loop.
+    Returns the generated tokens [b, max_new_tokens] (prompt excluded)."""
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if getattr(model.config, "moe_num_experts", 0) > 1:
+        from ..framework.errors import UnimplementedError
+
+        raise UnimplementedError(
+            "generate() does not decode MoE Llama configs yet (the expert "
+            "dispatch needs its own cached single-token path); dense "
+            "configs are supported")
+    params = _collect_params(model)
+    ids = input_ids._data if isinstance(input_ids, Tensor) \
+        else jnp.asarray(np.asarray(input_ids))
+    # under a live mesh the weights carry NamedShardings; inputs must sit
+    # on the same device set (replicated) or jit rejects the mix
+    from ..distributed import env as env_mod
+
+    e = env_mod.get_env()
+    if e is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        ids = jax.device_put(ids, NamedSharding(e.mesh, PartitionSpec()))
+    if top_k:
+        top_k = min(int(top_k), model.config.vocab_size)
+    out = _generate_jit(
+        params, ids.astype(jnp.int32), jax.random.key(seed),
+        jnp.float32(temperature), jnp.float32(top_p),
+        cfg=model.config, max_new_tokens=int(max_new_tokens),
+        do_sample=bool(do_sample), top_k=int(top_k),
+        eos_token_id=eos_token_id)
+    return Tensor(out)

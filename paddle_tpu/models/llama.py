@@ -291,6 +291,18 @@ class LlamaForCausalLM(Layer):
                 loss = loss + self.config.moe_aux_loss_coeff * aux
         return loss
 
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+                 seed=0):
+        """Compiled KV-cache autoregressive decoding (see
+        models/generation.py). Returns [b, max_new_tokens] new tokens."""
+        from .generation import generate as _generate
+
+        return _generate(self, input_ids, max_new_tokens=max_new_tokens,
+                         do_sample=do_sample, temperature=temperature,
+                         top_k=top_k, top_p=top_p,
+                         eos_token_id=eos_token_id, seed=seed)
+
     def flops_per_token(self, seq_len):
         """Approximate training FLOPs/token (fwd+bwd) for MFU accounting."""
         cfg = self.config
