@@ -1,0 +1,69 @@
+"""Autoregressive decode throughput (tokens/sec/chip) for the compiled
+KV-cache generation loop (`models/generation.py`).
+
+Run: python benchmarks/decode_bench.py [--smoke]
+Prints one JSON line: {"metric": "llama_decode_tokens_per_sec_per_chip", ...}
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    smoke = "--smoke" in sys.argv or jax.default_backend() == "cpu"
+    print(f"decode_bench: backend={jax.default_backend()} smoke={smoke}",
+          file=sys.stderr, flush=True)
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, generate
+
+    pt.seed(0)
+    if smoke:
+        cfg = LlamaConfig.tiny()
+        batch, prompt, new = 2, 8, 8
+    else:
+        # the headline-bench model size (~0.44B, fits one v5e chip)
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_hidden_layers=12, num_attention_heads=12,
+            max_position_embeddings=2048, dtype="bfloat16",
+            use_parallel_cross_entropy=False)
+        batch, prompt, new = 8, 128, 256
+    model = LlamaForCausalLM(cfg)
+    if cfg.dtype == "bfloat16":
+        for p in model.parameters():
+            p._data = p._data.astype("bfloat16")
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = pt.to_tensor(rng.randint(0, cfg.vocab_size, (batch, prompt)))
+
+    out = generate(model, ids, max_new_tokens=new)  # compile + warm
+    jax.block_until_ready(out._data)
+    t0 = time.perf_counter()
+    reps = 1 if smoke else 3
+    for i in range(reps):
+        out = generate(model, ids, max_new_tokens=new, seed=i)
+    jax.block_until_ready(out._data)
+    dt = time.perf_counter() - t0
+    tps = batch * new * reps / dt
+    rec = {"metric": "llama_decode_tokens_per_sec_per_chip",
+           "value": round(tps, 1), "unit": "tokens/s",
+           "batch": batch, "prompt_len": prompt, "new_tokens": new}
+    if smoke:
+        rec["note"] = "cpu smoke mode; not a TPU number"
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -33,9 +33,10 @@ def _collect_params(model):
     so repeated generate() calls don't re-copy the weights; any weight
     update (new arrays) invalidates the cache."""
     core = model.model
-    key = tuple(id(p._data) for _, p in model.named_parameters())
+    sources = tuple(p._data for _, p in model.named_parameters())
     cached = getattr(model, "_generation_params_cache", None)
-    if cached is not None and cached[0] == key:
+    if cached is not None and len(cached[0]) == len(sources) \
+            and all(a is b for a, b in zip(cached[0], sources)):
         return cached[1]
 
     def arr(p):
@@ -55,7 +56,8 @@ def _collect_params(model):
     params["embed"] = arr(core.embed_tokens.weight)
     params["norm"] = arr(core.norm.weight)
     params["lm_head"] = arr(model.lm_head.weight)
-    model._generation_params_cache = (key, params)
+    # the cache keeps the SOURCE arrays alive so identity comparison is sound
+    model._generation_params_cache = (sources, params)
     return params
 
 
@@ -67,22 +69,12 @@ def _rms(x, w, eps):
 
 
 def _rope_at(q, k, pos, theta):
-    """RoPE for [b, s, h, d] q/k with per-token absolute positions
-    ``pos`` [b, s]."""
-    d = q.shape[-1]
-    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    freqs = pos.astype(jnp.float32)[..., None] * inv  # [b, s, d/2]
-    cos = jnp.cos(freqs)[:, :, None, :]
-    sin = jnp.sin(freqs)[:, :, None, :]
+    """RoPE with per-token absolute positions — the SAME helper the
+    training forward uses (`llama._rope`), so the two paths cannot drift
+    in convention."""
+    from .llama import _rope
 
-    def rot(x):
-        xf = x.astype(jnp.float32)
-        x1, x2 = xf[..., 0::2], xf[..., 1::2]
-        out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
-                        axis=-1).reshape(x.shape)
-        return out.astype(q.dtype)
-
-    return rot(q), rot(k)
+    return _rope(q, k, theta, q.dtype, pos=pos)
 
 
 def _attend(q, kc, vc, valid_len, nh, nkv):
@@ -158,33 +150,65 @@ def _forward(params, ids, cache_k, cache_v, valid_len, cfg):
     return logits.astype(jnp.float32), cache_k, cache_v
 
 
-def _sample(logits, key, do_sample, temperature, top_k, top_p):
-    """do_sample/top_k are static (they change program structure);
-    temperature/top_p ride as traced scalars so per-request values never
-    retrace the decode program."""
+def _sample(logits, key, do_sample, temperature, top_k, top_p,
+            use_top_p):
+    """do_sample/top_k/use_top_p are static (program structure);
+    temperature and the top_p VALUE ride as traced scalars, so changing
+    either between requests never retraces — only toggling top-p
+    filtering on/off does (a legitimate structure change that spares the
+    default path a full-vocab sort per token)."""
     if not do_sample:
         return jnp.argmax(logits, axis=-1)
     logits = logits / jnp.maximum(temperature, 1e-6)
     if top_k and top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -1e30, logits)
-    # top-p computed unconditionally, applied only when top_p < 1 (traced)
-    sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
-    probs = jax.nn.softmax(sorted_l, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    cutoff_idx = jnp.sum(cum < top_p, axis=-1)  # first index past p
-    cutoff = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], axis=-1)
-    filtered = jnp.where(logits < cutoff, -1e30, logits)
-    logits = jnp.where(top_p < 1.0, filtered, logits)
+    if use_top_p:
+        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)  # first index past p
+        cutoff = jnp.take_along_axis(sorted_l, cutoff_idx[:, None],
+                                     axis=-1)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
     return jax.random.categorical(key, logits, axis=-1)
+
+
+class _GenCfg:
+    """Value-hashable static view of the LlamaConfig fields the decode
+    trace depends on — in-place config mutation or a fresh but identical
+    config can never serve a stale compiled program (LlamaConfig hashes
+    by identity)."""
+
+    __slots__ = ("num_attention_heads", "num_key_value_heads",
+                 "hidden_size", "rope_theta", "rms_norm_eps", "dtype")
+
+    def __init__(self, cfg):
+        self.num_attention_heads = cfg.num_attention_heads
+        self.num_key_value_heads = cfg.num_key_value_heads \
+            or cfg.num_attention_heads
+        self.hidden_size = cfg.hidden_size
+        self.rope_theta = float(cfg.rope_theta)
+        self.rms_norm_eps = float(cfg.rms_norm_eps)
+        self.dtype = str(cfg.dtype)
+
+    def _key(self):
+        return tuple(getattr(self, f) for f in self.__slots__)
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __eq__(self, other):
+        return isinstance(other, _GenCfg) and self._key() == other._key()
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "do_sample", "top_k",
-                     "eos_token_id"))
+                     "use_top_p", "eos_token_id"))
 def _generate_jit(params, ids, key, temperature, top_p, *, cfg,
-                  max_new_tokens, do_sample, top_k, eos_token_id):
+                  max_new_tokens, do_sample, top_k, use_top_p,
+                  eos_token_id):
     b, prompt_len = ids.shape
     nh = cfg.num_attention_heads
     nkv = cfg.num_key_value_heads or nh
@@ -198,7 +222,8 @@ def _generate_jit(params, ids, key, temperature, top_p, *, cfg,
     logits, cache_k, cache_v = _forward(params, ids, cache_k, cache_v,
                                         jnp.asarray(prompt_len), cfg)
     key, sub = jax.random.split(key)
-    next_tok = _sample(logits, sub, do_sample, temperature, top_k, top_p)
+    next_tok = _sample(logits, sub, do_sample, temperature,
+                       top_k, top_p, use_top_p)
     eos = -1 if eos_token_id is None else int(eos_token_id)
     finished = next_tok == eos
 
@@ -207,7 +232,8 @@ def _generate_jit(params, ids, key, temperature, top_p, *, cfg,
         valid = prompt_len + 1 + i
         logits, ck, cv = _forward(params, tok[:, None], ck, cv, valid, cfg)
         key, sub = jax.random.split(key)
-        nxt = _sample(logits, sub, do_sample, temperature, top_k, top_p)
+        nxt = _sample(logits, sub, do_sample, temperature,
+                      top_k, top_p, use_top_p)
         # after EOS keep emitting EOS (masking, not dynamic exit)
         nxt = jnp.where(fin, eos, nxt)
         fin = fin | (nxt == eos)
@@ -226,7 +252,12 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              seed=0):
     """Generate ``max_new_tokens`` continuations of ``input_ids``
     ([b, prompt_len] int tensor) with the compiled KV-cache decode loop.
-    Returns the generated tokens [b, max_new_tokens] (prompt excluded)."""
+    Returns the generated tokens [b, max_new_tokens] (prompt excluded).
+
+    Prompts in a batch must be REAL tokens of equal length — there is no
+    padding mask, so padded rows would be conditioned on the pad tokens.
+    Batch same-length prompts together (length-bucketing is also what
+    keeps the compiled-program count low on TPU)."""
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
     if getattr(model.config, "moe_num_experts", 0) > 1:
@@ -253,7 +284,8 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     out = _generate_jit(
         params, ids.astype(jnp.int32), jax.random.key(seed),
         jnp.float32(temperature), jnp.float32(top_p),
-        cfg=model.config, max_new_tokens=int(max_new_tokens),
+        cfg=_GenCfg(model.config), max_new_tokens=int(max_new_tokens),
         do_sample=bool(do_sample), top_k=int(top_k),
+        use_top_p=float(top_p) < 1.0,
         eos_token_id=eos_token_id)
     return Tensor(out)

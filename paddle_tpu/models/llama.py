@@ -99,15 +99,22 @@ class LlamaConfig:
         return cls(**kw)
 
 
-def _rope(q_arr, k_arr, theta, dtype):
+def _rope(q_arr, k_arr, theta, dtype, pos=None):
     """Rotary position embedding applied to [b, s, h, d] q/k arrays
-    (pure-jnp; runs inside the recorded op so its vjp is automatic)."""
+    (pure-jnp; runs inside the recorded op so its vjp is automatic).
+    ``pos`` ([s] or [b, s] absolute positions) defaults to arange(s);
+    the cached decode path passes explicit positions."""
     b, s, h, d = q_arr.shape
-    pos = jnp.arange(s, dtype=jnp.float32)
+    if pos is None:
+        pos = jnp.arange(s, dtype=jnp.float32)
     inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    freqs = jnp.einsum("s,f->sf", pos, inv)  # [s, d/2]
-    cos = jnp.cos(freqs)[None, :, None, :]
-    sin = jnp.sin(freqs)[None, :, None, :]
+    freqs = pos.astype(jnp.float32)[..., None] * inv  # [.., s, d/2]
+    if freqs.ndim == 2:  # [s, d/2] -> broadcast over batch
+        cos = jnp.cos(freqs)[None, :, None, :]
+        sin = jnp.sin(freqs)[None, :, None, :]
+    else:  # [b, s, d/2]
+        cos = jnp.cos(freqs)[:, :, None, :]
+        sin = jnp.sin(freqs)[:, :, None, :]
 
     def rot(x):
         x1, x2 = x[..., 0::2], x[..., 1::2]

@@ -98,3 +98,22 @@ def test_param_cache_reused(model):
     out = generate(model, prompt, max_new_tokens=2, do_sample=True,
                    top_k=10_000, seed=0)
     assert out.shape == [1, 2]
+
+
+def test_config_jit_key_is_value_based(model):
+    """The static jit key hashes the config FIELD VALUES: in-place
+    mutation changes the key (no stale trace), while a fresh identical
+    config hashes equal (no spurious retrace)."""
+    from paddle_tpu.models.generation import _GenCfg
+
+    c1 = _GenCfg(model.config)
+    old = model.config.rope_theta
+    try:
+        model.config.rope_theta = 17.0
+        c2 = _GenCfg(model.config)
+    finally:
+        model.config.rope_theta = old
+    assert c1 != c2 and hash(c1) != hash(c2)
+    fresh = _GenCfg(LlamaConfig.tiny(num_hidden_layers=2))
+    assert fresh == _GenCfg(LlamaConfig.tiny(num_hidden_layers=2))
+    assert hash(fresh) == hash(_GenCfg(LlamaConfig.tiny(num_hidden_layers=2)))
