@@ -1,0 +1,149 @@
+"""Generate docs/API_PARITY.md: the reference-__all__ sweep as a table.
+
+Run: PYTHONPATH=. JAX_PLATFORMS=cpu python tools/gen_api_parity.py
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+R = "/root/reference/python/paddle/"
+
+
+def ref_all(path):
+    if not os.path.exists(path):
+        return set()
+    tree = ast.parse(open(path).read())
+    names = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    for e in node.value.elts:
+                        try:
+                            v = ast.literal_eval(e)
+                            if isinstance(v, str):
+                                names.append(v)
+                        except Exception:  # noqa: BLE001
+                            pass
+    return set(names)
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as pt
+
+    pairs = [
+        ("paddle", "__init__.py", pt),
+        ("paddle.nn", "nn/__init__.py", pt.nn),
+        ("paddle.nn.functional", "nn/functional/__init__.py",
+         pt.nn.functional),
+        ("paddle.nn.initializer", "nn/initializer/__init__.py",
+         pt.nn.initializer),
+        ("paddle.nn.utils", "nn/utils/__init__.py", pt.nn.utils),
+        ("paddle.linalg", "linalg.py", pt.linalg),
+        ("paddle.optimizer", "optimizer/__init__.py", pt.optimizer),
+        ("paddle.optimizer.lr", "optimizer/lr.py", pt.optimizer.lr),
+        ("paddle.io", "io/__init__.py", pt.io),
+        ("paddle.metric", "metric/__init__.py", pt.metric),
+        ("paddle.amp", "amp/__init__.py", pt.amp),
+        ("paddle.autograd", "autograd/__init__.py", pt.autograd),
+        ("paddle.jit", "jit/__init__.py", pt.jit),
+        ("paddle.distribution", "distribution/__init__.py",
+         pt.distribution),
+        ("paddle.distribution.transform", "distribution/transform.py",
+         pt.distribution.transform),
+        ("paddle.vision", "vision/__init__.py", pt.vision),
+        ("paddle.vision.transforms", "vision/transforms/__init__.py",
+         pt.vision.transforms),
+        ("paddle.vision.ops", "vision/ops.py", pt.vision.ops),
+        ("paddle.vision.datasets", "vision/datasets/__init__.py",
+         pt.vision.datasets),
+        ("paddle.signal", "signal.py", pt.signal),
+        ("paddle.fft", "fft.py", pt.fft),
+        ("paddle.distributed", "distributed/__init__.py", pt.distributed),
+        ("paddle.distributed.fleet", "distributed/fleet/__init__.py",
+         pt.distributed.fleet),
+        ("paddle.distributed.fleet.utils",
+         "distributed/fleet/utils/__init__.py",
+         pt.distributed.fleet.utils),
+        ("paddle.sparse", "sparse/__init__.py", pt.sparse),
+        ("paddle.sparse.nn", "sparse/nn/__init__.py", pt.sparse.nn),
+        ("paddle.static", "static/__init__.py", pt.static),
+        ("paddle.incubate", "incubate/__init__.py", pt.incubate),
+        ("paddle.incubate.nn", "incubate/nn/__init__.py", pt.incubate.nn),
+        ("paddle.text", "text/__init__.py", pt.text),
+        ("paddle.audio", "audio/__init__.py", pt.audio),
+        ("paddle.audio.functional", "audio/functional/__init__.py",
+         pt.audio.functional),
+        ("paddle.geometric", "geometric/__init__.py", pt.geometric),
+        ("paddle.profiler", "profiler/__init__.py", pt.profiler),
+        ("paddle.quantization", "quantization/__init__.py",
+         pt.quantization),
+        ("paddle.utils", "utils/__init__.py", pt.utils),
+    ]
+    rows = []
+    total = covered = 0
+    for label, rel, obj in pairs:
+        names = ref_all(R + rel)
+        missing = sorted(n for n in names if not hasattr(obj, n))
+        total += len(names)
+        covered += len(names) - len(missing)
+        rows.append((label, len(names), len(missing),
+                     ", ".join(missing) or "—"))
+
+    # Tensor methods
+    tree = ast.parse(open(R + "tensor/__init__.py").read())
+    tnames = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in (
+                        "tensor_method_func", "magic_method_func"):
+                    for e in node.value.elts:
+                        try:
+                            v = ast.literal_eval(e)
+                            if isinstance(v, str):
+                                tnames.append(v)
+                        except Exception:  # noqa: BLE001
+                            pass
+    import numpy as np
+
+    t = pt.to_tensor(np.ones((2, 2), np.float32))
+    tmiss = sorted(n for n in set(tnames) if not hasattr(t, n))
+    total += len(set(tnames))
+    covered += len(set(tnames)) - len(tmiss)
+    rows.append(("paddle.Tensor (methods)", len(set(tnames)), len(tmiss),
+                 ", ".join(tmiss) or "—"))
+
+    out = ["# API_PARITY — reference `__all__` sweep",
+           "",
+           "Generated by `tools/gen_api_parity.py` against the reference "
+           "checkout; `tests/test_api_surface.py` enforces the same sweep "
+           "in CI.",
+           "",
+           f"**Coverage: {covered}/{total} public names resolve "
+           f"({covered / max(total, 1):.1%}).** Excluded capabilities "
+           "(PS, RPC, IPU/XPU) are importable and raise with rationale — "
+           "they count as covered here because the name resolves; the "
+           "README 'Scope' section lists them.",
+           "",
+           "| namespace | names | missing | which |",
+           "|---|---|---|---|"]
+    for label, n, m, which in rows:
+        out.append(f"| {label} | {n} | {m} | {which} |")
+    with open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "docs", "API_PARITY.md"),
+            "w") as f:
+        f.write("\n".join(out) + "\n")
+    print(f"docs/API_PARITY.md: {covered}/{total} "
+          f"({covered / max(total, 1):.1%})")
+
+
+if __name__ == "__main__":
+    main()
