@@ -4,18 +4,21 @@ Run: PYTHONPATH=. JAX_PLATFORMS=cpu python tools/gen_api_parity.py
 """
 from __future__ import annotations
 
+import argparse
 import ast
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-R = "/root/reference/python/paddle/"
+R = "/root/reference/python/paddle/"  # overridden by --reference
 
 
 def ref_all(path):
     if not os.path.exists(path):
-        return set()
+        raise FileNotFoundError(
+            f"reference file missing: {path} — a moved/renamed upstream "
+            "file must fail the sweep, not silently count as 100%")
     tree = ast.parse(open(path).read())
     names = []
     for node in ast.walk(tree):
@@ -33,6 +36,13 @@ def ref_all(path):
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reference", default="/root/reference/python/paddle/",
+                    help="reference python/paddle checkout root")
+    args = ap.parse_args()
+    global R
+    R = args.reference.rstrip("/") + "/"
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -91,6 +101,11 @@ def main():
     total = covered = 0
     for label, rel, obj in pairs:
         names = ref_all(R + rel)
+        if not names:
+            raise RuntimeError(
+                f"{rel}: parsed ZERO names from the reference __all__ — "
+                "the sweep would silently undercount; fix the path or "
+                "the parser")
         missing = sorted(n for n in names if not hasattr(obj, n))
         total += len(names)
         covered += len(names) - len(missing)
