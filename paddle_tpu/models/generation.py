@@ -26,6 +26,12 @@ from ..framework.core import Tensor
 __all__ = ["generate"]
 
 
+def _replicated(e):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(e.mesh, PartitionSpec())
+
+
 def _collect_params(model):
     """Pull the Llama weight pytree out of the Layer graph (stacked per
     layer so the decode program scans over layers, O(1) compile in
@@ -77,9 +83,10 @@ def _rope_at(q, k, pos, theta):
     return _rope(q, k, theta, q.dtype, pos=pos)
 
 
-def _attend(q, kc, vc, valid_len, nh, nkv):
+def _attend(q, kc, vc, valid_len, nh, nkv, key_pad=None):
     """q [b, sq, nh, d] against cached kc/vc [b, L, nkv, d], masked to
-    positions < valid_len (+ causal within the query block)."""
+    positions < valid_len (+ causal within the query block). ``key_pad``
+    [b] hides each row's leading left-pad slots."""
     b, sq, _, d = q.shape
     L = kc.shape[1]
     g = nh // nkv
@@ -90,13 +97,18 @@ def _attend(q, kc, vc, valid_len, nh, nkv):
     # valid_len - sq + t) iff l <= that position
     q_pos = valid_len - sq + jnp.arange(sq)  # [sq]
     vis = jnp.arange(L)[None, :] <= q_pos[:, None]  # [sq, L]
-    logits = jnp.where(vis[None, :, None, None, :], logits, -1e30)
+    vis = jnp.broadcast_to(vis[None], (b, sq, L))
+    if key_pad is not None:
+        vis = vis & (jnp.arange(L)[None, None, :]
+                     >= key_pad[:, None, None])
+    logits = jnp.where(vis[:, :, None, None, :], logits, -1e30)
     p = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bskgl,blkd->bskgd", p, vc.astype(jnp.float32))
     return out.reshape(b, sq, nh, d).astype(q.dtype)
 
 
-def _block(x, layer_p, cache_k, cache_v, li, pos, valid_len, cfg):
+def _block(x, layer_p, cache_k, cache_v, li, pos, valid_len, cfg,
+           key_pad=None):
     """One decoder layer over a [b, s] slice, reading/writing the cache at
     ``pos``. Returns (x_out, new_cache_k, new_cache_v)."""
     nh = cfg.num_attention_heads
@@ -116,7 +128,8 @@ def _block(x, layer_p, cache_k, cache_v, li, pos, valid_len, cfg):
     cv = cache_v.at[li].set(
         jax.lax.dynamic_update_slice_in_dim(cache_v[li], v,
                                             valid_len - s, 1))
-    out = _attend(q, ck[li], cv[li], valid_len, nh, nkv)
+    out = _attend(q, ck[li], cv[li], valid_len, nh, nkv,
+                  key_pad=key_pad)
     out = out.reshape(b, s, nh * d) @ layer_p["o"]
     x = x + out
     h2 = _rms(x, layer_p["ln2"], cfg.rms_norm_eps)
@@ -127,20 +140,26 @@ def _block(x, layer_p, cache_k, cache_v, li, pos, valid_len, cfg):
     return x, ck, cv
 
 
-def _forward(params, ids, cache_k, cache_v, valid_len, cfg):
+def _forward(params, ids, cache_k, cache_v, valid_len, cfg,
+             key_pad=None):
     """Forward [b, s] token ids at absolute positions
-    [valid_len - s, valid_len), attending over the cache. Returns
-    (last-position logits, cache_k, cache_v)."""
+    [valid_len - s, valid_len), attending over the cache. With left
+    padding (``key_pad`` [b]), RoPE positions shift so each row's first
+    REAL token sits at position 0. Returns (last-position logits,
+    cache_k, cache_v)."""
     b, s = ids.shape
     x = params["embed"][ids].astype(jnp.dtype(cfg.dtype))
     pos = (valid_len - s + jnp.arange(s))[None, :].repeat(b, axis=0)
+    if key_pad is not None:
+        pos = jnp.maximum(pos - key_pad[:, None], 0)
     n_layers = params["qkv"].shape[0]
 
     def body(carry, li):
         x, ck, cv = carry
         layer_p = {k: params[k][li] for k in
                    ("ln1", "qkv", "o", "ln2", "gate_up", "down")}
-        x, ck, cv = _block(x, layer_p, ck, cv, li, pos, valid_len, cfg)
+        x, ck, cv = _block(x, layer_p, ck, cv, li, pos, valid_len, cfg,
+                           key_pad=key_pad)
         return (x, ck, cv), None
 
     (x, cache_k, cache_v), _ = jax.lax.scan(
@@ -206,7 +225,7 @@ class _GenCfg:
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "do_sample", "top_k",
                      "use_top_p", "eos_token_id"))
-def _generate_jit(params, ids, key, temperature, top_p, *, cfg,
+def _generate_jit(params, ids, key, temperature, top_p, key_pad, *, cfg,
                   max_new_tokens, do_sample, top_k, use_top_p,
                   eos_token_id):
     b, prompt_len = ids.shape
@@ -220,7 +239,8 @@ def _generate_jit(params, ids, key, temperature, top_p, *, cfg,
 
     # prefill: the whole prompt in one batched pass
     logits, cache_k, cache_v = _forward(params, ids, cache_k, cache_v,
-                                        jnp.asarray(prompt_len), cfg)
+                                        jnp.asarray(prompt_len), cfg,
+                                        key_pad=key_pad)
     key, sub = jax.random.split(key)
     next_tok = _sample(logits, sub, do_sample, temperature,
                        top_k, top_p, use_top_p)
@@ -230,7 +250,8 @@ def _generate_jit(params, ids, key, temperature, top_p, *, cfg,
     def step(carry, i):
         tok, ck, cv, fin, key = carry
         valid = prompt_len + 1 + i
-        logits, ck, cv = _forward(params, tok[:, None], ck, cv, valid, cfg)
+        logits, ck, cv = _forward(params, tok[:, None], ck, cv, valid,
+                                  cfg, key_pad=key_pad)
         key, sub = jax.random.split(key)
         nxt = _sample(logits, sub, do_sample, temperature,
                       top_k, top_p, use_top_p)
@@ -249,15 +270,15 @@ def _generate_jit(params, ids, key, temperature, top_p, *, cfg,
 
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-             seed=0):
+             seed=0, attention_mask=None):
     """Generate ``max_new_tokens`` continuations of ``input_ids``
     ([b, prompt_len] int tensor) with the compiled KV-cache decode loop.
     Returns the generated tokens [b, max_new_tokens] (prompt excluded).
 
-    Prompts in a batch must be REAL tokens of equal length — there is no
-    padding mask, so padded rows would be conditioned on the pad tokens.
-    Batch same-length prompts together (length-bucketing is also what
-    keeps the compiled-program count low on TPU)."""
+    Unequal-length prompts batch via LEFT padding + ``attention_mask``
+    ([b, prompt_len] 1/0, zeros on the left): pad slots are hidden from
+    attention and RoPE positions start at each row's first real token.
+    Without a mask, prompts must be all-real tokens."""
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
     if getattr(model.config, "moe_num_experts", 0) > 1:
@@ -276,14 +297,35 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
 
     e = env_mod.get_env()
     if e is not None:
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        ids = jax.device_put(ids, NamedSharding(e.mesh, PartitionSpec()))
+        ids = jax.device_put(ids, _replicated(e))
     if top_k:
         top_k = min(int(top_k), model.config.vocab_size)
+    key_pad = None
+    if attention_mask is not None:
+        m = attention_mask._data if isinstance(attention_mask, Tensor) \
+            else jnp.asarray(np.asarray(attention_mask))
+        if m.shape != ids.shape:
+            raise ValueError(
+                f"attention_mask shape {tuple(m.shape)} must equal "
+                f"input_ids shape {tuple(ids.shape)}")
+        npad = jnp.sum(m == 0, axis=1).astype(jnp.int32)
+        # the mask must be exactly 0^k 1^(n-k) per row (LEFT padding):
+        # interior zeros would be silently misread as leading pad
+        expect = (jnp.arange(m.shape[1])[None, :]
+                  >= npad[:, None]).astype(m.dtype)
+        if not bool(jnp.array_equal(m.astype(bool),
+                                    expect.astype(bool))):
+            raise ValueError(
+                "attention_mask must be LEFT-padded (each row all zeros "
+                "then all ones); interior zeros / right padding are not "
+                "expressible in the cache layout")
+        if bool((npad > 0).any()):  # all-ones mask == no mask: share the
+            key_pad = npad           # maskless compiled program
+            if e is not None:
+                key_pad = jax.device_put(key_pad, _replicated(e))
     out = _generate_jit(
         params, ids.astype(jnp.int32), jax.random.key(seed),
-        jnp.float32(temperature), jnp.float32(top_p),
+        jnp.float32(temperature), jnp.float32(top_p), key_pad,
         cfg=_GenCfg(model.config), max_new_tokens=int(max_new_tokens),
         do_sample=bool(do_sample), top_k=int(top_k),
         use_top_p=float(top_p) < 1.0,

@@ -117,3 +117,51 @@ def test_config_jit_key_is_value_based(model):
     fresh = _GenCfg(LlamaConfig.tiny(num_hidden_layers=2))
     assert fresh == _GenCfg(LlamaConfig.tiny(num_hidden_layers=2))
     assert hash(fresh) == hash(_GenCfg(LlamaConfig.tiny(num_hidden_layers=2)))
+
+
+def test_left_padded_batch_matches_unpadded(model):
+    """A left-padded batch with attention_mask generates exactly what each
+    prompt produces alone (pad slots hidden, positions shifted)."""
+    rng = np.random.RandomState(9)
+    v = model.config.vocab_size
+    p_short = rng.randint(0, v, (1, 3))
+    p_long = rng.randint(0, v, (1, 5))
+    ref_short = generate(model, pt.to_tensor(p_short),
+                         max_new_tokens=4).numpy()
+    ref_long = generate(model, pt.to_tensor(p_long),
+                        max_new_tokens=4).numpy()
+    pad = 0
+    batch = np.concatenate(
+        [np.concatenate([[[pad, pad]], p_short], axis=1), p_long], axis=0)
+    mask = np.array([[0, 0, 1, 1, 1], [1, 1, 1, 1, 1]])
+    got = generate(model, pt.to_tensor(batch), max_new_tokens=4,
+                   attention_mask=pt.to_tensor(mask)).numpy()
+    np.testing.assert_array_equal(got[0:1], ref_short)
+    np.testing.assert_array_equal(got[1:2], ref_long)
+    # right padding is rejected loudly
+    with pytest.raises(ValueError, match="LEFT"):
+        generate(model, pt.to_tensor(batch), max_new_tokens=2,
+                 attention_mask=pt.to_tensor(mask[:, ::-1].copy()))
+
+
+def test_mask_validation(model):
+    rng = np.random.RandomState(11)
+    prompt = rng.randint(0, model.config.vocab_size, (1, 4))
+    # interior zero rejected
+    with pytest.raises(ValueError, match="LEFT"):
+        generate(model, pt.to_tensor(prompt), max_new_tokens=2,
+                 attention_mask=pt.to_tensor(np.array([[1, 0, 1, 1]])))
+    # shape mismatch rejected
+    with pytest.raises(ValueError, match="shape"):
+        generate(model, pt.to_tensor(prompt), max_new_tokens=2,
+                 attention_mask=pt.to_tensor(np.array([[1, 1, 1]])))
+    # all-ones mask == no mask (same result, shared program)
+    ref = generate(model, pt.to_tensor(prompt), max_new_tokens=3).numpy()
+    got = generate(model, pt.to_tensor(prompt), max_new_tokens=3,
+                   attention_mask=pt.to_tensor(np.ones((1, 4)))).numpy()
+    np.testing.assert_array_equal(got, ref)
+    # method form forwards the mask
+    got2 = model.generate(pt.to_tensor(prompt), max_new_tokens=3,
+                          attention_mask=pt.to_tensor(
+                              np.ones((1, 4)))).numpy()
+    np.testing.assert_array_equal(got2, ref)
