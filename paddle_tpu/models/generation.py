@@ -308,19 +308,18 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             raise ValueError(
                 f"attention_mask shape {tuple(m.shape)} must equal "
                 f"input_ids shape {tuple(ids.shape)}")
-        npad = jnp.sum(m == 0, axis=1).astype(jnp.int32)
-        # the mask must be exactly 0^k 1^(n-k) per row (LEFT padding):
-        # interior zeros would be silently misread as leading pad
-        expect = (jnp.arange(m.shape[1])[None, :]
-                  >= npad[:, None]).astype(m.dtype)
-        if not bool(jnp.array_equal(m.astype(bool),
-                                    expect.astype(bool))):
+        # validate host-side in one pass (tiny array; avoids device
+        # round-trips): each row must be 0^k 1^(n-k) — LEFT padding
+        mh = np.asarray(m).astype(bool)
+        npad_h = (~mh).sum(axis=1)
+        expect = np.arange(mh.shape[1])[None, :] >= npad_h[:, None]
+        if not np.array_equal(mh, expect):
             raise ValueError(
                 "attention_mask must be LEFT-padded (each row all zeros "
                 "then all ones); interior zeros / right padding are not "
                 "expressible in the cache layout")
-        if bool((npad > 0).any()):  # all-ones mask == no mask: share the
-            key_pad = npad           # maskless compiled program
+        if npad_h.any():  # all-ones mask == no mask: share the
+            key_pad = jnp.asarray(npad_h, jnp.int32)  # maskless program
             if e is not None:
                 key_pad = jax.device_put(key_pad, _replicated(e))
     out = _generate_jit(

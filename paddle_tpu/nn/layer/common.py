@@ -151,10 +151,18 @@ class UpsamplingNearest2D(Upsample):
         super().__init__(size, scale_factor, "nearest", False, 0, data_format)
 
 
+def _npairs(padding, n):
+    """Reference Pad-layer semantics: an int pads every edge of every
+    spatial dim; a list passes through."""
+    if isinstance(padding, int):
+        return [padding] * (2 * n)
+    return padding
+
+
 class Pad1D(Layer):
     def __init__(self, padding, mode="constant", value=0.0, data_format="NCL", name=None):
         super().__init__()
-        self.padding, self.mode, self.value = padding, mode, value
+        self.padding, self.mode, self.value = _npairs(padding, 1), mode, value
         self.data_format = "NCW" if data_format in ("NCL", "NCW") else "NWC"
 
     def forward(self, x):
@@ -164,7 +172,7 @@ class Pad1D(Layer):
 class Pad2D(Layer):
     def __init__(self, padding, mode="constant", value=0.0, data_format="NCHW", name=None):
         super().__init__()
-        self.padding, self.mode, self.value, self.data_format = padding, mode, value, data_format
+        self.padding, self.mode, self.value, self.data_format = _npairs(padding, 2), mode, value, data_format
 
     def forward(self, x):
         return F.pad(x, self.padding, self.mode, self.value, self.data_format)
@@ -173,7 +181,7 @@ class Pad2D(Layer):
 class Pad3D(Layer):
     def __init__(self, padding, mode="constant", value=0.0, data_format="NCDHW", name=None):
         super().__init__()
-        self.padding, self.mode, self.value, self.data_format = padding, mode, value, data_format
+        self.padding, self.mode, self.value, self.data_format = _npairs(padding, 3), mode, value, data_format
 
     def forward(self, x):
         return F.pad(x, self.padding, self.mode, self.value, self.data_format)

@@ -1,0 +1,176 @@
+"""Construct-and-forward sweep over the nn layer families: every layer
+the reference exports builds with canonical args and produces a
+finite-valued output of the expected shape. Catches latent constructor /
+forward bugs breadth-first (the per-layer numerics live in test_nn.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+
+
+def _x(*shape):
+    rng = np.random.RandomState(hash(shape) % (2**31))
+    return pt.to_tensor(rng.randn(*shape).astype(np.float32))
+
+
+def _check(out, shape=None):
+    arr = out.numpy() if hasattr(out, "numpy") else np.asarray(out)
+    assert np.isfinite(arr).all()
+    if shape is not None:
+        assert tuple(arr.shape) == tuple(shape), (arr.shape, shape)
+
+
+# shape-preserving activations: one spec covers the family
+ACTIVATIONS = [
+    "ReLU", "ReLU6", "Sigmoid", "LogSigmoid", "Tanh", "Tanhshrink",
+    "GELU", "SiLU", "Silu", "Swish", "Mish", "LeakyReLU", "ELU", "SELU",
+    "CELU", "Hardtanh", "Hardshrink", "Softshrink", "Hardsigmoid",
+    "Hardswish", "Softplus", "Softsign", "Softmax", "LogSoftmax",
+    "ThresholdedReLU",
+]
+
+
+@pytest.mark.parametrize("name", ACTIVATIONS)
+def test_activation_layers(name):
+    layer = getattr(nn, name)()
+    _check(layer(_x(2, 6)), (2, 6))
+
+
+def test_parametric_activations():
+    _check(nn.PReLU()(_x(2, 4)), (2, 4))
+    _check(nn.Maxout(groups=2)(_x(2, 4, 3, 3)), (2, 2, 3, 3))
+    _check(nn.GLU()(_x(2, 8)), (2, 4))
+    _check(nn.RReLU()(_x(2, 4)), (2, 4))
+    _check(nn.Softmax2D()(_x(2, 3, 4, 4)), (2, 3, 4, 4))
+
+
+NORMS = [
+    (lambda: nn.BatchNorm(4), (2, 4, 8), None),
+    (lambda: nn.BatchNorm1D(4), (2, 4, 8), None),
+    (lambda: nn.BatchNorm2D(4), (2, 4, 6, 6), None),
+    (lambda: nn.BatchNorm3D(4), (2, 4, 3, 3, 3), None),
+    (lambda: nn.SyncBatchNorm(4), (2, 4, 6, 6), None),
+    (lambda: nn.LayerNorm(8), (2, 5, 8), None),
+    (lambda: nn.RMSNorm(8), (2, 5, 8), None),
+    (lambda: nn.GroupNorm(2, 4), (2, 4, 6, 6), None),
+    (lambda: nn.InstanceNorm1D(4), (2, 4, 8), None),
+    (lambda: nn.InstanceNorm2D(4), (2, 4, 6, 6), None),
+    (lambda: nn.InstanceNorm3D(4), (2, 4, 3, 3, 3), None),
+    (lambda: nn.LocalResponseNorm(3), (2, 4, 6, 6), None),
+]
+
+
+@pytest.mark.parametrize("factory,shape,_", NORMS)
+def test_norm_layers(factory, shape, _):
+    layer = factory()
+    _check(layer(_x(*shape)), shape)
+
+
+POOLS = [
+    (lambda: nn.MaxPool1D(2), (2, 3, 8), (2, 3, 4)),
+    (lambda: nn.MaxPool2D(2), (2, 3, 8, 8), (2, 3, 4, 4)),
+    (lambda: nn.MaxPool3D(2), (2, 3, 4, 4, 4), (2, 3, 2, 2, 2)),
+    (lambda: nn.AvgPool1D(2), (2, 3, 8), (2, 3, 4)),
+    (lambda: nn.AvgPool2D(2), (2, 3, 8, 8), (2, 3, 4, 4)),
+    (lambda: nn.AvgPool3D(2), (2, 3, 4, 4, 4), (2, 3, 2, 2, 2)),
+    (lambda: nn.AdaptiveAvgPool1D(2), (2, 3, 8), (2, 3, 2)),
+    (lambda: nn.AdaptiveAvgPool2D(2), (2, 3, 8, 8), (2, 3, 2, 2)),
+    (lambda: nn.AdaptiveAvgPool3D(2), (2, 3, 4, 4, 4), (2, 3, 2, 2, 2)),
+    (lambda: nn.AdaptiveMaxPool1D(2), (2, 3, 8), (2, 3, 2)),
+    (lambda: nn.AdaptiveMaxPool2D(2), (2, 3, 8, 8), (2, 3, 2, 2)),
+    (lambda: nn.AdaptiveMaxPool3D(2), (2, 3, 4, 4, 4), (2, 3, 2, 2, 2)),
+    (lambda: nn.LPPool2D(2, 2), (2, 3, 8, 8), (2, 3, 4, 4)),
+]
+
+
+@pytest.mark.parametrize("factory,in_shape,out_shape", POOLS)
+def test_pool_layers(factory, in_shape, out_shape):
+    _check(factory()(_x(*in_shape)), out_shape)
+
+
+CONVS = [
+    (lambda: nn.Conv1D(3, 5, 3, padding=1), (2, 3, 8), (2, 5, 8)),
+    (lambda: nn.Conv2D(3, 5, 3, padding=1), (2, 3, 8, 8), (2, 5, 8, 8)),
+    (lambda: nn.Conv3D(3, 5, 3, padding=1), (2, 3, 4, 4, 4),
+     (2, 5, 4, 4, 4)),
+    (lambda: nn.Conv1DTranspose(3, 5, 2, stride=2), (2, 3, 4), (2, 5, 8)),
+    (lambda: nn.Conv2DTranspose(3, 5, 2, stride=2), (2, 3, 4, 4),
+     (2, 5, 8, 8)),
+    (lambda: nn.Conv3DTranspose(3, 5, 2, stride=2), (2, 3, 2, 2, 2),
+     (2, 5, 4, 4, 4)),
+]
+
+
+@pytest.mark.parametrize("factory,in_shape,out_shape", CONVS)
+def test_conv_layers(factory, in_shape, out_shape):
+    _check(factory()(_x(*in_shape)), out_shape)
+
+
+PADS = [
+    (lambda: nn.Pad1D(1), (2, 3, 6), (2, 3, 8)),
+    (lambda: nn.Pad2D(1), (2, 3, 6, 6), (2, 3, 8, 8)),
+    (lambda: nn.Pad3D(1), (2, 3, 4, 4, 4), (2, 3, 6, 6, 6)),
+    (lambda: nn.ZeroPad2D(1), (2, 3, 6, 6), (2, 3, 8, 8)),
+]
+
+
+@pytest.mark.parametrize("factory,in_shape,out_shape", PADS)
+def test_pad_layers(factory, in_shape, out_shape):
+    _check(factory()(_x(*in_shape)), out_shape)
+
+
+def test_shuffle_and_shape_layers():
+    _check(nn.PixelShuffle(2)(_x(2, 8, 3, 3)), (2, 2, 6, 6))
+    _check(nn.PixelUnshuffle(2)(_x(2, 2, 6, 6)), (2, 8, 3, 3))
+    _check(nn.ChannelShuffle(2)(_x(2, 4, 3, 3)), (2, 4, 3, 3))
+    _check(nn.Flatten()(_x(2, 3, 4)), (2, 12))
+    _check(nn.Unflatten(1, [3, 4])(_x(2, 12)), (2, 3, 4))
+    _check(nn.Upsample(scale_factor=2)(_x(2, 3, 4, 4)), (2, 3, 8, 8))
+    _check(nn.UpsamplingNearest2D(scale_factor=2)(_x(2, 3, 4, 4)),
+           (2, 3, 8, 8))
+    _check(nn.UpsamplingBilinear2D(scale_factor=2)(_x(2, 3, 4, 4)),
+           (2, 3, 8, 8))
+
+
+def test_similarity_and_distance():
+    _check(nn.CosineSimilarity()(_x(2, 6), _x(2, 6)), (2,))
+    _check(nn.PairwiseDistance()(_x(2, 6), _x(2, 6)), (2,))
+    _check(nn.Bilinear(3, 4, 5)(_x(2, 3), _x(2, 4)), (2, 5))
+
+
+def test_dropout_layers_eval_identity():
+    x = _x(2, 3, 4, 4)
+    for layer in [nn.Dropout(0.5), nn.Dropout2D(0.5), nn.AlphaDropout(0.5)]:
+        layer.eval()
+        np.testing.assert_allclose(layer(x).numpy(), x.numpy())
+
+
+def test_rnn_layers():
+    x = _x(2, 5, 4)  # [b, t, in]
+    for cls in (nn.SimpleRNN, nn.GRU):
+        out, h = cls(4, 6)(x)
+        _check(out, (2, 5, 6))
+    out, (h, c) = nn.LSTM(4, 6)(x)
+    _check(out, (2, 5, 6))
+    out, _ = nn.LSTM(4, 6, direction="bidirect")(x)
+    _check(out, (2, 5, 12))
+
+
+def test_transformer_layers():
+    enc_layer = nn.TransformerEncoderLayer(8, 2, 16)
+    _check(enc_layer(_x(2, 5, 8)), (2, 5, 8))
+    enc = nn.TransformerEncoder(enc_layer, 2)
+    _check(enc(_x(2, 5, 8)), (2, 5, 8))
+    mha = nn.MultiHeadAttention(8, 2)
+    _check(mha(_x(2, 5, 8), _x(2, 5, 8), _x(2, 5, 8)), (2, 5, 8))
+    tr = nn.Transformer(8, 2, 1, 1, 16)
+    _check(tr(_x(2, 5, 8), _x(2, 4, 8)), (2, 4, 8))
+
+
+def test_embedding_and_unfold():
+    ids = pt.to_tensor(np.array([[1, 2], [3, 0]], np.int64))
+    _check(nn.Embedding(10, 6)(ids), (2, 2, 6))
+    _check(nn.Unfold([2, 2])(_x(2, 3, 4, 4)), (2, 12, 9))
+    folded = nn.Fold([4, 4], [2, 2])(_x(2, 12, 9))
+    _check(folded, (2, 3, 4, 4))
