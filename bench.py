@@ -81,6 +81,22 @@ def _probe_backend(timeout_s: int = 240) -> str:
     raise RuntimeError(f"jax backend unavailable after retries: {last_err}")
 
 
+def enable_compilation_cache():
+    """Persistent XLA compilation cache: a brief tunnel window must
+    suffice, so never pay the same compile twice across invocations."""
+    import jax
+
+    try:
+        cache_dir = os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.expanduser("~/.cache/paddle_tpu_xla_cache"))
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
+
+
 # bf16 peak FLOPs/s per chip by TPU generation (public spec sheets)
 _PEAK = {
     "v4": 275e12,
@@ -118,6 +134,8 @@ def main():
 
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    enable_compilation_cache()
 
     import paddle_tpu as pt
     from paddle_tpu.jit.train_step import TrainStep
@@ -181,6 +199,32 @@ def main():
     if tpu_note:
         extra["note"] = tpu_note
         extra["see"] = "PERF.md records any TPU numbers measured earlier"
+
+    from paddle_tpu.utils import measurements as _meas
+
+    if not on_cpu:
+        # Persist the hardware number the moment it exists — a tunnel that
+        # dies after this line can no longer erase the round's truth.
+        try:
+            _meas.record(_METRIC, round(tokens_per_sec, 2), "tokens/s",
+                         extra={"mfu": round(mfu, 4),
+                                "vs_baseline": round(mfu / 0.45, 4),
+                                "batch": batch, "seq": seq,
+                                "model_params_b": extra["model_params_b"]})
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: measurement persist failed: {e}",
+                  file=sys.stderr, flush=True)
+    else:
+        # CPU fallback: surface the last-good hardware record inline so
+        # the driver's JSON carries the provenance-stamped TPU truth even
+        # when the tunnel is dead at bench time.
+        try:
+            lg = _meas.last_good(_METRIC)
+        except Exception:  # noqa: BLE001
+            lg = None
+        if lg is not None:
+            extra["last_good_tpu"] = lg
+            extra["mfu_last_good_tpu"] = lg.get("extra", {}).get("mfu")
     # HBM accounting is best-effort: it needs a second AOT compile over
     # the (possibly flaky) tunnel, so it gets its own short alarm — the
     # measured throughput must never be lost to an optional statistic.

@@ -74,6 +74,13 @@ def bench_resnet50(smoke):
 
         out["mfu"] = round(imgs_per_sec * flops_img
                            / _peak_flops(jax.devices()[0]), 4)
+    if not smoke:
+        from paddle_tpu.utils import measurements as _meas
+
+        _meas.record_or_warn(
+            out["metric"], out["value"], out["unit"],
+            extra={k: v for k, v in out.items()
+                   if k not in ("metric", "value", "unit")})
     print(json.dumps(out), flush=True)
     return out
 
@@ -131,6 +138,12 @@ def bench_bert_mlm(smoke):
 
         out["mfu"] = round(tokens_per_sec * flops_tok
                            / _peak_flops(jax.devices()[0]), 4)
+        from paddle_tpu.utils import measurements as _meas
+
+        _meas.record_or_warn(
+            out["metric"], out["value"], out["unit"],
+            extra={k: v for k, v in out.items()
+                   if k not in ("metric", "value", "unit")})
     print(json.dumps(out), flush=True)
     return out
 
@@ -141,6 +154,9 @@ def main():
 
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from bench import enable_compilation_cache
+
+    enable_compilation_cache()
     if smoke is None:
         smoke = jax.default_backend() == "cpu"
     print(f"baseline_configs: backend={jax.default_backend()} "

@@ -21,6 +21,9 @@ def main():
 
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from bench import enable_compilation_cache
+
+    enable_compilation_cache()
     smoke = "--smoke" in sys.argv or jax.default_backend() == "cpu"
     print(f"decode_bench: backend={jax.default_backend()} smoke={smoke}",
           file=sys.stderr, flush=True)
@@ -62,6 +65,13 @@ def main():
            "batch": batch, "prompt_len": prompt, "new_tokens": new}
     if smoke:
         rec["note"] = "cpu smoke mode; not a TPU number"
+    else:
+        from paddle_tpu.utils import measurements as _meas
+
+        _meas.record_or_warn(
+            rec["metric"], rec["value"], "tokens/s",
+            extra={"batch": batch, "prompt_len": prompt,
+                   "new_tokens": new})
     print(json.dumps(rec), flush=True)
 
 

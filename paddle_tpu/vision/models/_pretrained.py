@@ -1,0 +1,20 @@
+"""Shared ``pretrained=True`` gate for the model zoo.
+
+The reference's constructors download trained weights
+(`python/paddle/vision/models/resnet.py:312` `get_weights_path_from_url`);
+this environment has no network egress, and silently returning random
+weights where the reference returns trained ones corrupts downstream
+accuracy without a trace.  Match the datasets' behavior
+(`vision/datasets.py` `_require_no_download`): raise with the local-load
+recipe instead.
+"""
+from ...framework.errors import UnavailableError
+
+
+def require_no_pretrained(name, pretrained):
+    if pretrained:
+        raise UnavailableError(
+            f"{name}(pretrained=True): this environment has no network "
+            "egress, so reference pretrained weights cannot be downloaded. "
+            f"Build the model with pretrained=False and load local weights "
+            f"via model.set_state_dict(paddle_tpu.load(path)) instead.")

@@ -9,6 +9,7 @@ from ...nn.layer.layers import Layer, LayerList, Sequential
 from ...nn.layer.norm import BatchNorm2D
 from ...nn.layer.pooling import AdaptiveAvgPool2D, AvgPool2D, MaxPool2D
 from ...tensor.manipulation import concat
+from ._pretrained import require_no_pretrained
 
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201", "densenet264"]
@@ -96,20 +97,25 @@ def _densenet(depth, **kwargs):
 
 
 def densenet121(pretrained=False, **kwargs):
+    require_no_pretrained("densenet121", pretrained)
     return _densenet(121, **kwargs)
 
 
 def densenet161(pretrained=False, **kwargs):
+    require_no_pretrained("densenet161", pretrained)
     return _densenet(161, **kwargs)
 
 
 def densenet169(pretrained=False, **kwargs):
+    require_no_pretrained("densenet169", pretrained)
     return _densenet(169, **kwargs)
 
 
 def densenet201(pretrained=False, **kwargs):
+    require_no_pretrained("densenet201", pretrained)
     return _densenet(201, **kwargs)
 
 
 def densenet264(pretrained=False, **kwargs):
+    require_no_pretrained("densenet264", pretrained)
     return _densenet(264, **kwargs)

@@ -13,6 +13,7 @@ from ...nn.layer.conv import Conv2D
 from ...nn.layer.layers import Layer, Sequential
 from ...nn.layer.norm import BatchNorm2D
 from ...nn.layer.pooling import AdaptiveAvgPool2D
+from ._pretrained import require_no_pretrained
 
 __all__ = [
     "MobileNetV1", "MobileNetV2", "MobileNetV3Small", "MobileNetV3Large",
@@ -255,16 +256,20 @@ class MobileNetV3Large(_MobileNetV3):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    require_no_pretrained("mobilenet_v1", pretrained)
     return MobileNetV1(scale=scale, **kwargs)
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    require_no_pretrained("mobilenet_v2", pretrained)
     return MobileNetV2(scale=scale, **kwargs)
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    require_no_pretrained("mobilenet_v3_small", pretrained)
     return MobileNetV3Small(scale=scale, **kwargs)
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    require_no_pretrained("mobilenet_v3_large", pretrained)
     return MobileNetV3Large(scale=scale, **kwargs)

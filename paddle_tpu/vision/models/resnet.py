@@ -16,6 +16,7 @@ from ...nn.layer.conv import Conv2D
 from ...nn.layer.layers import Layer, Sequential
 from ...nn.layer.norm import BatchNorm2D
 from ...nn.layer.pooling import AdaptiveAvgPool2D, MaxPool2D
+from ._pretrained import require_no_pretrained
 
 
 class BasicBlock(Layer):
@@ -139,35 +140,43 @@ def _resnet(block, depth, **kwargs):
 
 
 def resnet18(pretrained=False, **kwargs):
+    require_no_pretrained("resnet18", pretrained)
     return _resnet(BasicBlock, 18, pretrained=pretrained, **kwargs)
 
 
 def resnet34(pretrained=False, **kwargs):
+    require_no_pretrained("resnet34", pretrained)
     return _resnet(BasicBlock, 34, pretrained=pretrained, **kwargs)
 
 
 def resnet50(pretrained=False, **kwargs):
+    require_no_pretrained("resnet50", pretrained)
     return _resnet(BottleneckBlock, 50, pretrained=pretrained, **kwargs)
 
 
 def resnet101(pretrained=False, **kwargs):
+    require_no_pretrained("resnet101", pretrained)
     return _resnet(BottleneckBlock, 101, pretrained=pretrained, **kwargs)
 
 
 def resnet152(pretrained=False, **kwargs):
+    require_no_pretrained("resnet152", pretrained)
     return _resnet(BottleneckBlock, 152, pretrained=pretrained, **kwargs)
 
 
 def wide_resnet50_2(pretrained=False, **kwargs):
+    require_no_pretrained("wide_resnet50_2", pretrained)
     return _resnet(BottleneckBlock, 50, width=128, pretrained=pretrained,
                    **kwargs)
 
 
 def wide_resnet101_2(pretrained=False, **kwargs):
+    require_no_pretrained("wide_resnet101_2", pretrained)
     return _resnet(BottleneckBlock, 101, width=128, pretrained=pretrained,
                    **kwargs)
 
 
 def resnext50_32x4d(pretrained=False, **kwargs):
+    require_no_pretrained("resnext50_32x4d", pretrained)
     return _resnet(BottleneckBlock, 50, groups=32, width=4,
                    pretrained=pretrained, **kwargs)

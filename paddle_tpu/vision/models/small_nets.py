@@ -11,6 +11,7 @@ from ...nn.layer.layers import Layer, Sequential
 from ...nn.layer.norm import BatchNorm2D
 from ...nn.layer.pooling import (AdaptiveAvgPool2D, AvgPool2D, MaxPool2D)
 from ...tensor.manipulation import concat, reshape, transpose
+from ._pretrained import require_no_pretrained
 
 __all__ = [
     "AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
@@ -52,6 +53,7 @@ class AlexNet(Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
+    require_no_pretrained("alexnet", pretrained)
     return AlexNet(**kwargs)
 
 
@@ -104,10 +106,12 @@ class SqueezeNet(Layer):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
+    require_no_pretrained("squeezenet1_0", pretrained)
     return SqueezeNet("1.0", **kwargs)
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
+    require_no_pretrained("squeezenet1_1", pretrained)
     return SqueezeNet("1.1", **kwargs)
 
 
@@ -205,26 +209,32 @@ def _shufflenet(scale, **kwargs):
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kw):
+    require_no_pretrained("shufflenet_v2_x0_25", pretrained)
     return _shufflenet(0.25, **kw)
 
 
 def shufflenet_v2_x0_33(pretrained=False, **kw):
+    require_no_pretrained("shufflenet_v2_x0_33", pretrained)
     return _shufflenet(0.33, **kw)
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kw):
+    require_no_pretrained("shufflenet_v2_x0_5", pretrained)
     return _shufflenet(0.5, **kw)
 
 
 def shufflenet_v2_x1_0(pretrained=False, **kw):
+    require_no_pretrained("shufflenet_v2_x1_0", pretrained)
     return _shufflenet(1.0, **kw)
 
 
 def shufflenet_v2_x1_5(pretrained=False, **kw):
+    require_no_pretrained("shufflenet_v2_x1_5", pretrained)
     return _shufflenet(1.5, **kw)
 
 
 def shufflenet_v2_x2_0(pretrained=False, **kw):
+    require_no_pretrained("shufflenet_v2_x2_0", pretrained)
     return _shufflenet(2.0, **kw)
 
 
@@ -317,6 +327,7 @@ class GoogLeNet(Layer):
 
 
 def googlenet(pretrained=False, **kwargs):
+    require_no_pretrained("googlenet", pretrained)
     return GoogLeNet(**kwargs)
 
 
@@ -387,4 +398,5 @@ class InceptionV3(Layer):
 
 
 def inception_v3(pretrained=False, **kwargs):
+    require_no_pretrained("inception_v3", pretrained)
     return InceptionV3(**kwargs)

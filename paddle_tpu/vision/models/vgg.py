@@ -9,6 +9,7 @@ from ...nn.layer.conv import Conv2D
 from ...nn.layer.layers import Layer, Sequential
 from ...nn.layer.norm import BatchNorm2D
 from ...nn.layer.pooling import AdaptiveAvgPool2D, MaxPool2D
+from ._pretrained import require_no_pretrained
 
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19"]
 
@@ -70,16 +71,20 @@ def _vgg(cfg, batch_norm=False, **kwargs):
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):
+    require_no_pretrained("vgg11", pretrained)
     return _vgg("A", batch_norm, **kwargs)
 
 
 def vgg13(pretrained=False, batch_norm=False, **kwargs):
+    require_no_pretrained("vgg13", pretrained)
     return _vgg("B", batch_norm, **kwargs)
 
 
 def vgg16(pretrained=False, batch_norm=False, **kwargs):
+    require_no_pretrained("vgg16", pretrained)
     return _vgg("D", batch_norm, **kwargs)
 
 
 def vgg19(pretrained=False, batch_norm=False, **kwargs):
+    require_no_pretrained("vgg19", pretrained)
     return _vgg("E", batch_norm, **kwargs)

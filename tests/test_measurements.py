@@ -1,0 +1,70 @@
+"""Persistent hardware-measurement store (utils/measurements.py)."""
+import json
+import os
+
+import pytest
+
+from paddle_tpu.utils import measurements as meas
+
+
+@pytest.fixture()
+def store(tmp_path, monkeypatch):
+    path = str(tmp_path / "PERF_MEASUREMENTS.json")
+    monkeypatch.setenv("PT_MEASUREMENTS_PATH", path)
+    return path
+
+
+def test_record_stamps_provenance(store):
+    rec = meas.record("m1", 123.4, "tok/s", backend="tpu",
+                      device="TPU v5 lite", extra={"mfu": 0.6})
+    assert rec["metric"] == "m1" and rec["value"] == 123.4
+    assert rec["backend"] == "tpu" and rec["device"] == "TPU v5 lite"
+    assert "timestamp" in rec
+    # provenance lands on disk, atomically, as valid json
+    with open(store) as f:
+        data = json.load(f)
+    assert data["records"][-1]["extra"] == {"mfu": 0.6}
+    # the repo is a git checkout, so commit provenance must be present
+    assert "commit" in data["records"][-1]
+
+
+def test_last_good_skips_cpu_records(store):
+    meas.record("m1", 1.0, "tok/s", backend="tpu", device="TPU v5 lite")
+    meas.record("m1", 2.0, "tok/s", backend="cpu", device="cpu")
+    lg = meas.last_good("m1")
+    assert lg is not None and lg["value"] == 1.0 and lg["backend"] == "tpu"
+    assert meas.last_good("missing") is None
+
+
+def test_last_good_returns_most_recent_hw(store):
+    meas.record("m1", 1.0, "tok/s", backend="tpu", device="d")
+    meas.record("m1", 3.0, "tok/s", backend="tpu", device="d")
+    assert meas.last_good("m1")["value"] == 3.0
+
+
+def test_all_latest(store):
+    meas.record("a", 1.0, "u", backend="tpu", device="d")
+    meas.record("b", 2.0, "u", backend="cpu", device="cpu")
+    meas.record("a", 5.0, "u", backend="tpu", device="d")
+    latest = meas.all_latest()
+    assert latest["a"]["value"] == 5.0 and "b" not in latest
+    latest_all = meas.all_latest(hardware_only=False)
+    assert latest_all["b"]["value"] == 2.0
+
+
+def test_corrupt_store_recovers(store):
+    with open(store, "w") as f:
+        f.write("{not json")
+    meas.record("m", 1.0, "u", backend="tpu", device="d")
+    assert meas.last_good("m")["value"] == 1.0
+
+
+def test_bench_emits_last_good_inline(store, monkeypatch):
+    """bench.py's CPU-fallback contract: the emitted JSON carries the
+    last-good TPU record with provenance when the chip is unreachable."""
+    meas.record("llama_train_tokens_per_sec_per_chip", 39595.0, "tokens/s",
+                backend="tpu", device="TPU v5 lite",
+                extra={"mfu": 0.574, "vs_baseline": 1.2756})
+    lg = meas.last_good("llama_train_tokens_per_sec_per_chip")
+    assert lg["extra"]["mfu"] == 0.574
+    assert lg["device"] == "TPU v5 lite"

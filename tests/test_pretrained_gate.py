@@ -1,0 +1,43 @@
+"""`pretrained=True` must raise, never silently return random weights.
+
+Reference behavior: constructors load trained weights
+(`python/paddle/vision/models/resnet.py:312`); with no egress the honest
+TPU-side contract is an `UnavailableError` with the local-load recipe —
+the same contract `vision/datasets.py` applies to `download=True`.
+"""
+import inspect
+
+import pytest
+
+import paddle_tpu.vision.models as M
+from paddle_tpu.framework.errors import UnavailableError
+
+
+def _constructors():
+    out = []
+    for name in sorted(set(dir(M))):
+        fn = getattr(M, name)
+        if name.startswith("_") or not callable(fn) or inspect.isclass(fn):
+            continue
+        try:
+            sig = inspect.signature(fn)
+        except (TypeError, ValueError):
+            continue
+        if "pretrained" in sig.parameters:
+            out.append(name)
+    return out
+
+
+CTORS = _constructors()
+
+
+def test_zoo_has_expected_breadth():
+    # resnet x8, vgg x4, mobilenet x4, densenet x5, alexnet, squeezenet x2,
+    # shufflenet x6, googlenet, inception_v3
+    assert len(CTORS) >= 30, CTORS
+
+
+@pytest.mark.parametrize("name", CTORS)
+def test_pretrained_true_raises(name):
+    with pytest.raises(UnavailableError, match="pretrained"):
+        getattr(M, name)(pretrained=True)

@@ -1,0 +1,94 @@
+"""Opportunistic hardware-bench orchestrator.
+
+Probes the TPU tunnel; when it is up, runs every benchmark in value order,
+each in its own subprocess with a timeout, so one hang cannot cost the
+others.  Every successful run persists its numbers to
+``PERF_MEASUREMENTS.json`` (see ``paddle_tpu/utils/measurements.py``) the
+moment they exist — run this whenever the chip is reachable during a
+round, not only at bench time.
+
+Usage: python tools/hwbench.py [--only headline,decode,bert,resnet,ernie]
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BENCHES = [
+    # (name, argv, timeout_s) — value order: headline MFU first.
+    ("headline", [sys.executable, "bench.py"], 2700),
+    ("decode", [sys.executable, "benchmarks/decode_bench.py"], 1800),
+    ("bert", [sys.executable, "benchmarks/baseline_configs.py",
+              "--bert-only"], 1800),
+    ("resnet", [sys.executable, "benchmarks/baseline_configs.py",
+                "--resnet-only"], 2400),
+    ("ernie", [sys.executable, "benchmarks/ernie_bench.py"], 1800),
+]
+
+
+def probe() -> str:
+    """Reuse bench.py's probe: it pins the platform config past the host
+    sitecustomize override and retries transient UNAVAILABLE with backoff —
+    a plain `import jax` probe falsely reports 'no TPU' in both cases."""
+    sys.path.insert(0, ROOT)
+    from bench import _probe_backend
+
+    try:
+        return _probe_backend()
+    except RuntimeError as e:
+        return f"error: {e}"
+
+
+def main() -> int:
+    only = None
+    if "--only" in sys.argv:
+        only = set(sys.argv[sys.argv.index("--only") + 1].split(","))
+    backend = probe()
+    print(f"hwbench: backend={backend}", flush=True)
+    if backend != "tpu":
+        print("hwbench: no TPU — nothing to measure", flush=True)
+        return 1
+    results = {}
+    for name, argv, timeout_s in BENCHES:
+        if only and name not in only:
+            continue
+        if not os.path.exists(os.path.join(ROOT, argv[1])):
+            print(f"hwbench: {name}: script missing, skipped", flush=True)
+            continue
+        t0 = time.time()
+        print(f"hwbench: running {name} ...", flush=True)
+        try:
+            proc = subprocess.run(argv, cwd=ROOT, capture_output=True,
+                                  text=True, timeout=timeout_s)
+            out = proc.stdout.strip().splitlines()
+            results[name] = {"rc": proc.returncode,
+                             "secs": round(time.time() - t0, 1),
+                             "lines": out[-3:]}
+            tail = (proc.stderr or "").strip().splitlines()[-3:]
+            print(f"hwbench: {name} rc={proc.returncode} "
+                  f"({results[name]['secs']}s)", flush=True)
+            for ln in out[-3:]:
+                print(f"  {ln}", flush=True)
+            if proc.returncode != 0:
+                for ln in tail:
+                    print(f"  [stderr] {ln}", flush=True)
+        except subprocess.TimeoutExpired:
+            results[name] = {"rc": -1, "secs": timeout_s,
+                             "lines": ["timeout"]}
+            print(f"hwbench: {name} TIMED OUT after {timeout_s}s",
+                  flush=True)
+    print(json.dumps({"hwbench_summary": {
+        k: v["rc"] for k, v in results.items()}}), flush=True)
+    # a run in which nothing was measured must be retryable by exit code
+    if not results or all(v["rc"] != 0 for v in results.values()):
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
