@@ -77,10 +77,7 @@ def bench_resnet50(smoke):
     if not smoke:
         from paddle_tpu.utils import measurements as _meas
 
-        _meas.record_or_warn(
-            out["metric"], out["value"], out["unit"],
-            extra={k: v for k, v in out.items()
-                   if k not in ("metric", "value", "unit")})
+        _meas.record_rec_or_warn(out)
     print(json.dumps(out), flush=True)
     return out
 
@@ -140,10 +137,7 @@ def bench_bert_mlm(smoke):
                            / _peak_flops(jax.devices()[0]), 4)
         from paddle_tpu.utils import measurements as _meas
 
-        _meas.record_or_warn(
-            out["metric"], out["value"], out["unit"],
-            extra={k: v for k, v in out.items()
-                   if k not in ("metric", "value", "unit")})
+        _meas.record_rec_or_warn(out)
     print(json.dumps(out), flush=True)
     return out
 

@@ -68,10 +68,7 @@ def main():
     else:
         from paddle_tpu.utils import measurements as _meas
 
-        _meas.record_or_warn(
-            rec["metric"], rec["value"], "tokens/s",
-            extra={"batch": batch, "prompt_len": prompt,
-                   "new_tokens": new})
+        _meas.record_rec_or_warn(rec)
     print(json.dumps(rec), flush=True)
 
 

@@ -91,10 +91,7 @@ def main():
                            / _peak_flops(jax.devices()[0]), 4)
         from paddle_tpu.utils import measurements as _meas
 
-        _meas.record_or_warn(
-            rec["metric"], rec["value"], rec["unit"],
-            extra={k: v for k, v in rec.items()
-                   if k not in ("metric", "value", "unit")})
+        _meas.record_rec_or_warn(rec)
     print(json.dumps(rec), flush=True)
 
 

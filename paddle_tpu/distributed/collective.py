@@ -363,8 +363,15 @@ def all_to_all(out_tensor_list, in_tensor_list=None, group=None, sync_op=True,
     e = env_mod.ensure_env()
     fn = _a2a_program(e.mesh, g.axes, t.ndim, split_axis, concat_axis)
     in_spec = _spec_on(t.ndim, g.axes, concat_axis)
-    arr = jax.device_put(_on_mesh(t._data), NamedSharding(e.mesh, in_spec))
-    return Tensor(fn(arr))
+    sharding = NamedSharding(e.mesh, in_spec)
+
+    # route through the tape (placement inside the traced fn): an eager
+    # all-to-all is linear, and jax derives its vjp — the transposed
+    # all-to-all — from the shard_map program
+    def _placed_a2a(a):
+        return fn(jax.device_put(a, sharding))
+
+    return apply("all_to_all", _placed_a2a, (t,))
 
 
 @functools.lru_cache(maxsize=512)
@@ -502,7 +509,13 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
             "splits (the reference's fast path has the same requirement)")
     res = all_to_all(in_tensor, group=group, split_axis=0, concat_axis=0)
     if isinstance(out_tensor, Tensor):
+        # inplace-adopt (same pattern as tensor inplace ops): the out=
+        # form must stay differentiable through the collective
         out_tensor._data = res._data
+        out_tensor._grad_node = res._grad_node
+        out_tensor._out_index = res._out_index
+        out_tensor.stop_gradient = (res.stop_gradient
+                                    and out_tensor.stop_gradient)
         return out_tensor
     return res
 
@@ -516,7 +529,13 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
         gather_list = []
     parts = []
     all_gather(parts, tensor, group=group)
-    gather_list.extend(parts)
+    if len(parts) != g.nranks:
+        raise RuntimeError(
+            f"gather produced {len(parts)} shards for a "
+            f"{g.nranks}-rank group")
+    # a reference-style caller preallocates nranks placeholders and
+    # expects them *replaced*, not appended after
+    gather_list[:] = parts
     return gather_list
 
 

@@ -27,7 +27,12 @@ class LookAhead:
         self.alpha = float(alpha)
         self.k = int(k)
         self._step_num = 0
-        self._slow: dict[int, object] = {}
+        # slow weights start at the parameters as of construction
+        # (reference lookahead.py initializes them on the first step), so
+        # the step-k sync already interpolates toward the initial weights
+        # instead of adopting the first k fast steps wholesale.
+        self._slow: dict[int, object] = {
+            id(p): p._data for p in inner_optimizer._parameter_list}
 
     @property
     def _parameter_list(self):
@@ -41,8 +46,7 @@ class LookAhead:
         with no_grad():
             for p in self.inner_optimizer._parameter_list:
                 slow = self._slow.get(id(p))
-                if slow is None:
-                    # first sync point: slow weights start at the fast ones
+                if slow is None:  # parameter added after construction
                     self._slow[id(p)] = p._data
                     continue
                 slow = slow + self.alpha * (p._data - slow)

@@ -449,10 +449,35 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
 
 
 def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError(
-        "class_center_sample requires dynamic shapes; planned for the "
-        "distributed margin-loss module"
-    )
+    """Sample ``num_samples`` class centers always containing every
+    positive class; remap labels into the sampled set (parity:
+    F.class_center_sample, ref `phi/kernels/gpu/class_center_sample_kernel.cu`
+    — the margin-loss partial-fc sampler).
+
+    Output shapes are static (``num_samples``); the sampling itself runs
+    host-side on the concrete labels, seeded from the framework PRNG, the
+    same split as the device random ops."""
+    orig = np.asarray(label._data if isinstance(label, Tensor) else label)
+    lab = orig.reshape(-1)
+    positives = np.unique(lab)
+    if num_samples < positives.size:
+        raise ValueError(
+            f"class_center_sample: num_samples={num_samples} is smaller "
+            f"than the {positives.size} distinct positive classes")
+    if num_samples > num_classes:
+        raise ValueError(
+            f"class_center_sample: num_samples={num_samples} exceeds "
+            f"num_classes={num_classes}; the sampled set is a subset of "
+            "the classes, so its static size cannot exceed num_classes")
+    negatives = np.setdiff1d(np.arange(num_classes), positives)
+    n_extra = num_samples - positives.size
+    key = rng.next_key()
+    perm = np.asarray(jax.random.permutation(key, negatives.size))
+    sampled = np.sort(np.concatenate(
+        [positives, negatives[perm[:n_extra]]])).astype(np.int64)
+    remapped = np.searchsorted(sampled, lab).astype(np.int64)
+    return (Tensor(jnp.asarray(remapped.reshape(orig.shape))),
+            Tensor(jnp.asarray(sampled)))
 
 
 def gather_tree(ids, parents):

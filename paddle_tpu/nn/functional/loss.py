@@ -471,6 +471,17 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
         pcode = (path_code._data if isinstance(path_code, _T)
                  else jnp.asarray(path_code)).astype(jnp.float32)
 
+    def _uncommit(a):
+        # concrete closure constants must not carry a device commitment:
+        # under a distributed mesh the weights are mesh-placed, and jit
+        # rejects mixing them with cpu:0-committed captures
+        if isinstance(a, jax.Array) and not isinstance(a, jax.core.Tracer):
+            return np.asarray(a)
+        return a
+
+    ptab = _uncommit(ptab)
+    pcode = _uncommit(pcode)
+
     def fn(x, w, *maybe_bias):
         valid = (ptab >= 0).astype(x.dtype)  # [b, L]
         idx = jnp.maximum(ptab, 0)

@@ -453,21 +453,64 @@ class BeamSearchDecoder(Layer):
         return M.transpose(self._seqs, [0, 2, 1]), self._log_probs
 
 
+def _tree_map2(fn, a, b):
+    """Pairwise tree-map over the (tuple/list/namedtuple/dict/Tensor)
+    state pytrees dynamic_decode sees.  Structure-changing states (a
+    decoder growing its state list or re-keying a dict between steps)
+    fall back to the new value — a partial freeze, never a silent
+    truncation."""
+    if isinstance(a, tuple) and hasattr(a, "_fields"):  # namedtuple
+        if type(b) is not type(a):
+            return b
+        return type(a)(*(_tree_map2(fn, x, y) for x, y in zip(a, b)))
+    if isinstance(a, (list, tuple)):
+        if not isinstance(b, (list, tuple)) or len(a) != len(b):
+            return b
+        return type(a)(_tree_map2(fn, x, y) for x, y in zip(a, b))
+    if isinstance(a, dict):
+        if not isinstance(b, dict) or set(a) != set(b):
+            return b
+        return {k: _tree_map2(fn, a[k], b[k]) for k in a}
+    if a is None or b is None:
+        return b
+    return fn(a, b)
+
+
 def dynamic_decode(decoder, inits=None, max_step_num=None,
                    output_time_major=False, impute_finished=False,
                    is_test=False, return_length=False, **kwargs):
     """Drive a decoder until every beam finishes or ``max_step_num``
     (parity: paddle.nn.dynamic_decode). Decoding is autoregressive and
     length-dynamic, so the loop is host-driven; each step body is one
-    compiled batched program."""
+    compiled batched program.
+
+    ``impute_finished=True`` freezes the states of already-finished beams
+    (the step still runs, its state updates are masked out), matching the
+    reference semantics. ``is_test`` is advisory here: the decode loop
+    itself records no training state, so test mode changes nothing.
+    """
     from ...tensor import logic as tlogic
 
     max_steps = int(max_step_num or 100)
     inputs, states, finished = decoder.initialize(inits)
     lengths = None
     for t in range(max_steps):
+        prev_states, prev_finished = states, finished
         _, states, inputs, finished = decoder.step(t, inputs, states,
                                                    finished=finished)
+        if impute_finished:
+            def freeze(old, new):
+                def f(o, n, fin):
+                    m = jnp.asarray(fin).reshape([-1]).astype(bool)
+                    if (n.ndim == 0 or o.shape != n.shape
+                            or m.shape[0] != n.shape[0]):
+                        return n  # scalar/shape-changing: nothing to freeze
+                    m = m.reshape((m.shape[0],) + (1,) * (n.ndim - 1))
+                    return jnp.where(m, o, n)
+
+                return apply("impute_finished", f, (old, new, prev_finished))
+
+            states = _tree_map2(freeze, prev_states, states)
         if bool(tlogic.all(finished.reshape([-1])).numpy()):
             break
     ids, scores = decoder.finalize()

@@ -77,6 +77,90 @@ class LBFGS(Optimizer):
             q += (a - b) * s
         return -q
 
+    # -- line searches ------------------------------------------------
+    # Both return (loss, step_size, evals_used, accepted). On rejection
+    # the caller restores the pre-step point: leaving parameters at a
+    # failed (possibly worse-loss) trial point corrupts every following
+    # iteration.
+
+    def _armijo(self, eval_closure, flat, d, t, base, gd, eval_budget):
+        evals = 0
+        for _bt in range(20):
+            if evals >= eval_budget:
+                break
+            self._assign(flat + t * d)
+            trial = eval_closure()
+            evals += 1
+            if trial <= base + 1e-4 * t * gd:
+                return trial, t, evals, True
+            t *= 0.5
+        return base, 0.0, evals, False
+
+    def _strong_wolfe(self, eval_closure, flat, d, t, base, gd,
+                      eval_budget, c1=1e-4, c2=0.9):
+        """Bracket + bisection-zoom strong-Wolfe search (reference
+        `python/paddle/optimizer/lbfgs.py` `_strong_wolfe`; bisection in
+        place of its cubic interpolation — same conditions, a few more
+        closure calls in the worst case)."""
+        evals = 0
+
+        def phi(step_size):
+            nonlocal evals
+            self._assign(flat + step_size * d)
+            f = eval_closure()
+            evals += 1
+            return f, float(self._flat_grads() @ d)
+
+        t_prev, f_prev, g_prev = 0.0, base, gd
+        bracket = None
+        f_new, g_new = base, gd
+        for i in range(10):
+            if evals >= eval_budget:
+                # budget exhausted mid-bracketing: params sit at t_prev,
+                # the best descending point found — keep that progress
+                # (reference _strong_wolfe keeps the last iterate on
+                # max_ls exhaustion) instead of discarding the iteration
+                if f_prev < base and t_prev > 0.0:
+                    return f_prev, t_prev, evals, True
+                return base, 0.0, evals, False
+            f_new, g_new = phi(t)
+            if f_new > base + c1 * t * gd or (i > 0 and f_new >= f_prev):
+                bracket = (t_prev, t, f_prev, f_new, g_prev, g_new)
+                break
+            if abs(g_new) <= -c2 * gd:
+                return f_new, t, evals, True  # both conditions hold
+            if g_new >= 0:
+                bracket = (t, t_prev, f_new, f_prev, g_new, g_prev)
+                break
+            t_prev, f_prev, g_prev = t, f_new, g_new
+            t *= 2.0
+        if bracket is None:  # ran out of expansion steps while descending
+            return f_new, t_prev, evals, f_new < base
+        lo, hi, f_lo, f_hi, g_lo, g_hi = bracket
+        for _ in range(10):
+            if evals >= eval_budget or abs(hi - lo) * float(
+                    np.abs(d).max(initial=0.0)) <= self.tolerance_change:
+                break
+            mid = 0.5 * (lo + hi)
+            f_mid, g_mid = phi(mid)
+            if f_mid > base + c1 * mid * gd or f_mid >= f_lo:
+                hi, f_hi, g_hi = mid, f_mid, g_mid
+            else:
+                if abs(g_mid) <= -c2 * gd:
+                    return f_mid, mid, evals, True
+                if g_mid * (hi - lo) >= 0:
+                    hi, f_hi, g_hi = lo, f_lo, g_lo
+                lo, f_lo, g_lo = mid, f_mid, g_mid
+        if f_lo < base and evals < eval_budget:
+            # Armijo point without curvature: still usable; the re-eval
+            # leaves params+grads at the accepted point and must respect
+            # the max_eval budget like every other closure call
+            self._assign(flat + lo * d)
+            f_lo = eval_closure()
+            evals += 1
+            return f_lo, lo, evals, True
+        return base, 0.0, evals, False
+
     def step(self, closure=None):
         if closure is None:
             raise ValueError(
@@ -114,21 +198,21 @@ class LBFGS(Optimizer):
                 self._s.clear()
                 self._y.clear()
             t = float(self.get_lr())
-            # backtracking Armijo (sufficient decrease); the reference
-            # uses strong-wolfe — Armijo keeps the same contract with
-            # fewer closure calls and guarantees monotone loss. The
-            # closure runs its own backward, so the accepted point's
-            # gradients are fresh for the next iteration.
-            base = loss
-            trial = base
-            for _bt in range(20):
-                self._assign(flat + t * d)
-                trial = eval_closure()
-                evals += 1
-                if trial <= base + 1e-4 * t * gd \
-                        or evals >= self.max_eval:
-                    break
-                t *= 0.5
+            search = (self._strong_wolfe
+                      if self.line_search_fn == "strong_wolfe"
+                      else self._armijo)
+            trial, t, used, ok = search(
+                eval_closure, flat, d, t, loss, gd, self.max_eval - evals)
+            evals += used
+            if not ok:
+                # restore the pre-step point; refresh its gradients if the
+                # budget allows so a caller inspecting p.grad sees the
+                # accepted point, not the failed trial
+                self._assign(flat)
+                if evals < self.max_eval:
+                    eval_closure()
+                    evals += 1
+                break
             loss = trial
             if abs(float(np.abs(t * d).max(initial=0.0))) \
                     <= self.tolerance_change:

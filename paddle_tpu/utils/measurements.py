@@ -23,8 +23,8 @@ import tempfile
 import time
 from typing import Any, Dict, List, Optional
 
-__all__ = ["measurements_path", "record", "record_or_warn", "last_good",
-           "all_latest"]
+__all__ = ["measurements_path", "record", "record_or_warn",
+           "record_rec_or_warn", "last_good", "all_latest"]
 
 _ENV_PATH = "PT_MEASUREMENTS_PATH"
 
@@ -175,6 +175,16 @@ def record_or_warn(metric: str, value: float, unit: str,
         print(f"measurements: persist failed for {metric}: {e}",
               file=sys.stderr, flush=True)
         return None
+
+
+def record_rec_or_warn(rec: Dict[str, Any], **kw) -> Optional[Dict[str, Any]]:
+    """Persist a bench's one-line JSON dict: metric/value/unit become the
+    record head, every other key lands in ``extra``. Keeps the persist
+    contract in one place for all benchmark scripts."""
+    extra = {k: v for k, v in rec.items()
+             if k not in ("metric", "value", "unit")}
+    return record_or_warn(rec["metric"], rec["value"], rec["unit"],
+                          extra=extra or None, **kw)
 
 
 def _is_hw(rec: Dict[str, Any]) -> bool:

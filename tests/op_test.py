@@ -30,7 +30,10 @@ def check_output(fn, np_fn, inputs, rtol=1e-4, atol=1e-5, **kwargs):
 
 def numeric_grad(fn, inputs, idx=0, eps=1e-3, **kwargs):
     """Central finite differences of sum(fn(*inputs)) w.r.t. inputs[idx]."""
-    inputs = [np.asarray(x, np.float64) for x in inputs]
+    inputs = [np.asarray(x)
+              if np.issubdtype(np.asarray(x).dtype, np.integer)
+              or np.asarray(x).dtype == np.bool_
+              else np.asarray(x, np.float64) for x in inputs]
     base = inputs[idx]
     grad = np.zeros_like(base)
     it = np.nditer(base, flags=["multi_index"])
@@ -48,9 +51,15 @@ def numeric_grad(fn, inputs, idx=0, eps=1e-3, **kwargs):
 
 
 def check_grad(fn, np_fn, inputs, grad_idx=0, rtol=1e-3, atol=1e-3, **kwargs):
-    """Analytic grad via the tape vs numeric finite differences."""
+    """Analytic grad via the tape vs numeric finite differences.
+
+    Integer inputs (indices, lengths) keep their dtype and take no grad;
+    float inputs are cast to float32 leaves."""
     tensors = [
-        paddle.to_tensor(np.asarray(x, np.float32), stop_gradient=False)
+        paddle.to_tensor(np.asarray(x))
+        if np.issubdtype(np.asarray(x).dtype, np.integer)
+        or np.asarray(x).dtype == np.bool_
+        else paddle.to_tensor(np.asarray(x, np.float32), stop_gradient=False)
         for x in inputs
     ]
     out = fn(*tensors, **kwargs)

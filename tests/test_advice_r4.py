@@ -1,0 +1,155 @@
+"""Round-4 regression tests for the round-3 advisor findings (ADVICE.md)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _t(x):
+    return pt.to_tensor(x)
+
+
+class TestLBFGSLineSearch:
+    def _quadratic_setup(self, line_search_fn):
+        # f(w) = 0.5 * w^T A w - b^T w, A SPD — unique minimum at A w = b
+        A = np.array([[3.0, 0.5], [0.5, 1.0]], np.float32)
+        b = np.array([1.0, -2.0], np.float32)
+        w = pt.to_tensor(np.zeros(2, np.float32), stop_gradient=False)
+        opt = pt.optimizer.LBFGS(learning_rate=1.0, max_iter=25,
+                                 line_search_fn=line_search_fn,
+                                 parameters=[w])
+        tA, tb = _t(A), _t(b)
+
+        def closure():
+            loss = 0.5 * (w @ (tA @ w)) - tb @ w
+            loss.backward()
+            return loss
+
+        return w, opt, closure, np.linalg.solve(A, b)
+
+    @pytest.mark.parametrize("ls", [None, "strong_wolfe"])
+    def test_converges_on_quadratic(self, ls):
+        w, opt, closure, expected = self._quadratic_setup(ls)
+        for _ in range(5):
+            opt.step(closure)
+        np.testing.assert_allclose(w.numpy(), expected, atol=1e-4)
+
+    def test_strong_wolfe_rosenbrock(self):
+        # the classic curved valley: strong-wolfe must make monotone-ish
+        # progress where a fixed step diverges
+        w = pt.to_tensor(np.array([-1.2, 1.0], np.float32),
+                         stop_gradient=False)
+        opt = pt.optimizer.LBFGS(learning_rate=1.0, max_iter=60,
+                                 line_search_fn="strong_wolfe",
+                                 parameters=[w])
+
+        def closure():
+            x, y = w[0], w[1]
+            loss = (1 - x) ** 2 + 100 * (y - x * x) ** 2
+            loss.backward()
+            return loss
+
+        for _ in range(8):
+            loss = opt.step(closure)
+        assert float(loss.numpy()) < 1e-3
+
+    def test_invalid_line_search_fn_rejected(self):
+        w = pt.to_tensor(np.zeros(2, np.float32), stop_gradient=False)
+        with pytest.raises(ValueError, match="strong_wolfe"):
+            pt.optimizer.LBFGS(parameters=[w], line_search_fn="armijo")
+
+    def test_failed_search_restores_pre_step_point(self):
+        # max_eval=1: the initial closure eval exhausts the budget, the
+        # line search cannot run, and parameters must stay where they were
+        w = pt.to_tensor(np.array([1.0, 1.0], np.float32),
+                         stop_gradient=False)
+        opt = pt.optimizer.LBFGS(learning_rate=1.0, max_iter=5, max_eval=1,
+                                 parameters=[w])
+
+        def closure():
+            loss = (w * w).sum()
+            loss.backward()
+            return loss
+
+        before = w.numpy().copy()
+        opt.step(closure)
+        np.testing.assert_array_equal(w.numpy(), before)
+
+
+class TestLookAhead:
+    def test_slow_weights_initialized_at_construction(self):
+        # param p0=4.0, grad always 1.0, inner SGD lr=1 → fast: 3, 2
+        # k=2 sync: slow = p0 + 0.5*(p2 - p0) = 4 + 0.5*(2-4) = 3
+        # (the old behavior adopted p2=2 wholesale at the first sync)
+        p = pt.to_tensor(np.array([4.0], np.float32), stop_gradient=False)
+        inner = pt.optimizer.SGD(learning_rate=1.0, parameters=[p])
+        la = pt.incubate.LookAhead(inner, alpha=0.5, k=2)
+        for _ in range(2):
+            loss = p.sum()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+        np.testing.assert_allclose(p.numpy(), [3.0], atol=1e-6)
+
+
+class TestCollectiveAdvice:
+    def test_gather_fills_preallocated_placeholder_list(self):
+        g = pt.distributed.get_group()
+        x = _t(np.arange(8, dtype=np.float32))
+        placeholder = [None] * g.nranks
+        out = pt.distributed.gather(x, gather_list=placeholder)
+        assert out is placeholder
+        assert len(placeholder) == g.nranks  # replaced, not appended after
+        assert all(v is not None for v in placeholder)
+
+    def test_alltoall_single_out_is_differentiable(self):
+        x = pt.to_tensor(np.arange(64, dtype=np.float32),
+                         stop_gradient=False)
+        out = pt.to_tensor(np.zeros(64, np.float32))
+        y = pt.distributed.alltoall_single(out, x)
+        assert y is out
+        (y * y).sum().backward()
+        assert x.grad is not None
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   2 * np.arange(64, dtype=np.float32))
+
+
+class TestDynamicDecodeImputeFinished:
+    class CountingDecoder:
+        """States count the steps; element 0 finishes immediately.
+        Records the states it *receives* so the test can observe whether
+        finished elements were frozen between steps."""
+
+        end_token = -1
+
+        def __init__(self):
+            self.received = []
+
+        def initialize(self, inits):
+            state = _t(np.zeros((2, 1), np.float32))
+            finished = _t(np.array([False, False]))
+            inputs = _t(np.zeros((2,), np.float32))
+            return inputs, state, finished
+
+        def step(self, t, inputs, states, finished=None):
+            self.received.append(states.numpy().copy())
+            new_states = states + 1.0
+            fin = _t(np.array([True, t >= 2]))
+            return None, new_states, inputs, fin
+
+        def finalize(self):
+            ids = _t(np.zeros((1, 1, 1), np.int64))
+            scores = _t(np.zeros((1, 1), np.float32))
+            return ids, scores
+
+    def test_finished_states_frozen(self):
+        dec = self.CountingDecoder()
+        pt.nn.dynamic_decode(dec, max_step_num=5, impute_finished=True)
+        # t=2 receives elem0 frozen at its finish-step value (1), elem1
+        # still counting (2)
+        np.testing.assert_allclose(dec.received[2], [[1.0], [2.0]])
+
+    def test_default_leaves_states_unfrozen(self):
+        dec = self.CountingDecoder()
+        pt.nn.dynamic_decode(dec, max_step_num=5, impute_finished=False)
+        np.testing.assert_allclose(dec.received[2], [[2.0], [2.0]])

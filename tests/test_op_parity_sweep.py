@@ -1,0 +1,293 @@
+"""Generated per-op numeric parity sweep.
+
+One test per spec in op_specs.SPECS: check_output vs numpy, plus
+finite-difference check_grad for the inputs each spec marks
+differentiable.  Completeness is enforced against docs/OP_COVERAGE.md:
+every 'implemented' op must be either specced here or whitelisted with a
+reason (reference analogue: `test/legacy_test/eager_op_test.py` +
+`test/white_list/`)."""
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_specs import SPECS
+from op_test import check_output, numeric_grad
+
+# ops that are 'implemented' in OP_COVERAGE.md but deliberately not in the
+# numeric sweep — each with the reason (the reference's white_list idea)
+WHITELIST = {
+    # stochastic kernels: distribution-level tests live in
+    # tests/test_tensor_ops.py / test_distribution.py; elementwise equality
+    # with numpy is undefined
+    "bernoulli": "stochastic (tested statistically)",
+    "dirichlet": "stochastic (tested statistically)",
+    "exponential_": "stochastic (tested statistically)",
+    "gaussian": "stochastic (tested statistically)",
+    "gumbel_softmax": "stochastic (tested statistically)",
+    "multinomial": "stochastic (tested statistically)",
+    "poisson": "stochastic (tested statistically)",
+    "randint": "stochastic (tested statistically)",
+    "randperm": "stochastic (tested statistically)",
+    "uniform": "stochastic (tested statistically)",
+    "uniform_inplace": "stochastic (tested statistically)",
+    "truncated_gaussian_random": "stochastic (tested statistically)",
+    "rrelu": "stochastic activation (mean-path tested in layer sweep)",
+    "class_center_sample": "stochastic sampling (invariants checked by "
+                           "test_class_center_sample_invariants below)",
+    "weighted_sample_neighbors": "stochastic graph sampling "
+                                 "(tests/test_geometric_signal.py)",
+    # optimizer update kernels: exercised with numeric parity in
+    # tests/test_optimizer.py against reference update rules
+    "lamb_": "optimizer kernel (tests/test_optimizer.py)",
+    "average_accumulates_": "ModelAverage state kernel "
+                            "(test_model_average_behavior below)",
+    "update_loss_scaling_": "GradScaler kernel (tests/test_amp.py)",
+    "check_finite_and_unscale_": "GradScaler kernel (tests/test_amp.py)",
+    "check_numerics": "NaN/Inf watchdog kernel (tests/test_io_metric_flags.py / "
+                      "amp debugging tests)",
+    "embedding_grad_dense": "backward kernel of embedding — its numeric "
+                            "content is the embedding spec's grad=(1,) "
+                            "check",
+    # framework/infra ops: no numeric content to sweep
+    "assign_out_": "aliasing/infra (covered by test_tensor_ops set_value)",
+    "assign_value_": "aliasing/infra",
+    "copy_to": "device transfer (tests/test_user_journey.py "
+               "set_device flow)",
+    "memcpy_d2h": "device transfer",
+    "memcpy_h2d": "device transfer",
+    "shape": "metadata accessor (everywhere in tests)",
+    "is_empty": "metadata accessor",
+    "full_": "inplace fill (tested in test_tensor_ops)",
+    "fill": "inplace fill (tested in test_tensor_ops)",
+    "fill_diagonal_tensor": "inplace variant of fill_diagonal (specced)",
+    "full_int_array": "alias of full (specced)",
+    "full_batch_size_like": "alias of full_like (specced)",
+    "assign": None,  # specced — placeholder so the set below stays exact
+    # composite subsystems with dedicated parity suites
+    "flash_attn": "attention parity in tests/test_pallas_flash.py",
+    "flash_attn_unpadded": "attention parity in "
+                           "tests/test_pallas_flash.py",
+    "memory_efficient_attention": "attention parity in "
+                                  "tests/test_pallas_flash.py",
+    "rnn": "recurrent stack parity in tests/test_nn.py",
+    "einsum": None,  # specced
+    "batch_norm": "train/eval moments parity in tests/test_nn.py",
+    "sync_batch_norm_": "mesh-synced BN in tests/test_distributed.py",
+    "instance_norm": "norm parity in tests/test_nn.py",
+    "group_norm": "norm parity in tests/test_nn.py",
+    "spectral_norm": "power-iteration parity in "
+                     "test_spectral_norm_parity below",
+    # vision/detection compound ops with dedicated tests
+    "prior_box": "tests/test_vision_ops.py",
+    "yolo_box": "tests/test_vision_ops.py",
+    "yolo_loss": "tests/test_vision_ops.py",
+    "matrix_nms": "tests/test_vision_ops.py",
+    "multiclass_nms3": "tests/test_vision_ops.py",
+    "roi_align": "tests/test_vision_ops.py",
+    "roi_pool": "tests/test_vision_ops.py",
+    "psroi_pool": "tests/test_vision_ops.py",
+    "generate_proposals": "tests/test_vision_ops.py",
+    "distribute_fpn_proposals": "tests/test_vision_ops.py",
+    "deformable_conv": "tests/test_vision_ops.py",
+    "decode_jpeg": "needs a jpeg file (tests/test_vision_ops.py)",
+    # conv/pool/interp variants covered by dedicated layer tests; the
+    # sweep keeps one representative per family (conv2d, pool2d)
+    "bicubic_interp": "tests/test_nn.py",
+    "unpool3d": "tests/test_op_additions.py",
+    # fft family: numpy-parity tests in tests/test_fft.py
+    # graph/geometric kernels: tests/test_geometric_signal.py
+    "reindex_graph": "tests/test_geometric_signal.py",
+    # misc with dedicated suites
+    "auc": "tests/test_io_metric_flags.py",
+}
+WHITELIST = {k: v for k, v in WHITELIST.items() if v is not None}
+
+
+def _resolve(path):
+    parts = re.split(r"[.:]", path)
+    assert parts[0] == "paddle_tpu"
+    obj = paddle
+    for p in parts[1:]:
+        obj = getattr(obj, p)
+    return obj
+
+
+def _to_tensors(inputs):
+    out = []
+    for x in inputs:
+        if isinstance(x, (list, tuple)):
+            out.append([paddle.to_tensor(np.asarray(v)) for v in x])
+        else:
+            out.append(paddle.to_tensor(np.asarray(x)))
+    return out
+
+
+@pytest.mark.parametrize("name", sorted(SPECS))
+def test_output_parity(name):
+    spec = SPECS[name]
+    fn = _resolve(spec["path"])
+    if spec["adapter"] is not None:
+        fn = spec["adapter"](fn)
+    kwargs = dict(spec["kwargs"])
+    sort_complex = kwargs.pop("_sort_complex", False)
+    inputs = list(spec["inputs"])
+    tensors = _to_tensors(inputs)
+    out = fn(*tensors, **kwargs)
+    expected = spec["np_fn"](*inputs, **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    exps = (expected if isinstance(expected, (tuple, list))
+            else [expected])
+    if sort_complex:
+        outs = [paddle.to_tensor(np.sort_complex(np.asarray(o.numpy())))
+                for o in outs]
+    if not np.isfinite(spec["rtol"]):
+        # shape/dtype-only contract (empty/empty_like)
+        assert list(np.asarray(outs[0].numpy()).shape) \
+            == list(np.asarray(exps[0]).shape)
+        return
+    for o, e in zip(outs, exps):
+        e = np.asarray(e)
+        o = np.asarray(o.numpy())
+        if np.issubdtype(e.dtype, np.floating):
+            o = o.astype(np.float64)
+        np.testing.assert_allclose(o, e, rtol=spec["rtol"],
+                                   atol=spec["atol"], err_msg=name)
+
+
+_GRAD_SPECS = [n for n in sorted(SPECS) if SPECS[n]["grad"]]
+
+
+@pytest.mark.parametrize("name", _GRAD_SPECS)
+def test_grad_parity(name):
+    spec = SPECS[name]
+    fn = _resolve(spec["path"])
+    if spec["adapter"] is not None:
+        fn = spec["adapter"](fn)
+    kwargs = dict(spec["kwargs"])
+    inputs = list(spec["inputs"])
+    for gi in spec["grad"]:
+        tensors = []
+        for i, x in enumerate(inputs):
+            arr = np.asarray(x)
+            if np.issubdtype(arr.dtype, np.integer) or arr.dtype == np.bool_:
+                tensors.append(paddle.to_tensor(arr))
+            else:
+                tensors.append(paddle.to_tensor(
+                    arr.astype(np.float32), stop_gradient=(i != gi)))
+        out = fn(*tensors, **kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        out.sum().backward()
+        analytic = tensors[gi].grad
+        assert analytic is not None, f"{name}: no grad for input {gi}"
+        numeric = numeric_grad(
+            lambda *xs, **kw: np.sum(np.asarray(spec["np_fn"](*xs, **kw),
+                                                np.float64)),
+            inputs, idx=gi, **kwargs)
+        np.testing.assert_allclose(
+            analytic.numpy().astype(np.float64), numeric,
+            rtol=spec["grad_rtol"], atol=spec["grad_atol"],
+            err_msg=f"{name} d/d input[{gi}]")
+
+
+def _implemented_ops():
+    doc = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "OP_COVERAGE.md")
+    ops = []
+    for line in open(doc):
+        m = re.match(r"\| `([^`]+)` \| \w+ \| implemented \|", line)
+        if m:
+            ops.append(m.group(1))
+    return ops
+
+
+def test_sweep_is_complete():
+    """Every implemented op is either specced or whitelisted with a
+    reason; the sweep covers >=300 ops by direct spec."""
+    implemented = _implemented_ops()
+    assert len(implemented) >= 350, "OP_COVERAGE.md parse broke"
+    unaccounted = [op for op in implemented
+                   if op not in SPECS and op not in WHITELIST
+                   and TABLE_TO_SPEC.get(op) not in SPECS]
+    assert not unaccounted, f"no spec and no whitelist reason: {unaccounted}"
+    # the sweep itself must carry the bulk, not the whitelist
+    assert len(SPECS) >= 300, len(SPECS)
+    swept = [op for op in implemented
+             if op in SPECS or TABLE_TO_SPEC.get(op) in SPECS]
+    assert len(swept) >= 300, (len(swept), "of", len(implemented))
+
+
+def test_no_dead_entries():
+    """Specs/whitelist must not drift from the coverage table."""
+    implemented = set(_implemented_ops())
+    dead_specs = [n for n in SPECS
+                  if n not in implemented and n not in _EXTRA_SPEC_OK]
+    assert not dead_specs, f"specs for non-implemented ops: {dead_specs}"
+    dead_wl = [n for n in WHITELIST if n not in implemented]
+    assert not dead_wl, f"whitelist rows for non-implemented ops: {dead_wl}"
+
+
+# table-name -> spec-name aliases (the yaml kernel name differs from the
+# python surface name the spec uses)
+TABLE_TO_SPEC = {
+    "elementwise_pow": "pow", "logsigmoid": "log_sigmoid",
+    "tanh_shrink": "tanhshrink", "reverse": "flip",
+    "split_with_num": "split",
+    "repeat_interleave_with_tensor_index": "repeat_interleave",
+    "matrix_rank_tol": "matrix_rank", "norm": "p_norm",
+    "mean_all": "mean",
+}
+
+# specs that intentionally cover surface beyond the yaml table
+_EXTRA_SPEC_OK = {"logaddexp", "median", "tanhshrink", "log_sigmoid",
+                  "pow", "flip", "split", "repeat_interleave",
+                  "matrix_rank", "p_norm", "mean", "linear"}
+
+
+# --- targeted parity tests for whitelisted ops with no numpy-equality ----
+
+def test_spectral_norm_parity():
+    """SpectralNorm layer vs an identical numpy power iteration."""
+    rng = np.random.RandomState(3)
+    w = rng.randn(4, 5).astype(np.float32)
+    layer = paddle.nn.SpectralNorm(w.shape, dim=0, power_iters=50)
+    out = layer(paddle.to_tensor(w)).numpy()
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(out, w / sigma, rtol=1e-3, atol=1e-4)
+
+
+def test_class_center_sample_invariants():
+    """Sampled class set must contain every positive label and have the
+    requested size; remapped labels must index into the sampled set."""
+    label = paddle.to_tensor(np.array([0, 5, 9, 5], np.int64))
+    remapped, sampled = paddle.nn.functional.class_center_sample(
+        label, num_classes=10, num_samples=6)
+    sampled_np = np.asarray(sampled.numpy())
+    for pos_cls in [0, 5, 9]:
+        assert pos_cls in sampled_np
+    rem = np.asarray(remapped.numpy())
+    np.testing.assert_array_equal(sampled_np[rem],
+                                  np.asarray(label.numpy()))
+
+
+def test_model_average_behavior():
+    """ModelAverage applies the running average and restores on exit
+    (the average_accumulates_ kernel's contract)."""
+    from paddle_tpu.incubate.model_average import ModelAverage
+
+    w = paddle.to_tensor(np.zeros(2, np.float32), stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w])
+    ma = ModelAverage(0.0, parameters=[w])  # full-window average
+    for _ in range(3):
+        (w.sum()).backward()
+        opt.step()       # w goes -1, -2, -3
+        ma.step()
+        opt.clear_grad()
+    with ma.apply(need_restore=True):
+        np.testing.assert_allclose(w.numpy(), [-2.0, -2.0], atol=1e-6)
+    np.testing.assert_allclose(w.numpy(), [-3.0, -3.0], atol=1e-6)
