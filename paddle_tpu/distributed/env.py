@@ -131,6 +131,30 @@ def init_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1,
     return _global_env
 
 
+def put_replicated(x, mesh):
+    """Replicate a host value onto ``mesh``, multihost-safe.
+
+    Single-process meshes use plain ``device_put``; when the mesh spans
+    other processes (launcher + `jax.distributed.initialize`), the
+    host-local value — identical on every process by the single-program
+    contract — becomes the global replicated array via
+    `multihost_utils.host_local_array_to_global_array` (device_put rejects
+    non-addressable shardings)."""
+    repl = NamedSharding(mesh, PartitionSpec())
+    if repl.is_fully_addressable:
+        return jax.device_put(x, repl)
+    from jax.experimental import multihost_utils
+
+    if jax.dtypes.issubdtype(getattr(x, "dtype", None),
+                             jax.dtypes.prng_key):
+        data = multihost_utils.host_local_array_to_global_array(
+            np.asarray(jax.random.key_data(x)), mesh, PartitionSpec())
+        return jax.random.wrap_key_data(
+            data, impl=jax.random.key_impl(x))
+    return multihost_utils.host_local_array_to_global_array(
+        np.asarray(x), mesh, PartitionSpec())
+
+
 def _install_mesh_hook(mesh):
     """Teach the op dispatcher to replicate off-mesh eager operands onto the
     mesh (mixing a host-side batch with sharded params is the common case),
@@ -147,7 +171,7 @@ def _install_mesh_hook(mesh):
 
     def place_param(arr):
         if isinstance(arr, jax.Array) and len(arr.sharding.device_set) != n_mesh:
-            return jax.device_put(arr, repl)
+            return put_replicated(arr, mesh)
         return arr
 
     _core.set_param_place_hook(place_param)
@@ -166,7 +190,7 @@ def _install_mesh_hook(mesh):
         if not (on_mesh and off_mesh):
             return arrays
         return [
-            jax.device_put(a, repl)
+            put_replicated(a, mesh)
             if _concrete(a) and len(a.sharding.device_set) != n_mesh
             else a
             for a in arrays

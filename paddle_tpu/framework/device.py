@@ -91,7 +91,10 @@ def current_device() -> jax.Device:
 
 
 def _init_default():
-    d = jax.devices()[0]
+    # local_devices, not devices: under a multi-process runtime
+    # (launcher + jax.distributed.initialize) jax.devices()[0] belongs to
+    # process 0 and is non-addressable from the others
+    d = jax.local_devices()[0]
     platform = d.platform.lower()
     for public, aliases in _PLATFORM_ALIASES.items():
         if platform in aliases:

@@ -212,15 +212,14 @@ class TrainStep:
         self._step_count += 1
 
         def place(x):
-            # host-side scalars/batches join the params' mesh (replicated)
+            # host-side scalars/batches join the params' mesh (replicated;
+            # multihost-safe via env.put_replicated)
             from ..distributed import env as env_mod
 
             e = env_mod.get_env()
             if e is None or e.mesh.size == 1:
                 return x
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            return jax.device_put(x, NamedSharding(e.mesh, PartitionSpec()))
+            return env_mod.put_replicated(x, e.mesh)
 
         new_params, flat_state, new_buffers, loss = fn(
             [p._data for p in self._params],

@@ -219,13 +219,16 @@ def _pick_block(seq, target=512):
     return max(b, 1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_bhsd(q, k, v, causal, scale, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd(q, k, v, causal, scale, interpret, block_q=None,
+                block_k=None):
+    out, _ = _flash_fwd(q, k, v, causal, scale, interpret, block_q,
+                        block_k)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, interpret):
+def _flash_fwd(q, k, v, causal, scale, interpret, block_q=None,
+               block_k=None):
     """q: [bh, s, d], k/v: [bh_kv, s, d] with bh % bh_kv == 0 (GQA: each
     group of bh//bh_kv query heads shares one KV head — the K/V BlockSpec
     index maps divide the bh program index, so grouped heads stream the
@@ -239,8 +242,8 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
     bh, sq, d = q.shape
     sk = k.shape[1]
     group = bh // k.shape[0]
-    block_q = _pick_block(sq)
-    block_k = _pick_block(sk)
+    block_q = block_q or _pick_block(sq)
+    block_k = block_k or _pick_block(sk)
     n_kb = sk // block_k
     grid = (bh, sq // block_q, n_kb)
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
@@ -280,19 +283,21 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
     return out, lse
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, scale, interpret)
+def _flash_fwd_rule(q, k, v, causal, scale, interpret, block_q=None,
+                    block_k=None):
+    out, lse = _flash_fwd(q, k, v, causal, scale, interpret, block_q,
+                          block_k)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, scale, interpret, res, g):
+def _flash_bwd_rule(causal, scale, interpret, block_q, block_k, res, g):
     q, k, v, out, lse = res
     bh, sq, d = q.shape
     sk = k.shape[1]
     bh_kv = k.shape[0]
     group = bh // bh_kv
-    block_q = _pick_block(sq)
-    block_k = _pick_block(sk)
+    block_q = block_q or _pick_block(sq)
+    block_k = block_k or _pick_block(sk)
     n_qb = sq // block_q
     n_kb = sk // block_k
     offset = sk - sq
@@ -400,18 +405,27 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
     if (sq < 16 or sk < 16 or d % 8 or h % h_kv or v.shape[2] != h_kv
             or not ok_blocks):
         return fallback(0.0)
-    # measured crossover (PERF.md, TPU v5e wall-clock): at short sequences
-    # with wide heads XLA's fused composite beats the kernel (0.73x at
-    # s=1024 d=128 fwd+bwd); the kernel wins from s>=2048 at any d, and at
-    # every length for d<=64. Engage it only where it wins — O(s^2) memory
-    # of the composite is fine at s<2048.
-    if max(sq, sk) < 2048 and d > 64 and not interpret:
-        return fallback(0.0)
+    # engagement is measurement-driven: the autotune cache stores the
+    # kernel-vs-composite fwd+bwd ratio per shape (tools/flash_autotune.py
+    # on hardware). Where no measurement applies, fall back to the round-3
+    # measured heuristic (PERF.md, TPU v5e wall-clock): composite wins at
+    # short seq with wide heads (0.73x at s=1024 d=128 fwd+bwd); kernel
+    # wins from s>=2048 at any d, and at every length for d<=64.
+    from . import autotune as _tune
+
+    bq_t = bk_t = None
+    if not interpret:
+        beats = _tune.kernel_beats_composite(sq, sk, d, causal)
+        if beats is False:
+            return fallback(0.0)
+        if beats is None and max(sq, sk) < 2048 and d > 64:
+            return fallback(0.0)
+        bq_t, bk_t = _tune.best_blocks(sq, sk, d, causal)
     scale = 1.0 / math.sqrt(d)
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)
-    out = _flash_bhsd(qt, kt, vt, causal, scale, interpret)
+    out = _flash_bhsd(qt, kt, vt, causal, scale, interpret, bq_t, bk_t)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 

@@ -119,56 +119,240 @@ def _ring_bwd_shard(q, k, v, out, lse, g, *, axis, n, causal, scale):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def make_ring_attention(mesh, axis="sep", causal=True):
+# ---- flash-backed local blocks (VERDICT r3 weak #7) ----------------------
+# Each ring step's local attention runs the registered Pallas flash kernel
+# instead of materializing the [s_loc, s_loc] score matrix: the fwd merges
+# per-block (out, lse) pairs with the standard logsumexp combine, the bwd
+# calls the FA2 backward kernels per block with the GLOBAL lse/delta (the
+# per-block contributions then sum exactly — FlashAttention-2's ds formula
+# is linear in the kv blocks). O(block) memory inside each ring step.
+
+
+def _flash_block_fwd(q, kt, vt, causal_flag, scale, interpret):
+    """Local flash on [b, s, h, d] blocks -> (out, lse [b, h, s])."""
+    from .pallas import flash_attention as fa
+
+    b, s, h, d = q.shape
+
+    def to_bh(x):
+        return jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)
+
+    out, lse = fa._flash_fwd(to_bh(q), to_bh(kt), to_bh(vt), causal_flag,
+                             scale, interpret)
+    out = jnp.moveaxis(out.reshape(b, h, s, d), 1, 2)
+    return out.astype(jnp.float32), lse[..., 0].reshape(b, h, s)
+
+
+def _ring_fwd_shard_flash(q, k, v, *, axis, n, causal, scale, interpret):
+    # runs under check_vma=False (pallas out_shapes carry no vma tags)
+    idx = jax.lax.axis_index(axis)
+    b, s_loc, h, d = q.shape
+    o = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    lse = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def local_block(kt, vt, src):
+        def diag(_):
+            return _flash_block_fwd(q, kt, vt, True, scale, interpret)
+
+        def full(_):
+            return _flash_block_fwd(q, kt, vt, False, scale, interpret)
+
+        def masked(_):
+            return (jnp.zeros((b, s_loc, h, d), jnp.float32),
+                    jnp.full((b, h, s_loc), NEG_INF, jnp.float32))
+
+        if not causal:
+            return full(None)
+        return jax.lax.cond(
+            src > idx, masked,
+            lambda op: jax.lax.cond(src == idx, diag, full, op), None)
+
+    def step(carry, t):
+        o, lse, kt, vt = carry
+        src = (idx - t) % n
+        o_t, lse_t = local_block(kt, vt, src)
+        lse_new = jnp.logaddexp(lse, lse_t)
+        w_prev = jnp.exp(lse - lse_new)
+        w_t = jnp.exp(lse_t - lse_new)
+
+        def ex(w):  # [b, h, s] -> [b, s, h, 1]
+            return jnp.moveaxis(w, 1, 2)[..., None]
+
+        o = o * ex(w_prev) + o_t * ex(w_t)
+        kt = jax.lax.ppermute(kt, axis, perm)
+        vt = jax.lax.ppermute(vt, axis, perm)
+        return (o, lse_new, kt, vt), None
+
+    (o, lse, _, _), _ = jax.lax.scan(step, (o, lse, k, v), jnp.arange(n))
+    return o.astype(q.dtype), lse
+
+
+def _ring_bwd_shard_flash(q, k, v, out, lse, g, *, axis, n, causal, scale,
+                          interpret):
+    from .pallas import flash_attention as fa
+
+    idx = jax.lax.axis_index(axis)
+    b, s_loc, h, d = q.shape
+
+    def to_bh(x):
+        return jnp.moveaxis(x, 2, 1).reshape(b * h, s_loc, d)
+
+    def from_bh(x):
+        return jnp.moveaxis(x.reshape(b, h, s_loc, d), 1, 2)
+
+    qt, outt, gt = to_bh(q), to_bh(out), to_bh(g)
+    lse_bh = jnp.broadcast_to(
+        lse.reshape(b * h, s_loc)[..., None], (b * h, s_loc, fa._LANES))
+
+    def local_block(kt, vt, src):
+        ktt, vtt = to_bh(kt), to_bh(vt)
+
+        def run(flag):
+            def go(_):
+                dq, dk, dv = fa._flash_bwd_rule(
+                    flag, scale, interpret, None, None,
+                    (qt, ktt, vtt, outt, lse_bh), gt)
+                return (from_bh(dq).astype(jnp.float32),
+                        from_bh(dk).astype(jnp.float32),
+                        from_bh(dv).astype(jnp.float32))
+
+            return go
+
+        def masked(_):
+            z = jnp.zeros((b, s_loc, h, d), jnp.float32)
+            return z, z, z
+
+        if not causal:
+            return run(False)(None)
+        return jax.lax.cond(
+            src > idx, masked,
+            lambda op: jax.lax.cond(src == idx, run(True), run(False), op),
+            None)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    dq0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    dk0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    dv0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+
+    def step(carry, t):
+        dq, kt, vt, dkt, dvt = carry
+        src = (idx - t) % n
+        dq_add, dk_add, dv_add = local_block(kt, vt, src)
+        dq = dq + dq_add
+        dkt = dkt + dk_add
+        dvt = dvt + dv_add
+        kt = jax.lax.ppermute(kt, axis, perm)
+        vt = jax.lax.ppermute(vt, axis, perm)
+        dkt = jax.lax.ppermute(dkt, axis, perm)
+        dvt = jax.lax.ppermute(dvt, axis, perm)
+        return (dq, kt, vt, dkt, dvt), None
+
+    (dq, _, _, dk, dv), _ = jax.lax.scan(
+        step, (dq0, k, v, dk0, dv0), jnp.arange(n))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _flash_serves(s_loc, d, use_flash):
+    """Shape gate mirroring flash_attention_kernel's lowering contract."""
+    if use_flash is not None:
+        return use_flash
+    from . import registry
+
+    if not registry.platform_kernels("tpu"):
+        return False  # pallas disabled (bench pre-flight containment)
+    from .pallas.flash_attention import _pick_block
+
+    bq = _pick_block(s_loc)
+    return (s_loc >= 16 and d % 8 == 0
+            and (bq == s_loc or bq % 8 == 0))
+
+
+def make_ring_attention(mesh, axis="sep", causal=True, use_flash=None):
     """Build a differentiable ring-attention fn for `mesh` over `axis`.
 
     Returns fn(q, k, v) on [b, s, h, d] arrays with s sharded over `axis`
     (replicated inputs are accepted; outputs carry the seq sharding).
+    ``use_flash``: None = auto (the Pallas flash kernel serves each ring
+    step's local block when its shape contract holds), True/False forces.
     """
+    import jax as _jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     n = int(dict(zip(mesh.axis_names, mesh.devices.shape))[axis])
     seq_spec = P(None, axis, None, None)
     lse_spec = P(None, None, axis)
+    # 'axon' is the tunneled real chip (registry.lookup_kernel aliases it
+    # to 'tpu'); only genuinely non-TPU hosts run pallas in interpret mode
+    interpret = _jax.default_backend() not in ("tpu", "axon")
+
+    def _serves(global_seq, d):
+        return _flash_serves(global_seq // n, d, use_flash)
 
     def fwd_shard(q, k, v):
         scale = 1.0 / math.sqrt(q.shape[-1])
         return _ring_fwd_shard(q, k, v, axis=axis, n=n, causal=causal,
                                scale=scale)
 
+    def fwd_shard_flash(q, k, v):
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        return _ring_fwd_shard_flash(
+            q, k, v, axis=axis, n=n, causal=causal, scale=scale,
+            interpret=interpret)
+
+    # the jnp variant keeps check_vma; the flash variant cannot (pallas
+    # out_shapes carry no vma tags for shard_map's varying-mask analysis)
     fwd_mapped = jax.shard_map(
         fwd_shard, mesh=mesh, in_specs=(seq_spec,) * 3,
         out_specs=(seq_spec, lse_spec), check_vma=True,
         axis_names=frozenset({axis}))
+    fwd_mapped_flash = jax.shard_map(
+        fwd_shard_flash, mesh=mesh, in_specs=(seq_spec,) * 3,
+        out_specs=(seq_spec, lse_spec), check_vma=False)
 
     def bwd_shard(q, k, v, out, lse, g):
         scale = 1.0 / math.sqrt(q.shape[-1])
         return _ring_bwd_shard(q, k, v, out, lse, g, axis=axis, n=n,
                                causal=causal, scale=scale)
 
-    bwd_mapped = jax.shard_map(
-        bwd_shard, mesh=mesh,
+    def bwd_shard_flash(q, k, v, out, lse, g):
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        return _ring_bwd_shard_flash(
+            q, k, v, out, lse, g, axis=axis, n=n, causal=causal,
+            scale=scale, interpret=interpret)
+
+    bwd_specs = dict(
         in_specs=(seq_spec, seq_spec, seq_spec, seq_spec, lse_spec,
                   seq_spec),
-        out_specs=(seq_spec,) * 3, check_vma=True,
-        axis_names=frozenset({axis}))
+        out_specs=(seq_spec,) * 3)
+    bwd_mapped = jax.shard_map(
+        bwd_shard, mesh=mesh, check_vma=True,
+        axis_names=frozenset({axis}), **bwd_specs)
+    bwd_mapped_flash = jax.shard_map(
+        bwd_shard_flash, mesh=mesh, check_vma=False, **bwd_specs)
 
     def place(x):
         return jax.device_put(x, NamedSharding(mesh, seq_spec))
 
     @jax.custom_vjp
     def ring_attn(q, k, v):
-        out, _ = fwd_mapped(place(q), place(k), place(v))
+        fm = (fwd_mapped_flash if _serves(q.shape[1], q.shape[-1])
+              else fwd_mapped)
+        out, _ = fm(place(q), place(k), place(v))
         return out
 
     def fwd_rule(q, k, v):
         q, k, v = place(q), place(k), place(v)
-        out, lse = fwd_mapped(q, k, v)
+        fm = (fwd_mapped_flash if _serves(q.shape[1], q.shape[-1])
+              else fwd_mapped)
+        out, lse = fm(q, k, v)
         return out, (q, k, v, out, lse)
 
     def bwd_rule(res, g):
         q, k, v, out, lse = res
-        return bwd_mapped(q, k, v, out, lse, place(g))
+        bm = (bwd_mapped_flash if _serves(q.shape[1], q.shape[-1])
+              else bwd_mapped)
+        return bm(q, k, v, out, lse, place(g))
 
     ring_attn.defvjp(fwd_rule, bwd_rule)
     return ring_attn

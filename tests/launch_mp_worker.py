@@ -1,0 +1,93 @@
+"""Payload for the two-process distributed launch test.
+
+Run by `python -m paddle_tpu.distributed.launch --nproc_per_node 2` (see
+test_launch_multiprocess.py). Mirrors the reference's multi-process
+trainer scripts (`test/legacy_test/test_dist_base.py:963` spawns trainers
+with hand-set PADDLE_TRAINER_ID/endpoints): each process owns 4 virtual
+CPU devices, rendezvouses through `init_parallel_env` →
+`jax.distributed.initialize`, then proves the cross-process boundary with
+one collective and a tiny DP-sharded train step.
+"""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu.framework.core import Tensor  # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    pt.distributed.init_parallel_env()  # rendezvous + dp mesh, all devices
+
+    res = {
+        "rank": rank,
+        "process_count": jax.process_count(),
+        "device_count": jax.device_count(),
+        "local_device_count": len(jax.local_devices()),
+    }
+
+    # -- collective across the process boundary --------------------------
+    # each DEVICE contributes its global index; the all-reduce must sum
+    # contributions living in the *other* process too
+    from paddle_tpu.distributed import env as dist_env
+
+    mesh = dist_env.get_env().mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P("dp"))
+
+    def per_shard(index):
+        # index is the global slice this device owns: encode its start
+        start = index[0].start or 0
+        return np.array([float(start)], np.float32)
+
+    arr = jax.make_array_from_callback((jax.device_count(),), sharding,
+                                       per_shard)
+    t = Tensor(arr)
+    out = pt.distributed.all_reduce(t)
+    # all_reduce over the dp axis sums the 8 per-device values 0..7
+    res["allreduce_sum"] = float(np.asarray(
+        out._data.addressable_data(0)).ravel()[0])
+
+    # -- tiny DP train step ----------------------------------------------
+    pt.seed(0)
+    model = pt.nn.Linear(4, 2)
+    opt = pt.optimizer.SGD(learning_rate=0.1,
+                           parameters=model.parameters())
+    loss_fn = pt.nn.MSELoss()
+    from paddle_tpu.jit.train_step import TrainStep
+
+    step = TrainStep(model, opt, lambda m, x, y: loss_fn(m(x), y),
+                     donate=False)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 4).astype(np.float32)
+    ys = rng.randn(8, 2).astype(np.float32)
+    losses = []
+    for _ in range(3):
+        loss = step(pt.to_tensor(xs), pt.to_tensor(ys))
+        losses.append(float(np.asarray(
+            loss._data.addressable_data(0)).ravel()[0]))
+    res["losses"] = losses
+
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(res, f)
+    print("WORKER_OK", rank, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""Two-process distributed runtime test through the launcher.
+
+Mirrors `/root/reference/test/legacy_test/test_dist_base.py:963`
+(`TestDistBase._run_cluster`: trainer subprocesses on one host, loss
+parity asserted) and
+`/root/reference/test/collective/test_communication_api_base.py:39`
+(launch-module subprocess): spawns
+`python -m paddle_tpu.distributed.launch --nproc_per_node 2` over
+`tests/launch_mp_worker.py`, with 4 virtual CPU devices per process —
+`env.init_distributed_runtime` → `jax.distributed.initialize` actually
+executes across a real process boundary.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_launch(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PADDLE_TRAINER_ID", None)
+    env.pop("PADDLE_TRAINERS_NUM", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--master", f"127.0.0.1:{_free_port()}",
+         "--log_dir", str(tmp_path / "log"), "--max_restart", "0",
+         os.path.join(ROOT, "tests", "launch_mp_worker.py"),
+         str(tmp_path)],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=600)
+    logs = ""
+    log_dir = tmp_path / "log"
+    if log_dir.exists():
+        for f in sorted(log_dir.iterdir()):
+            logs += f"\n--- {f.name} ---\n" + f.read_text()[-3000:]
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:], logs)
+
+    ranks = []
+    for r in (0, 1):
+        path = tmp_path / f"rank{r}.json"
+        assert path.exists(), logs
+        ranks.append(json.loads(path.read_text()))
+
+    for res in ranks:
+        # the runtime really spans two processes x 4 devices
+        assert res["process_count"] == 2, res
+        assert res["device_count"] == 8, res
+        assert res["local_device_count"] == 4, res
+        # the collective crossed the boundary: sum of global device
+        # indices 0..7, which no single process holds alone
+        assert res["allreduce_sum"] == float(sum(range(8))), res
+
+    # both ranks computed the identical loss trajectory (one logical
+    # program), and it matches the single-process run of the same model
+    assert ranks[0]["losses"] == ranks[1]["losses"]
+    expected = _single_process_losses()
+    np.testing.assert_allclose(ranks[0]["losses"], expected, rtol=1e-5,
+                               atol=1e-6)
+
+
+def _single_process_losses():
+    """The same 3-step training run inside this (single) process on the
+    8-device mesh — the parity reference, as in TestDistBase."""
+    import paddle_tpu as pt
+    from paddle_tpu.jit.train_step import TrainStep
+
+    pt.seed(0)
+    model = pt.nn.Linear(4, 2)
+    opt = pt.optimizer.SGD(learning_rate=0.1,
+                           parameters=model.parameters())
+    loss_fn = pt.nn.MSELoss()
+    step = TrainStep(model, opt, lambda m, x, y: loss_fn(m(x), y),
+                     donate=False)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 4).astype(np.float32)
+    ys = rng.randn(8, 2).astype(np.float32)
+    return [float(np.asarray(step(pt.to_tensor(xs),
+                                  pt.to_tensor(ys)).numpy()))
+            for _ in range(3)]

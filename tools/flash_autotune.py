@@ -1,0 +1,72 @@
+"""Run the flash-attention block-size autotuner on the live backend.
+
+Usage: python tools/flash_autotune.py [--iters 20] [--shapes bh,sq,sk,d,causal ...]
+
+Writes `paddle_tpu/ops/pallas/flash_tune.json` (block choices + kernel-vs-
+composite ratios with device provenance) and records a summary metric to
+PERF_MEASUREMENTS.json. Run whenever a chip is reachable (hwbench stage).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--shapes", nargs="*", default=None,
+                    help="bh,sq,sk,d,causal tuples")
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from bench import enable_compilation_cache
+
+    enable_compilation_cache()
+    backend = jax.default_backend()
+    print(f"flash_autotune: backend={backend}", flush=True)
+    if backend == "cpu":
+        print("flash_autotune: no TPU — tuning wall-clock on CPU is "
+              "meaningless; exiting", flush=True)
+        return 1
+
+    from paddle_tpu.ops.pallas import autotune
+
+    if args.shapes:
+        shapes = []
+        for s in args.shapes:
+            bh, sq, sk, d, causal = s.split(",")
+            shapes.append((int(bh), int(sq), int(sk), int(d),
+                           causal.lower() in ("1", "true", "c")))
+    else:
+        shapes = autotune.STANDARD_SHAPES
+
+    entries = []
+    for bh, sq, sk, d, causal in shapes:
+        print(f"tuning bh={bh} s={sq}x{sk} d={d} causal={causal}",
+              flush=True)
+        entries.append(autotune.tune_shape(bh, sq, sk, d, causal,
+                                           iters=args.iters))
+
+    from paddle_tpu.utils import measurements as meas
+
+    wins = sum(1 for e in entries if e["ratio_fwd_bwd"] > 1.0)
+    meas.record_or_warn(
+        "flash_autotune_shapes_kernel_wins", float(wins), "shapes",
+        extra={"tuned": len(entries),
+               "entries": {f"s{e['sq']}d{e['d']}": e["ratio_fwd_bwd"]
+                           for e in entries}})
+    print(f"flash_autotune: {wins}/{len(entries)} shapes favor the "
+          f"kernel; cache at paddle_tpu/ops/pallas/flash_tune.json",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,7 @@ BENCHES = [
     ("resnet", [sys.executable, "benchmarks/baseline_configs.py",
                 "--resnet-only"], 2400),
     ("ernie", [sys.executable, "benchmarks/ernie_bench.py"], 1800),
+    ("flashtune", [sys.executable, "tools/flash_autotune.py"], 2400),
 ]
 
 
