@@ -84,17 +84,9 @@ def _probe_backend(timeout_s: int = 240) -> str:
 def enable_compilation_cache():
     """Persistent XLA compilation cache: a brief tunnel window must
     suffice, so never pay the same compile twice across invocations."""
-    import jax
+    from paddle_tpu.utils.xla_cache import enable_compilation_cache as _e
 
-    try:
-        cache_dir = os.environ.get(
-            "JAX_COMPILATION_CACHE_DIR",
-            os.path.expanduser("~/.cache/paddle_tpu_xla_cache"))
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:  # noqa: BLE001 — cache is an optimization only
-        pass
+    _e("~/.cache/paddle_tpu_xla_cache")
 
 
 # bf16 peak FLOPs/s per chip by TPU generation (public spec sheets)

@@ -1,0 +1,24 @@
+"""Persistent XLA compilation cache setup, shared by bench.py and the
+test conftest — one place for the dir convention and thresholds."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["enable_compilation_cache"]
+
+
+def enable_compilation_cache(default_dir: str) -> None:
+    """Point jax at a persistent compilation cache (best-effort).
+
+    ``JAX_COMPILATION_CACHE_DIR`` overrides ``default_dir``. Never raises:
+    the cache is an optimization, not a prerequisite."""
+    import jax
+
+    try:
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                   os.path.expanduser(default_dir))
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001
+        pass

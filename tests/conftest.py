@@ -23,6 +23,13 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# persistent compilation cache: deep-model tests are compile-dominated on
+# the CPU mesh (XLA:CPU only caches small executables today, so the win
+# is modest here and real on TPU); dir survives across sessions
+from paddle_tpu.utils.xla_cache import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache("~/.cache/paddle_tpu_test_xla_cache")
+
 # the axon sitecustomize pins jax_platforms="axon,cpu" at interpreter start
 # (overriding env); force CPU-only here so tests never touch the TPU tunnel.
 jax.config.update("jax_platforms", "cpu")

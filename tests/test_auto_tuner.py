@@ -10,6 +10,8 @@ from paddle_tpu.distributed.auto_tuner import (
     AutoTuner, default_prunes, estimate_memory_bytes, generate_candidates,
 )
 
+pytestmark = pytest.mark.slow  # integration tier: heavy XLA compiles
+
 MODEL = {
     "hidden_size": 64, "num_hidden_layers": 4, "num_attention_heads": 4,
     "vocab_size": 128, "global_batch_size": 16, "seq_length": 16,

@@ -3,6 +3,10 @@ import time
 
 from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
 
+import pytest
+
+pytestmark = pytest.mark.slow  # integration tier: heavy XLA compiles
+
 
 def test_membership_and_restart_detection():
     m = ElasticManager(job_id="jt", rank=0, np=2, heartbeat_interval=0.2,

@@ -6,6 +6,8 @@ import pytest
 import paddle_tpu as pt
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, generate
 
+pytestmark = pytest.mark.slow  # integration tier: heavy XLA compiles
+
 
 @pytest.fixture(scope="module")
 def model():

@@ -27,6 +27,7 @@ def test_entry_jits():
     assert out.shape == (2, 256, 8192)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8():
     g = _graft()
     g.dryrun_multichip(8)

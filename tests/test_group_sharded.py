@@ -12,6 +12,8 @@ from paddle_tpu.distributed import fleet
 from paddle_tpu.distributed.sharding import group_sharded_parallel
 from paddle_tpu.jit.train_step import TrainStep
 
+pytestmark = pytest.mark.slow  # integration tier: heavy XLA compiles
+
 
 @pytest.fixture(scope="module", autouse=True)
 def _mesh():

@@ -1,12 +1,15 @@
 """hapi Model + vision package tests (reference `test/legacy_test/test_model.py`,
 `test/legacy_test/test_vision_models.py`)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 from paddle_tpu.vision import transforms as T
 from paddle_tpu.vision.datasets import FakeData
 from paddle_tpu.vision.models import LeNet, resnet18
+
+pytestmark = pytest.mark.slow  # integration tier: heavy XLA compiles
 
 
 class RegDS(paddle.io.Dataset):

@@ -52,6 +52,7 @@ class TestToStaticParity:
         net(x).sum().backward()
         assert x.grad is not None and x.grad.shape == [4, 8]
 
+    @pytest.mark.slow
     def test_training_with_jit_converges(self):
         net = nn.Sequential(nn.Linear(4, 32), nn.ReLU(), nn.Linear(32, 1))
         snet = paddle.jit.to_static(net)

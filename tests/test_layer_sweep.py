@@ -146,6 +146,7 @@ def test_dropout_layers_eval_identity():
         np.testing.assert_allclose(layer(x).numpy(), x.numpy())
 
 
+@pytest.mark.slow
 def test_rnn_layers():
     x = _x(2, 5, 4)  # [b, t, in]
     for cls in (nn.SimpleRNN, nn.GRU):
@@ -157,6 +158,7 @@ def test_rnn_layers():
     _check(out, (2, 5, 12))
 
 
+@pytest.mark.slow
 def test_transformer_layers():
     enc_layer = nn.TransformerEncoderLayer(8, 2, 16)
     _check(enc_layer(_x(2, 5, 8)), (2, 5, 8))

@@ -12,6 +12,8 @@ from paddle_tpu.distributed import fleet
 from paddle_tpu.jit.train_step import TrainStep
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, LlamaForCausalLMPipe
 
+pytestmark = pytest.mark.slow  # integration tier: heavy XLA compiles
+
 
 @pytest.fixture(scope="module", autouse=True)
 def _mesh():

@@ -10,6 +10,10 @@ import paddle_tpu as paddle
 from paddle_tpu import nn, optimizer
 import paddle_tpu.nn.functional as F
 
+import pytest
+
+pytestmark = pytest.mark.slow  # integration tier: heavy XLA compiles
+
 
 def make_synthetic_mnist(n=512, seed=0):
     """Linearly-separable-ish 10-class synthetic 28x28 data."""

@@ -8,6 +8,8 @@ import paddle_tpu as paddle
 from paddle_tpu.jit.train_step import TrainStep
 from paddle_tpu.vision import models as M
 
+pytestmark = pytest.mark.slow  # integration tier: heavy XLA compiles
+
 NC = 7  # small head to keep tests fast
 
 

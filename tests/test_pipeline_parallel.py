@@ -14,6 +14,8 @@ from paddle_tpu.distributed.fleet.meta_parallel import (
     LayerDesc, PipelineLayer, PipelineParallel,
 )
 
+pytestmark = pytest.mark.slow  # integration tier: heavy XLA compiles
+
 
 class Block(nn.Layer):
     def __init__(self, h):

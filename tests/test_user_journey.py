@@ -10,6 +10,8 @@ import pytest
 
 import paddle_tpu as paddle
 
+pytestmark = pytest.mark.slow  # integration tier: heavy XLA compiles
+
 
 class _TinyImages(paddle.io.Dataset):
     """Synthetic HWC uint8 images through the real transform stack."""

@@ -29,6 +29,7 @@ BENCHES = [
                 "--resnet-only"], 2400),
     ("ernie", [sys.executable, "benchmarks/ernie_bench.py"], 1800),
     ("flashtune", [sys.executable, "tools/flash_autotune.py"], 2400),
+    ("profile", [sys.executable, "tools/profile_train_step.py"], 1800),
 ]
 
 

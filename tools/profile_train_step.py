@@ -1,0 +1,168 @@
+"""Capture an xplane trace of the compiled headline train step on the
+live chip and print the MFU breakdown (VERDICT r3 next-round item 3).
+
+Usage: python tools/profile_train_step.py [--steps 5] [--outdir profiles/]
+
+Captures `jax.profiler.trace` around the bench model's TrainStep, then
+parses the xplane proto for per-op-category time (matmul / attention /
+optimizer / other / host gaps) and appends the summary to
+PERF_MEASUREMENTS.json. One command so a brief tunnel window suffices;
+run via hwbench or standalone whenever the chip is up.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _trace_files(outdir):
+    return set(glob.glob(os.path.join(
+        outdir, "**", "*.trace.json.gz"), recursive=True))
+
+
+def _breakdown_from_xplane(paths):
+    """Best-effort xplane parse: per-op self-time grouped by name class,
+    over exactly the trace files THIS run produced (repeat runs into the
+    same outdir must not double-count)."""
+    rows = {}
+    for path in sorted(paths):
+        with gzip.open(path, "rt") as f:
+            trace = json.load(f)
+        events = trace.get("traceEvents", [])
+        # device lanes only: host thread slices would overcount wall time
+        pid_names = {ev.get("pid"): ev.get("args", {}).get("name", "")
+                     for ev in events
+                     if ev.get("ph") == "M"
+                     and ev.get("name") == "process_name"}
+        device_pids = {pid for pid, name in pid_names.items()
+                       if any(k in name for k in ("TPU", "/device",
+                                                  "Device", "XLA Op"))}
+        for ev in events:
+            if ev.get("ph") != "X" or "dur" not in ev:
+                continue
+            if device_pids and ev.get("pid") not in device_pids:
+                continue
+            name = ev.get("name", "")
+            low = name.lower()
+            if any(k in low for k in ("fusion", "dot", "conv", "matmul")):
+                cat = "matmul/fusion"
+            elif any(k in low for k in ("custom-call", "mosaic", "flash")):
+                cat = "custom-call(pallas)"
+            elif any(k in low for k in ("all-reduce", "all-gather",
+                                        "collective", "permute")):
+                cat = "collective"
+            elif any(k in low for k in ("copy", "transpose", "reshape",
+                                        "bitcast")):
+                cat = "data-movement"
+            else:
+                cat = "other"
+            rows[cat] = rows.get(cat, 0.0) + ev["dur"] / 1e6  # us -> s
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--outdir", default="profiles")
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from bench import _peak_flops, enable_compilation_cache
+
+    enable_compilation_cache()
+    backend = jax.default_backend()
+    print(f"profile_train_step: backend={backend}", flush=True)
+    on_cpu = backend == "cpu"
+
+    import paddle_tpu as pt
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    pt.seed(0)
+    if on_cpu:
+        cfg = LlamaConfig.tiny(use_parallel_cross_entropy=False)
+        batch, seq = 2, 64
+    else:  # the bench.py headline config
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_hidden_layers=12, num_attention_heads=12,
+            max_position_embeddings=1024, dtype="bfloat16",
+            use_parallel_cross_entropy=False)
+        batch, seq = 4, 1024
+    model = LlamaForCausalLM(cfg)
+    if cfg.dtype == "bfloat16":
+        for p in model.parameters():
+            p._data = p._data.astype("bfloat16")
+    opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters(),
+                             multi_precision=cfg.dtype == "bfloat16")
+    step = TrainStep(model, opt, lambda m, i, l: m(i, l), donate=True)
+    ids = pt.to_tensor(np.random.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = pt.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (batch, seq)))
+
+    # warm/compile outside the trace
+    float(np.asarray(step(ids, labels).numpy()).sum())
+    os.makedirs(args.outdir, exist_ok=True)
+    before = _trace_files(args.outdir)
+    t0 = time.perf_counter()
+    with jax.profiler.trace(args.outdir):
+        for _ in range(args.steps):
+            loss = step(ids, labels)
+        loss._data.block_until_ready()
+    wall = time.perf_counter() - t0
+    tokens_per_sec = batch * seq * args.steps / wall
+    mfu = (tokens_per_sec * model.flops_per_token(seq)
+           / _peak_flops(jax.devices()[0]))
+    print(f"traced {args.steps} steps in {wall:.3f}s "
+          f"({tokens_per_sec:.0f} tok/s, mfu {mfu:.4f})", flush=True)
+
+    rows = _breakdown_from_xplane(_trace_files(args.outdir) - before)
+    if on_cpu:
+        print("(CPU: no device lane in the trace — host-thread slices "
+              "below overcount; the breakdown is meaningful on TPU)",
+              flush=True)
+    if rows:
+        total = sum(rows.values())
+        print("device-time breakdown (self time):", flush=True)
+        for cat, secs in sorted(rows.items(), key=lambda kv: -kv[1]):
+            print(f"  {cat:24s} {secs:8.4f}s  {secs / total:6.1%}",
+                  flush=True)
+        device_busy = total / wall if wall else None
+        print(f"  device busy / wall: {device_busy:.1%}", flush=True)
+    else:
+        device_busy = None
+        print("no trace events parsed — breakdown unavailable "
+              "(trace format drift?); NOT recording a busy fraction",
+              flush=True)
+
+    if not on_cpu:
+        from paddle_tpu.utils import measurements as meas
+
+        meas.record_or_warn(
+            "llama_train_profile_mfu", round(mfu, 4), "mfu",
+            extra={"tokens_per_sec": round(tokens_per_sec, 1),
+                   "breakdown_s": ({k: round(v, 4)
+                                    for k, v in rows.items()}
+                                   if rows else None),
+                   "device_busy_frac": (round(device_busy, 4)
+                                        if device_busy is not None
+                                        else None),
+                   "steps": args.steps, "outdir": args.outdir})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
