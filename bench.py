@@ -107,6 +107,40 @@ def _peak_flops(device) -> float:
     return 459e12  # assume v5p-class if unknown
 
 
+def build_headline_trainstep(on_cpu: bool):
+    """The ONE headline model+step (also profiled by
+    tools/profile_train_step.py — a profile must be attributable to the
+    bench number, so the config lives in exactly one place).
+
+    Returns (model, step, batch, seq)."""
+    import paddle_tpu as pt
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_cpu:  # smoke-mode so local runs finish; real numbers need a chip
+        cfg = LlamaConfig.tiny(use_parallel_cross_entropy=False)
+        batch, seq = 2, 64
+    else:
+        # sized for a single v5e chip (16G HBM): ~0.44B params, bf16 +
+        # fp32 masters + Adam moments ≈ 6G, activations ≈ 4G at b4×s1024
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_hidden_layers=12, num_attention_heads=12,
+            max_position_embeddings=1024, dtype="bfloat16",
+            use_parallel_cross_entropy=False)
+        batch, seq = 4, 1024
+    pt.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if cfg.dtype == "bfloat16":
+        for p in model.parameters():
+            p._data = p._data.astype("bfloat16")
+    opt = pt.optimizer.AdamW(
+        learning_rate=1e-4, parameters=model.parameters(),
+        multi_precision=cfg.dtype == "bfloat16")
+    step = TrainStep(model, opt, lambda m, i, l: m(i, l), donate=True)
+    return model, step, batch, seq
+
+
 def main():
     tpu_note = None
     try:
@@ -130,8 +164,6 @@ def main():
     enable_compilation_cache()
 
     import paddle_tpu as pt
-    from paddle_tpu.jit.train_step import TrainStep
-    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
     # Pre-flight: Mosaic-lower every Pallas kernel before the timed run.
     # If a kernel fails to lower, fall back to the XLA composite path so
@@ -148,31 +180,12 @@ def main():
         print(f"bench: {pallas_note}", file=sys.stderr, flush=True)
 
     on_cpu = jax.default_backend() == "cpu"
-    if on_cpu:  # smoke-mode so local runs finish; real numbers need a chip
-        cfg = LlamaConfig.tiny(use_parallel_cross_entropy=False)
-        batch, seq, steps, warmup = 2, 64, 3, 1
-    else:
-        # sized for a single v5e chip (16G HBM): ~0.44B params, bf16 +
-        # fp32 masters + Adam moments ≈ 6G, activations ≈ 4G at b4×s1024
-        cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
-            num_hidden_layers=12, num_attention_heads=12,
-            max_position_embeddings=1024, dtype="bfloat16",
-            use_parallel_cross_entropy=False)
-        batch, seq, steps, warmup = 4, 1024, 10, 2
+    steps, warmup = (3, 1) if on_cpu else (10, 2)
+    model, step, batch, seq = build_headline_trainstep(on_cpu)
+    vocab = model.config.vocab_size
 
-    pt.seed(0)
-    model = LlamaForCausalLM(cfg)
-    if cfg.dtype == "bfloat16":
-        for p in model.parameters():
-            p._data = p._data.astype("bfloat16")
-    opt = pt.optimizer.AdamW(
-        learning_rate=1e-4, parameters=model.parameters(),
-        multi_precision=cfg.dtype == "bfloat16")
-    step = TrainStep(model, opt, lambda m, i, l: m(i, l), donate=True)
-
-    ids = pt.to_tensor(np.random.randint(0, cfg.vocab_size, (batch, seq)))
-    labels = pt.to_tensor(np.random.randint(0, cfg.vocab_size, (batch, seq)))
+    ids = pt.to_tensor(np.random.randint(0, vocab, (batch, seq)))
+    labels = pt.to_tensor(np.random.randint(0, vocab, (batch, seq)))
 
     for _ in range(warmup):
         float(step(ids, labels).numpy())  # host transfer = real sync

@@ -1440,3 +1440,61 @@ S("adadelta_", lambda w, g: w - _LR * g * np.sqrt(
   path="paddle_tpu.optimizer.Adadelta",
   adapter=_opt_adapter(lambda c, ps: c(learning_rate=_LR, parameters=ps)),
   grad=(), rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------- grad-coverage round-2 ----
+# kink ops get finite-difference grads too, with inputs engineered to sit
+# at least 0.05 from every non-differentiable point (fd eps is 1e-3)
+
+
+def away(x, points, margin=0.05):
+    """Push values of x at least `margin` away from each kink point."""
+    x = x.copy()
+    for pt in points:
+        close = np.abs(x - pt) < margin
+        x[close] = pt + margin * np.where(x[close] >= pt, 1.0, -1.0)
+    return x.astype(np.float32)
+
+
+_SEP_A = away(f32(3, 4, lo=-2, hi=2), [0.0])
+_SEP_B = away(_SEP_A + away(f32(3, 4, lo=-1, hi=1), [0.0]), [0.0])
+
+S("maximum_grad", np.maximum, (_SEP_A, _SEP_B),
+  path="paddle_tpu.maximum", grad=(0, 1))
+S("minimum_grad", np.minimum, (_SEP_A, _SEP_B),
+  path="paddle_tpu.minimum", grad=(0, 1))
+S("fmax_grad", np.fmax, (_SEP_A, _SEP_B), path="paddle_tpu.fmax",
+  grad=(0,))
+S("fmin_grad", np.fmin, (_SEP_A, _SEP_B), path="paddle_tpu.fmin",
+  grad=(0,))
+S("relu_grad", lambda x: np.maximum(x, 0), (_XNZ,),
+  path="paddle_tpu.nn.functional.relu", grad=(0,))
+S("relu6_grad", lambda x: np.clip(x, 0, 6),
+  (away(f32(3, 4, lo=-3, hi=8), [0.0, 6.0]),),
+  path="paddle_tpu.nn.functional.relu6", grad=(0,))
+S("hardtanh_grad", lambda x: np.clip(x, -1, 1),
+  (away(f32(3, 4, lo=-2, hi=2), [-1.0, 1.0]),),
+  path="paddle_tpu.nn.functional.hardtanh", grad=(0,))
+S("hardshrink_grad", lambda x, threshold=0.5:
+  np.where(np.abs(x) > threshold, x, 0),
+  (away(f32(3, 4, lo=-2, hi=2), [-0.5, 0.5]),),
+  path="paddle_tpu.nn.functional.hardshrink", grad=(0,))
+S("softshrink_grad", lambda x, threshold=0.5:
+  np.sign(x) * np.maximum(np.abs(x) - threshold, 0),
+  (away(f32(3, 4, lo=-2, hi=2), [-0.5, 0.5]),),
+  path="paddle_tpu.nn.functional.softshrink", grad=(0,))
+S("thresholded_relu_grad", lambda x, threshold=1.0:
+  np.where(x > threshold, x, 0),
+  (away(f32(3, 4, lo=-2, hi=3), [1.0]),),
+  path="paddle_tpu.nn.functional.thresholded_relu", grad=(0,))
+S("where_grad", np.where, ((_A > 0), _SEP_A, _SEP_B),
+  path="paddle_tpu.where", grad=(1, 2))
+S("diag_grad", np.diag, (f32(4),), path="paddle_tpu.diag", grad=(0,))
+S("diagonal_grad", lambda x: np.diagonal(x), (f32(4, 4),),
+  path="paddle_tpu.diagonal", grad=(0,))
+S("gather_nd_grad", lambda x, index: x[tuple(index.T)],
+  (_A, np.array([[0, 1], [2, 3]], np.int64)),
+  path="paddle_tpu.gather_nd", grad=(0,))
+S("clip_grad", lambda x, min=None, max=None: np.clip(x, min, max),  # noqa: A002
+  (away(f32(3, 4, lo=-1, hi=1), [-0.3, 0.4]),),
+  path="paddle_tpu.clip", min=-0.3, max=0.4, grad=(0,))

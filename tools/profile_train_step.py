@@ -46,10 +46,21 @@ def _breakdown_from_xplane(paths):
         device_pids = {pid for pid, name in pid_names.items()
                        if any(k in name for k in ("TPU", "/device",
                                                   "Device", "XLA Op"))}
+        # within a device pid, keep the per-op lane only: module/step
+        # lanes span whole steps and would double-count everything
+        tid_names = {(ev.get("pid"), ev.get("tid")):
+                     ev.get("args", {}).get("name", "")
+                     for ev in events
+                     if ev.get("ph") == "M"
+                     and ev.get("name") == "thread_name"}
+        op_tids = {key for key, name in tid_names.items()
+                   if "XLA Ops" in name}
         for ev in events:
             if ev.get("ph") != "X" or "dur" not in ev:
                 continue
             if device_pids and ev.get("pid") not in device_pids:
+                continue
+            if op_tids and (ev.get("pid"), ev.get("tid")) not in op_tids:
                 continue
             name = ev.get("name", "")
             low = name.lower()
@@ -79,7 +90,8 @@ def main():
 
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    from bench import _peak_flops, enable_compilation_cache
+    from bench import (_peak_flops, build_headline_trainstep,
+                       enable_compilation_cache)
 
     enable_compilation_cache()
     backend = jax.default_backend()
@@ -87,31 +99,13 @@ def main():
     on_cpu = backend == "cpu"
 
     import paddle_tpu as pt
-    from paddle_tpu.jit.train_step import TrainStep
-    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
-    pt.seed(0)
-    if on_cpu:
-        cfg = LlamaConfig.tiny(use_parallel_cross_entropy=False)
-        batch, seq = 2, 64
-    else:  # the bench.py headline config
-        cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
-            num_hidden_layers=12, num_attention_heads=12,
-            max_position_embeddings=1024, dtype="bfloat16",
-            use_parallel_cross_entropy=False)
-        batch, seq = 4, 1024
-    model = LlamaForCausalLM(cfg)
-    if cfg.dtype == "bfloat16":
-        for p in model.parameters():
-            p._data = p._data.astype("bfloat16")
-    opt = pt.optimizer.AdamW(learning_rate=1e-4,
-                             parameters=model.parameters(),
-                             multi_precision=cfg.dtype == "bfloat16")
-    step = TrainStep(model, opt, lambda m, i, l: m(i, l), donate=True)
-    ids = pt.to_tensor(np.random.randint(0, cfg.vocab_size, (batch, seq)))
-    labels = pt.to_tensor(
-        np.random.randint(0, cfg.vocab_size, (batch, seq)))
+    # the EXACT bench.py headline model/step — the profile must be
+    # attributable to the headline number
+    model, step, batch, seq = build_headline_trainstep(on_cpu)
+    vocab = model.config.vocab_size
+    ids = pt.to_tensor(np.random.randint(0, vocab, (batch, seq)))
+    labels = pt.to_tensor(np.random.randint(0, vocab, (batch, seq)))
 
     # warm/compile outside the trace
     float(np.asarray(step(ids, labels).numpy()).sum())
