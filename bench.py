@@ -118,16 +118,22 @@ def build_headline_trainstep(on_cpu: bool):
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
     if on_cpu:  # smoke-mode so local runs finish; real numbers need a chip
-        cfg = LlamaConfig.tiny(use_parallel_cross_entropy=False)
+        cfg = LlamaConfig.tiny(
+            use_parallel_cross_entropy=False,
+            ce_chunk_size=int(os.environ.get("PT_BENCH_CE_CHUNK", "0")))
         batch, seq = 2, 64
     else:
         # sized for a single v5e chip (16G HBM): ~0.44B params, bf16 +
-        # fp32 masters + Adam moments ≈ 6G, activations ≈ 4G at b4×s1024
+        # fp32 masters + Adam moments ≈ 6G, activations ≈ 4G at b4×s1024.
+        # PT_BENCH_CE_CHUNK>0 switches the loss to the chunked CE (no
+        # [N, V] fp32 logits) — the candidate MFU lever to A/B on
+        # hardware (see PERF.md).
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1536, intermediate_size=4096,
             num_hidden_layers=12, num_attention_heads=12,
             max_position_embeddings=1024, dtype="bfloat16",
-            use_parallel_cross_entropy=False)
+            use_parallel_cross_entropy=False,
+            ce_chunk_size=int(os.environ.get("PT_BENCH_CE_CHUNK", "0")))
         batch, seq = 4, 1024
     pt.seed(0)
     model = LlamaForCausalLM(cfg)

@@ -55,6 +55,7 @@ class LlamaConfig:
         sequence_parallel=False,
         context_parallel=False,
         use_parallel_cross_entropy=True,
+        ce_chunk_size=0,
         recompute=False,
         dtype="float32",
         moe_num_experts=0,
@@ -75,6 +76,16 @@ class LlamaConfig:
         self.sequence_parallel = sequence_parallel
         self.context_parallel = context_parallel
         self.use_parallel_cross_entropy = use_parallel_cross_entropy
+        # >0: the training loss uses F.chunked_softmax_cross_entropy —
+        # the [N, V] fp32 logits never materialize (HBM win at V=32000);
+        # single-chip / non-parallel-CE path only
+        if ce_chunk_size > 0 and use_parallel_cross_entropy:
+            raise ValueError(
+                "ce_chunk_size requires use_parallel_cross_entropy=False: "
+                "the chunked loss consumes the unsharded lm_head weight; "
+                "under TP use ParallelCrossEntropy instead (it already "
+                "avoids gathering vocab-sharded logits)")
+        self.ce_chunk_size = ce_chunk_size
         self.recompute = recompute
         self.dtype = dtype
         self.moe_num_experts = moe_num_experts
@@ -273,6 +284,15 @@ class LlamaForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None):
         hidden = self.model(input_ids)
+        if (labels is not None and self.loss_fn is None
+                and self.config.ce_chunk_size > 0):
+            # chunked CE: lm_head matmul + softmax + gather fused per
+            # vocab chunk — the full fp32 logits never materialize
+            per_tok = F.chunked_softmax_cross_entropy(
+                hidden, self.lm_head.weight, labels,
+                self.config.ce_chunk_size)
+            loss = masked_token_mean(per_tok, labels, -100)
+            return self._add_moe_aux(loss)
         logits = self.lm_head(hidden)
         if labels is None:
             return logits
@@ -285,6 +305,9 @@ class LlamaForCausalLM(Layer):
             ignore = -100
         # divide by the non-ignored token count, not total tokens
         loss = masked_token_mean(loss, labels, ignore)
+        return self._add_moe_aux(loss)
+
+    def _add_moe_aux(self, loss):
         if self.config.moe_num_experts > 1:
             # GShard load-balancing aux loss, consumed in the same trace it
             # was produced in (the MoE layers stash it during forward)

@@ -38,6 +38,7 @@ from .loss import (  # noqa: F401
     edit_distance, hsigmoid_loss, poisson_nll_loss, gaussian_nll_loss,
     multi_margin_loss, triplet_margin_with_distance_loss, dice_loss,
     npair_loss, rnnt_loss, margin_cross_entropy,
+    chunked_softmax_cross_entropy,
 )
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, ring_flash_attention,
