@@ -93,7 +93,26 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
-_ring_cache: dict = {}
+_seq_parallel_cache: dict = {}
+
+
+def _seq_parallel_attention(op_name, make_fn, query, key, value, axis,
+                            causal):
+    """Shared wiring for the sequence-parallel attention variants: mesh
+    lookup, degree-1 fallback to the single-device attention path, and a
+    per-(mesh, axis, causal) cache of the built shard_map program."""
+    from ...distributed import env as env_mod
+
+    e = env_mod.ensure_env()
+    if e.degree(axis) <= 1:
+        return scaled_dot_product_attention(query, key, value,
+                                            is_causal=causal)
+    key_ = (op_name, e.mesh, axis, causal)
+    fn = _seq_parallel_cache.get(key_)
+    if fn is None:
+        fn = make_fn(e.mesh, axis=axis, causal=causal)
+        _seq_parallel_cache[key_] = fn
+    return apply(op_name, fn, (query, key, value))
 
 
 def ring_flash_attention(query, key, value, axis="sep", causal=True,
@@ -101,17 +120,25 @@ def ring_flash_attention(query, key, value, axis="sep", causal=True,
     """Context-parallel exact attention: sequence sharded over mesh ``axis``,
     KV blocks rotating on the ICI ring (`ops/ring_attention.py`). Exceeds the
     reference (SURVEY §5.7: no ring/context parallelism in the snapshot).
-    Degree-1 axes fall back to the regular flash_attention path."""
-    from ...distributed import env as env_mod
+    Degree-1 axes fall back to the single-device attention path."""
     from ...ops.ring_attention import make_ring_attention
 
-    e = env_mod.ensure_env()
-    if e.degree(axis) <= 1:
-        return scaled_dot_product_attention(query, key, value,
-                                            is_causal=causal)
+    return _seq_parallel_attention("ring_flash_attention",
+                                   make_ring_attention, query, key, value,
+                                   axis, causal)
 
-    ring = _ring_cache.get((e.mesh, axis, causal))
-    if ring is None:
-        ring = make_ring_attention(e.mesh, axis=axis, causal=causal)
-        _ring_cache[(e.mesh, axis, causal)] = ring
-    return apply("ring_flash_attention", ring, (query, key, value))
+
+def ulysses_attention(query, key, value, axis="sep", causal=True,
+                      name=None):
+    """DeepSpeed-Ulysses sequence parallelism: two all-to-alls re-shard
+    heads across ``axis`` so each device attends over the FULL sequence
+    with h/n heads (`ops/ulysses_attention.py`). Exceeds the reference
+    (SURVEY §2.6 lists Ulysses as absent). Complements
+    :func:`ring_flash_attention`: prefer Ulysses when heads are
+    plentiful, the ring at extreme sequence lengths. Degree-1 axes fall
+    back to the single-device attention path."""
+    from ...ops.ulysses_attention import make_ulysses_attention
+
+    return _seq_parallel_attention("ulysses_attention",
+                                   make_ulysses_attention, query, key,
+                                   value, axis, causal)

@@ -46,3 +46,19 @@ def _seed_all():
     paddle_tpu.seed(2024)
     np.random.seed(2024)
     yield
+
+
+@pytest.fixture(scope="session")
+def mesh_dp2_sep4():
+    """The shared 2x4 (dp, sep) mesh for sequence-parallel attention
+    tests (ring + ulysses)."""
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()).reshape(2, 4)
+    return Mesh(devs, ("dp", "sep"))
+
+
+def attn_qkv(b=2, s=64, h=2, d=16, seed=0):
+    """Deterministic [b, s, h, d] q/k/v triples for attention parity."""
+    rng = np.random.RandomState(seed)
+    return (rng.randn(b, s, h, d).astype(np.float32) for _ in range(3))
