@@ -54,6 +54,7 @@ class LlamaConfig:
         tie_word_embeddings=False,
         sequence_parallel=False,
         context_parallel=False,
+        context_parallel_mode="ring",
         use_parallel_cross_entropy=True,
         ce_chunk_size=0,
         recompute=False,
@@ -75,6 +76,12 @@ class LlamaConfig:
         self.tie_word_embeddings = tie_word_embeddings
         self.sequence_parallel = sequence_parallel
         self.context_parallel = context_parallel
+        if context_parallel_mode not in ("ring", "ulysses"):
+            raise ValueError(
+                "context_parallel_mode must be 'ring' (KV rotation, "
+                "extreme lengths) or 'ulysses' (head/seq all-to-all, "
+                f"plentiful heads); got {context_parallel_mode!r}")
+        self.context_parallel_mode = context_parallel_mode
         self.use_parallel_cross_entropy = use_parallel_cross_entropy
         # >0: the training loss uses F.chunked_softmax_cross_entropy —
         # the [N, V] fp32 logits never materialize (HBM win at V=32000);
@@ -192,9 +199,15 @@ class LlamaAttention(Layer):
         k = shard.sharding_constraint(k, None, None, "mp", None)
         v = shard.sharding_constraint(v, None, None, "mp", None)
         if cfg.context_parallel:
-            # ring attention over the 'sep' axis: exact attention with the
-            # sequence sharded across chips (long-context path)
-            out = F.ring_flash_attention(q, k, v, axis="sep", causal=True)
+            # exact attention with the sequence sharded across chips
+            # (long-context path): KV-rotating ring by default, or
+            # Ulysses head/seq all-to-all when configured
+            if cfg.context_parallel_mode == "ulysses":
+                out = F.ulysses_attention(q, k, v, axis="sep",
+                                          causal=True)
+            else:
+                out = F.ring_flash_attention(q, k, v, axis="sep",
+                                             causal=True)
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         out = out.reshape([b, s, self.num_heads * self.head_dim])

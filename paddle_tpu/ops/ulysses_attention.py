@@ -24,12 +24,10 @@ import jax
 import jax.numpy as jnp
 
 
-def _local_attention(q, k, v, causal, scale, interpret, use_flash):
+def _local_attention(q, k, v, causal, scale, interpret, flash):
     """Full-sequence attention on local heads: [b, s, h_loc, d]."""
     b, s, h, d = q.shape
-    from .ring_attention import _flash_serves
-
-    if _flash_serves(s, d, use_flash):
+    if flash:
         from .pallas import flash_attention as fa
 
         def to_bh(x):
@@ -38,14 +36,9 @@ def _local_attention(q, k, v, causal, scale, interpret, use_flash):
         out = fa._flash_bhsd(to_bh(q), to_bh(k), to_bh(v), causal, scale,
                              interpret)
         return jnp.moveaxis(out.reshape(b, h, s, d), 1, 2)
-    qf = q.astype(jnp.float32) * scale
-    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
-    if causal:
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        scores = jnp.where(mask[None, None], scores, -1e30)
-    p = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    from ..nn.functional.attention import _sdpa_reference
+
+    return _sdpa_reference(q, k, v, causal=causal, scale=scale)
 
 
 def make_ulysses_attention(mesh, axis="sep", causal=True, use_flash=None):
@@ -60,25 +53,34 @@ def make_ulysses_attention(mesh, axis="sep", causal=True, use_flash=None):
     seq_spec = P(None, axis, None, None)
     interpret = jax.default_backend() not in ("tpu", "axon")
 
-    def shard_fn(q, k, v):
-        scale = 1.0 / math.sqrt(q.shape[-1])
+    def make_shard_fn(flash):
+        def shard_fn(q, k, v):
+            scale = 1.0 / math.sqrt(q.shape[-1])
 
-        def seq_to_heads(x):
-            # [b, s_loc, h, d] -> [b, s, h/n, d]
-            return jax.lax.all_to_all(x, axis, split_axis=2,
-                                      concat_axis=1, tiled=True)
+            def seq_to_heads(x):
+                # [b, s_loc, h, d] -> [b, s, h/n, d]
+                return jax.lax.all_to_all(x, axis, split_axis=2,
+                                          concat_axis=1, tiled=True)
 
-        def heads_to_seq(x):
-            return jax.lax.all_to_all(x, axis, split_axis=1,
-                                      concat_axis=2, tiled=True)
+            def heads_to_seq(x):
+                return jax.lax.all_to_all(x, axis, split_axis=1,
+                                          concat_axis=2, tiled=True)
 
-        q2, k2, v2 = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-        out = _local_attention(q2, k2, v2, causal, scale, interpret,
-                               use_flash)
-        return heads_to_seq(out.astype(q.dtype))
+            q2, k2, v2 = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+            out = _local_attention(q2, k2, v2, causal, scale, interpret,
+                                   flash)
+            return heads_to_seq(out.astype(q.dtype))
 
-    mapped = jax.shard_map(shard_fn, mesh=mesh, in_specs=(seq_spec,) * 3,
-                           out_specs=seq_spec, check_vma=False)
+        return shard_fn
+
+    # like ring attention: the jnp variant keeps shard_map's varying-mask
+    # analysis; the Pallas variant cannot (kernel out_shapes carry no vma)
+    mapped = jax.shard_map(
+        make_shard_fn(False), mesh=mesh, in_specs=(seq_spec,) * 3,
+        out_specs=seq_spec, check_vma=True, axis_names=frozenset({axis}))
+    mapped_flash = jax.shard_map(
+        make_shard_fn(True), mesh=mesh, in_specs=(seq_spec,) * 3,
+        out_specs=seq_spec, check_vma=False)
 
     def place(x):
         return jax.device_put(x, NamedSharding(mesh, seq_spec))
@@ -94,6 +96,12 @@ def make_ulysses_attention(mesh, axis="sep", causal=True, use_flash=None):
             raise ValueError(
                 f"ulysses attention needs heads % axis degree == 0, got "
                 f"h={q.shape[2]} over {axis}={n}")
-        return mapped(place(q), place(k), place(v))
+        from .ring_attention import _flash_serves
+
+        # local attention sees the FULL sequence with h/n heads
+        m = (mapped_flash
+             if _flash_serves(q.shape[1], q.shape[-1], use_flash)
+             else mapped)
+        return m(place(q), place(k), place(v))
 
     return ulysses

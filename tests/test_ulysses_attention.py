@@ -71,3 +71,36 @@ def test_functional_surface(mesh_dp2_sep4):
                                    np.asarray(ref), atol=2e-5)
     finally:
         env_mod.reset_env()
+
+
+def test_llama_with_ulysses_context_parallel():
+    """LlamaConfig(context_parallel=True, context_parallel_mode='ulysses')
+    trains a compiled step on a dp x sep mesh."""
+    from paddle_tpu.distributed import env as env_mod
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    env_mod.init_mesh(dp=2, sep=4)
+    try:
+        pt.seed(0)
+        cfg = LlamaConfig.tiny(context_parallel=True,
+                               context_parallel_mode="ulysses")
+        model = LlamaForCausalLM(cfg)
+        opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+        ids = pt.to_tensor(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (4, 32)))
+        step = TrainStep(model, opt, lambda m, i, l: m(i, l))
+        losses = [float(np.asarray(step(ids, ids).numpy()))
+                  for _ in range(3)]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    finally:
+        env_mod.reset_env()
+
+
+def test_bad_context_parallel_mode_rejected():
+    from paddle_tpu.models import LlamaConfig
+
+    with pytest.raises(ValueError, match="context_parallel_mode"):
+        LlamaConfig.tiny(context_parallel=True,
+                         context_parallel_mode="alltoall")
