@@ -1498,3 +1498,173 @@ S("gather_nd_grad", lambda x, index: x[tuple(index.T)],
 S("clip_grad", lambda x, min=None, max=None: np.clip(x, min, max),  # noqa: A002
   (away(f32(3, 4, lo=-1, hi=1), [-0.3, 0.4]),),
   path="paddle_tpu.clip", min=-0.3, max=0.4, grad=(0,))
+
+
+# ------------------------------------------- completeness round-7 adds --
+def _cubic_kernel(t, a=-0.75):
+    at = np.abs(t)
+    return np.where(
+        at <= 1, (a + 2) * at ** 3 - (a + 3) * at ** 2 + 1,
+        np.where(at < 2,
+                 a * at ** 3 - 5 * a * at ** 2 + 8 * a * at - 4 * a, 0.0))
+
+
+def _np_bicubic_1d(x, size):
+    # align_corners=True cubic resize on the last axis (Keys a=-0.75)
+    w = x.shape[-1]
+    pos = np.linspace(0, w - 1, size)
+    out = np.zeros(x.shape[:-1] + (size,), np.float32)
+    for j, pj in enumerate(pos):
+        j0 = int(np.floor(pj))
+        acc = np.zeros(x.shape[:-1], np.float32)
+        norm = 0.0
+        for t in range(-1, 3):
+            idx = np.clip(j0 + t, 0, w - 1)
+            wgt = _cubic_kernel(pj - (j0 + t))
+            acc = acc + wgt * x[..., idx]
+            norm += wgt
+        out[..., j] = acc / norm
+    return out
+
+
+def _np_bicubic(x, size):
+    b, c, h, w = x.shape
+    out = _np_bicubic_1d(x.reshape(-1, w).astype(np.float32), size[1])
+    out = out.reshape(b, c, h, size[1]).transpose(0, 1, 3, 2)
+    out = _np_bicubic_1d(out.reshape(-1, h), size[0])
+    return out.reshape(b, c, size[1], size[0]).transpose(0, 1, 3, 2)
+
+
+S("bicubic_interp", _np_bicubic, (f32(1, 2, 4, 4),),
+  path="paddle_tpu.nn.functional.interpolate",
+  adapter=lambda f: (lambda x, size: f(
+      x, size=list(size), mode="bicubic", align_corners=True)),
+  size=(7, 6), grad=(), rtol=2e-2, atol=2e-2)
+
+
+def _np_roi_align(x, boxes, output_size, spatial_scale=1.0,
+                  sampling_ratio=-1):
+    # aligned=True bilinear-average RoIAlign (reference
+    # phi/kernels/cpu/roi_align_kernel.cc semantics, with the
+    # implementation's documented deviation: sampling_ratio=-1 uses a
+    # STATIC 2 samples per bin axis — XLA needs static sample counts —
+    # instead of the reference's adaptive ceil(bin))
+    ph = pw = output_size
+    n_rois = boxes.shape[0]
+    c = x.shape[1]
+    out = np.zeros((n_rois, c, ph, pw), np.float32)
+    for r, (x1, y1, x2, y2) in enumerate(boxes):
+        rx, ry = x1 * spatial_scale - 0.5, y1 * spatial_scale - 0.5
+        rw = max((x2 - x1) * spatial_scale, 1e-3)
+        rh = max((y2 - y1) * spatial_scale, 1e-3)
+        bin_h, bin_w = rh / ph, rw / pw
+        for i in range(ph):
+            for j in range(pw):
+                sy = 2 if sampling_ratio <= 0 else sampling_ratio
+                sx = 2 if sampling_ratio <= 0 else sampling_ratio
+                acc = np.zeros(c, np.float32)
+                for iy in range(sy):
+                    yy = ry + i * bin_h + (iy + 0.5) * bin_h / sy
+                    for ix in range(sx):
+                        xx = rx + j * bin_w + (ix + 0.5) * bin_w / sx
+                        acc += _bilinear_at(x[0], yy, xx)
+                out[r, :, i, j] = acc / (sy * sx)
+    return out
+
+
+def _bilinear_at(img, y, x):
+    c, h, w = img.shape
+    if y < -1 or y > h or x < -1 or x > w:
+        return np.zeros(c, np.float32)
+    y = min(max(y, 0), h - 1)
+    x = min(max(x, 0), w - 1)
+    y0, x0 = int(np.floor(y)), int(np.floor(x))
+    y1, x1 = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+    ly, lx = y - y0, x - x0
+    return ((1 - ly) * (1 - lx) * img[:, y0, x0]
+            + (1 - ly) * lx * img[:, y0, x1]
+            + ly * (1 - lx) * img[:, y1, x0]
+            + ly * lx * img[:, y1, x1]).astype(np.float32)
+
+
+S("roi_align", _np_roi_align,
+  (f32(1, 2, 8, 8), np.array([[1, 1, 5, 5], [0, 0, 7, 3]], np.float32)),
+  path="paddle_tpu.vision.ops.roi_align",
+  adapter=lambda f: (lambda x, boxes, output_size: f(
+      x, boxes, __import__("paddle_tpu").to_tensor(
+          np.array([boxes.shape[0]], np.int32)), output_size)),
+  output_size=2, grad=(), rtol=1e-3, atol=1e-3)
+
+
+def _np_prior_box(feat, img, min_sizes, aspect_ratios=(1.0,),
+                  variance=(0.1, 0.1, 0.2, 0.2), offset=0.5):
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    step_w, step_h = img_w / w, img_h / h
+    whs = []
+    for ms in min_sizes:
+        for r in aspect_ratios:
+            sr = np.sqrt(r)
+            whs.append((ms * sr, ms / sr))
+    boxes = np.zeros((h, w, len(whs), 4), np.float32)
+    for i in range(h):
+        cy = (i + offset) * step_h
+        for j in range(w):
+            cx = (j + offset) * step_w
+            for k, (bw, bh) in enumerate(whs):
+                boxes[i, j, k] = [(cx - bw / 2) / img_w,
+                                  (cy - bh / 2) / img_h,
+                                  (cx + bw / 2) / img_w,
+                                  (cy + bh / 2) / img_h]
+    var = np.broadcast_to(np.asarray(variance, np.float32), boxes.shape)
+    return boxes, var.astype(np.float32)
+
+
+S("prior_box", _np_prior_box, (f32(1, 8, 4, 4), f32(1, 3, 32, 32)),
+  path="paddle_tpu.vision.ops.prior_box",
+  min_sizes=[8.0, 16.0], aspect_ratios=(1.0, 2.0), grad=())
+
+
+def _np_yolo_box(x, img_size, anchors, class_num, conf_thresh,
+                 downsample_ratio):
+    def sig(z):
+        return 1 / (1 + np.exp(-z))
+
+    s = len(anchors) // 2
+    anc = np.asarray(anchors, np.float32).reshape(s, 2)
+    n, c, h, w = x.shape
+    attrs = 5 + class_num
+    v = x.reshape(n, s, attrs, h, w)
+    boxes_out = np.zeros((n, s, h, w, 4), np.float32)
+    scores_out = np.zeros((n, s, h, w, class_num), np.float32)
+    for b in range(n):
+        imh, imw = float(img_size[b, 0]), float(img_size[b, 1])
+        for a in range(s):
+            for i in range(h):
+                for j in range(w):
+                    bx = (sig(v[b, a, 0, i, j]) + j) / w
+                    by = (sig(v[b, a, 1, i, j]) + i) / h
+                    bw = np.exp(v[b, a, 2, i, j]) * anc[a, 0] / (
+                        w * downsample_ratio)
+                    bh = np.exp(v[b, a, 3, i, j]) * anc[a, 1] / (
+                        h * downsample_ratio)
+                    conf = sig(v[b, a, 4, i, j])
+                    keep = conf >= conf_thresh
+                    box = np.array([(bx - bw / 2) * imw,
+                                    (by - bh / 2) * imh,
+                                    (bx + bw / 2) * imw,
+                                    (by + bh / 2) * imh], np.float32)
+                    box[0::2] = np.clip(box[0::2], 0, imw - 1)
+                    box[1::2] = np.clip(box[1::2], 0, imh - 1)
+                    boxes_out[b, a, i, j] = box * keep
+                    scores_out[b, a, i, j] = (
+                        sig(v[b, a, 5:, i, j]) * conf * keep)
+    return (boxes_out.reshape(n, -1, 4),
+            scores_out.reshape(n, -1, class_num))
+
+
+S("yolo_box", _np_yolo_box,
+  (f32(1, 14, 3, 3), np.array([[24, 24]], np.int32)),
+  path="paddle_tpu.vision.ops.yolo_box",
+  anchors=[10, 13, 16, 30], class_num=2, conf_thresh=0.3,
+  downsample_ratio=8, grad=(), rtol=1e-4, atol=1e-4)

@@ -83,12 +83,9 @@ WHITELIST = {
     "spectral_norm": "power-iteration parity in "
                      "test_spectral_norm_parity below",
     # vision/detection compound ops with dedicated tests
-    "prior_box": "tests/test_vision_ops.py",
-    "yolo_box": "tests/test_vision_ops.py",
     "yolo_loss": "tests/test_vision_ops.py",
     "matrix_nms": "tests/test_vision_ops.py",
     "multiclass_nms3": "tests/test_vision_ops.py",
-    "roi_align": "tests/test_vision_ops.py",
     "roi_pool": "tests/test_vision_ops.py",
     "psroi_pool": "tests/test_vision_ops.py",
     "generate_proposals": "tests/test_vision_ops.py",
@@ -97,7 +94,6 @@ WHITELIST = {
     "decode_jpeg": "needs a jpeg file (tests/test_vision_ops.py)",
     # conv/pool/interp variants covered by dedicated layer tests; the
     # sweep keeps one representative per family (conv2d, pool2d)
-    "bicubic_interp": "tests/test_nn.py",
     "unpool3d": "tests/test_op_additions.py",
     # fft family: numpy-parity tests in tests/test_fft.py
     # graph/geometric kernels: tests/test_geometric_signal.py
