@@ -42,5 +42,5 @@ from .loss import (  # noqa: F401
 )
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, ring_flash_attention,
-    ulysses_attention,
+    ulysses_attention, sliding_window_attention,
 )

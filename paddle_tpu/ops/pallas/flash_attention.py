@@ -47,21 +47,37 @@ NEG_INF = -1e30
 _LANES = 128
 
 
-def _causal_mask(s, q_idx, k_idx, block_q, block_k, offset):
+def _causal_mask(s, q_idx, k_idx, block_q, block_k, offset, window=0):
     """Bottom-right-aligned causal mask for one [block_q, block_k] tile.
 
     Global query row r may attend key col c iff  r + offset >= c,
-    where offset = seq_k - seq_q.
+    where offset = seq_k - seq_q. ``window > 0`` additionally bounds the
+    lookback (sliding-window / Mistral-style local attention): c must
+    also satisfy  c > r + offset - window.
     """
     rows = q_idx * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     cols = k_idx * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return jnp.where(rows + offset >= cols, s, NEG_INF)
+    keep = rows + offset >= cols
+    if window > 0:
+        keep &= cols > rows + offset - window
+    return jnp.where(keep, s, NEG_INF)
+
+
+def _tile_live(q_idx, k_idx, block_q, block_k, offset, window):
+    """Whether a [block_q, block_k] tile intersects the (causal, window)
+    band at all — fully-masked tiles skip their MXU work."""
+    below_diag = k_idx * block_k < (q_idx + 1) * block_q + offset
+    if window <= 0:
+        return below_diag
+    in_window = (k_idx + 1) * block_k > q_idx * block_q + offset - window + 1
+    return below_diag & in_window
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, causal, scale, offset, n_kb):
+                acc_ref, m_ref, l_ref, *, causal, scale, offset, n_kb,
+                window=0):
     q_idx = pl.program_id(1)
     k_idx = pl.program_id(2)
     block_q, d = q_ref.shape[1], q_ref.shape[2]
@@ -81,7 +97,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, bk]
         if causal:
-            s = _causal_mask(s, q_idx, k_idx, block_q, block_k, offset)
+            s = _causal_mask(s, q_idx, k_idx, block_q, block_k, offset,
+                             window)
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -95,10 +112,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        # tiles strictly above the (bottom-right-aligned) diagonal are
-        # entirely masked — skip their compute (their HBM fetch still
-        # happens; the win is MXU time, which is the bottleneck here).
-        pl.when(k_idx * block_k < (q_idx + 1) * block_q + offset)(_step)
+        # tiles fully outside the (causal, window) band are entirely
+        # masked — skip their compute (their HBM fetch still happens;
+        # the win is MXU time, which is the bottleneck here).
+        pl.when(_tile_live(q_idx, k_idx, block_q, block_k, offset,
+                           window))(_step)
     else:
         _step()
 
@@ -117,7 +135,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc_ref, *, causal, scale, offset, n_kb):
+                   dq_acc_ref, *, causal, scale, offset, n_kb, window=0):
     q_idx = pl.program_id(1)
     k_idx = pl.program_id(2)
     block_q = q_ref.shape[1]
@@ -138,7 +156,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if causal:
-            s = _causal_mask(s, q_idx, k_idx, block_q, block_k, offset)
+            s = _causal_mask(s, q_idx, k_idx, block_q, block_k, offset,
+                             window)
         # no-valid-key rows have lse ~ NEG_INF; exp(s - lse) would blow up
         p = jnp.where(lse > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)  # [bq, bk]
         dp = jax.lax.dot_general(
@@ -150,7 +169,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        pl.when(k_idx * block_k < (q_idx + 1) * block_q + offset)(_step)
+        pl.when(_tile_live(q_idx, k_idx, block_q, block_k, offset,
+                           window))(_step)
     else:
         _step()
 
@@ -161,7 +181,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
-                    *, causal, scale, offset, n_qb, n_iters):
+                    *, causal, scale, offset, n_qb, n_iters, window=0):
     """dk/dv accumulate over the q-minor grid dim, which iterates
     group × q-blocks under GQA (the same KV block serves every q head of
     its group; q_idx below is the position within one head's q blocks)."""
@@ -187,7 +207,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, bk]
         if causal:
-            s = _causal_mask(s, q_idx, k_idx, block_q, block_k, offset)
+            s = _causal_mask(s, q_idx, k_idx, block_q, block_k, offset,
+                             window)
         p = jnp.where(lse > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)
         dv_acc_ref[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -201,8 +222,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        # tile has any unmasked entry iff last row can see first col
-        pl.when(k_idx * block_k < (q_idx + 1) * block_q + offset)(_step)
+        pl.when(_tile_live(q_idx, k_idx, block_q, block_k, offset,
+                           window))(_step)
     else:
         _step()
 
@@ -219,16 +240,16 @@ def _pick_block(seq, target=512):
     return max(b, 1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_bhsd(q, k, v, causal, scale, interpret, block_q=None,
-                block_k=None):
+                block_k=None, window=0):
     out, _ = _flash_fwd(q, k, v, causal, scale, interpret, block_q,
-                        block_k)
+                        block_k, window)
     return out
 
 
 def _flash_fwd(q, k, v, causal, scale, interpret, block_q=None,
-               block_k=None):
+               block_k=None, window=0):
     """q: [bh, s, d], k/v: [bh_kv, s, d] with bh % bh_kv == 0 (GQA: each
     group of bh//bh_kv query heads shares one KV head — the K/V BlockSpec
     index maps divide the bh program index, so grouped heads stream the
@@ -247,7 +268,7 @@ def _flash_fwd(q, k, v, causal, scale, interpret, block_q=None,
     n_kb = sk // block_k
     grid = (bh, sq // block_q, n_kb)
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
-                               offset=sk - sq, n_kb=n_kb)
+                               offset=sk - sq, n_kb=n_kb, window=window)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -284,13 +305,14 @@ def _flash_fwd(q, k, v, causal, scale, interpret, block_q=None,
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, interpret, block_q=None,
-                    block_k=None):
+                    block_k=None, window=0):
     out, lse = _flash_fwd(q, k, v, causal, scale, interpret, block_q,
-                          block_k)
+                          block_k, window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, scale, interpret, block_q, block_k, res, g):
+def _flash_bwd_rule(causal, scale, interpret, block_q, block_k, window,
+                    res, g):
     q, k, v, out, lse = res
     bh, sq, d = q.shape
     sk = k.shape[1]
@@ -311,7 +333,7 @@ def _flash_bwd_rule(causal, scale, interpret, block_q, block_k, res, g):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
-                          offset=offset, n_kb=n_kb),
+                          offset=offset, n_kb=n_kb, window=window),
         grid=(bh, n_qb, n_kb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -336,7 +358,7 @@ def _flash_bwd_rule(causal, scale, interpret, block_q, block_k, res, g):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
                           offset=offset, n_qb=n_qb,
-                          n_iters=group * n_qb),
+                          n_iters=group * n_qb, window=window),
         grid=(bh_kv, n_kb, group * n_qb),
         in_specs=[
             pl.BlockSpec((1, block_q, d),
@@ -449,6 +471,22 @@ def check_lowering():
 
         jax.export.export(jax.jit(fwd), platforms=["tpu"])(q, kv, kv)
         jax.export.export(jax.jit(bwd), platforms=["tpu"])(q, kv, kv)
+
+    # sliding-window variant (window bands engage the tile-skip path)
+    q = jnp.zeros((8, 1024, 128), jnp.bfloat16)
+    kv = jnp.zeros((8, 1024, 128), jnp.bfloat16)
+
+    def swa(q, k, v):
+        return _flash_bhsd(q, k, v, True, 1.0 / math.sqrt(128.0), False,
+                           None, None, 256)
+
+    def swa_bwd(q, k, v):
+        return jax.grad(
+            lambda *a: swa(*a).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+
+    jax.export.export(jax.jit(swa), platforms=["tpu"])(q, kv, kv)
+    jax.export.export(jax.jit(swa_bwd), platforms=["tpu"])(q, kv, kv)
 
 
 def register(platform="tpu", interpret=False):
