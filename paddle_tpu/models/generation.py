@@ -83,10 +83,12 @@ def _rope_at(q, k, pos, theta):
     return _rope(q, k, theta, q.dtype, pos=pos)
 
 
-def _attend(q, kc, vc, valid_len, nh, nkv, key_pad=None):
+def _attend(q, kc, vc, valid_len, nh, nkv, key_pad=None,
+            sliding_window=0):
     """q [b, sq, nh, d] against cached kc/vc [b, L, nkv, d], masked to
-    positions < valid_len (+ causal within the query block). ``key_pad``
-    [b] hides each row's leading left-pad slots."""
+    positions < valid_len (+ causal within the query block, + the
+    sliding-window band when configured). ``key_pad`` [b] hides each
+    row's leading left-pad slots."""
     b, sq, _, d = q.shape
     L = kc.shape[1]
     g = nh // nkv
@@ -97,6 +99,8 @@ def _attend(q, kc, vc, valid_len, nh, nkv, key_pad=None):
     # valid_len - sq + t) iff l <= that position
     q_pos = valid_len - sq + jnp.arange(sq)  # [sq]
     vis = jnp.arange(L)[None, :] <= q_pos[:, None]  # [sq, L]
+    if sliding_window > 0:  # local attention: key within the lookback band
+        vis &= jnp.arange(L)[None, :] > q_pos[:, None] - sliding_window
     vis = jnp.broadcast_to(vis[None], (b, sq, L))
     if key_pad is not None:
         vis = vis & (jnp.arange(L)[None, None, :]
@@ -129,7 +133,7 @@ def _block(x, layer_p, cache_k, cache_v, li, pos, valid_len, cfg,
         jax.lax.dynamic_update_slice_in_dim(cache_v[li], v,
                                             valid_len - s, 1))
     out = _attend(q, ck[li], cv[li], valid_len, nh, nkv,
-                  key_pad=key_pad)
+                  key_pad=key_pad, sliding_window=cfg.sliding_window)
     out = out.reshape(b, s, nh * d) @ layer_p["o"]
     x = x + out
     h2 = _rms(x, layer_p["ln2"], cfg.rms_norm_eps)
@@ -200,7 +204,8 @@ class _GenCfg:
     by identity)."""
 
     __slots__ = ("num_attention_heads", "num_key_value_heads",
-                 "hidden_size", "rope_theta", "rms_norm_eps", "dtype")
+                 "hidden_size", "rope_theta", "rms_norm_eps", "dtype",
+                 "sliding_window")
 
     def __init__(self, cfg):
         self.num_attention_heads = cfg.num_attention_heads
@@ -210,6 +215,7 @@ class _GenCfg:
         self.rope_theta = float(cfg.rope_theta)
         self.rms_norm_eps = float(cfg.rms_norm_eps)
         self.dtype = str(cfg.dtype)
+        self.sliding_window = int(getattr(cfg, "sliding_window", 0) or 0)
 
     def _key(self):
         return tuple(getattr(self, f) for f in self.__slots__)

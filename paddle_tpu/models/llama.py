@@ -55,6 +55,7 @@ class LlamaConfig:
         sequence_parallel=False,
         context_parallel=False,
         context_parallel_mode="ring",
+        sliding_window=0,
         use_parallel_cross_entropy=True,
         ce_chunk_size=0,
         recompute=False,
@@ -82,6 +83,17 @@ class LlamaConfig:
                 "extreme lengths) or 'ulysses' (head/seq all-to-all, "
                 f"plentiful heads); got {context_parallel_mode!r}")
         self.context_parallel_mode = context_parallel_mode
+        # Mistral-style local attention (0 = full causal); training and
+        # the compiled KV-cache decode honor the same band
+        if not isinstance(sliding_window, int) or sliding_window < 0:
+            raise ValueError(
+                "sliding_window must be a non-negative int (0 = full "
+                f"causal), got {sliding_window!r}")
+        if sliding_window and context_parallel:
+            raise ValueError(
+                "sliding_window with context_parallel is unsupported: the "
+                "ring/ulysses paths assume full causal attention")
+        self.sliding_window = sliding_window
         self.use_parallel_cross_entropy = use_parallel_cross_entropy
         # >0: the training loss uses F.chunked_softmax_cross_entropy —
         # the [N, V] fp32 logits never materialize (HBM win at V=32000);
@@ -208,6 +220,8 @@ class LlamaAttention(Layer):
             else:
                 out = F.ring_flash_attention(q, k, v, axis="sep",
                                              causal=True)
+        elif cfg.sliding_window > 0:
+            out = F.sliding_window_attention(q, k, v, cfg.sliding_window)
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         out = out.reshape([b, s, self.num_heads * self.head_dim])

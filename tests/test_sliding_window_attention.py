@@ -133,3 +133,39 @@ def test_gqa_and_cross_length_edges():
     # non-int window rejected before any dispatch divergence
     with pytest.raises(ValueError, match="positive int"):
         F.sliding_window_attention(q3, k3, v3, window_size=8.5)
+
+
+def test_llama_sliding_window_train_and_decode():
+    """LlamaConfig(sliding_window=N): training forward honors the band,
+    and the compiled KV-cache decode applies the SAME band (greedy
+    cache-decode == full-forward argmax token for token)."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(sliding_window=8,
+                           use_parallel_cross_entropy=False)
+    m = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    ids = pt.to_tensor(rng.randint(0, cfg.vocab_size, (2, 32)))
+    logits_w = m(ids).numpy()
+    m.config.sliding_window = 0  # same weights, full causal
+    logits_full = m(ids).numpy()
+    assert not np.allclose(logits_w[:, -1], logits_full[:, -1])
+    np.testing.assert_allclose(logits_w[:, :8], logits_full[:, :8],
+                               atol=1e-5)
+
+    m.config.sliding_window = 8
+    m.eval()
+    out = m.generate(ids, max_new_tokens=3).numpy()
+    cur = ids.numpy()
+    for t in range(3):
+        nxt = m(pt.to_tensor(cur)).numpy()[:, -1].argmax(-1)
+        np.testing.assert_array_equal(nxt, out[:, t])
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+
+
+def test_sliding_window_rejects_context_parallel():
+    from paddle_tpu.models import LlamaConfig
+
+    with pytest.raises(ValueError, match="sliding_window"):
+        LlamaConfig.tiny(sliding_window=8, context_parallel=True)
