@@ -1668,3 +1668,83 @@ S("yolo_box", _np_yolo_box,
   path="paddle_tpu.vision.ops.yolo_box",
   anchors=[10, 13, 16, 30], class_num=2, conf_thresh=0.3,
   downsample_ratio=8, grad=(), rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------- completeness round-8 adds --
+def _np_psroi_pool(x, boxes, output_size, spatial_scale=1.0):
+    # position-sensitive RoI average pool: C = out_c * ph * pw; bin
+    # (i, j) reads channel group (i*pw + j) (reference
+    # phi/kernels/cpu/psroi_pool_kernel.cc)
+    ph = pw = output_size
+    n_rois = boxes.shape[0]
+    c = x.shape[1]
+    out_c = c // (ph * pw)
+    out = np.zeros((n_rois, out_c, ph, pw), np.float32)
+    for r, (x1, y1, x2, y2) in enumerate(boxes):
+        # reference convention: round the box corners, end-inclusive +1,
+        # THEN scale (matches phi psroi_pool_kernel)
+        rx1 = round(x1) * spatial_scale
+        ry1 = round(y1) * spatial_scale
+        rx2 = round(x2 + 1.0) * spatial_scale
+        ry2 = round(y2 + 1.0) * spatial_scale
+        rw = max(rx2 - rx1, 0.1)
+        rh = max(ry2 - ry1, 0.1)
+        bin_h, bin_w = rh / ph, rw / pw
+        for i in range(ph):
+            for j in range(pw):
+                hs = int(np.floor(ry1 + i * bin_h))
+                he = int(np.ceil(ry1 + (i + 1) * bin_h))
+                ws = int(np.floor(rx1 + j * bin_w))
+                we = int(np.ceil(rx1 + (j + 1) * bin_w))
+                hs, he = max(hs, 0), min(he, x.shape[2])
+                ws, we = max(ws, 0), min(we, x.shape[3])
+                for oc in range(out_c):
+                    ch = oc * ph * pw + i * pw + j
+                    if he > hs and we > ws:
+                        out[r, oc, i, j] = x[0, ch, hs:he, ws:we].mean()
+    return out
+
+
+S("psroi_pool", _np_psroi_pool,
+  (f32(1, 8, 8, 8), np.array([[0, 0, 4, 4], [2, 2, 6, 6]], np.float32)),
+  path="paddle_tpu.vision.ops.psroi_pool",
+  adapter=lambda f: (lambda x, boxes, output_size: f(
+      x, boxes, __import__("paddle_tpu").to_tensor(
+          np.array([boxes.shape[0]], np.int32)), output_size)),
+  output_size=2, grad=(), rtol=1e-4, atol=1e-4)
+
+
+def _np_distribute_fpn(rois, min_level, max_level, refer_level,
+                       refer_scale):
+    # level = floor(refer_level + log2(sqrt(area) / refer_scale))
+    areas = (rois[:, 2] - rois[:, 0]) * (rois[:, 3] - rois[:, 1])
+    lvl = np.floor(refer_level + np.log2(
+        np.sqrt(np.maximum(areas, 1e-6)) / refer_scale + 1e-12))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs = [rois[lvl == L] for L in range(min_level, max_level + 1)]
+    restore = np.argsort(
+        np.concatenate([np.where(lvl == L)[0]
+                        for L in range(min_level, max_level + 1)]))
+    return outs, restore
+
+
+_FPN_ROIS = np.array([[0, 0, 16, 16], [0, 0, 64, 64], [0, 0, 224, 224],
+                      [10, 10, 42, 42]], np.float32)
+
+
+def _fpn_adapter(f):
+    def run(rois):
+        outs, restore = f(rois, 2, 5, 4, 224)
+        return tuple(outs) + (restore,)
+
+    return run
+
+
+def _np_fpn_flat(rois):
+    outs, restore = _np_distribute_fpn(rois, 2, 5, 4, 224)
+    return tuple(outs) + (restore.reshape(-1, 1).astype(np.int64),)
+
+
+S("distribute_fpn_proposals", _np_fpn_flat, (_FPN_ROIS,),
+  path="paddle_tpu.vision.ops.distribute_fpn_proposals",
+  adapter=_fpn_adapter, grad=())

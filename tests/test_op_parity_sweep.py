@@ -87,9 +87,7 @@ WHITELIST = {
     "matrix_nms": "tests/test_vision_ops.py",
     "multiclass_nms3": "tests/test_vision_ops.py",
     "roi_pool": "tests/test_vision_ops.py",
-    "psroi_pool": "tests/test_vision_ops.py",
     "generate_proposals": "tests/test_vision_ops.py",
-    "distribute_fpn_proposals": "tests/test_vision_ops.py",
     "deformable_conv": "tests/test_vision_ops.py",
     "decode_jpeg": "needs a jpeg file (tests/test_vision_ops.py)",
     # conv/pool/interp variants covered by dedicated layer tests; the
@@ -215,7 +213,7 @@ def test_sweep_is_complete():
     assert len(SPECS) >= 300, len(SPECS)
     swept = [op for op in implemented
              if op in SPECS or TABLE_TO_SPEC.get(op) in SPECS]
-    assert len(swept) >= 300, (len(swept), "of", len(implemented))
+    assert len(swept) >= 310, (len(swept), "of", len(implemented))
 
 
 def test_no_dead_entries():
