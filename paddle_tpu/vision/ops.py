@@ -387,7 +387,10 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         def per_img(feat, yy, xx, *mk):
             # feat [cin, h, w]; yy/xx [g, ho, wo, kh, kw]
             def per_group(fg, ygg, xgg):
-                return _bilinear_gather(fg, ygg, xgg)  # [cg, ho,wo,kh,kw]
+                # deformable_im2col convention: OOB corners contribute 0
+                return _bilinear_gather(
+                    fg, ygg, xgg,
+                    zero_outside_corners=True)  # [cg, ho,wo,kh,kw]
 
             v = jax.vmap(per_group)(feat.reshape(g, cg, h, wdt), yy, xx)
             if mk:
@@ -419,8 +422,14 @@ def _roi_batch_index(boxes_num, num_rois):
     return jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
 
 
-def _bilinear_gather(feat, y, x):
-    """feat [C, H, W]; y/x [...] float coords -> [C, ...]."""
+def _bilinear_gather(feat, y, x, zero_outside_corners=False):
+    """feat [C, H, W]; y/x [...] float coords -> [C, ...].
+
+    ``zero_outside_corners=False`` clamps corner reads to the image (the
+    reference RoIAlign's `bilinear_interpolate` convention);
+    ``True`` drops out-of-image corners entirely (the reference
+    deformable-conv `deformable_im2col` convention — the two kernels
+    genuinely differ at borders)."""
     h, w = feat.shape[-2], feat.shape[-1]
     y0 = jnp.floor(y)
     x0 = jnp.floor(x)
@@ -434,9 +443,17 @@ def _bilinear_gather(feat, y, x):
         xi = jnp.clip(xx.astype(jnp.int32), 0, w - 1)
         return feat[:, yi, xi]  # [C, ...]
 
+    def cw(yy, xx, wgt):
+        if not zero_outside_corners:
+            return wgt
+        inside = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+        return jnp.where(inside, wgt, 0.0)
+
     valid = (y > -1.0) & (y < h) & (x > -1.0) & (x < w)
-    out = (g(y0, x0) * (wy0 * wx0) + g(y0, x1) * (wy0 * wx1)
-           + g(y1, x0) * (wy1 * wx0) + g(y1, x1) * (wy1 * wx1))
+    out = (g(y0, x0) * cw(y0, x0, wy0 * wx0)
+           + g(y0, x1) * cw(y0, x1, wy0 * wx1)
+           + g(y1, x0) * cw(y1, x0, wy1 * wx0)
+           + g(y1, x1) * cw(y1, x1, wy1 * wx1))
     return jnp.where(valid[None], out, 0.0)
 
 

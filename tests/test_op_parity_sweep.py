@@ -85,10 +85,14 @@ WHITELIST = {
     # vision/detection compound ops with dedicated tests
     "yolo_loss": "tests/test_vision_ops.py",
     "matrix_nms": "tests/test_vision_ops.py",
-    "multiclass_nms3": "tests/test_vision_ops.py",
+    "multiclass_nms3": "behavior invariants in "
+                       "test_multiclass_nms_invariants below (the op is "
+                       "host-side numpy; an external numpy reference "
+                       "would duplicate it)",
     "roi_pool": "tests/test_vision_ops.py",
     "generate_proposals": "tests/test_vision_ops.py",
-    "deformable_conv": "tests/test_vision_ops.py",
+    "deformable_conv": "test_deform_conv_zero_offset_equals_conv and "
+                       "the np-loop parity test below",
     "decode_jpeg": "needs a jpeg file (tests/test_vision_ops.py)",
     # conv/pool/interp variants covered by dedicated layer tests; the
     # sweep keeps one representative per family (conv2d, pool2d)
@@ -290,3 +294,92 @@ def test_model_average_behavior():
     with ma.apply(need_restore=True):
         np.testing.assert_allclose(w.numpy(), [-2.0, -2.0], atol=1e-6)
     np.testing.assert_allclose(w.numpy(), [-3.0, -3.0], atol=1e-6)
+
+
+def test_multiclass_nms_invariants():
+    """multiclass_nms (host-side): every kept row is above the score
+    threshold, rows are per-image score-sorted, and two identical boxes
+    of one class never both survive."""
+    rng = np.random.RandomState(0)
+    boxes = rng.rand(1, 6, 4).astype(np.float32) * 10
+    boxes[..., 2:] += boxes[..., :2] + 1  # valid x2>x1, y2>y1
+    boxes[0, 1] = boxes[0, 0]             # exact duplicate of box 0
+    scores = rng.rand(1, 3, 6).astype(np.float32)
+    scores[0, 1, 0] = 0.9
+    scores[0, 1, 1] = 0.8                 # duplicate, lower score
+    out, idx, num = paddle.vision.ops.multiclass_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.2, nms_top_k=10, keep_top_k=10,
+        nms_threshold=0.5, return_index=True)
+    o = np.asarray(out.numpy()).reshape(-1, 6)
+    assert (o[:, 1] >= 0.2).all()
+    assert (np.diff(o[:, 1]) <= 1e-6).all()  # score-sorted
+    # identical boxes of ONE class never both survive (IoU 1 > 0.5):
+    # count class-1 detections whose coords equal the duplicated box
+    dup_coords = boxes[0, 0]
+    cls1 = o[o[:, 0] == 1]
+    same = np.all(np.isclose(cls1[:, 2:], dup_coords[None], atol=1e-5),
+                  axis=1)
+    assert same.sum() <= 1, cls1
+    assert int(np.asarray(num.numpy())[0]) == len(o)
+
+
+def _np_deform_conv(x, offset, w):
+    # deformable_groups=1, stride 1, no pad/dilation, v1 (no mask)
+    n, cin, h, wid = x.shape
+    cout, _, kh, kw = w.shape
+    ho, wo = h - kh + 1, wid - kw + 1
+    off = offset.reshape(n, kh * kw, 2, ho, wo)
+
+    def bil(img, y, xx):
+        if y <= -1 or y >= img.shape[0] or xx <= -1 or xx >= img.shape[1]:
+            return 0.0
+        y0, x0 = int(np.floor(y)), int(np.floor(xx))
+        vals = 0.0
+        for (yi, xi) in [(y0, x0), (y0, x0 + 1), (y0 + 1, x0),
+                         (y0 + 1, x0 + 1)]:
+            if 0 <= yi < img.shape[0] and 0 <= xi < img.shape[1]:
+                wgt = (1 - abs(y - yi)) * (1 - abs(xx - xi))
+                if wgt > 0:
+                    vals += wgt * img[yi, xi]
+        return vals
+
+    out = np.zeros((n, cout, ho, wo), np.float32)
+    for b in range(n):
+        for i in range(ho):
+            for j in range(wo):
+                for ki in range(kh):
+                    for kj in range(kw):
+                        tap = ki * kw + kj
+                        dy = off[b, tap, 0, i, j]
+                        dx = off[b, tap, 1, i, j]
+                        y, xx = i + ki + dy, j + kj + dx
+                        for ci in range(cin):
+                            v = bil(x[b, ci], y, xx)
+                            out[b, :, i, j] += w[:, ci, ki, kj] * v
+    return out
+
+
+def test_deform_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    zero_off = np.zeros((1, 18, 4, 4), np.float32)
+    out = paddle.vision.ops.deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(zero_off),
+        paddle.to_tensor(w)).numpy()
+    ref = paddle.nn.functional.conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(w)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv_offset_parity_vs_np_loop():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    off = (rng.randn(1, 18, 4, 4) * 0.5).astype(np.float32)
+    out = paddle.vision.ops.deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(off),
+        paddle.to_tensor(w)).numpy()
+    ref = _np_deform_conv(x, off, w)
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
