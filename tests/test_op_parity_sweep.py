@@ -77,7 +77,7 @@ WHITELIST = {
     "rnn": "recurrent stack parity in tests/test_nn.py",
     "einsum": None,  # specced
     "batch_norm": "train/eval moments parity in tests/test_nn.py",
-    "sync_batch_norm_": "mesh-synced BN in tests/test_distributed.py",
+    "sync_batch_norm_": "mesh-synced BN in tests/test_nn.py",
     "instance_norm": "norm parity in tests/test_nn.py",
     "group_norm": "norm parity in tests/test_nn.py",
     "spectral_norm": "power-iteration parity in "
