@@ -220,6 +220,10 @@ def ensure_env() -> ParallelEnv:
                 "all visible devices. Call fleet.init(...) explicitly to "
                 "choose a topology.", stacklevel=3)
         init_mesh(dp=-1)
+        # mark the env as implicitly manufactured — test harnesses reset
+        # these between tests so one test's collective cannot leave the
+        # whole suite running under a surprise mesh
+        _global_env.auto_initialized = True
     return _global_env
 
 

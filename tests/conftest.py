@@ -46,6 +46,15 @@ def _seed_all():
     paddle_tpu.seed(2024)
     np.random.seed(2024)
     yield
+    # tear down any mesh a test left behind through the implicit
+    # ensure_env() path (one test's collective must not put the rest of
+    # the suite under a surprise 8-device mesh); explicitly initialized
+    # meshes (fleet.init / init_mesh in fixtures) are left alone
+    from paddle_tpu.distributed import env as _env
+
+    e = _env.get_env()
+    if e is not None and getattr(e, "auto_initialized", False):
+        _env.reset_env()
 
 
 @pytest.fixture(scope="session")
