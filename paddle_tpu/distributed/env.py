@@ -155,6 +155,16 @@ def put_replicated(x, mesh):
         np.asarray(x), mesh, PartitionSpec())
 
 
+def ensure_on_mesh(a, mesh):
+    """Replicate a concrete array onto ``mesh`` iff it is not already on
+    that mesh's device set — the one placement predicate shared by the
+    param-place hook and the generation path."""
+    if isinstance(a, jax.Array) \
+            and len(a.sharding.device_set) != mesh.size:
+        return put_replicated(a, mesh)
+    return a
+
+
 def _install_mesh_hook(mesh):
     """Teach the op dispatcher to replicate off-mesh eager operands onto the
     mesh (mixing a host-side batch with sharded params is the common case),
@@ -170,9 +180,7 @@ def _install_mesh_hook(mesh):
     repl = NamedSharding(mesh, PartitionSpec())
 
     def place_param(arr):
-        if isinstance(arr, jax.Array) and len(arr.sharding.device_set) != n_mesh:
-            return put_replicated(arr, mesh)
-        return arr
+        return ensure_on_mesh(arr, mesh)
 
     _core.set_param_place_hook(place_param)
 

@@ -26,12 +26,6 @@ from ..framework.core import Tensor
 __all__ = ["generate"]
 
 
-def _replicated(e):
-    from jax.sharding import NamedSharding, PartitionSpec
-
-    return NamedSharding(e.mesh, PartitionSpec())
-
-
 def _collect_params(model):
     """Pull the Llama weight pytree out of the Layer graph (stacked per
     layer so the decode program scans over layers, O(1) compile in
@@ -297,13 +291,30 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     params = _collect_params(model)
     ids = input_ids._data if isinstance(input_ids, Tensor) \
         else jnp.asarray(np.asarray(input_ids))
-    # under a live mesh the weights carry NamedShardings; inputs must sit
-    # on the same device set (replicated) or jit rejects the mix
+    # every operand must sit on one device set or jit rejects the mix.
+    # Two asymmetric cases exist in the wild: (a) a live mesh with
+    # weights created BEFORE it existed (model built pre-fleet.init —
+    # the param-place hook only covers params created after install);
+    # (b) NO live env but mesh-placed weights (a TP-annotated model
+    # whose env was reset/re-made — the arrays keep their NamedShardings).
+    # Normalize to the mesh the params carry, else the live env's mesh.
+    from jax.sharding import NamedSharding
+
     from ..distributed import env as env_mod
 
     e = env_mod.get_env()
-    if e is not None:
-        ids = jax.device_put(ids, _replicated(e))
+    param_mesh = None
+    for a in jax.tree_util.tree_leaves(params):
+        s = getattr(a, "sharding", None)
+        if isinstance(s, NamedSharding) and len(s.device_set) > 1:
+            param_mesh = s.mesh
+            break
+    if param_mesh is None and e is not None:
+        param_mesh = e.mesh
+    if param_mesh is not None:
+        ids = env_mod.put_replicated(ids, param_mesh)
+        params = jax.tree_util.tree_map(
+            lambda a: env_mod.ensure_on_mesh(a, param_mesh), params)
     if top_k:
         top_k = min(int(top_k), model.config.vocab_size)
     key_pad = None
@@ -326,8 +337,8 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
                 "expressible in the cache layout")
         if npad_h.any():  # all-ones mask == no mask: share the
             key_pad = jnp.asarray(npad_h, jnp.int32)  # maskless program
-            if e is not None:
-                key_pad = jax.device_put(key_pad, _replicated(e))
+            if param_mesh is not None:
+                key_pad = env_mod.put_replicated(key_pad, param_mesh)
     out = _generate_jit(
         params, ids.astype(jnp.int32), jax.random.key(seed),
         jnp.float32(temperature), jnp.float32(top_p), key_pad,

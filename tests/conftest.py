@@ -46,10 +46,17 @@ def _seed_all():
     paddle_tpu.seed(2024)
     np.random.seed(2024)
     yield
-    # tear down any mesh a test left behind through the implicit
-    # ensure_env() path (one test's collective must not put the rest of
-    # the suite under a surprise 8-device mesh); explicitly initialized
-    # meshes (fleet.init / init_mesh in fixtures) are left alone
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _reset_auto_mesh():
+    """Tear down any mesh a module left behind through the implicit
+    ensure_env() path (one module's collective must not put the rest of
+    the suite under a surprise 8-device mesh — pytest-randomly exposed
+    this). Module-scoped, not per-test: a module fixture's model may
+    legitimately live on the auto mesh for the whole module. Explicit
+    fleet.init/init_mesh fixtures manage their own teardown."""
+    yield
     from paddle_tpu.distributed import env as _env
 
     e = _env.get_env()
