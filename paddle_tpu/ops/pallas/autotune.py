@@ -128,23 +128,88 @@ def _candidates(seq: int):
     return out or [seq]
 
 
-def _time_compiled(fn, args, iters=20) -> float:
-    """Amortized per-iteration seconds: `iters` dependent applications
-    inside ONE compiled program (the honest method through a tunnel)."""
+_sync_overhead: Dict[str, float] = {}
 
-    @jax.jit
-    def loop(*a):
-        def body(_, q):
-            r = fn(q, *a[1:])
-            # keep a data dependence so XLA cannot hoist the loop body
-            return q + 0.0 * r[..., :1].astype(q.dtype).mean()
 
-        return jax.lax.fori_loop(0, iters, body, a[0])
+def _time_compiled(fn, args, iters=20, n_hint=None) -> float:
+    """Amortized per-iteration seconds.
 
-    loop(*args).block_until_ready()  # compile + warm
-    t0 = time.perf_counter()
-    loop(*args).block_until_ready()
-    return (time.perf_counter() - t0) / iters
+    Two tunnel realities shape this method (both produced plausible-looking
+    0.01 ms "measurements" for s=4096 attention — 30x past chip peak —
+    before they were fixed):
+
+    - the sync is a device->host transfer (`float(out[0, ...])`) — certain
+      to fence on every backend, measured equal-cost to block_until_ready
+      through the tunnel (~70-95 ms either way).
+    - that per-sync overhead dwarfs sub-ms kernels and jitters by ~±15 ms.
+      So time TWO compiled loops (n and 4*n dependent applications) and
+      divide the DIFFERENCE by 3*n: the constant sync + dispatch overhead
+      cancels, and n is sized so the difference carries ~600 ms of kernel
+      time.
+
+    The loop body feeds the output back as the next query — a true data
+    dependence (`q + 0.0 * r.mean()` gets algebraically simplified away
+    and the kernel DCE'd).
+    """
+
+    def make(n):
+        @jax.jit
+        def loop(*a):
+            def body(_, q):
+                r = fn(q, *a[1:])
+                if r.shape == q.shape:
+                    return r.astype(q.dtype)
+                return q + r.astype(q.dtype).sum() * 1e-12
+
+            return jax.lax.fori_loop(0, n, body, a[0])
+
+        return loop
+
+    def run(loop):
+        t0 = time.perf_counter()
+        out = loop(*args)
+        float(out[(0,) * out.ndim])  # full sync (transfer-backed)
+        return time.perf_counter() - t0
+
+    if iters < 16 and jax.default_backend() == "cpu":
+        # smoke mode (interpret-mode CPU tests): one short loop, no
+        # calibration — accuracy is irrelevant, wall-clock is not.
+        # CPU-only: on a real backend small --iters still calibrates, so
+        # a hardware tune can never persist uncalibrated numbers.
+        loop = make(iters)
+        run(loop)  # compile + warm
+        return max(run(loop), 1e-9) / iters
+
+    # constant dispatch+sync overhead (~70-95 ms through the tunnel,
+    # ~1 ms on an attached chip): a property of the harness, not of fn —
+    # measure once per backend and memoize
+    overhead = _sync_overhead.get(jax.default_backend())
+    if overhead is None:
+        empty = make(0)
+        run(empty)
+        overhead = min(run(empty) for _ in range(2))
+        _sync_overhead[jax.default_backend()] = overhead
+    # calibrate: size n so the long-short difference carries ~600 ms of
+    # kernel time — well above the measured ~±15 ms sync jitter.
+    # Candidates of one shape/direction run within a small factor of each
+    # other, so callers may share a calibration via n_hint (a mutable
+    # dict) instead of paying the ~3 calibration runs per candidate.
+    if n_hint and "n" in n_hint:
+        n = n_hint["n"]
+    else:
+        cal_n = max(iters, 128)
+        cal = make(cal_n)
+        run(cal)  # compile + warm
+        t_cal = min(run(cal) for _ in range(2))
+        t_est = max((t_cal - overhead) / cal_n, 2e-7)
+        n = int(min(max(0.6 / (3 * t_est), 8), 20000))
+        if n_hint is not None:
+            n_hint["n"] = n
+
+    short, long_ = make(n), make(4 * n)
+    run(short), run(long_)  # compile + warm both
+    deltas = sorted(run(long_) - run(short) for _ in range(3))
+    return max(deltas[1], 1e-9) / (3 * n)
 
 
 def tune_shape(bh: int, sq: int, sk: int, d: int, causal: bool,
@@ -170,24 +235,48 @@ def tune_shape(bh: int, sq: int, sk: int, d: int, causal: bool,
 
     def gradify(f):
         def g(q, k, v):
-            return jax.grad(
+            dq, dk, dv = jax.grad(
                 lambda *a: f(*a).astype(jnp.float32).sum(),
-                argnums=(0, 1, 2))(q, k, v)[0]
+                argnums=(0, 1, 2))(q, k, v)
+            # fold every grad into the timing dependence — returning dq
+            # alone lets XLA DCE the dk/dv computation (measured: "bwd"
+            # adding only 0.2 ms on a 2.5x-fwd-FLOPs pass). For
+            # cross-length shapes dk/dv have sk rows, not sq: fold a
+            # seq-reduced broadcast instead of a direct add.
+            r = dq
+            for dother in (dk, dv):
+                if dother.shape == r.shape:
+                    r = r + dother
+                else:
+                    r = r + dother.sum(axis=-2, keepdims=True) * 1e-6
+            return r
 
         return g
 
-    t_comp_fwd = _time_compiled(composite, (q, k, v), iters)
-    t_comp_fb = _time_compiled(gradify(composite), (q, k, v), iters)
+    # the composite baseline may OOM at long-context shapes (it
+    # materializes the [sq, sk] score matrix the flash kernel exists to
+    # avoid) — tune the kernel anyway, just without an engagement ratio
+    try:
+        t_comp_fwd = _time_compiled(composite, (q, k, v), iters)
+        t_comp_fb = _time_compiled(gradify(composite), (q, k, v), iters)
+    except Exception as e:  # noqa: BLE001 — baseline OOM must not stop tuning
+        if verbose:
+            print(f"  composite baseline failed ({type(e).__name__}); "
+                  f"tuning kernel without a ratio", flush=True)
+        t_comp_fwd = t_comp_fb = None
 
     results = []
+    hint_fwd, hint_fb = {}, {}  # one calibration per direction, shared
     for bq in _candidates(sq):
         for bk in _candidates(sk):
             def run(q, k, v, _bq=bq, _bk=bk):
                 return _flash_bhsd(q, k, v, causal, scale, False, _bq, _bk)
 
             try:
-                t_fwd = _time_compiled(run, (q, k, v), iters)
-                t_fb = _time_compiled(gradify(run), (q, k, v), iters)
+                t_fwd = _time_compiled(run, (q, k, v), iters,
+                                       n_hint=hint_fwd)
+                t_fb = _time_compiled(gradify(run), (q, k, v), iters,
+                                      n_hint=hint_fb)
             except Exception as e:  # noqa: BLE001 — a bad tiling skips
                 if verbose:
                     print(f"  ({bq},{bk}): failed {type(e).__name__}",
@@ -207,14 +296,17 @@ def tune_shape(bh: int, sq: int, sk: int, d: int, causal: bool,
         "block_q": bq, "block_k": bk,
         "t_fwd_ms": round(t_fwd * 1e3, 4),
         "t_fwd_bwd_ms": round(t_fb * 1e3, 4),
-        "t_xla_fwd_ms": round(t_comp_fwd * 1e3, 4),
-        "t_xla_fwd_bwd_ms": round(t_comp_fb * 1e3, 4),
-        "ratio_fwd": round(t_comp_fwd / t_fwd, 4),
-        "ratio_fwd_bwd": round(t_comp_fb / t_fb, 4),
         "device": getattr(dev, "device_kind", str(dev)),
         "backend": jax.default_backend(),
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if t_comp_fwd is not None:
+        entry.update({
+            "t_xla_fwd_ms": round(t_comp_fwd * 1e3, 4),
+            "t_xla_fwd_bwd_ms": round(t_comp_fb * 1e3, 4),
+            "ratio_fwd": round(t_comp_fwd / t_fwd, 4),
+            "ratio_fwd_bwd": round(t_comp_fb / t_fb, 4),
+        })
     cache = load_cache()
     cache.setdefault("entries", {})[_key(sq, sk, d, causal)] = entry
     save_cache(cache)

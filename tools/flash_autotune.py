@@ -56,12 +56,13 @@ def main():
 
     from paddle_tpu.utils import measurements as meas
 
-    wins = sum(1 for e in entries if e["ratio_fwd_bwd"] > 1.0)
+    wins = sum(1 for e in entries if e.get("ratio_fwd_bwd", 0) > 1.0)
     meas.record_or_warn(
         "flash_autotune_shapes_kernel_wins", float(wins), "shapes",
         extra={"tuned": len(entries),
-               "entries": {f"s{e['sq']}d{e['d']}": e["ratio_fwd_bwd"]
-                           for e in entries}})
+               "entries": {
+                   autotune._key(e["sq"], e["sk"], e["d"], e["causal"]):
+                   e.get("ratio_fwd_bwd") for e in entries}})
     print(f"flash_autotune: {wins}/{len(entries)} shapes favor the "
           f"kernel; cache at paddle_tpu/ops/pallas/flash_tune.json",
           flush=True)

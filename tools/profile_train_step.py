@@ -63,7 +63,8 @@ def _breakdown_from_xplane(paths):
             if op_tids and (ev.get("pid"), ev.get("tid")) not in op_tids:
                 continue
             name = ev.get("name", "")
-            low = name.lower()
+            hlo_cat = (ev.get("args") or {}).get("hlo_category", "")
+            low = (hlo_cat + " " + name).lower()
             if any(k in low for k in ("fusion", "dot", "conv", "matmul")):
                 cat = "matmul/fusion"
             elif any(k in low for k in ("custom-call", "mosaic", "flash")):
@@ -111,11 +112,26 @@ def main():
     float(np.asarray(step(ids, labels).numpy()).sum())
     os.makedirs(args.outdir, exist_ok=True)
     before = _trace_files(args.outdir)
+    # python/host tracers off: their ~1M events per few steps exhaust the
+    # trace budget and truncate away the device per-op lane — the one
+    # lane this tool exists to read
+    try:
+        opts = jax.profiler.ProfileOptions()
+        opts.python_tracer_level = 0
+        opts.host_tracer_level = 1
+    except AttributeError:  # older jax: no options — trace anyway
+        opts = None
     t0 = time.perf_counter()
-    with jax.profiler.trace(args.outdir):
+    if opts is not None:
+        jax.profiler.start_trace(args.outdir, profiler_options=opts)
+    else:  # older jax predates the kwarg too — omit it entirely
+        jax.profiler.start_trace(args.outdir)
+    try:
         for _ in range(args.steps):
             loss = step(ids, labels)
-        loss._data.block_until_ready()
+        float(np.asarray(loss.numpy()).sum())  # transfer-backed sync
+    finally:
+        jax.profiler.stop_trace()
     wall = time.perf_counter() - t0
     tokens_per_sec = batch * seq * args.steps / wall
     mfu = (tokens_per_sec * model.flops_per_token(seq)
