@@ -124,7 +124,9 @@ def build_headline_trainstep(on_cpu: bool):
         batch, seq = 2, 64
     else:
         # sized for a single v5e chip (16G HBM): ~0.44B params, bf16 +
-        # fp32 masters + Adam moments ≈ 6G, activations ≈ 4G at b4×s1024.
+        # fp32 masters + Adam moments ≈ 5.7G, activations ≈ 5.6G at the
+        # b8×s1024 default (11.3 GiB peak measured; b12 hits 14.1 and
+        # regresses — see PERF.md batch sweep).
         # PT_BENCH_CE_CHUNK>0 switches the loss to the chunked CE (no
         # [N, V] fp32 logits) — the candidate MFU lever to A/B on
         # hardware (see PERF.md).
@@ -134,7 +136,9 @@ def build_headline_trainstep(on_cpu: bool):
             max_position_embeddings=1024, dtype="bfloat16",
             use_parallel_cross_entropy=False,
             ce_chunk_size=int(os.environ.get("PT_BENCH_CE_CHUNK", "0")))
-        batch, seq = 4, 1024
+        # b8 measured MFU 0.647 vs 0.578 at b4 (+12%: 8192 rows fill the
+        # MXU M dim; b10/b12 regress on HBM pressure) — PT_BENCH_BATCH to A/B
+        batch, seq = int(os.environ.get("PT_BENCH_BATCH", "8")), 1024
     pt.seed(0)
     model = LlamaForCausalLM(cfg)
     if cfg.dtype == "bfloat16":
@@ -221,6 +225,7 @@ def main():
                          extra={"mfu": round(mfu, 4),
                                 "vs_baseline": round(mfu / 0.45, 4),
                                 "batch": batch, "seq": seq,
+                                "ce_chunk": model.config.ce_chunk_size,
                                 "model_params_b": extra["model_params_b"]})
         except Exception as e:  # noqa: BLE001
             print(f"bench: measurement persist failed: {e}",

@@ -110,8 +110,9 @@ def kernel_beats_composite(sq: int, sk: int, d: int, causal: bool
     """Measured engagement decision; None when no measurement applies.
 
     Exact-shape hits only: the win/lose ratio flips across the measured
-    seq crossover (composite wins at s=1024 d=128, kernel at s=2048), so
-    transferring it one octave would invert the decision exactly there.
+    seq crossover (round-4 DCE-free timing: composite wins at s=512,
+    kernel from s=1024 — 3.4-6.1x, growing with seq), so transferring
+    the verdict one octave would invert it exactly at the crossover.
     Block sizes transfer (see `best_blocks`); the binary verdict does not.
     """
     e = lookup(sq, sk, d, causal, exact=True)

@@ -429,10 +429,10 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
         return fallback(0.0)
     # engagement is measurement-driven: the autotune cache stores the
     # kernel-vs-composite fwd+bwd ratio per shape (tools/flash_autotune.py
-    # on hardware). Where no measurement applies, fall back to the round-3
-    # measured heuristic (PERF.md, TPU v5e wall-clock): composite wins at
-    # short seq with wide heads (0.73x at s=1024 d=128 fwd+bwd); kernel
-    # wins from s>=2048 at any d, and at every length for d<=64.
+    # on hardware). Where no measurement applies, fall back to the round-4
+    # measured crossover (PERF.md, TPU v5e, DCE-free differential timing):
+    # the kernel wins from seq >= 1024 at every measured head_dim (3.4-5.2x);
+    # the composite wins below (0.37x at s=512 d=64).
     from . import autotune as _tune
 
     bq_t = bk_t = None
@@ -440,7 +440,7 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
         beats = _tune.kernel_beats_composite(sq, sk, d, causal)
         if beats is False:
             return fallback(0.0)
-        if beats is None and max(sq, sk) < 2048 and d > 64:
+        if beats is None and max(sq, sk) < 1024:
             return fallback(0.0)
         bq_t, bk_t = _tune.best_blocks(sq, sk, d, causal)
     scale = 1.0 / math.sqrt(d)

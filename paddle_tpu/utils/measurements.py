@@ -191,11 +191,20 @@ def _is_hw(rec: Dict[str, Any]) -> bool:
     return rec.get("backend") not in (None, "cpu", "unknown")
 
 
-def last_good(metric: str) -> Optional[Dict[str, Any]]:
-    """Most recent real-hardware record for ``metric`` (None if none)."""
+def last_good(metric: str,
+              match: Optional[Dict[str, Any]] = None
+              ) -> Optional[Dict[str, Any]]:
+    """Most recent real-hardware record for ``metric`` (None if none).
+
+    ``match`` filters on extra fields — e.g. ``{"batch": 8, "seq": 1024}``
+    skips over sweep points at other configs instead of returning them."""
     for rec in reversed(_load()["records"]):
-        if rec.get("metric") == metric and _is_hw(rec):
-            return rec
+        if rec.get("metric") != metric or not _is_hw(rec):
+            continue
+        ex = rec.get("extra") or {}
+        if match and any(ex.get(k) != v for k, v in match.items()):
+            continue
+        return rec
     return None
 
 
