@@ -28,6 +28,8 @@ BENCHES = [
     ("resnet", [sys.executable, "benchmarks/baseline_configs.py",
                 "--resnet-only"], 2400),
     ("ernie", [sys.executable, "benchmarks/ernie_bench.py"], 1800),
+    ("longcontext", [sys.executable, "benchmarks/longcontext_bench.py"],
+     2400),
     ("flashtune", [sys.executable, "tools/flash_autotune.py"], 2400),
     ("profile", [sys.executable, "tools/profile_train_step.py"], 1800),
 ]
