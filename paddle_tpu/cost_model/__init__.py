@@ -77,15 +77,16 @@ class CostModel:
                                 else val)
             if not ok:
                 continue
+            from ..utils.timing import device_sync
+
             try:
                 fn = jax.jit(lambda *a, _f=op.fn, _s=op.static:
                              _f(*a, **_s))
-                out = fn(*args)
-                jax.block_until_ready(out)
+                device_sync(fn(*args))
                 t0 = time.perf_counter()
                 for _ in range(repeat):
                     out = fn(*args)
-                jax.block_until_ready(out)
+                device_sync(out)
                 dt = (time.perf_counter() - t0) / repeat
             except Exception:  # noqa: BLE001 — a non-jittable op is skipped
                 continue

@@ -467,9 +467,15 @@ def barrier(group=None):
             f = jax.jit(shard_map(lambda x: jax.lax.psum(x, ax), mesh=e.mesh,
                                   in_specs=P(), out_specs=P()))
             _barrier_fns[e.mesh] = f
-        f(jnp.ones(())).block_until_ready()
+        from ..utils.timing import device_sync
+
+        # transfer-backed fence: block_until_ready acks enqueue, not
+        # completion, through tunneled PJRT plugins (utils/timing.py)
+        device_sync(f(jnp.ones(())))
         return None
-    jnp.zeros(()).block_until_ready()
+    from ..utils.timing import device_sync
+
+    device_sync(jnp.zeros(()))
     return None
 
 
@@ -479,7 +485,9 @@ _barrier_fns: dict = {}
 
 def wait(tensor, group=None, use_calc_stream=True):
     if isinstance(tensor, Tensor):
-        tensor._data.block_until_ready()
+        from ..utils.timing import device_sync
+
+        device_sync(tensor._data)
 
 
 # ---- object collectives (host-side; parity communication/all_gather_object) ----

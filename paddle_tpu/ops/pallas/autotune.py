@@ -139,9 +139,10 @@ def _time_compiled(fn, args, iters=20, n_hint=None) -> float:
     0.01 ms "measurements" for s=4096 attention — 30x past chip peak —
     before they were fixed):
 
-    - the sync is a device->host transfer (`float(out[0, ...])`) — certain
-      to fence on every backend, measured equal-cost to block_until_ready
-      through the tunnel (~70-95 ms either way).
+    - the sync is a device->host transfer (`float(out[0, ...])`) — the
+      only fence that is strong on every backend: through the tunnel,
+      block_until_ready acks enqueue rather than completion (see
+      utils/timing.py), and a transfer costs ~70-95 ms.
     - that per-sync overhead dwarfs sub-ms kernels and jitters by ~±15 ms.
       So time TWO compiled loops (n and 4*n dependent applications) and
       divide the DIFFERENCE by 3*n: the constant sync + dispatch overhead
