@@ -51,7 +51,8 @@ def main():
             num_task_attention_heads=8, task_intermediate_size=2048,
             max_position_embeddings=1024, dtype="bfloat16",
             hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
-        batch, seq, steps, warmup = 4, 1024, 10, 2
+        batch = int(os.environ.get("PT_ERNIE_BATCH", "4"))
+        seq, steps, warmup = 1024, 10, 2
     model = ErnieForPretraining(cfg)
     if cfg.dtype == "bfloat16":
         for p in model.parameters():

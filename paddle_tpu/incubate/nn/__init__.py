@@ -137,6 +137,16 @@ class functional:
     def fused_rotary_position_embedding(q, k, v=None, sin=None, cos=None,
                                         position_ids=None,
                                         use_neox_rotary_style=True):
+        if not use_neox_rotary_style:
+            from ...framework.errors import UnimplementedError
+
+            raise UnimplementedError(
+                "use_neox_rotary_style=False (interleaved GPT-J pairing) "
+                "is not implemented: this build uses the half-split NeoX "
+                "pairing, which is TPU-lane-friendly (the interleaved "
+                "pairing lowers to stride-2 relayout copies). Permute "
+                "head_dim as d[2i]->d[i], d[2i+1]->d[i+d/2] to convert "
+                "weights/activations between the conventions.")
         from ...models.llama import apply_rotary_pos_emb
 
         q2, k2 = apply_rotary_pos_emb(q, k)

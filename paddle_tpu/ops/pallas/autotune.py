@@ -323,6 +323,13 @@ STANDARD_SHAPES = [
     (32, 512, 512, 64, True),
     (24, 2048, 2048, 128, True),
     (12, 4096, 4096, 128, True),
+    # long-context legs (composite may OOM-skip; kernel still tunes)
+    (8, 8192, 8192, 128, True),
+    (4, 16384, 16384, 128, True),
+    # non-causal (encoder / BERT-shape) engagement rows
+    (768, 512, 512, 64, False),
+    (48, 1024, 1024, 64, False),
+    (48, 1024, 1024, 128, False),
 ]
 
 
