@@ -1,0 +1,89 @@
+"""RoPE invariants for the shared helper (models/llama._rope).
+
+The property that makes rotary embeddings work — and that any pairing
+convention (half-split or interleaved) must satisfy — is that the
+rotated dot product depends on positions only through their DIFFERENCE:
+    <R(p) q, R(p') k> == <R(p+c) q, R(p'+c) k>  for any shift c.
+These tests pin that identity for the half-split convention this build
+uses (see docs/MIGRATION.md pitfall 5), plus norm preservation and the
+decode path's explicit-position consistency."""
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.models.llama import _rope
+
+B, S, H, D = 2, 16, 3, 32
+
+
+def _qk(seed):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    return q, k
+
+
+def _scores(qr, kr):
+    # [b, h, s, s] attention scores from rotated q/k
+    return jnp.einsum("bihd,bjhd->bhij", qr, kr)
+
+
+def test_relative_position_identity():
+    q, k = _qk(0)
+    base = jnp.arange(S, dtype=jnp.float32)
+    qr0, kr0 = _rope(q, k, 10000.0, jnp.float32, pos=base)
+    for shift in (1.0, 7.0, 1000.0):
+        qr, kr = _rope(q, k, 10000.0, jnp.float32, pos=base + shift)
+        np.testing.assert_allclose(np.asarray(_scores(qr, kr)),
+                                   np.asarray(_scores(qr0, kr0)),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_norm_preserved():
+    # rotation: per-position norms are unchanged
+    q, k = _qk(1)
+    qr, kr = _rope(q, k, 10000.0, jnp.float32)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(qr), axis=-1),
+        np.linalg.norm(np.asarray(q), axis=-1), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(kr), axis=-1),
+        np.linalg.norm(np.asarray(k), axis=-1), rtol=1e-5)
+
+
+def test_position_zero_is_identity():
+    q, k = _qk(2)
+    qr, kr = _rope(q, k, 10000.0, jnp.float32,
+                   pos=jnp.zeros((S,), jnp.float32))
+    np.testing.assert_allclose(np.asarray(qr), np.asarray(q), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(kr), np.asarray(k), atol=1e-6)
+
+
+def test_decode_position_slice_matches_full():
+    # rotating position i alone (the cached-decode path) must equal row i
+    # of the full-sequence rotation — train and decode cannot drift
+    q, k = _qk(3)
+    qr_full, kr_full = _rope(q, k, 10000.0, jnp.float32)
+    i = 5
+    qr_i, kr_i = _rope(q[:, i:i + 1], k[:, i:i + 1], 10000.0, jnp.float32,
+                       pos=jnp.asarray([float(i)], jnp.float32))
+    np.testing.assert_allclose(np.asarray(qr_i),
+                               np.asarray(qr_full[:, i:i + 1]), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(kr_i),
+                               np.asarray(kr_full[:, i:i + 1]), atol=1e-6)
+
+
+def test_half_split_pairing_layout():
+    # the documented convention: lane i pairs with lane i + d/2 and the
+    # pair rotates by freq_i — so zeroing the second half of a one-hot
+    # vector must put the sine component exactly in lane i + d/2
+    x = np.zeros((1, 1, 1, D), np.float32)
+    x[..., 3] = 1.0  # one-hot in the first half
+    pos = jnp.asarray([2.0], jnp.float32)
+    xr, _ = _rope(jnp.asarray(x), jnp.asarray(x), 10000.0, jnp.float32,
+                  pos=pos)
+    xr = np.asarray(xr)[0, 0, 0]
+    inv = 1.0 / (10000.0 ** (np.arange(0, D, 2, dtype=np.float32) / D))
+    ang = 2.0 * inv[3]
+    assert abs(xr[3] - np.cos(ang)) < 1e-6
+    assert abs(xr[3 + D // 2] - np.sin(ang)) < 1e-6
+    assert np.abs(np.delete(xr, [3, 3 + D // 2])).max() < 1e-6
