@@ -136,21 +136,49 @@ class functional:
     @staticmethod
     def fused_rotary_position_embedding(q, k, v=None, sin=None, cos=None,
                                         position_ids=None,
-                                        use_neox_rotary_style=True):
-        if not use_neox_rotary_style:
+                                        use_neox_rotary_style=True,
+                                        theta=10000.0):
+        # Paddle flag semantics (reference fused_rope_utils.h: the kernel
+        # rotates adjacent pairs pr=2i/ls=2i+1): use_neox_rotary_style=True
+        # = interleaved rotate-every-two; False = rotate_half (half-split).
+        # This build implements only the half-split pairing, which is
+        # TPU-lane-friendly — so the False path is served and the True
+        # (interleaved) path raises with a conversion recipe.
+        if use_neox_rotary_style:
             from ...framework.errors import UnimplementedError
 
             raise UnimplementedError(
-                "use_neox_rotary_style=False (interleaved GPT-J pairing) "
-                "is not implemented: this build uses the half-split NeoX "
-                "pairing, which is TPU-lane-friendly (the interleaved "
-                "pairing lowers to stride-2 relayout copies). Permute "
-                "head_dim as d[2i]->d[i], d[2i+1]->d[i+d/2] to convert "
-                "weights/activations between the conventions.")
-        from ...models.llama import apply_rotary_pos_emb
+                "use_neox_rotary_style=True (Paddle's interleaved "
+                "rotate-every-two pairing) is not implemented: this build "
+                "uses the half-split rotate_half pairing "
+                "(use_neox_rotary_style=False), which is TPU-lane-friendly "
+                "(the interleaved pairing lowers to stride-2 relayout "
+                "copies). Permute head_dim as d[2i]->d[i], "
+                "d[2i+1]->d[i+d/2] to convert weights/activations between "
+                "the conventions, then call with "
+                "use_neox_rotary_style=False.")
+        if sin is not None or cos is not None:
+            from ...framework.errors import UnimplementedError
 
-        q2, k2 = apply_rotary_pos_emb(q, k)
-        return (q2, k2, v) if v is not None else (q2, k2, None)
+            raise UnimplementedError(
+                "custom sin/cos tables are not supported by this build's "
+                "fused rope (they would need the caller's pairing "
+                "convention re-expressed in half-split lane order). Pass "
+                "position_ids (and the theta= kwarg for non-default "
+                "frequencies, e.g. Llama-3 theta=500000) instead.")
+        from ...models.llama import (apply_rotary_pos_emb,
+                                     apply_rotary_pos_emb_single)
+
+        q2, k2 = apply_rotary_pos_emb(q, k, theta=theta,
+                                      position_ids=position_ids)
+        if v is not None:
+            # reference fused_rope_utils.h rotates every provided input
+            # (q, k, AND v) identically — match that rather than passing
+            # v through unrotated.
+            v2 = apply_rotary_pos_emb_single(v, theta=theta,
+                                             position_ids=position_ids)
+            return q2, k2, v2
+        return q2, k2, None
 
     @staticmethod
     def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):

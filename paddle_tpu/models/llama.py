@@ -147,8 +147,11 @@ def _rope(q_arr, k_arr, theta, dtype, pos=None):
         sin = jnp.sin(freqs)[:, :, None, :]
 
     def rot(x):
-        # half-split (NeoX / HF-Llama) pairing: (x_i, x_{i+d/2}) rotated
-        # by freq_i. TPU-deliberate: the interleaved (x_{2i}, x_{2i+1})
+        # half-split rotate_half (HF-Llama) pairing: (x_i, x_{i+d/2})
+        # rotated by freq_i. (Beware Paddle's flag naming: its
+        # use_neox_rotary_style=True selects the *interleaved* pairing —
+        # see docs/MIGRATION.md pitfall 5.)
+        # TPU-deliberate: the interleaved (x_{2i}, x_{2i+1})
         # pairing needs stride-2 lane shuffles that XLA materializes as
         # relayout copies (~4% of the headline train step, profiled);
         # contiguous halves are cheap lane slices. Both are valid RoPE
@@ -161,15 +164,34 @@ def _rope(q_arr, k_arr, theta, dtype, pos=None):
         out = jnp.concatenate([xr1, xr2], axis=-1)
         return out.astype(dtype)
 
+    if k_arr is None:
+        return rot(q_arr.astype(jnp.float32)), None
     return rot(q_arr.astype(jnp.float32)), rot(k_arr.astype(jnp.float32))
 
 
-def apply_rotary_pos_emb(q, k, theta=10000.0):
+def apply_rotary_pos_emb(q, k, theta=10000.0, position_ids=None):
     """Paddle-shaped rope entry (parity: fused_rotary_position_embedding in
-    `paddle/incubate/nn/functional`)."""
+    `paddle/incubate/nn/functional`). ``position_ids`` ([s] or [b, s])
+    overrides the default arange positions (cached-decode offsets)."""
     dtype = q._data.dtype if isinstance(q, Tensor) else q.dtype
-    return apply("rope", lambda qa, ka: _rope(qa, ka, theta, dtype), (q, k),
-                 n_outputs=2)
+    pos = position_ids
+    if isinstance(pos, Tensor):
+        pos = pos._data
+    return apply("rope",
+                 lambda qa, ka: _rope(qa, ka, theta, dtype, pos=pos),
+                 (q, k), n_outputs=2)
+
+
+def apply_rotary_pos_emb_single(x, theta=10000.0, position_ids=None):
+    """Rotate one array (the fused-rope v input) without paying a second
+    rotation for a discarded slot."""
+    dtype = x._data.dtype if isinstance(x, Tensor) else x.dtype
+    pos = position_ids
+    if isinstance(pos, Tensor):
+        pos = pos._data
+    return apply("rope_single",
+                 lambda xa: _rope(xa, None, theta, dtype, pos=pos)[0],
+                 (x,))
 
 
 class LlamaAttention(Layer):

@@ -440,7 +440,11 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
         beats = _tune.kernel_beats_composite(sq, sk, d, causal)
         if beats is False:
             return fallback(0.0)
-        if beats is None and max(sq, sk) < 1024:
+        if beats is None and (max(sq, sk) < 1024 or not causal):
+            # the >=1024 crossover is extrapolated from CAUSAL
+            # measurements only (flash_tune.json has no non-causal
+            # >=1024 rows yet); unmeasured non-causal shapes stay on
+            # the composite until tools/flash_autotune.py measures them.
             return fallback(0.0)
         bq_t, bk_t = _tune.best_blocks(sq, sk, d, causal)
     scale = 1.0 / math.sqrt(d)

@@ -14,13 +14,19 @@ import jax
 
 def device_sync(out):
     """Block until `out` (any pytree of arrays) has actually been
-    computed, by fetching one element of its first leaf to the host.
+    computed, by fetching one element of EVERY leaf to the host (leaves
+    may come from separate dispatches, so fencing only the first would
+    leave the rest in flight; one scalar per leaf is cheap).
     Returns `out` so it can wrap expressions inline."""
-    leaves = [x for x in jax.tree_util.tree_leaves(out)
-              if hasattr(x, "dtype")]
-    if leaves:
-        leaf = leaves[0]
+    fetch = []
+    for leaf in jax.tree_util.tree_leaves(out):
+        if not hasattr(leaf, "dtype"):
+            continue
+        if getattr(leaf, "size", 1) == 0:
+            continue  # nothing to fetch; indexing would raise
         if getattr(leaf, "ndim", 0):
             leaf = leaf[(0,) * leaf.ndim]
-        jax.device_get(leaf)
+        fetch.append(leaf)
+    if fetch:
+        jax.device_get(fetch)
     return out

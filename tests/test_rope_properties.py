@@ -87,3 +87,61 @@ def test_half_split_pairing_layout():
     assert abs(xr[3] - np.cos(ang)) < 1e-6
     assert abs(xr[3 + D // 2] - np.sin(ang)) < 1e-6
     assert np.abs(np.delete(xr, [3, 3 + D // 2])).max() < 1e-6
+
+
+def test_incubate_fused_rope_flag_semantics():
+    # Paddle flag semantics (reference fused_rope_utils.h rotates adjacent
+    # pairs 2i/2i+1 — that is use_neox_rotary_style=True, interleaved):
+    # False = rotate_half (half-split) is what this build serves; True
+    # (interleaved) raises with a conversion recipe. Guards against
+    # re-inverting the mapping (round-4 advisor finding).
+    import pytest
+
+    from paddle_tpu.incubate.nn import functional as incubate_F
+    from paddle_tpu.models.llama import apply_rotary_pos_emb
+
+    q, k = _qk(4)
+    q2, k2, v2 = incubate_F.fused_rotary_position_embedding(
+        q, k, None, use_neox_rotary_style=False)
+    qe, ke = apply_rotary_pos_emb(q, k)
+    np.testing.assert_allclose(np.asarray(q2), np.asarray(qe), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(k2), np.asarray(ke), atol=1e-6)
+    assert v2 is None
+
+    from paddle_tpu.framework.errors import UnimplementedError
+    with pytest.raises(UnimplementedError, match="interleaved"):
+        incubate_F.fused_rotary_position_embedding(
+            q, k, None, use_neox_rotary_style=True)
+
+
+def test_incubate_fused_rope_v_and_position_ids():
+    import pytest
+
+    from paddle_tpu.incubate.nn import functional as incubate_F
+    from paddle_tpu.framework.errors import UnimplementedError
+    from paddle_tpu.models.llama import apply_rotary_pos_emb
+
+    q, k = _qk(5)
+    v, _ = _qk(6)
+    # v rotates identically to q/k (reference fused_rope_utils.h rotates
+    # every provided input)
+    q2, k2, v2 = incubate_F.fused_rotary_position_embedding(
+        q, k, v, use_neox_rotary_style=False)
+    ve, _ = apply_rotary_pos_emb(v, v)
+    np.testing.assert_allclose(np.asarray(v2), np.asarray(ve), atol=1e-6)
+
+    # position_ids shifts positions (decode offset): row 0 of a
+    # position_ids=[i] call equals row i of the full rotation
+    i = 3
+    qi, ki, _ = incubate_F.fused_rotary_position_embedding(
+        q[:, i:i + 1], k[:, i:i + 1], None,
+        position_ids=jnp.asarray([float(i)]), use_neox_rotary_style=False)
+    qf, kf = apply_rotary_pos_emb(q, k)
+    np.testing.assert_allclose(np.asarray(qi), np.asarray(qf[:, i:i + 1]),
+                               atol=1e-6)
+
+    # custom sin/cos tables raise rather than being silently dropped
+    with pytest.raises(UnimplementedError, match="sin/cos"):
+        incubate_F.fused_rotary_position_embedding(
+            q, k, None, sin=np.zeros((S, D)), cos=np.ones((S, D)),
+            use_neox_rotary_style=False)
