@@ -1,20 +1,42 @@
-"""Distributed checkpoint: sharded save + reshard-on-load.
+"""Distributed checkpoint: sharded per-region save + reshard-on-load.
 
-Reference parity: auto-parallel `dist_saver.py` + `converter.py` (SURVEY
-§5.4 — "re-shard checkpoints across different parallel configs (the
-converter.py capability is the important contract)") and the PP/TP
-checkpoint adaptors (`fleet/utils/pp_parallel_adaptor.py`).
+Reference parity: auto-parallel `dist_saver.py:53` (per-rank shard files) +
+`converter.py:25` (cross-config conversion) and the PP/TP checkpoint
+adaptors (`fleet/utils/pp_parallel_adaptor.py`); SURVEY §5.4 asks for the
+tensorstore/OCDBT-style contract: async sharded checkpoint keyed by global
+shape + sharding, with reshard-on-load.
 
 TPU-first design: tensors are GLOBAL arrays (sharding is placement, not
-identity), so the reference's shard-merging converter collapses: save writes
-each tensor's global value plus its layout metadata; load places the global
-value into whatever sharding the *destination* parameter currently has.
-Mesh-shape changes (tp4->tp8, pp on/off, ZeRO on/off) are therefore
-reshard-on-load by construction. Layout: one .npy per tensor + index.json —
-streamable per-tensor (no giant pickle), async-saveable.
+identity), so the reference's shard-merging converter collapses into
+layout metadata. Format (v2):
+
+  index.json                       {"format": 2, "tensors": {key: meta}}
+  <key>.r<start>x<start>....npy    one .npy PER SHARD REGION
+
+Save never materializes a tensor's global value: each unique shard region
+(deduped by ``replica_id == 0``) is fetched device->host on its own and
+streamed to its own file; single-device / host arrays stream in
+row-chunks through a memmap. Load never materializes the global value
+either: `jax.make_array_from_callback` asks for exactly the regions the
+*destination* sharding needs, and each region is assembled by slicing the
+overlapping shard files (mmap reads). Mesh-shape changes (tp4->tp8, pp
+on/off, ZeRO on/off) are therefore reshard-on-load by construction, at
+per-device memory cost.
+
+Async save bounds host memory: shard snapshots are produced into a
+byte-bounded queue (default 1 GiB in flight) and written by one writer
+thread — the full checkpoint is never resident on the host at once
+(the v1 design held a complete host copy per pending save).
+
+Multi-host: every process writes only its addressable ``replica_id == 0``
+shards (disjoint across processes by construction); the coordinator
+writes the index, enumerating all regions from the global sharding via
+``devices_indices_map`` — so a shared filesystem assembles the checkpoint
+with no cross-host gathers.
 """
 from __future__ import annotations
 
+import collections
 import json
 import os
 import re
@@ -26,6 +48,7 @@ import numpy as np
 from ..framework.core import Tensor
 
 _INDEX = "index.json"
+_CHUNK_BYTES = 64 << 20  # streaming-chunk size for unsharded tensors
 
 
 def _safe_name(key):
@@ -40,90 +63,397 @@ def _spec_of(arr):
     return [list(p) if isinstance(p, tuple) else p for p in spec]
 
 
-def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
-                    async_save=False):
-    """Save {name: Tensor} to a checkpoint directory.
+def _norm_index(idx, shape):
+    """Tuple of slices (possibly with None endpoints) -> [[start, stop]]."""
+    out = []
+    for sl, dim in zip(idx, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
 
-    Returns None, or a `threading.Thread` (already started) if async_save —
-    join it (or call wait_all()) before relying on the files.
+
+def _region_tag(bounds):
+    if not bounds:
+        return "r0"
+    return "r" + "x".join(str(b[0]) for b in bounds)
+
+
+def _unique_regions(arr):
+    """All shard regions of a jax.Array's GLOBAL sharding, deduped, as
+    normalized bounds lists. Enumerated from devices_indices_map so the
+    index is complete even when some shards live on other hosts."""
+    seen = {}
+    for idx in arr.sharding.devices_indices_map(arr.shape).values():
+        bounds = _norm_index(idx, arr.shape)
+        seen[_region_tag(bounds)] = bounds
+    return seen
+
+
+def _dtype_str(arr):
+    return str(arr.dtype)
+
+
+class _ByteQueue:
+    """Bounded-byte producer/consumer queue for async checkpoint writes.
+    A writer failure unblocks and re-raises in the producer (`put`)
+    rather than deadlocking it against a dead consumer."""
+
+    def __init__(self, max_bytes):
+        self.max = max_bytes
+        self._q = collections.deque()
+        self._bytes = 0
+        self._cv = threading.Condition()
+        self._closed = False
+        self.error = None
+
+    def put(self, item, nbytes):
+        with self._cv:
+            while (self.error is None and self._bytes
+                   and self._bytes + nbytes > self.max):
+                self._cv.wait()
+            if self.error is not None:
+                raise RuntimeError(
+                    "async checkpoint writer failed") from self.error
+            self._q.append((item, nbytes))
+            self._bytes += nbytes
+            self._cv.notify_all()
+
+    def get(self):
+        with self._cv:
+            while not self._q and not self._closed:
+                self._cv.wait()
+            if not self._q:
+                return None
+            item, nbytes = self._q.popleft()
+            self._bytes -= nbytes
+            self._cv.notify_all()
+            return item
+
+    def fail(self, exc):
+        with self._cv:
+            self.error = exc
+            self._q.clear()
+            self._bytes = 0
+            self._cv.notify_all()
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+
+class _WriterThread(threading.Thread):
+    """Async-save writer whose failure actually surfaces: `join()`
+    re-raises the writer's exception in the joining thread (a bare
+    `Thread.join` returns normally over a dead thread, which would let
+    a failed checkpoint pass for a written one). `join()` then runs the
+    save's `finalize` (cross-process barrier + index write) ON THE
+    CALLER THREAD — a device collective issued from a background thread
+    could interleave with the training step's collectives in different
+    orders on different hosts and deadlock."""
+
+    def __init__(self, target, finalize=None):
+        super().__init__(daemon=True)
+        self._target_fn = target
+        self._finalize = finalize
+        self._finalized = False
+        self._lock = threading.Lock()
+        self.error = None
+
+    def run(self):
+        try:
+            self._target_fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised in join()
+            self.error = e
+
+    def join(self, timeout=None):
+        super().join(timeout)
+        if self.is_alive():  # timeout expired
+            return
+        if self.error is not None:
+            raise RuntimeError(
+                "async checkpoint writer failed") from self.error
+        with self._lock:
+            if self._finalized or self._finalize is None:
+                return
+            self._finalized = True
+        self._finalize()
+
+
+def _barrier():
+    """Cross-process fence: every process's shard writes are on disk
+    before the coordinator writes index.json (whose presence is the
+    checkpoint-complete marker). No-op single-process."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu_ckpt_save")
+
+
+def _write_item(path, item, open_memmaps):
+    kind = item[0]
+    if kind == "barrier":
+        for mm in open_memmaps.values():
+            mm.flush()
+        _barrier()
+    elif kind == "npy":
+        _, fname, arr = item
+        np.save(os.path.join(path, fname), arr)
+    elif kind == "chunk":
+        _, fname, shape, dtype, row0, arr = item
+        mm = open_memmaps.get(fname)
+        if mm is None:
+            mm = np.lib.format.open_memmap(
+                os.path.join(path, fname), mode="w+",
+                dtype=np.dtype(dtype), shape=tuple(shape))
+            open_memmaps[fname] = mm
+        mm[row0:row0 + arr.shape[0]] = arr
+    elif kind == "index":
+        _, meta = item
+        for mm in open_memmaps.values():
+            mm.flush()
+        open_memmaps.clear()
+        # index last: its presence marks the checkpoint complete
+        with open(os.path.join(path, _INDEX), "w") as f:
+            json.dump(meta, f, indent=1)
+
+
+def _emit_tensor(key, arr, entries, sink, snapshot=False,
+                 write_unsharded=True):
+    """Stream one tensor's addressable shards into `sink` and record its
+    index entry. Never touches the global value. `snapshot=True` forces
+    an owned copy of every piece (async saves: np.asarray of a host
+    ndarray — or of a CPU-backend jax buffer — is a zero-copy VIEW the
+    caller may mutate or donate before the writer drains it).
+    `write_unsharded=False` records the entry but skips the data write
+    for tensors with no shard ownership (host ndarrays, 0-d arrays) —
+    multi-host saves gate those on the coordinator so N processes don't
+    race truncate/write on the same file."""
+    fbase = _safe_name(key)
+    if isinstance(arr, Tensor):
+        arr = arr._data
+    is_jax = isinstance(arr, jax.Array)
+    if is_jax and getattr(arr, "sharding", None) is not None and arr.ndim:
+        regions = _unique_regions(arr)
+        shards = {
+            _region_tag(_norm_index(s.index, arr.shape)): s
+            for s in arr.addressable_shards if s.replica_id == 0
+        }
+    else:
+        arr = np.asarray(arr)
+        regions = {_region_tag([[0, d] for d in arr.shape]):
+                   [[0, d] for d in arr.shape]}
+        shards = None
+    entry = {
+        "shape": list(arr.shape),
+        "dtype": _dtype_str(arr),
+        "spec": _spec_of(arr),
+        "shards": [{"file": f"{fbase}.{tag}.npy", "index": bounds}
+                   for tag, bounds in sorted(regions.items())],
+    }
+    entries[key] = entry
+    for tag, bounds in sorted(regions.items()):
+        fname = f"{fbase}.{tag}.npy"
+        if shards is not None:
+            shard = shards.get(tag)
+            if shard is None:
+                continue  # owned by another host's process
+            data = shard.data
+        else:
+            if not write_unsharded:
+                continue  # coordinator writes ownerless tensors
+            data = arr
+        shape = tuple(b[1] - b[0] for b in bounds) if bounds else ()
+        nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(
+            _dtype_str(data)).itemsize if shape else np.dtype(
+            _dtype_str(data)).itemsize
+        snap = (lambda a: np.array(a, copy=True)) if snapshot \
+            else np.asarray
+        if not shape or nbytes <= _CHUNK_BYTES:
+            sink(("npy", fname, snap(data)), max(nbytes, 1))
+        else:
+            # stream row-chunks: bounds the host high-water mark for
+            # huge single-region tensors (embedding tables etc.)
+            rows = max(1, _CHUNK_BYTES // max(1, nbytes // shape[0]))
+            for r0 in range(0, shape[0], rows):
+                piece = snap(data[r0:r0 + rows])
+                sink(("chunk", fname, shape, _dtype_str(data), r0, piece),
+                     piece.nbytes)
+
+
+def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    async_save=False, max_inflight_bytes=1 << 30):
+    """Save {name: Tensor} to a sharded checkpoint directory.
+
+    Returns None, or a started writer thread if async_save — join it (or
+    call wait_all()) before relying on the files; join RAISES if the
+    writer failed (ENOSPC, permissions), so a failed checkpoint cannot
+    pass for a written one. Async saves hold at most ~max_inflight_bytes
+    of host snapshots at a time; the producer (caller) blocks when the
+    writer falls that far behind, which keeps memory bounded instead of
+    buffering the whole model. `process_group` is accepted for API parity
+    but unused: shard ownership comes from the arrays' global shardings.
     """
     os.makedirs(path, exist_ok=True)
     entries = {}
-    arrays = {}
-    for key, val in state_dict.items():
-        arr = val._data if isinstance(val, Tensor) else val
-        fname = _safe_name(key) + ".npy"
-        entries[key] = {
-            "file": fname,
-            "shape": list(np.shape(arr)),
-            "dtype": str(np.asarray(arr).dtype if not hasattr(arr, "dtype")
-                         else arr.dtype),
-            "spec": _spec_of(arr),
-        }
-        arrays[fname] = arr
+    is_coordinator = jax.process_index() == coordinator_rank
 
-    if async_save:
-        # snapshot to host SYNCHRONOUSLY: the live jax.Arrays may be donated
-        # or rebound by the very next train step (round-1 ADVICE: the writer
-        # thread could read invalidated/torn buffers). Only file I/O is
-        # deferred to the thread.
-        arrays = {f: np.asarray(a) for f, a in arrays.items()}
+    if not async_save:
+        open_memmaps = {}
 
-    def write():
-        for fname, arr in arrays.items():
-            np.save(os.path.join(path, fname),
-                    np.asarray(arr))  # gathers sharded arrays to host
-        with open(os.path.join(path, _INDEX), "w") as f:
-            json.dump({"tensors": entries}, f, indent=1)
+        def sink(item, nbytes):
+            _write_item(path, item, open_memmaps)
 
-    if async_save:
-        t = threading.Thread(target=write, daemon=True)
-        t.start()
-        _pending.append(t)
-        return t
-    write()
-    return None
+        for key, val in state_dict.items():
+            _emit_tensor(key, val, entries, sink,
+                         write_unsharded=is_coordinator
+                         or jax.process_count() == 1)
+        sink(("barrier",), 0)  # all hosts' shards durable before index
+        if is_coordinator:
+            sink(("index", {"format": 2, "tensors": entries}), 0)
+        return None
+
+    q = _ByteQueue(max_inflight_bytes)
+
+    def writer():
+        open_memmaps = {}
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+                _write_item(path, item, open_memmaps)
+            for mm in open_memmaps.values():
+                mm.flush()
+        except BaseException as e:
+            q.fail(e)  # unblock + fail the producer
+            raise
+
+    def finalize():
+        # runs in join(), on the CALLER thread: cross-process barrier,
+        # then the coordinator publishes the completeness marker
+        _barrier()
+        if is_coordinator:
+            _write_item(path, ("index", {"format": 2, "tensors": entries}),
+                        {})
+
+    t = _WriterThread(writer, finalize)
+    t.start()
+    # snapshots are produced SYNCHRONOUSLY with respect to the live
+    # jax.Arrays (the next train step may donate/rebind their buffers;
+    # round-1 ADVICE), and as OWNED copies (snapshot=True) so the writer
+    # never reads a buffer the caller can mutate — only file I/O
+    # overlaps with the caller.
+    try:
+        for key, val in state_dict.items():
+            _emit_tensor(key, val, entries, q.put, snapshot=True,
+                         write_unsharded=is_coordinator
+                         or jax.process_count() == 1)
+    finally:
+        q.close()
+    _pending.append(t)
+    return t
 
 
 _pending: list = []
 
 
 def wait_all():
-    """Block until every async save has finished."""
+    """Block until every async save has finished. Raises if any writer
+    failed (the checkpoint on disk is then incomplete)."""
     while _pending:
         _pending.pop().join()
+
+
+def _np_from_file(fpath, dtype):
+    """mmap a shard .npy; re-view exotic dtypes (bfloat16 round-trips
+    through .npy as raw 'V2' bytes)."""
+    data = np.load(fpath, mmap_mode="r")
+    want = np.dtype(dtype)
+    if data.dtype != want and data.dtype.itemsize == want.itemsize:
+        data = data.view(want)
+    return data
+
+
+def _read_region(path, meta, bounds):
+    """Assemble one region [[start, stop], ...] of a tensor from the shard
+    files that overlap it. Reads only overlapping byte ranges (mmap)."""
+    shape = tuple(b[1] - b[0] for b in bounds)
+    out = np.empty(shape, np.dtype(meta["dtype"]))
+    for sh in meta["shards"]:
+        s_bounds = sh["index"]
+        lo = [max(b[0], s[0]) for b, s in zip(bounds, s_bounds)]
+        hi = [min(b[1], s[1]) for b, s in zip(bounds, s_bounds)]
+        if any(l >= h for l, h in zip(lo, hi)):
+            continue
+        src = tuple(slice(l - s[0], h - s[0])
+                    for l, h, s in zip(lo, hi, s_bounds))
+        dst = tuple(slice(l - b[0], h - b[0])
+                    for l, h, b in zip(lo, hi, bounds))
+        data = _np_from_file(os.path.join(path, sh["file"]), meta["dtype"])
+        out[dst] = data[src]
+    return out
+
+
+def _meta_v1_to_v2(meta):
+    """v1 entries ({'file': ...}) read as a single whole-tensor shard."""
+    if "shards" in meta:
+        return meta
+    meta = dict(meta)
+    meta["shards"] = [{"file": meta.pop("file"),
+                       "index": [[0, d] for d in meta["shape"]]}]
+    return meta
+
+
+def _load_index(path):
+    with open(os.path.join(path, _INDEX)) as f:
+        raw = json.load(f)
+    tensors = raw["tensors"]
+    return {k: _meta_v1_to_v2(m) for k, m in tensors.items()}
 
 
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, offload=False):
     """Load a checkpoint INTO the given {name: Tensor} dict, placing each
     value with the destination tensor's current sharding (reshard-on-load).
+    Each device's region is assembled from only the shard files overlapping
+    it — the global value is never materialized for sharded destinations.
     Missing keys raise; extra checkpoint keys are ignored."""
-    with open(os.path.join(path, _INDEX)) as f:
-        index = json.load(f)["tensors"]
+    index = _load_index(path)
     for key, dest in state_dict.items():
         if key not in index:
             raise KeyError(f"checkpoint at {path} has no tensor {key!r}")
         meta = index[key]
-        arr = np.load(os.path.join(path, meta["file"]))
         if not isinstance(dest, Tensor):
             continue
-        if tuple(arr.shape) != tuple(dest.shape):
+        if tuple(meta["shape"]) != tuple(dest.shape):
             raise ValueError(
-                f"{key}: checkpoint shape {arr.shape} != dest {dest.shape} "
-                "(shape-changing conversion is not a reshard)")
+                f"{key}: checkpoint shape {tuple(meta['shape'])} != dest "
+                f"{tuple(dest.shape)} (shape-changing conversion is not a "
+                "reshard)")
         sharding = getattr(dest._data, "sharding", None)
-        new = np.asarray(arr, dtype=dest._data.dtype)
-        if sharding is not None:
-            dest._data = jax.device_put(new, sharding)
+        dtype = dest._data.dtype
+
+        if sharding is not None and dest._data.ndim:
+            def cb(idx, _m=meta, _d=dtype):
+                bounds = _norm_index(idx, _m["shape"])
+                return _read_region(path, _m, bounds).astype(_d)
+
+            dest._data = jax.make_array_from_callback(
+                tuple(meta["shape"]), sharding, cb)
         else:
-            dest._data = jax.device_put(new)
+            full = _read_region(path, meta,
+                                [[0, d] for d in meta["shape"]])
+            if sharding is not None:  # 0-d: keep the mesh placement
+                dest._data = jax.device_put(full.astype(dtype), sharding)
+            else:
+                dest._data = jax.device_put(full.astype(dtype))
     return state_dict
 
 
 def load_checkpoint(path):
     """Load to host: {name: np.ndarray} without placement."""
-    with open(os.path.join(path, _INDEX)) as f:
-        index = json.load(f)["tensors"]
-    return {k: np.load(os.path.join(path, m["file"]))
+    index = _load_index(path)
+    return {k: _read_region(path, m, [[0, d] for d in m["shape"]])
             for k, m in index.items()}
