@@ -24,6 +24,11 @@ sharding spec:
 Tensors whose first dim doesn't divide the axis stay replicated — the
 reference pads instead (`_param2align`); dropping the pad logic costs a few
 small tensors' worth of savings and removes a whole class of bugs.
+
+offload=True places optimizer states + fp32 masters in pinned HOST memory
+(sharded layout preserved): the eager step streams them to HBM, updates,
+and streams back; the compiled TrainStep stages the same transfers inside
+the one XLA program (reference `group_sharded.py:43,61`).
 """
 from __future__ import annotations
 
@@ -63,6 +68,51 @@ def _sharded_put(arr, axis):
     return arr
 
 
+def _host_put(arr):
+    """Move `arr` to pinned host memory, keeping its (sharded) layout —
+    the ZeRO offload placement (reference `group_sharded.py:43,61`
+    `offload=True`: optimizer states + fp32 masters live on CPU)."""
+    s = getattr(arr, "sharding", None)
+    if s is None or not hasattr(s, "with_memory_kind"):
+        return arr
+    return jax.device_put(arr, s.with_memory_kind("pinned_host"))
+
+
+def _dev_put(arr):
+    s = getattr(arr, "sharding", None)
+    if s is None or getattr(s, "memory_kind", "device") == "device":
+        return arr
+    return jax.device_put(arr, s.with_memory_kind("device"))
+
+
+def _wrap_step_for_offload(optimizer, dev_place, host_place):
+    """Eager `optimizer.step` under offload: stream state host->device,
+    run the (device-memory) update, stream the new state back to host.
+    Mixed host/device operands are a hard error in XLA, so the staging
+    must bracket the whole update — which is exactly the reference's
+    offload semantics (CPU-resident state, device compute per step)."""
+    orig_step = optimizer.step
+
+    def step():
+        optimizer._state_placement = dev_place
+        for key, st in list(optimizer._accumulators.items()):
+            optimizer._accumulators[key] = {
+                k: _dev_put(v) for k, v in st.items()}
+        for key, m in list(optimizer._master_weights.items()):
+            optimizer._master_weights[key] = _dev_put(m)
+        try:
+            orig_step()
+        finally:
+            for key, st in list(optimizer._accumulators.items()):
+                optimizer._accumulators[key] = {
+                    k: _host_put(v) for k, v in st.items()}
+            for key, m in list(optimizer._master_weights.items()):
+                optimizer._master_weights[key] = _host_put(m)
+            optimizer._state_placement = host_place
+
+    optimizer.step = step
+
+
 def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,
                            group=None, offload=False, sync_buffers=False,
                            buffer_max_size=2**23, segment_size=2**20,
@@ -75,17 +125,23 @@ def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,
     axis = _shard_axis()
     if axis is None:
         return model, optimizer, scaler
-    if offload:
-        raise NotImplementedError(
-            "CPU offload: TPU HBM<->host streaming is round-2 work")
 
-    optimizer._state_placement = lambda arr: _sharded_put(arr, axis)
+    dev_place = lambda arr: _sharded_put(arr, axis)  # noqa: E731
+    if offload:
+        host_place = lambda arr: _host_put(_sharded_put(arr, axis))  # noqa: E731
+        place = host_place
+        _wrap_step_for_offload(optimizer, dev_place, host_place)
+        optimizer._offload_state = True
+    else:
+        place = dev_place
+
+    optimizer._state_placement = place
     # re-place any state that already exists
     for key, st in list(optimizer._accumulators.items()):
         optimizer._accumulators[key] = {
-            k: _sharded_put(v, axis) for k, v in st.items()}
+            k: place(v) for k, v in st.items()}
     for key, m in list(optimizer._master_weights.items()):
-        optimizer._master_weights[key] = _sharded_put(m, axis)
+        optimizer._master_weights[key] = place(m)
 
     if level == "p_g_os":
         for p in model.parameters():

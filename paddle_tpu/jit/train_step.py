@@ -146,8 +146,26 @@ class TrainStep:
         loss_fn = self._loss_fn
         outer = self
 
+        # ZeRO offload: state leaves living in pinned host memory are
+        # staged device-ward inside the program; the new state is staged
+        # back host-ward eagerly in __call__ (reference group_sharded
+        # offload=True semantics). Stage-out cannot live inside the
+        # program: host-placement annotations on SPMD outputs don't
+        # lower on the CPU test backend, and peak HBM is identical
+        # either way (the state is resident during the update).
+        host_shardings = [
+            s.sharding if getattr(getattr(s, "sharding", None),
+                                  "memory_kind", "device") != "device"
+            else None
+            for s in self._flatten_state()]
+
         def step_fn(param_arrays, state_flat, buffer_arrays, lr, step, prng,
                     batch_arrays):
+            if any(s is not None for s in host_shardings):
+                state_flat = [
+                    a if s is None else jax.device_put(
+                        a, s.with_memory_kind("device"))
+                    for a, s in zip(state_flat, host_shardings)]
             state, masters = outer._unflatten_state(state_flat)
             saved = [(t, t._data, t._grad_node) for t in params + buffers]
             try:
@@ -234,6 +252,12 @@ class TrainStep:
             p._data = a
             p._grad_node = None
             p.grad = None
+        if getattr(self._opt, "_offload_state", False):
+            flat_state = [
+                a if getattr(a.sharding, "memory_kind", "device")
+                != "device" else jax.device_put(
+                    a, a.sharding.with_memory_kind("pinned_host"))
+                for a in flat_state]
         self._state, self._masters = self._unflatten_state(flat_state)
         for b, a in zip(self._buffers, new_buffers):
             b._data = a
