@@ -50,7 +50,7 @@ def main():
             task_hidden_size=512, num_task_layers=4,
             num_task_attention_heads=8, task_intermediate_size=2048,
             max_position_embeddings=1024, dtype="bfloat16",
-            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+            hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1)
         batch = int(os.environ.get("PT_ERNIE_BATCH", "4"))
         seq, steps, warmup = 1024, 10, 2
     model = ErnieForPretraining(cfg)

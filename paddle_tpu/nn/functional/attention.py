@@ -61,24 +61,36 @@ def scaled_dot_product_attention(
     """Inputs [batch, seq, num_heads, head_dim] — same layout as the
     reference's flash_attn op. Routed through op name "flash_attention" so a
     Pallas kernel can take over on TPU."""
+    import jax
+
     from ...framework import random as rng
 
-    operands = (query, key, value) if attn_mask is None else (
-        query, key, value, attn_mask
-    )
+    operands = [query, key, value]
+    if attn_mask is not None:
+        operands.append(attn_mask)
     p = dropout_p if training else 0.0
-    dk = rng.next_key() if p > 0.0 else None
+    has_key = p > 0.0
+    if has_key:
+        # the key rides as an OPERAND (raw uint32 words) so the Pallas
+        # kernel can seed its in-kernel dropout mask under jit tracing;
+        # the composite fallback re-wraps it into a typed key
+        operands.append(jax.random.key_data(rng.next_key()))
 
-    def default(*arrs, causal=False, dropout=0.0):
+    def default(*arrs, causal=False, dropout=0.0, has_key=False):
+        dkey = None
+        if has_key:
+            *arrs, kd = arrs
+            dkey = jax.random.wrap_key_data(kd)
         return _sdpa_reference(*arrs, causal=causal, dropout=dropout,
-                               dropout_key=dk)
+                               dropout_key=dkey)
 
     return apply(
         "flash_attention",
         default,
-        operands,
+        tuple(operands),
         causal=is_causal,
         dropout=p,
+        has_key=has_key,
     )
 
 

@@ -105,8 +105,8 @@ def best_blocks(sq: int, sk: int, d: int, causal: bool
     return bq, bk
 
 
-def kernel_beats_composite(sq: int, sk: int, d: int, causal: bool
-                           ) -> Optional[bool]:
+def kernel_beats_composite(sq: int, sk: int, d: int, causal: bool,
+                           margin: float = 1.0) -> Optional[bool]:
     """Measured engagement decision; None when no measurement applies.
 
     Exact-shape hits only: the win/lose ratio flips across the measured
@@ -114,11 +114,14 @@ def kernel_beats_composite(sq: int, sk: int, d: int, causal: bool
     kernel from s=1024 — 3.4-6.1x, growing with seq), so transferring
     the verdict one octave would invert it exactly at the crossover.
     Block sizes transfer (see `best_blocks`); the binary verdict does not.
+    ``margin > 1`` demands measured headroom — used when the caller adds
+    unmeasured work on top of the measured configuration (in-kernel
+    dropout adds hash+select VPU time the no-dropout rows don't carry).
     """
     e = lookup(sq, sk, d, causal, exact=True)
     if e is None or "ratio_fwd_bwd" not in e:
         return None
-    return e["ratio_fwd_bwd"] > 1.0
+    return e["ratio_fwd_bwd"] > margin
 
 
 def _candidates(seq: int):

@@ -47,6 +47,37 @@ NEG_INF = -1e30
 _LANES = 128
 
 
+def _keep_mask(seed_ref, head, q_idx, k_idx, block_q, block_k, rate):
+    """Per-element dropout keep-mask for one [block_q, block_k] tile.
+
+    Counter-based hash PRNG (murmur3 fmix32 avalanche over global
+    (head, row, col) + two seed words) in plain uint32 VPU ops rather
+    than `pltpu.prng_random_bits`: the bits are a pure function of the
+    GLOBAL element coordinates, so the forward and both backward kernels
+    reproduce the identical mask with no per-tile seeding protocol (and
+    with any block shape), and the CPU interpret-mode tests see the same
+    numbers the hardware does (the TPU-interpret PRNG stub returns
+    zeros). Reference parity: in-kernel dropout of
+    `phi/kernels/gpu/flash_attn_kernel.cu` (philox counter PRNG).
+    """
+    rows = (q_idx * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)).astype(jnp.uint32)
+    cols = (k_idx * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)).astype(jnp.uint32)
+    s0 = seed_ref[0].astype(jnp.uint32)
+    s1 = seed_ref[1].astype(jnp.uint32)
+    h = (s0 * jnp.uint32(0x9E3779B9)
+         + (head + 1).astype(jnp.uint32) * jnp.uint32(0x85EBCA6B) + s1)
+    x = rows * jnp.uint32(0x27D4EB2F) + cols * jnp.uint32(0x165667B1) + h
+    x ^= x >> 16
+    x *= jnp.uint32(0x85EBCA6B)
+    x ^= x >> 13
+    x *= jnp.uint32(0xC2B2AE35)
+    x ^= x >> 16
+    threshold = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
+    return x >= threshold
+
+
 def _causal_mask(s, q_idx, k_idx, block_q, block_k, offset, window=0):
     """Bottom-right-aligned causal mask for one [block_q, block_k] tile.
 
@@ -75,9 +106,15 @@ def _tile_live(q_idx, k_idx, block_q, block_k, offset, window):
     return below_diag & in_window
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, causal, scale, offset, n_kb,
-                window=0):
+def _fwd_kernel(*refs, causal, scale, offset, n_kb, window=0, dropout=0.0):
+    if dropout > 0.0:
+        (seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        seed_ref = None
+        (q_ref, k_ref, v_ref, o_ref, lse_ref,
+         acc_ref, m_ref, l_ref) = refs
+    b_idx = pl.program_id(0)
     q_idx = pl.program_id(1)
     k_idx = pl.program_id(2)
     block_q, d = q_ref.shape[1], q_ref.shape[2]
@@ -107,8 +144,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        if dropout > 0.0:
+            # dropout acts on the POST-softmax probs: the denominator l
+            # keeps the undropped sum, only the value-accumulator sees
+            # the masked + 1/(1-rate)-rescaled probs
+            keep = _keep_mask(seed_ref, b_idx, q_idx, k_idx,
+                              block_q, block_k, dropout)
+            p_acc = jnp.where(keep, p * (1.0 / (1.0 - dropout)), 0.0)
+        else:
+            p_acc = p
         acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p_acc, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -134,8 +180,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                                       lse_ref.shape[1:])
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc_ref, *, causal, scale, offset, n_kb, window=0):
+def _bwd_dq_kernel(*refs, causal, scale, offset, n_kb, window=0,
+                   dropout=0.0):
+    if dropout > 0.0:
+        (seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc_ref) = refs
+    else:
+        seed_ref = None
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc_ref) = refs
+    b_idx = pl.program_id(0)
     q_idx = pl.program_id(1)
     k_idx = pl.program_id(2)
     block_q = q_ref.shape[1]
@@ -163,6 +217,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            # ds_ij = P_ij (D_ij dp_ij - delta_i) with D the keep/(1-r)
+            # mask; delta already carries the dropped-out forward
+            keep = _keep_mask(seed_ref, b_idx, q_idx, k_idx,
+                              block_q, block_k, dropout)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout)), 0.0)
         ds = p * (dp - delta) * scale
         dq_acc_ref[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -179,12 +239,19 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc_ref[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
-                    *, causal, scale, offset, n_qb, n_iters, window=0):
+def _bwd_dkv_kernel(*refs, causal, scale, offset, n_qb, n_iters, window=0,
+                    dropout=0.0):
     """dk/dv accumulate over the q-minor grid dim, which iterates
     group × q-blocks under GQA (the same KV block serves every q head of
     its group; q_idx below is the position within one head's q blocks)."""
+    if dropout > 0.0:
+        (seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc_ref, dv_acc_ref) = refs
+    else:
+        seed_ref = None
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc_ref, dv_acc_ref) = refs
+    b_idx = pl.program_id(0)
     k_idx = pl.program_id(1)
     q_iter = pl.program_id(2)
     q_idx = q_iter % n_qb
@@ -210,12 +277,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = _causal_mask(s, q_idx, k_idx, block_q, block_k, offset,
                              window)
         p = jnp.where(lse > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)
+        if dropout > 0.0:
+            # GQA: the mask was drawn per QUERY head in the forward
+            head = b_idx * (n_iters // n_qb) + q_iter // n_qb
+            keep = _keep_mask(seed_ref, head, q_idx, k_idx,
+                              block_q, block_k, dropout)
+            dmask = jnp.where(keep, 1.0 / (1.0 - dropout), 0.0)
+            pd = p * dmask
+        else:
+            dmask = None
+            pd = p
         dv_acc_ref[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            pd, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            dp = dp * dmask
         ds = p * (dp - delta) * scale
         dk_acc_ref[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -249,7 +328,7 @@ def _flash_bhsd(q, k, v, causal, scale, interpret, block_q=None,
 
 
 def _flash_fwd(q, k, v, causal, scale, interpret, block_q=None,
-               block_k=None, window=0):
+               block_k=None, window=0, seed=None, dropout=0.0):
     """q: [bh, s, d], k/v: [bh_kv, s, d] with bh % bh_kv == 0 (GQA: each
     group of bh//bh_kv query heads shares one KV head — the K/V BlockSpec
     index maps divide the bh program index, so grouped heads stream the
@@ -268,17 +347,23 @@ def _flash_fwd(q, k, v, causal, scale, interpret, block_q=None,
     n_kb = sk // block_k
     grid = (bh, sq // block_q, n_kb)
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
-                               offset=sk - sq, n_kb=n_kb, window=window)
+                               offset=sk - sq, n_kb=n_kb, window=window,
+                               dropout=dropout)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d),
+                     lambda b, i, j: (b // group, j, 0)),
+        pl.BlockSpec((1, block_k, d),
+                     lambda b, i, j: (b // group, j, 0)),
+    ]
+    args = (q, k, v)
+    if dropout > 0.0:
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + in_specs
+        args = (seed,) + args
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda b, i, j: (b // group, j, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda b, i, j: (b // group, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
@@ -300,7 +385,7 @@ def _flash_fwd(q, k, v, causal, scale, interpret, block_q=None,
             bytes_accessed=int(q.size * 2 + k.size * 2 + v.size * 2),
             transcendentals=int(bh * sq * sk),
         ),
-    )(q, k, v)
+    )(*args)
     return out, lse
 
 
@@ -314,6 +399,12 @@ def _flash_fwd_rule(q, k, v, causal, scale, interpret, block_q=None,
 def _flash_bwd_rule(causal, scale, interpret, block_q, block_k, window,
                     res, g):
     q, k, v, out, lse = res
+    return _flash_bwd_impl(q, k, v, out, lse, g, causal, scale, interpret,
+                           block_q, block_k, window, None, 0.0)
+
+
+def _flash_bwd_impl(q, k, v, out, lse, g, causal, scale, interpret,
+                    block_q, block_k, window, seed, dropout):
     bh, sq, d = q.shape
     sk = k.shape[1]
     bh_kv = k.shape[0]
@@ -331,51 +422,63 @@ def _flash_bwd_rule(causal, scale, interpret, block_q, block_k, window,
                 axis=-1, keepdims=True),
         (bh, sq, _LANES))
 
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d),
+                     lambda b, i, j: (b // group, j, 0)),
+        pl.BlockSpec((1, block_k, d),
+                     lambda b, i, j: (b // group, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+    ]
+    dq_args = (q, k, v, g, lse, delta)
+    if dropout > 0.0:
+        dq_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + dq_specs
+        dq_args = (seed,) + dq_args
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
-                          offset=offset, n_kb=n_kb, window=window),
+                          offset=offset, n_kb=n_kb, window=window,
+                          dropout=dropout),
         grid=(bh, n_qb, n_kb),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda b, i, j: (b // group, j, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda b, i, j: (b // group, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY)),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(*dq_args)
 
     # dkv grid runs per KV head; the minor dim sweeps group × q-blocks so
     # grouped q heads accumulate into one dk/dv block (GQA)
+    dkv_specs = [
+        pl.BlockSpec((1, block_q, d),
+                     lambda b, j, i: (b * group + i // n_qb,
+                                      i % n_qb, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d),
+                     lambda b, j, i: (b * group + i // n_qb,
+                                      i % n_qb, 0)),
+        pl.BlockSpec((1, block_q, _LANES),
+                     lambda b, j, i: (b * group + i // n_qb,
+                                      i % n_qb, 0)),
+        pl.BlockSpec((1, block_q, _LANES),
+                     lambda b, j, i: (b * group + i // n_qb,
+                                      i % n_qb, 0)),
+    ]
+    dkv_args = (q, k, v, g, lse, delta)
+    if dropout > 0.0:
+        dkv_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + dkv_specs
+        dkv_args = (seed,) + dkv_args
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
                           offset=offset, n_qb=n_qb,
-                          n_iters=group * n_qb, window=window),
+                          n_iters=group * n_qb, window=window,
+                          dropout=dropout),
         grid=(bh_kv, n_kb, group * n_qb),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d),
-                         lambda b, j, i: (b * group + i // n_qb,
-                                          i % n_qb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d),
-                         lambda b, j, i: (b * group + i // n_qb,
-                                          i % n_qb, 0)),
-            pl.BlockSpec((1, block_q, _LANES),
-                         lambda b, j, i: (b * group + i // n_qb,
-                                          i % n_qb, 0)),
-            pl.BlockSpec((1, block_q, _LANES),
-                         lambda b, j, i: (b * group + i // n_qb,
-                                          i % n_qb, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -391,28 +494,72 @@ def _flash_bwd_rule(causal, scale, interpret, block_q, block_k, window,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY)),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(*dkv_args)
     return dq, dk, dv
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash_bhsd_drop(q, k, v, seed, causal, scale, interpret,
+                     block_q=None, block_k=None, window=0, dropout=0.0):
+    """Dropout variant: `seed` is an int32[2] array (derived from the
+    caller's dropout PRNG key) feeding the counter-hash mask — the same
+    mask is regenerated in the backward kernels (see _keep_mask)."""
+    out, _ = _flash_fwd(q, k, v, causal, scale, interpret, block_q,
+                        block_k, window, seed=seed, dropout=dropout)
+    return out
+
+
+def _flash_fwd_rule_drop(q, k, v, seed, causal, scale, interpret,
+                         block_q=None, block_k=None, window=0,
+                         dropout=0.0):
+    out, lse = _flash_fwd(q, k, v, causal, scale, interpret, block_q,
+                          block_k, window, seed=seed, dropout=dropout)
+    return out, (q, k, v, seed, out, lse)
+
+
+def _flash_bwd_rule_drop(causal, scale, interpret, block_q, block_k,
+                         window, dropout, res, g):
+    q, k, v, seed, out, lse = res
+    dq, dk, dv = _flash_bwd_impl(q, k, v, out, lse, g, causal, scale,
+                                 interpret, block_q, block_k, window,
+                                 seed, dropout)
+    return dq, dk, dv, None
+
+
+_flash_bhsd_drop.defvjp(_flash_fwd_rule_drop, _flash_bwd_rule_drop)
+
+
 def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
-                           default_fn=None, interpret=False):
+                           has_key=False, default_fn=None,
+                           interpret=False):
     """Kernel-registry entry: [b, s, h, d] inputs, same signature as the
-    default XLA implementation in nn/functional/attention.py. Falls back to
-    ``default_fn`` (the caller's composite closure, which carries the live
-    dropout PRNG key) for masks/dropout/odd shapes."""
+    default XLA implementation in nn/functional/attention.py. When
+    ``has_key`` the trailing operand is the dropout PRNG key's raw
+    uint32 data; dropout then runs IN-KERNEL (reference
+    flash_attn_kernel.cu supports in-kernel dropout — the round-4 gap
+    that forced every dropout>0 call onto the composite). Falls back to
+    ``default_fn`` for masks/odd shapes."""
+    dkey = None
+    if has_key and rest:
+        *head_rest, dkey = rest
+        rest = tuple(head_rest)
 
     def fallback(dp):
+        arrs = (q, k, v) + rest + ((dkey,) if dkey is not None else ())
         if default_fn is not None:
-            return default_fn(q, k, v, *rest, causal=causal, dropout=dp)
+            return default_fn(*arrs, causal=causal, dropout=dp,
+                              has_key=dkey is not None)
         from ...nn.functional.attention import _sdpa_reference
 
-        return _sdpa_reference(q, k, v, *rest, causal=causal, dropout=dp)
+        key_arr = (jax.random.wrap_key_data(dkey)
+                   if dkey is not None else None)
+        return _sdpa_reference(q, k, v, *rest, causal=causal, dropout=dp,
+                               dropout_key=key_arr)
 
-    if rest or dropout > 0.0:
+    if rest or (dropout > 0.0 and dkey is None):
         return fallback(dropout)
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -426,7 +573,7 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
     ok_blocks = (bq == sq or bq % 8 == 0) and (bk == sk or bk % 8 == 0)
     if (sq < 16 or sk < 16 or d % 8 or h % h_kv or v.shape[2] != h_kv
             or not ok_blocks):
-        return fallback(0.0)
+        return fallback(dropout)
     # engagement is measurement-driven: the autotune cache stores the
     # kernel-vs-composite fwd+bwd ratio per shape (tools/flash_autotune.py
     # on hardware). Where no measurement applies, fall back to the round-4
@@ -437,21 +584,37 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
 
     bq_t = bk_t = None
     if not interpret:
-        beats = _tune.kernel_beats_composite(sq, sk, d, causal)
+        # dropout variants have no dedicated tune rows yet: demand 20%
+        # measured headroom over the composite before engaging the
+        # dropout kernel on a no-dropout measurement (the mask adds
+        # VPU hash+select work). The >=1024 heuristic rows measured
+        # 3.4-6.1x, far above the margin.
+        margin = 1.2 if dropout > 0.0 else 1.0
+        beats = _tune.kernel_beats_composite(sq, sk, d, causal,
+                                             margin=margin)
         if beats is False:
-            return fallback(0.0)
+            return fallback(dropout)
         if beats is None and (max(sq, sk) < 1024 or not causal):
             # the >=1024 crossover is extrapolated from CAUSAL
             # measurements only (flash_tune.json has no non-causal
             # >=1024 rows yet); unmeasured non-causal shapes stay on
             # the composite until tools/flash_autotune.py measures them.
-            return fallback(0.0)
+            # (dropout inherits the no-dropout engagement decision: the
+            # mask adds only VPU integer work.)
+            return fallback(dropout)
         bq_t, bk_t = _tune.best_blocks(sq, sk, d, causal)
     scale = 1.0 / math.sqrt(d)
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)
-    out = _flash_bhsd(qt, kt, vt, causal, scale, interpret, bq_t, bk_t)
+    if dropout > 0.0:
+        seed = jax.lax.bitcast_convert_type(
+            jnp.asarray(dkey).reshape(2), jnp.int32)
+        out = _flash_bhsd_drop(qt, kt, vt, seed, causal, scale, interpret,
+                               bq_t, bk_t, 0, dropout)
+    else:
+        out = _flash_bhsd(qt, kt, vt, causal, scale, interpret, bq_t,
+                          bk_t)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 
@@ -491,6 +654,23 @@ def check_lowering():
 
     jax.export.export(jax.jit(swa), platforms=["tpu"])(q, kv, kv)
     jax.export.export(jax.jit(swa_bwd), platforms=["tpu"])(q, kv, kv)
+
+    # in-kernel dropout variant (counter-hash mask; uint32 VPU ops)
+    seed = jnp.zeros((2,), jnp.int32)
+
+    def drop(q, k, v, seed):
+        return _flash_bhsd_drop(q, k, v, seed, True,
+                                1.0 / math.sqrt(128.0), False, None, None,
+                                0, 0.1)
+
+    def drop_bwd(q, k, v, seed):
+        return jax.grad(
+            lambda *a: drop(*a, seed).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+
+    jax.export.export(jax.jit(drop), platforms=["tpu"])(q, kv, kv, seed)
+    jax.export.export(jax.jit(drop_bwd), platforms=["tpu"])(q, kv, kv,
+                                                            seed)
 
 
 def register(platform="tpu", interpret=False):

@@ -190,3 +190,142 @@ class TestGQA:
             np.asarray(out), np.asarray(_sdpa_reference(q, kv, kv,
                                                         causal=True)),
             atol=2e-3)
+
+
+# ---- in-kernel dropout (reference flash_attn_kernel.cu parity) ----
+
+def _np_keep_mask(bh, sq, sk, seed, rate):
+    """Replicates the kernel's counter-hash mask (_keep_mask) in numpy.
+    A deliberate cross-implementation pin: changing the kernel hash
+    silently changes training reproducibility, so it must fail a test."""
+    with np.errstate(over="ignore"):
+        rows = np.arange(sq, dtype=np.uint32)[None, :, None]
+        cols = np.arange(sk, dtype=np.uint32)[None, None, :]
+        head = np.arange(bh, dtype=np.uint32)[:, None, None]
+        s0, s1 = np.uint32(seed[0]), np.uint32(seed[1])
+        h = (s0 * np.uint32(0x9E3779B9)
+             + (head + np.uint32(1)) * np.uint32(0x85EBCA6B) + s1)
+        x = (rows * np.uint32(0x27D4EB2F)
+             + cols * np.uint32(0x165667B1) + h).astype(np.uint32)
+        x ^= x >> np.uint32(16)
+        x = (x * np.uint32(0x85EBCA6B)).astype(np.uint32)
+        x ^= x >> np.uint32(13)
+        x = (x * np.uint32(0xC2B2AE35)).astype(np.uint32)
+        x ^= x >> np.uint32(16)
+        thr = np.uint32(min(int(rate * 2 ** 32), 2 ** 32 - 1))
+        return x >= thr
+
+
+def _dense_dropout_ref(q, k, v, keep, rate, causal, group=1):
+    """[bh, s, d] dense attention with an explicit keep mask on the
+    post-softmax probs (denominator undropped — standard dropout-after-
+    softmax semantics)."""
+    import jax.numpy as jnp
+
+    if group > 1:
+        k = jnp.repeat(k, group, axis=0)
+        v = jnp.repeat(v, group, axis=0)
+    d = q.shape[-1]
+    sq, sk = q.shape[1], k.shape[1]
+    s = jnp.einsum("bqd,bkd->bqk", q, k) / np.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, -1e30)
+    P = jax.nn.softmax(s, axis=-1)
+    D = jnp.where(keep, 1.0 / (1.0 - rate), 0.0)
+    return jnp.einsum("bqk,bkd->bqd", P * D, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("group", [1, 2])
+def test_dropout_fwd_bwd_exact_vs_masked_reference(causal, group):
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.flash_attention import _flash_bhsd_drop
+
+    bh, s, d = 4, 64, 16
+    rate = 0.3
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(bh, s, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(bh // group, s, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(bh // group, s, d).astype(np.float32))
+    seed = (7, 13)
+    keep = jnp.asarray(_np_keep_mask(bh, s, s, seed, rate))
+    scale = 1.0 / np.sqrt(d)
+
+    out = _flash_bhsd_drop(q, k, v, jnp.asarray(seed, jnp.int32), causal,
+                           scale, True, None, None, 0, rate)
+    ref = _dense_dropout_ref(q, k, v, keep, rate, causal, group)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-6)
+
+    def loss_k(q_, k_, v_):
+        return (_flash_bhsd_drop(q_, k_, v_, jnp.asarray(seed, jnp.int32),
+                                 causal, scale, True, None, None, 0,
+                                 rate) ** 2).sum()
+
+    def loss_r(q_, k_, v_):
+        return (_dense_dropout_ref(q_, k_, v_, keep, rate, causal,
+                                   group) ** 2).sum()
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+    gr_full = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gr_full):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_dropout_engages_kernel_via_dispatch(monkeypatch):
+    # dropout>0 must now run IN-KERNEL (round-4: it always fell back)
+    import paddle_tpu as pt
+    import paddle_tpu.ops.pallas.flash_attention as fa
+    from paddle_tpu.ops import registry
+
+    calls = {"drop": 0}
+    orig = fa._flash_bhsd_drop
+
+    def counting(*a, **kw):
+        calls["drop"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(fa, "_flash_bhsd_drop", counting)
+    fa.register(platform="cpu", interpret=True)
+    try:
+        q = pt.to_tensor(np.random.RandomState(0)
+                         .randn(2, 32, 2, 16).astype(np.float32))
+        out = pt.nn.functional.scaled_dot_product_attention(
+            q, q, q, dropout_p=0.2, is_causal=True, training=True)
+        assert calls["drop"] == 1
+        assert np.isfinite(out.numpy()).all()
+        # backward engages the dropout bwd kernels without error
+        q.stop_gradient = False
+        loss = (pt.nn.functional.scaled_dot_product_attention(
+            q, q, q, dropout_p=0.2, is_causal=True,
+            training=True) ** 2).sum()
+        loss.backward()
+        assert np.isfinite(q.grad.numpy()).all()
+    finally:
+        registry.deregister_kernel("flash_attention", "cpu")
+
+
+def test_dropout_keep_rate_and_determinism():
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.flash_attention import _flash_bhsd_drop
+
+    bh, s, d = 2, 64, 16
+    rate = 0.25
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(bh, s, d).astype(np.float32))
+    seed = jnp.asarray([11, 5], jnp.int32)
+    scale = 1.0 / np.sqrt(d)
+    # v = ones: each output row is the (dropped, rescaled) prob mass —
+    # mean ~= 1.0 if keep rate ~= 1 - rate with 1/(1-rate) rescale
+    vone = jnp.ones((bh, s, d), jnp.float32)
+    out = _flash_bhsd_drop(q, q, vone, seed, False, scale, True,
+                           None, None, 0, rate)
+    assert abs(float(jnp.mean(out)) - 1.0) < 0.05
+    out2 = _flash_bhsd_drop(q, q, vone, seed, False, scale, True,
+                            None, None, 0, rate)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    out3 = _flash_bhsd_drop(q, q, vone, jnp.asarray([12, 5], jnp.int32),
+                            False, scale, True, None, None, 0, rate)
+    assert not np.allclose(np.asarray(out), np.asarray(out3))
