@@ -85,32 +85,42 @@ def _dev_put(arr):
     return jax.device_put(arr, s.with_memory_kind("device"))
 
 
-def _wrap_step_for_offload(optimizer, dev_place, host_place):
-    """Eager `optimizer.step` under offload: stream state host->device,
-    run the (device-memory) update, stream the new state back to host.
-    Mixed host/device operands are a hard error in XLA, so the staging
-    must bracket the whole update — which is exactly the reference's
-    offload semantics (CPU-resident state, device compute per step)."""
-    orig_step = optimizer.step
+def _wrap_accessors_for_offload(optimizer):
+    """Eager offload: bracket ONE param's state at a time through the
+    optimizer's state accessors — _get stages host->HBM just before the
+    update consumes it, _set parks the new state back in host memory, so
+    peak HBM holds a single param's moments+master rather than the whole
+    optimizer (mixed host/device operands are a hard error in XLA, which
+    is why the staging must bracket the compute). Mirrors the reference
+    offload's per-param host-resident state."""
 
-    def step():
-        optimizer._state_placement = dev_place
-        for key, st in list(optimizer._accumulators.items()):
-            optimizer._accumulators[key] = {
-                k: _dev_put(v) for k, v in st.items()}
-        for key, m in list(optimizer._master_weights.items()):
-            optimizer._master_weights[key] = _dev_put(m)
-        try:
-            orig_step()
-        finally:
-            for key, st in list(optimizer._accumulators.items()):
-                optimizer._accumulators[key] = {
-                    k: _host_put(v) for k, v in st.items()}
-            for key, m in list(optimizer._master_weights.items()):
-                optimizer._master_weights[key] = _host_put(m)
-            optimizer._state_placement = host_place
+    def get_accum(key):
+        st = Optimizer_get_accum(optimizer, key)
+        if st is None:
+            return None
+        return {k: _dev_put(v) for k, v in st.items()}
 
-    optimizer.step = step
+    def set_accum(key, st):
+        Optimizer_set_accum(optimizer, key,
+                            {k: _host_put(v) for k, v in st.items()})
+
+    def get_master(key):
+        m = Optimizer_get_master(optimizer, key)
+        return None if m is None else _dev_put(m)
+
+    def set_master(key, m):
+        Optimizer_set_master(optimizer, key, _host_put(m))
+
+    from ...optimizer.optimizer import Optimizer
+
+    Optimizer_get_accum = Optimizer._get_accum
+    Optimizer_set_accum = Optimizer._set_accum
+    Optimizer_get_master = Optimizer._get_master
+    Optimizer_set_master = Optimizer._set_master
+    optimizer._get_accum = get_accum
+    optimizer._set_accum = set_accum
+    optimizer._get_master = get_master
+    optimizer._set_master = set_master
 
 
 def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,
@@ -129,13 +139,19 @@ def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,
     dev_place = lambda arr: _sharded_put(arr, axis)  # noqa: E731
     if offload:
         host_place = lambda arr: _host_put(_sharded_put(arr, axis))  # noqa: E731
+        # in-step creations stay in device memory (they are consumed
+        # immediately); the accessors park state in host memory after
+        # each per-param update, and _initial_state_placement host-places
+        # state created OUTSIDE a step (compiled TrainStep._ensure_state)
         place = host_place
-        _wrap_step_for_offload(optimizer, dev_place, host_place)
+        optimizer._state_placement = dev_place
+        optimizer._initial_state_placement = host_place
+        _wrap_accessors_for_offload(optimizer)
         optimizer._offload_state = True
     else:
         place = dev_place
+        optimizer._state_placement = place
 
-    optimizer._state_placement = place
     # re-place any state that already exists
     for key, st in list(optimizer._accumulators.items()):
         optimizer._accumulators[key] = {

@@ -88,6 +88,13 @@ class TrainStep:
             return
         opt = self._opt
         self._step_count = opt._global_step
+        # state created OUTSIDE a step parks in its at-rest placement:
+        # under ZeRO offload that is pinned host memory
+        # (_initial_state_placement); the compiled step stages it in
+        ip = getattr(opt, "_initial_state_placement", None)
+        place_m = ip if ip is not None else opt._place_master
+        place_s = ((lambda st: {k: ip(v) for k, v in st.items()})
+                   if ip is not None else opt._place_state)
         for p in self._params:
             arr = p._data
             low_prec = arr.dtype.name in ("bfloat16", "float16")
@@ -95,13 +102,13 @@ class TrainStep:
             if opt._multi_precision and low_prec:
                 master = opt._master_weights.get(id(p))
                 if master is None:
-                    master = opt._place_master(arr.astype(jnp.float32))
+                    master = place_m(arr.astype(jnp.float32))
                 self._state.append(existing if existing is not None else
-                                   opt._place_state(opt._init_state(master)))
+                                   place_s(opt._init_state(master)))
                 self._masters.append(master)
             else:
                 self._state.append(existing if existing is not None else
-                                   opt._place_state(opt._init_state(arr)))
+                                   place_s(opt._init_state(arr)))
                 self._masters.append(None)
 
     def _sync_optimizer(self):

@@ -63,6 +63,23 @@ class Optimizer:
         (they are the largest single saving)."""
         return arr if self._state_placement is None else self._state_placement(arr)
 
+    # ---- overridable state accessors ----
+    # The eager step goes through these so a wrapper can bracket ONE
+    # param's state at a time (ZeRO offload stages host->HBM here,
+    # bounding peak HBM to a single param's state instead of the whole
+    # optimizer — reference offload runs per-param on CPU).
+    def _get_accum(self, key):
+        return self._accumulators.get(key)
+
+    def _set_accum(self, key, state):
+        self._accumulators[key] = state
+
+    def _get_master(self, key):
+        return self._master_weights.get(key)
+
+    def _set_master(self, key, arr):
+        self._master_weights[key] = arr
+
     # ---- lr ----
     def get_lr(self):
         if isinstance(self._learning_rate, LRScheduler):
@@ -131,7 +148,7 @@ class Optimizer:
             param_arr = p._data
             # multi-precision: keep an fp32 master copy for bf16/fp16 params
             if self._multi_precision and param_arr.dtype.name in ("bfloat16", "float16"):
-                master = self._master_weights.get(key)
+                master = self._get_master(key)
                 if master is None:
                     master = self._place_master(param_arr.astype(jnp.float32))
                 work = master
@@ -139,18 +156,17 @@ class Optimizer:
             else:
                 work = param_arr
                 g_arr = g._data.astype(param_arr.dtype)
-            state = self._accumulators.get(key)
+            state = self._get_accum(key)
             if state is None:
                 state = self._place_state(self._init_state(work))
-                self._accumulators[key] = state
             work = self._apply_decoupled_decay(work, lr_p, p)
             new_p, new_state = self._update(work, g_arr, state, lr_p, step)
             mask = self._param_masks.get(key)
             if mask is not None:
                 new_p = new_p * mask.astype(new_p.dtype)
-            self._accumulators[key] = new_state
+            self._set_accum(key, new_state)
             if self._multi_precision and param_arr.dtype.name in ("bfloat16", "float16"):
-                self._master_weights[key] = new_p
+                self._set_master(key, new_p)
                 p._data = new_p.astype(param_arr.dtype)
             else:
                 p._data = new_p
