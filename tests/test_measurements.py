@@ -68,3 +68,48 @@ def test_bench_emits_last_good_inline(store, monkeypatch):
     lg = meas.last_good("llama_train_tokens_per_sec_per_chip")
     assert lg["extra"]["mfu"] == 0.574
     assert lg["device"] == "TPU v5 lite"
+
+
+def test_dirty_headline_marked_and_digest(tmp_path, monkeypatch):
+    from paddle_tpu.utils import measurements as m
+
+    monkeypatch.setenv("PT_MEASUREMENTS_PATH", str(tmp_path / "s.json"))
+    monkeypatch.setattr(m, "_git_commit", lambda: {
+        "commit": "abc123", "dirty": True, "diff_digest": "deadbeefcafe"})
+    rec = m.record("llama_train_tokens_per_sec_per_chip", 1.0, "tokens/s",
+                   backend="tpu", device="TPU v5 lite")
+    assert rec["dirty_headline"] is True
+    assert rec["diff_digest"] == "deadbeefcafe"
+    # non-headline dirty records are stored without the loud mark
+    rec2 = m.record("some_micro_metric", 2.0, "s", backend="tpu",
+                    device="TPU v5 lite")
+    assert "dirty_headline" not in rec2
+    # cpu records never headline-mark
+    rec3 = m.record("llama_train_tokens_per_sec_per_chip", 1.0,
+                    "tokens/s", backend="cpu", device="cpu")
+    assert "dirty_headline" not in rec3
+
+
+def test_dirty_headline_refused_in_strict_mode(tmp_path, monkeypatch):
+    import pytest
+
+    from paddle_tpu.utils import measurements as m
+
+    monkeypatch.setenv("PT_MEASUREMENTS_PATH", str(tmp_path / "s.json"))
+    monkeypatch.setenv("PT_REFUSE_DIRTY_HEADLINE", "1")
+    monkeypatch.setattr(m, "_git_commit", lambda: {
+        "commit": "abc123", "dirty": True, "diff_digest": "deadbeefcafe"})
+    with pytest.raises(RuntimeError, match="refusing dirty-tree"):
+        m.record("llama_train_tokens_per_sec_per_chip", 1.0, "tokens/s",
+                 backend="tpu", device="TPU v5 lite")
+
+
+def test_diff_digest_real_git_when_dirty(monkeypatch, tmp_path):
+    # live _git_commit: digest present iff dirty
+    from paddle_tpu.utils import measurements as m
+
+    out = m._git_commit()
+    if out.get("dirty"):
+        assert len(out.get("diff_digest", "")) == 12
+    else:
+        assert "diff_digest" not in out

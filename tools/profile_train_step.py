@@ -182,20 +182,26 @@ def main():
     if not on_cpu:
         from paddle_tpu.utils import measurements as meas
 
+        # persist the DEVICE-BUSY fraction, not traced-wall MFU: the
+        # traced wall is profiler-inflated ~12-40x, so a metric named
+        # "mfu" computed from it is junk data that contradicts its own
+        # name (round-4 verdict weak #4). Throughput truth lives in the
+        # bench metric; this record carries the profile breakdown.
         meas.record_or_warn(
-            "llama_train_profile_mfu", round(mfu, 4), "mfu",
-            extra={"tokens_per_sec": round(tokens_per_sec, 1),
-                   "note": "tokens_per_sec/mfu here are profiler-inflated"
-                           "; the bench metric is the throughput truth",
+            "llama_train_profile_device_busy_frac",
+            round(device_busy, 4) if device_busy is not None else -1.0,
+            "fraction",
+            extra={"note": "device-time/step over the last-good bench "
+                           "step wall at the same config; -1 = no "
+                           "matching bench record or no device lane",
+                   "traced_wall_tokens_per_sec":
+                       round(tokens_per_sec, 1),
                    "breakdown_s": ({k: round(v, 4)
                                     for k, v in rows.items()}
                                    if rows else None),
                    "device_s_per_step": (round(device_s_per_step, 4)
                                          if device_s_per_step is not None
                                          else None),
-                   "device_busy_vs_bench": (round(device_busy, 4)
-                                            if device_busy is not None
-                                            else None),
                    "steps": args.steps, "outdir": args.outdir})
     return 0
 
