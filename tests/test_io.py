@@ -1,0 +1,125 @@
+"""DataLoader worker-mode tests (reference
+`io/dataloader/dataloader_iter.py`: single/multi-process iterators)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+class _RangeDataset(pt.io.Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.asarray([i], np.int64)
+
+
+
+
+class TestProcessWorkers:
+    """worker_mode='process' (reference _DataLoaderIterMultiProcess)."""
+
+    def test_order_and_values(self):
+        import paddle_tpu as pt
+
+        ds = _RangeDataset(37)
+        dl = pt.io.DataLoader(ds, batch_size=5, num_workers=2,
+                              worker_mode="process")
+        seen = []
+        for b in dl:
+            seen.extend(np.asarray(b.numpy()).ravel().tolist())
+        assert seen == list(range(37))
+
+    def test_worker_init_fn_runs_in_child_pids(self):
+        import multiprocessing as mp
+        import os
+
+        import paddle_tpu as pt
+
+        init_q = mp.get_context("fork").Queue()
+
+        def init_fn(wid):
+            init_q.put((wid, os.getpid()))
+
+        class PidDataset(pt.io.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return np.asarray([os.getpid()], np.int64)
+
+        dl = pt.io.DataLoader(PidDataset(), batch_size=2, num_workers=2,
+                              worker_mode="process",
+                              worker_init_fn=init_fn)
+        got = {int(np.asarray(b.numpy()).ravel()[0]) for b in dl}
+        assert os.getpid() not in got  # work really ran out-of-process
+        inits = [init_q.get(timeout=5) for _ in range(2)]
+        assert sorted(w for w, _ in inits) == [0, 1]
+        assert all(pid != os.getpid() for _, pid in inits)
+
+    def test_worker_init_fn_error_fails_fast_thread_mode(self):
+        import paddle_tpu as pt
+
+        def bad_init(wid):
+            raise ValueError("boom in init")
+
+        dl = pt.io.DataLoader(_RangeDataset(8), batch_size=2,
+                              num_workers=2, worker_init_fn=bad_init)
+        with pytest.raises(RuntimeError, match="worker_init_fn failed"):
+            list(dl)
+
+    def test_dead_worker_process_raises_not_hangs(self):
+        import paddle_tpu as pt
+
+        class Killer(pt.io.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                if i == 3:
+                    import os
+
+                    os._exit(17)  # simulated OOM-kill / segfault
+                return np.asarray([i], np.int64)
+
+        dl = pt.io.DataLoader(Killer(), batch_size=2, num_workers=2,
+                              worker_mode="process")
+        with pytest.raises(RuntimeError, match="died"):
+            list(dl)
+
+    def test_error_propagates(self):
+        import paddle_tpu as pt
+
+        class Bad(pt.io.Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                if i == 2:
+                    raise ValueError("bad item 2")
+                return np.zeros(2, np.float32)
+
+        dl = pt.io.DataLoader(Bad(), batch_size=2, num_workers=2,
+                              worker_mode="process")
+        with pytest.raises(RuntimeError, match="bad item 2"):
+            list(dl)
+
+    def test_invalid_mode_raises(self):
+        import paddle_tpu as pt
+
+        with pytest.raises(Exception, match="worker_mode"):
+            pt.io.DataLoader(_RangeDataset(4), batch_size=2,
+                             worker_mode="greenlet")
+
+    def test_thread_mode_worker_init_fn(self):
+        import paddle_tpu as pt
+
+        called = []
+        dl = pt.io.DataLoader(_RangeDataset(8), batch_size=2,
+                              num_workers=2,
+                              worker_init_fn=lambda w: called.append(w))
+        list(dl)
+        assert sorted(called) == [0, 1]

@@ -1,0 +1,64 @@
+"""Threads vs processes for Python-heavy vs numpy-heavy __getitem__.
+
+Backs the DataLoader worker_mode default (io/reader.py, PERF.md "Input
+pipeline"). Run on a MULTI-CORE host for the scaling question — a 1-core
+box (the round-5 CI box) can only show the serial rates and the IPC tax.
+"""
+import time, threading, queue, multiprocessing as mp
+import numpy as np
+
+N_ITEMS = 512
+
+def py_heavy(i):
+    # tokenizer-ish: pure-Python loop + small-object churn (GIL-bound)
+    rng = np.random.RandomState(i)
+    s = rng.randint(0, 255, 2048).tolist()
+    toks = []
+    for b in s:
+        toks.append((b * 131 + 7) % 30000)
+        if b % 7 == 0:
+            toks.append(b)
+    arr = np.asarray(toks[:1024], np.int32)
+    return np.pad(arr, (0, 1024 - len(arr)))
+
+def np_heavy(i):
+    # decode/augment-ish: big numpy ops (GIL released)
+    rng = np.random.RandomState(i)
+    img = rng.randint(0, 255, (224, 224, 3)).astype(np.float32)
+    img = img[::-1].copy()
+    img = (img - img.mean((0, 1))) / (img.std((0, 1)) + 1e-5)
+    return img.transpose(2, 0, 1)
+
+def bench_serial(fn):
+    t0 = time.perf_counter()
+    for i in range(N_ITEMS):
+        fn(i)
+    return N_ITEMS / (time.perf_counter() - t0)
+
+def bench_threads(fn, n):
+    q_in = queue.Queue(); done = []
+    for i in range(N_ITEMS): q_in.put(i)
+    def w():
+        while True:
+            try: i = q_in.get_nowait()
+            except queue.Empty: return
+            done.append(fn(i) is not None)
+    t0 = time.perf_counter()
+    ts = [threading.Thread(target=w) for _ in range(n)]
+    [t.start() for t in ts]; [t.join() for t in ts]
+    return N_ITEMS / (time.perf_counter() - t0)
+
+def bench_procs(fn, n):
+    with mp.get_context("fork").Pool(n) as pool:
+        pool.map(fn, range(n))  # real warm-up: every worker forks + runs once
+        t0 = time.perf_counter()
+        list(pool.imap_unordered(fn, range(N_ITEMS), chunksize=8))
+        return N_ITEMS / (time.perf_counter() - t0)
+
+for name, fn in [("py_heavy", py_heavy), ("np_heavy", np_heavy)]:
+    ser = bench_serial(fn)
+    print(f"{name}: serial {ser:.0f} it/s")
+    for n in (4, 8):
+        print(f"  threads x{n}: {bench_threads(fn, n):.0f} it/s")
+    for n in (4, 8):
+        print(f"  procs   x{n}: {bench_procs(fn, n):.0f} it/s")
