@@ -70,7 +70,23 @@ class Config:
 
     def set_precision(self, precision):
         """"float32" | "bfloat16" | "float16": cast floating inputs before
-        the compiled program (reference: auto-mixed-precision inference)."""
+        the compiled program (reference: auto-mixed-precision inference).
+
+        int8 note: a jit-exported program's dtypes are fixed at export,
+        so int8 execution is a MODEL conversion, not an input cast —
+        run `paddle.quantization.convert_to_int8(model)` (weight-only or
+        full s8xs8 matmuls) BEFORE `paddle.jit.save`; the exported
+        program then carries the int8 ops (reference analogue: TRT int8
+        engines are likewise built from a calibrated model)."""
+        if precision == "int8":
+            from ..framework.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                "set_precision('int8'): int8 is a model conversion, not "
+                "an input cast. Convert before export: "
+                "paddle.quantization.convert_to_int8(model, "
+                "mode='weight_only'|'int8'), then paddle.jit.save — see "
+                "the Config.set_precision docstring.")
         self._precision = precision
 
     def enable_memory_optim(self, x=True):

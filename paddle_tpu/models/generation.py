@@ -26,16 +26,41 @@ from ..framework.core import Tensor
 __all__ = ["generate"]
 
 
-def _collect_params(model):
+def _quantize_weight_int8(w):
+    """Per-output-channel symmetric int8 weight-only quantization for
+    decode: HBM reads of the matmul weights halve vs bf16 (decode is
+    bandwidth-bound — PERF.md decode accounting). w [..., in, out] ->
+    {"q": int8 same shape, "s": fp32 [..., 1, out]}; `_mm` dequantizes
+    in-register (XLA fuses the convert into the dot's operand read)."""
+    w32 = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(w32), axis=-2, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-12)
+    q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def _mm(x, w):
+    """x @ w where w is a plain array or an int8 weight-only pack."""
+    if isinstance(w, dict):
+        return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    return x @ w
+
+
+def _collect_params(model, int8_weights=False):
     """Pull the Llama weight pytree out of the Layer graph (stacked per
     layer so the decode program scans over layers, O(1) compile in
     depth). Cached on the model keyed by the parameter array identities,
     so repeated generate() calls don't re-copy the weights; any weight
-    update (new arrays) invalidates the cache."""
+    update (new arrays) invalidates the cache. ``int8_weights`` packs
+    the large matmul weights (qkv/o/gate_up/down/lm_head) as
+    per-channel int8 (reference analogue: weight-only quantized
+    inference kernels); embeddings/norms stay in the model dtype."""
     core = model.model
     sources = tuple(p._data for _, p in model.named_parameters())
     cached = getattr(model, "_generation_params_cache", None)
-    if cached is not None and len(cached[0]) == len(sources) \
+    if cached is not None and len(cached) == 3 \
+            and cached[2] == int8_weights \
+            and len(cached[0]) == len(sources) \
             and all(a is b for a, b in zip(cached[0], sources)):
         return cached[1]
 
@@ -56,8 +81,11 @@ def _collect_params(model):
     params["embed"] = arr(core.embed_tokens.weight)
     params["norm"] = arr(core.norm.weight)
     params["lm_head"] = arr(model.lm_head.weight)
+    if int8_weights:
+        for key in ("qkv", "o", "gate_up", "down", "lm_head"):
+            params[key] = _quantize_weight_int8(params[key])
     # the cache keeps the SOURCE arrays alive so identity comparison is sound
-    model._generation_params_cache = (sources, params)
+    model._generation_params_cache = (sources, params, int8_weights)
     return params
 
 
@@ -113,7 +141,7 @@ def _block(x, layer_p, cache_k, cache_v, li, pos, valid_len, cfg,
     nkv = cfg.num_key_value_heads or nh
     d = cfg.hidden_size // nh
     h = _rms(x, layer_p["ln1"], cfg.rms_norm_eps)
-    qkv = h @ layer_p["qkv"]
+    qkv = _mm(h, layer_p["qkv"])
     q, k, v = jnp.split(qkv, [nh * d, nh * d + nkv * d], axis=-1)
     b, s = x.shape[0], x.shape[1]
     q = q.reshape(b, s, nh, d)
@@ -128,13 +156,13 @@ def _block(x, layer_p, cache_k, cache_v, li, pos, valid_len, cfg,
                                             valid_len - s, 1))
     out = _attend(q, ck[li], cv[li], valid_len, nh, nkv,
                   key_pad=key_pad, sliding_window=cfg.sliding_window)
-    out = out.reshape(b, s, nh * d) @ layer_p["o"]
+    out = _mm(out.reshape(b, s, nh * d), layer_p["o"])
     x = x + out
     h2 = _rms(x, layer_p["ln2"], cfg.rms_norm_eps)
-    gu = h2 @ layer_p["gate_up"]
+    gu = _mm(h2, layer_p["gate_up"])
     gate, up = jnp.split(gu, 2, axis=-1)
-    x = x + (jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype)
-             * up) @ layer_p["down"]
+    x = x + _mm(jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype)
+                * up, layer_p["down"])
     return x, ck, cv
 
 
@@ -150,11 +178,12 @@ def _forward(params, ids, cache_k, cache_v, valid_len, cfg,
     pos = (valid_len - s + jnp.arange(s))[None, :].repeat(b, axis=0)
     if key_pad is not None:
         pos = jnp.maximum(pos - key_pad[:, None], 0)
-    n_layers = params["qkv"].shape[0]
+    n_layers = params["ln1"].shape[0]
 
     def body(carry, li):
         x, ck, cv = carry
-        layer_p = {k: params[k][li] for k in
+        layer_p = {k: jax.tree_util.tree_map(lambda a: a[li], params[k])
+                   for k in
                    ("ln1", "qkv", "o", "ln2", "gate_up", "down")}
         x, ck, cv = _block(x, layer_p, ck, cv, li, pos, valid_len, cfg,
                            key_pad=key_pad)
@@ -163,7 +192,7 @@ def _forward(params, ids, cache_k, cache_v, valid_len, cfg,
     (x, cache_k, cache_v), _ = jax.lax.scan(
         body, (x, cache_k, cache_v), jnp.arange(n_layers))
     x = _rms(x, params["norm"], cfg.rms_norm_eps)
-    logits = x[:, -1] @ params["lm_head"]
+    logits = _mm(x[:, -1], params["lm_head"])
     return logits.astype(jnp.float32), cache_k, cache_v
 
 
@@ -234,7 +263,7 @@ def _generate_jit(params, ids, key, temperature, top_p, key_pad, *, cfg,
     d = cfg.hidden_size // nh
     max_len = prompt_len + max_new_tokens
     dt = jnp.dtype(cfg.dtype)
-    cache_k = jnp.zeros((params["qkv"].shape[0], b, max_len, nkv, d), dt)
+    cache_k = jnp.zeros((params["ln1"].shape[0], b, max_len, nkv, d), dt)
     cache_v = jnp.zeros_like(cache_k)
 
     # prefill: the whole prompt in one batched pass
@@ -270,7 +299,7 @@ def _generate_jit(params, ids, key, temperature, top_p, key_pad, *, cfg,
 
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-             seed=0, attention_mask=None):
+             seed=0, attention_mask=None, int8_weights=None):
     """Generate ``max_new_tokens`` continuations of ``input_ids``
     ([b, prompt_len] int tensor) with the compiled KV-cache decode loop.
     Returns the generated tokens [b, max_new_tokens] (prompt excluded).
@@ -288,7 +317,11 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             "generate() does not decode MoE Llama configs yet (the expert "
             "dispatch needs its own cached single-token path); dense "
             "configs are supported")
-    params = _collect_params(model)
+    if int8_weights is None:
+        import os
+
+        int8_weights = os.environ.get("PT_DECODE_INT8") == "1"
+    params = _collect_params(model, int8_weights=int8_weights)
     ids = input_ids._data if isinstance(input_ids, Tensor) \
         else jnp.asarray(np.asarray(input_ids))
     # every operand must sit on one device set or jit rejects the mix.

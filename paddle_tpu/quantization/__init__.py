@@ -221,3 +221,113 @@ def quanter(name):
 _QUANTER_REGISTRY: dict = {}
 
 __all__ += ["BaseObserver", "BaseQuanter", "quanter"]
+
+
+def _int8_linear_fn(xa, wq, ws, ba=None, *, mode="weight_only",
+                    act_scale=None):
+    if mode == "int8":
+        a_s = jnp.float32(act_scale / 127.0)
+        xq = jnp.clip(jnp.round(xa.astype(jnp.float32) / a_s),
+                      -127, 127).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            xq, wq, (((xq.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (ws * a_s)
+    else:
+        y = jax.lax.dot_general(
+            xa, wq.astype(xa.dtype),
+            (((xa.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * ws.astype(jnp.float32)
+    y = y.astype(xa.dtype)
+    if ba is not None:
+        y = y + ba
+    return y
+
+
+class Int8Linear(Layer):
+    """True int8-EXECUTING linear (not fake-quant simulation).
+
+    Reference parity: the reference runs QAT/PTQ output through
+    quantized PHI kernels / TRT int8 (`paddle/fluid/inference/tensorrt`,
+    quantized GPU ops); here the execution paths are XLA-native:
+
+    - ``mode='weight_only'``: weights stored per-output-channel int8 and
+      dequantized in-register inside the matmul — HBM weight traffic
+      halves vs bf16 (the decode-bandwidth lever; identical math to
+      `models/generation._mm`).
+    - ``mode='int8'``: activations are ALSO quantized (per-tensor, the
+      PTQ-calibrated scale) and the product runs as an s8 x s8 -> s32
+      `lax.dot_general`, hitting the int8 MXU peak (~2x bf16 on v5e);
+      the s32 accumulator is rescaled by act_scale * w_scale.
+    """
+
+    def __init__(self, inner: Linear, act_scale=None, mode="weight_only"):
+        super().__init__()
+        if mode not in ("weight_only", "int8"):
+            raise ValueError(f"Int8Linear mode {mode!r}")
+        if mode == "int8" and act_scale is None:
+            raise ValueError(
+                "mode='int8' needs a calibrated activation scale (run "
+                "PTQ, then convert_to_int8(model, mode='int8'))")
+        self.mode = mode
+        w = inner.weight._data.astype(jnp.float32)  # [in, out]
+        s = jnp.maximum(jnp.max(jnp.abs(w), axis=0, keepdims=True),
+                        1e-12) / 127.0
+        self.register_buffer("w_q", Tensor(
+            jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)))
+        self.register_buffer("w_scale", Tensor(s))
+        self.bias = inner.bias
+        self.act_scale = (float(act_scale)
+                          if act_scale is not None else None)
+
+    def forward(self, x):
+        # per-layer state travels as STATIC kwargs on a module-level fn:
+        # a closure over `self` would key the dispatch primitive cache by
+        # instance identity, pinning every converted layer's weights in
+        # the (eviction-free) cache and compiling one jit per instance
+        args = (x, self.w_q, self.w_scale)
+        if self.bias is not None:
+            args = args + (self.bias,)
+        return apply("int8_linear", _int8_linear_fn, args,
+                     mode=self.mode, act_scale=self.act_scale)
+
+
+def convert_to_int8(model, mode="weight_only", inplace=True):
+    """Replace quantized (or plain) Linear layers with int8-EXECUTING
+    `Int8Linear`. `QuantedLinear` layers (PTQ/QAT output) contribute
+    their calibrated activation scale for ``mode='int8'``; plain Linear
+    layers convert in ``weight_only`` mode only (no activation scale).
+    """
+    for name, sub in list(model._sub_layers.items()):
+        if isinstance(sub, QuantedLinear):
+            act_scale = None
+            q = sub.activation_quanter
+            if q is not None and hasattr(q, "scale"):
+                act_scale = float(np.asarray(q.scale._data))
+                if act_scale <= 0:
+                    act_scale = None
+            layer_mode = mode
+            if mode == "int8" and act_scale is None:
+                # uncalibrated observer (no calibration forward ran):
+                # stay numerically safe, but say so — a silently
+                # downgraded model benches bf16 matmuls while the user
+                # expects the int8 MXU path
+                import warnings
+
+                warnings.warn(
+                    f"convert_to_int8: layer {name!r} has no calibrated "
+                    "activation scale (did the PTQ calibration forward "
+                    "run?); downgrading it to weight_only",
+                    stacklevel=2)
+                layer_mode = "weight_only"
+            new = Int8Linear(sub.inner, act_scale, layer_mode)
+            model._sub_layers[name] = new
+            object.__setattr__(model, name, new)
+        elif isinstance(sub, Linear):
+            if mode == "weight_only":
+                new = Int8Linear(sub, None, "weight_only")
+                model._sub_layers[name] = new
+                object.__setattr__(model, name, new)
+        else:
+            convert_to_int8(sub, mode, inplace)
+    return model

@@ -173,3 +173,45 @@ def test_mask_validation(model):
                           attention_mask=pt.to_tensor(
                               np.ones((1, 4)))).numpy()
     np.testing.assert_array_equal(got2, ref)
+
+
+def test_int8_weight_only_decode_close_to_fp():
+    # weight-only per-channel int8 (decode bandwidth lever): logits of
+    # the quantized forward stay close to fp, and generate() runs
+    # end-to-end with int8 packs
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, generate
+    from paddle_tpu.models import generation as gen
+
+    pt.seed(3)
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8))
+
+    p_fp = gen._collect_params(model, int8_weights=False)
+    p_q = gen._collect_params(model, int8_weights=True)
+    # pack structure: int8 payload + fp scale, ~halved bytes on matmuls
+    assert p_q["qkv"]["q"].dtype == jnp.int8
+    b = ids.shape[0]
+    ck = jnp.zeros((cfg.num_hidden_layers, b, 16,
+                    cfg.num_key_value_heads or cfg.num_attention_heads,
+                    cfg.hidden_size // cfg.num_attention_heads),
+                   jnp.dtype(cfg.dtype))
+    lf, _, _ = gen._forward(p_fp, jnp.asarray(ids), ck, ck, 8, cfg)
+    lq, _, _ = gen._forward(p_q, jnp.asarray(ids), ck, ck, 8, cfg)
+    a = np.asarray(lf).ravel()
+    q = np.asarray(lq).ravel()
+    cos = float(np.dot(a, q) / (np.linalg.norm(a) * np.linalg.norm(q)))
+    assert cos > 0.995, cos
+
+    out = generate(model, pt.to_tensor(ids), max_new_tokens=4,
+                   int8_weights=True)
+    assert np.asarray(out.numpy()).shape == (2, 4)
+    out2 = generate(model, pt.to_tensor(ids), max_new_tokens=4,
+                    int8_weights=True)
+    np.testing.assert_array_equal(np.asarray(out.numpy()),
+                                  np.asarray(out2.numpy()))

@@ -1,0 +1,76 @@
+"""Int8 execution tests (reference: quantized PHI kernels / TRT int8
+subgraphs — SURVEY long-tail Quantization row)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+
+
+class TestInt8Execution:
+    """True int8 execution (reference: quantized kernels / TRT int8)."""
+
+    def _model_and_x(self, seed=0):
+        pt.seed(seed)
+        m = pt.nn.Sequential(pt.nn.Linear(16, 32), pt.nn.ReLU(),
+                             pt.nn.Linear(32, 4))
+        x = pt.to_tensor(np.random.RandomState(0)
+                         .randn(8, 16).astype(np.float32))
+        return m, x
+
+    def test_weight_only_close_and_int8_payload(self):
+        from paddle_tpu.quantization import Int8Linear, convert_to_int8
+
+        m, x = self._model_and_x()
+        ref = m(x).numpy()
+        m8 = convert_to_int8(m, mode="weight_only")
+        assert isinstance(m8[0], Int8Linear)
+        assert str(m8[0].w_q._data.dtype) == "int8"
+        out = m8(x).numpy()
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.05, rel
+
+    def test_ptq_to_full_int8_dot(self):
+        import jax
+
+        from paddle_tpu.quantization import (PTQ, Int8Linear,
+                                             convert_to_int8)
+
+        m, x = self._model_and_x(1)
+        ref = m(x).numpy()
+        ptq = PTQ()
+        mq = ptq.quantize(m)
+        mq(x)  # calibrate observers
+        ptq.convert(mq)
+        m8 = convert_to_int8(mq, mode="int8")
+        assert m8[0].mode == "int8"  # calibrated scale available
+        out = m8(x).numpy()
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.1, rel
+        # the executed program really runs an s8 x s8 -> s32 dot
+        txt = jax.jit(
+            lambda a: m8[0](pt.Tensor(a))._data).lower(x._data).as_text()
+        assert "xi8>" in txt and "xi32>" in txt and "dot_general" in txt
+
+    def test_int8_model_exports_via_jit_save(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.quantization import convert_to_int8
+
+        m, x = self._model_and_x(2)
+        m8 = convert_to_int8(m, mode="weight_only")
+        ref = m8(x).numpy()
+        path = str(tmp_path / "int8_model")
+        paddle.jit.save(m8, path, input_spec=[x])
+        loaded = paddle.jit.load(path)
+        out = np.asarray(loaded(x).numpy())
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_inference_config_int8_points_to_conversion(self):
+        import pytest
+
+        from paddle_tpu.inference import Config
+
+        cfg = Config()
+        with pytest.raises(Exception, match="convert_to_int8"):
+            cfg.set_precision("int8")
