@@ -25,21 +25,28 @@ def _sync(x):
     return float(np.asarray(x.numpy()).sum())
 
 
-def bench_resnet50(smoke):
-    import jax
+def build_resnet_trainstep(smoke):
+    """The ONE ResNet50 model+step (shared with
+    tools/profile_train_step.py --model resnet — a profile must be
+    attributable to the bench number). Returns (model, step, x, y,
+    batch, hw)."""
     import paddle_tpu as pt
     from paddle_tpu.jit.train_step import TrainStep
     from paddle_tpu.vision.models import resnet50
 
     pt.seed(0)
     if smoke:
-        batch, hw, steps, warmup, depth_kw = 4, 32, 2, 1, {"num_classes": 10}
+        batch, hw, depth_kw = 4, 32, {"num_classes": 10}
     else:
         # b256 measured 2084 imgs/s vs 1984 at b128 (round 4); the
         # persistent compile cache amortizes the bigger compile the
         # round-3 tunnel couldn't afford. PT_RESNET_BATCH to sweep
         batch = int(os.environ.get("PT_RESNET_BATCH", "256"))
-        hw, steps, warmup, depth_kw = 224, 10, 2, {}
+        hw, depth_kw = 224, {}
+    # PT_RESNET_FORMAT=NHWC: channel-last end-to-end — the round-5
+    # layout A/B against the 0.130-MFU NCHW measurement
+    fmt = os.environ.get("PT_RESNET_FORMAT", "NCHW")
+    depth_kw["data_format"] = fmt
     model = resnet50(**depth_kw)
     model = pt.amp.decorate(model, level="O2", dtype="bfloat16")
     opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
@@ -51,11 +58,22 @@ def bench_resnet50(smoke):
         return loss_fn(m(x), y)
 
     step = TrainStep(model, opt, compute, donate=True)
-    x = pt.to_tensor(
-        (np.random.randn(batch, 3, hw, hw) * 0.1).astype(np.float32))
+    shape = (batch, 3, hw, hw) if fmt == "NCHW" else (batch, hw, hw, 3)
+    x = pt.to_tensor((np.random.randn(*shape) * 0.1).astype(np.float32))
     x = x.astype("bfloat16")
     y = pt.to_tensor(np.random.randint(
         0, model.num_classes, (batch, 1)).astype(np.int64))
+    return model, step, x, y, batch, hw
+
+
+def bench_resnet50(smoke):
+    import jax
+
+    if smoke:
+        steps, warmup = 2, 1
+    else:
+        steps, warmup = 10, 2
+    model, step, x, y, batch, hw = build_resnet_trainstep(smoke)
 
     for _ in range(warmup):
         _sync(step(x, y))
@@ -70,7 +88,8 @@ def bench_resnet50(smoke):
     flops_img = 3 * 4.1e9 if hw == 224 else None
     out = {"metric": "resnet50_train_imgs_per_sec_per_chip",
            "value": round(imgs_per_sec, 1), "unit": "imgs/s",
-           "batch": batch, "final_loss": round(final, 3)}
+           "batch": batch, "final_loss": round(final, 3),
+           "data_format": os.environ.get("PT_RESNET_FORMAT", "NCHW")}
     if flops_img:
         from bench import _peak_flops  # same chip peak table
 

@@ -29,14 +29,13 @@ __all__ = ["generate"]
 def _quantize_weight_int8(w):
     """Per-output-channel symmetric int8 weight-only quantization for
     decode: HBM reads of the matmul weights halve vs bf16 (decode is
-    bandwidth-bound — PERF.md decode accounting). w [..., in, out] ->
-    {"q": int8 same shape, "s": fp32 [..., 1, out]}; `_mm` dequantizes
-    in-register (XLA fuses the convert into the dot's operand read)."""
-    w32 = w.astype(jnp.float32)
-    s = jnp.max(jnp.abs(w32), axis=-2, keepdims=True) / 127.0
-    s = jnp.maximum(s, 1e-12)
-    q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
-    return {"q": q, "s": s}
+    bandwidth-bound — PERF.md decode accounting). Delegates to the ONE
+    shared helper (`quantization.quantize_weight_int8`) so the decode
+    pack and Int8Linear cannot diverge; `_mm` dequantizes in-register
+    (XLA fuses the convert into the dot's operand read)."""
+    from ..quantization import quantize_weight_int8
+
+    return quantize_weight_int8(w)
 
 
 def _mm(x, w):

@@ -23,7 +23,8 @@ from ..nn.layer.layers import Layer
 from ..ops.dispatch import apply
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMax",
-           "AbsmaxObserver", "quant_dequant"]
+           "AbsmaxObserver", "quant_dequant", "Int8Linear",
+           "convert_to_int8", "quantize_weight_int8"]
 
 
 def _fake_quant(x, scale, bits=8):
@@ -223,6 +224,19 @@ _QUANTER_REGISTRY: dict = {}
 __all__ += ["BaseObserver", "BaseQuanter", "quanter"]
 
 
+def quantize_weight_int8(w):
+    """Per-output-channel symmetric int8 weight-only quantization —
+    THE shared helper (models/generation decode packs and Int8Linear
+    both use it, so the decode path and the inference layer cannot
+    diverge on scale/clip semantics). w [..., in, out] ->
+    {"q": int8 same shape, "s": fp32 [..., 1, out]}."""
+    w32 = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(w32), axis=-2, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-12)
+    q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
 def _int8_linear_fn(xa, wq, ws, ba=None, *, mode="weight_only",
                     act_scale=None):
     if mode == "int8":
@@ -270,12 +284,9 @@ class Int8Linear(Layer):
                 "mode='int8' needs a calibrated activation scale (run "
                 "PTQ, then convert_to_int8(model, mode='int8'))")
         self.mode = mode
-        w = inner.weight._data.astype(jnp.float32)  # [in, out]
-        s = jnp.maximum(jnp.max(jnp.abs(w), axis=0, keepdims=True),
-                        1e-12) / 127.0
-        self.register_buffer("w_q", Tensor(
-            jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)))
-        self.register_buffer("w_scale", Tensor(s))
+        pack = quantize_weight_int8(inner.weight._data)  # [in, out]
+        self.register_buffer("w_q", Tensor(pack["q"]))
+        self.register_buffer("w_scale", Tensor(pack["s"]))
         self.bias = inner.bias
         self.act_scale = (float(act_scale)
                           if act_scale is not None else None)
@@ -297,7 +308,13 @@ def convert_to_int8(model, mode="weight_only", inplace=True):
     `Int8Linear`. `QuantedLinear` layers (PTQ/QAT output) contribute
     their calibrated activation scale for ``mode='int8'``; plain Linear
     layers convert in ``weight_only`` mode only (no activation scale).
+    ``inplace=False`` deep-copies first so the caller keeps the fp
+    model (the A/B case).
     """
+    if not inplace:
+        import copy
+
+        model = copy.deepcopy(model)
     for name, sub in list(model._sub_layers.items()):
         if isinstance(sub, QuantedLinear):
             act_scale = None
@@ -329,5 +346,5 @@ def convert_to_int8(model, mode="weight_only", inplace=True):
                 model._sub_layers[name] = new
                 object.__setattr__(model, name, new)
         else:
-            convert_to_int8(sub, mode, inplace)
+            convert_to_int8(sub, mode, inplace=True)
     return model

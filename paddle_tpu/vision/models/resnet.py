@@ -4,9 +4,12 @@ Reference parity: `python/paddle/vision/models/resnet.py` (resnet18/34/50/
 101/152, wide variants, resnext) — BASELINE config 2's workload
 (ResNet50 ImageNet, single chip).
 
-TPU note: NCHW layout is kept at the API surface (paddle parity); XLA
-re-lays out convolutions for the MXU internally, so no NHWC surgery is
-needed in model code.
+TPU note: NCHW is the API default (paddle parity). The round-4 hardware
+measurement (MFU 0.130 at b256) contradicted the earlier assumption that
+XLA's internal re-layout makes data format moot, so the family now
+plumbs ``data_format="NHWC"`` end-to-end (convs, BN, pools run
+channel-last; the FC head is layout-free after pooling) — the A/B lever
+for the round-5 ResNet profile session (`PT_RESNET_FORMAT=NHWC`).
 """
 from __future__ import annotations
 
@@ -23,14 +26,16 @@ class BasicBlock(Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or BatchNorm2D
         self.conv1 = Conv2D(inplanes, planes, 3, padding=1, stride=stride,
-                            bias_attr=False)
-        self.bn1 = norm_layer(planes)
-        self.conv2 = Conv2D(planes, planes, 3, padding=1, bias_attr=False)
-        self.bn2 = norm_layer(planes)
+                            bias_attr=False, data_format=data_format)
+        self.bn1 = norm_layer(planes, data_format=data_format)
+        self.conv2 = Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                            data_format=data_format)
+        self.bn2 = norm_layer(planes, data_format=data_format)
         self.downsample = downsample
         self.stride = stride
 
@@ -47,17 +52,22 @@ class BottleneckBlock(Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or BatchNorm2D
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = norm_layer(width)
+        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False,
+                            data_format=data_format)
+        self.bn1 = norm_layer(width, data_format=data_format)
         self.conv2 = Conv2D(width, width, 3, padding=dilation, stride=stride,
-                            groups=groups, dilation=dilation, bias_attr=False)
-        self.bn2 = norm_layer(width)
-        self.conv3 = Conv2D(width, planes * self.expansion, 1, bias_attr=False)
-        self.bn3 = norm_layer(planes * self.expansion)
+                            groups=groups, dilation=dilation,
+                            bias_attr=False, data_format=data_format)
+        self.bn2 = norm_layer(width, data_format=data_format)
+        self.conv3 = Conv2D(width, planes * self.expansion, 1,
+                            bias_attr=False, data_format=data_format)
+        self.bn3 = norm_layer(planes * self.expansion,
+                              data_format=data_format)
         self.downsample = downsample
         self.stride = stride
 
@@ -75,8 +85,12 @@ class ResNet(Layer):
     """Parity: `paddle.vision.models.ResNet`."""
 
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1):
+                 with_pool=True, groups=1, data_format="NCHW"):
         super().__init__()
+        if data_format not in ("NCHW", "NHWC"):
+            raise ValueError(f"data_format must be NCHW or NHWC, got "
+                             f"{data_format!r}")
+        self.data_format = data_format
         layer_cfg = {
             18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
             101: [3, 4, 23, 3], 152: [3, 8, 36, 3],
@@ -90,15 +104,17 @@ class ResNet(Layer):
         self.dilation = 1
 
         self.conv1 = Conv2D(3, self.inplanes, 7, stride=2, padding=3,
-                            bias_attr=False)
-        self.bn1 = BatchNorm2D(self.inplanes)
-        self.maxpool = MaxPool2D(kernel_size=3, stride=2, padding=1)
+                            bias_attr=False, data_format=data_format)
+        self.bn1 = BatchNorm2D(self.inplanes, data_format=data_format)
+        self.maxpool = MaxPool2D(kernel_size=3, stride=2, padding=1,
+                                 data_format=data_format)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = AdaptiveAvgPool2D((1, 1))
+            self.avgpool = AdaptiveAvgPool2D((1, 1),
+                                             data_format=data_format)
         if num_classes > 0:
             self.fc = Linear(512 * block.expansion, num_classes)
 
@@ -107,16 +123,20 @@ class ResNet(Layer):
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = Sequential(
                 Conv2D(self.inplanes, planes * block.expansion, 1,
-                       stride=stride, bias_attr=False),
-                BatchNorm2D(planes * block.expansion),
+                       stride=stride, bias_attr=False,
+                       data_format=self.data_format),
+                BatchNorm2D(planes * block.expansion,
+                            data_format=self.data_format),
             )
         layers = [block(self.inplanes, planes, stride, downsample,
-                        self.groups, self.base_width)]
+                        self.groups, self.base_width,
+                        data_format=self.data_format)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes,
                                 groups=self.groups,
-                                base_width=self.base_width))
+                                base_width=self.base_width,
+                                data_format=self.data_format))
         return Sequential(*layers)
 
     def forward(self, x):

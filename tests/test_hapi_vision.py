@@ -166,3 +166,29 @@ def test_gated_visual_callbacks():
         VisualDL()
     with pytest.raises(UnavailableError):
         WandbCallback()
+
+
+def test_resnet_nhwc_layout_parity():
+    # round-5 layout lever: channel-last trunk must match NCHW exactly
+    # in eval mode (train-mode BN over tiny 1x1 maps amplifies f32
+    # rounding; eval uses running stats so parity is exact)
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.vision.models import resnet50
+
+    pt.seed(0)
+    m1 = resnet50(num_classes=10)
+    pt.seed(0)
+    m2 = resnet50(num_classes=10, data_format="NHWC")
+    m1.eval()
+    m2.eval()
+    x = np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32)
+    o1 = np.asarray(m1(pt.to_tensor(x)).numpy())
+    o2 = np.asarray(m2(pt.to_tensor(x.transpose(0, 2, 3, 1))).numpy())
+    np.testing.assert_allclose(o1, o2, atol=1e-5)
+
+    import pytest
+
+    with pytest.raises(ValueError, match="NCHW or NHWC"):
+        resnet50(num_classes=10, data_format="NWHC")

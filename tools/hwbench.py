@@ -20,18 +20,31 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 BENCHES = [
-    # (name, argv, timeout_s) — value order: headline MFU first.
-    ("headline", [sys.executable, "bench.py"], 2700),
-    ("decode", [sys.executable, "benchmarks/decode_bench.py"], 1800),
-    ("bert", [sys.executable, "benchmarks/baseline_configs.py",
-              "--bert-only"], 1800),
+    # (name, argv, timeout_s, env) — round-5 value order (VERDICT r4
+    # "Next round"): clean-tree headline + loss curve first, then 7B
+    # geometry, then the ResNet layout A/B, then the rest.
+    ("headline", [sys.executable, "bench.py"], 2700, None),
+    ("loss_curve", [sys.executable, "tools/loss_curve.py",
+                    "--steps", "200"], 2700, None),
+    ("llama7b", [sys.executable, "benchmarks/llama7b_geometry.py"],
+     2400, None),
     ("resnet", [sys.executable, "benchmarks/baseline_configs.py",
-                "--resnet-only"], 2400),
-    ("ernie", [sys.executable, "benchmarks/ernie_bench.py"], 1800),
+                "--resnet-only"], 2400, None),
+    ("resnet_nhwc", [sys.executable, "benchmarks/baseline_configs.py",
+                     "--resnet-only"], 2400, {"PT_RESNET_FORMAT": "NHWC"}),
+    ("resnet_profile", [sys.executable, "tools/profile_train_step.py",
+                        "--model", "resnet"], 1800, None),
+    ("decode", [sys.executable, "benchmarks/decode_bench.py"], 1800, None),
+    ("decode_int8", [sys.executable, "benchmarks/decode_bench.py"],
+     1800, {"PT_DECODE_INT8": "1"}),
+    ("bert", [sys.executable, "benchmarks/baseline_configs.py",
+              "--bert-only"], 1800, None),
+    ("ernie", [sys.executable, "benchmarks/ernie_bench.py"], 1800, None),
     ("longcontext", [sys.executable, "benchmarks/longcontext_bench.py"],
-     2400),
-    ("flashtune", [sys.executable, "tools/flash_autotune.py"], 2400),
-    ("profile", [sys.executable, "tools/profile_train_step.py"], 1800),
+     2400, None),
+    ("flashtune", [sys.executable, "tools/flash_autotune.py"], 2400, None),
+    ("profile", [sys.executable, "tools/profile_train_step.py"], 1800,
+     None),
 ]
 
 
@@ -58,17 +71,21 @@ def main() -> int:
         print("hwbench: no TPU — nothing to measure", flush=True)
         return 1
     results = {}
-    for name, argv, timeout_s in BENCHES:
+    for name, argv, timeout_s, extra_env in BENCHES:
         if only and name not in only:
             continue
         if not os.path.exists(os.path.join(ROOT, argv[1])):
             print(f"hwbench: {name}: script missing, skipped", flush=True)
             continue
+        env = None
+        if extra_env:
+            env = dict(os.environ)
+            env.update(extra_env)
         t0 = time.time()
         print(f"hwbench: running {name} ...", flush=True)
         try:
             proc = subprocess.run(argv, cwd=ROOT, capture_output=True,
-                                  text=True, timeout=timeout_s)
+                                  text=True, timeout=timeout_s, env=env)
             out = proc.stdout.strip().splitlines()
             results[name] = {"rc": proc.returncode,
                              "secs": round(time.time() - t0, 1),
