@@ -312,6 +312,10 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         sink(("barrier",), 0)  # all hosts' shards durable before index
         if is_coordinator:
             sink(("index", {"format": 2, "tensors": entries}), 0)
+        # post-index barrier: no process returns before the completeness
+        # marker exists — otherwise a non-coordinator that reads the
+        # checkpoint right after save races the coordinator's write
+        sink(("barrier",), 0)
         return None
 
     q = _ByteQueue(max_inflight_bytes)
@@ -332,11 +336,13 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
 
     def finalize():
         # runs in join(), on the CALLER thread: cross-process barrier,
-        # then the coordinator publishes the completeness marker
+        # then the coordinator publishes the completeness marker, then a
+        # second barrier so no process's join() returns pre-index
         _barrier()
         if is_coordinator:
             _write_item(path, ("index", {"format": 2, "tensors": entries}),
                         {})
+        _barrier()
 
     t = _WriterThread(writer, finalize)
     t.start()

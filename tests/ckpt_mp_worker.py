@@ -1,0 +1,94 @@
+"""Payload for the two-process sharded-checkpoint test.
+
+Run by `python -m paddle_tpu.distributed.launch --nproc_per_node 2`
+(see test_launch_multiprocess.py for the harness pattern). Exercises the
+multi-host write path of `distributed.checkpoint`: each process writes
+only its addressable replica_id==0 shard files, ownerless (host/0-d)
+tensors are written by the coordinator alone, the cross-process barrier
+runs before the coordinator publishes index.json, and reshard-on-load
+assembles each process's regions from the shared directory.
+"""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu.framework.core import Tensor  # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    ckpt_dir = os.path.join(out_dir, "ckpt")
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    pt.distributed.init_parallel_env()
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.distributed import env as dist_env
+
+    mesh = dist_env.get_env().mesh
+    n_dev = jax.device_count()
+
+    # a dp-sharded tensor whose global value every process can recompute
+    ref = np.arange(n_dev * 16, dtype=np.float32).reshape(n_dev, 16)
+    sharding = NamedSharding(mesh, P("dp"))
+    arr = jax.make_array_from_callback(
+        ref.shape, sharding, lambda idx: ref[idx])
+    x = Tensor(arr)
+    scalar = Tensor(jax.device_put(np.float32(7.25),
+                                   NamedSharding(mesh, P())))
+    host_np = np.arange(5, dtype=np.float32)
+
+    ckpt.save_state_dict({"w": x, "step": scalar, "host": host_np},
+                         ckpt_dir)
+
+    res = {"rank": rank, "process_count": jax.process_count()}
+    with open(os.path.join(ckpt_dir, "index.json")) as f:
+        index = json.load(f)
+    res["format"] = index["format"]
+    res["w_shards"] = len(index["tensors"]["w"]["shards"])
+    # every shard file referenced by the index exists on the shared fs
+    res["all_files_exist"] = all(
+        os.path.exists(os.path.join(ckpt_dir, sh["file"]))
+        for meta in index["tensors"].values() for sh in meta["shards"])
+
+    # reshard-on-load into freshly-scrambled destinations
+    dest = Tensor(jax.make_array_from_callback(
+        ref.shape, sharding, lambda idx: np.zeros_like(ref[idx])))
+    dscalar = Tensor(jax.device_put(np.float32(0.0),
+                                    NamedSharding(mesh, P())))
+    ckpt.load_state_dict({"w": dest, "step": dscalar}, ckpt_dir)
+    got = np.concatenate([
+        np.asarray(s.data).reshape(-1, 16)
+        for s in sorted(dest._data.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)])
+    lo = min((s.index[0].start or 0)
+             for s in dest._data.addressable_shards)
+    res["w_roundtrip"] = bool(
+        np.allclose(got, ref[lo:lo + got.shape[0]]))
+    res["scalar_roundtrip"] = float(np.asarray(
+        dscalar._data.addressable_data(0)))
+    host_back = ckpt.load_checkpoint(ckpt_dir)["host"]
+    res["host_roundtrip"] = bool(np.allclose(host_back, host_np))
+
+    with open(os.path.join(out_dir, f"ckptrank{rank}.json"), "w") as f:
+        json.dump(res, f)
+    print("CKPT_WORKER_OK", rank, flush=True)
+
+
+if __name__ == "__main__":
+    main()
