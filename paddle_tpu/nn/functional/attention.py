@@ -36,7 +36,13 @@ def _sdpa_reference(q, k, v, *rest, causal=False, dropout=0.0, scale=None,
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * s
     if rest:
         mask = rest[0]
-        logits = logits + mask.astype(logits.dtype)
+        if mask.dtype == jnp.bool_:
+            # paddle attn_mask semantics: bool True = KEEP (an additive
+            # 0/1 cast would be silently wrong)
+            logits = jnp.where(mask, logits,
+                               jnp.asarray(-1e30, logits.dtype))
+        else:
+            logits = logits + mask.astype(logits.dtype)
     row_valid = None
     if causal:
         sq, sk = logits.shape[-2], logits.shape[-1]

@@ -23,8 +23,10 @@ processed as one (batch·head) per grid row.
 
 Registered as the 'flash_attention' kernel override for platform 'tpu', so
 `paddle.nn.functional.scaled_dot_product_attention` transparently uses it
-on TPU (mask / dropout calls fall back to the XLA composite
-implementation, with the caller's dropout PRNG key preserved).
+on TPU. Dropout runs IN-KERNEL (counter-hash mask), and key-PADDING
+masks ([b, 1, 1, sk] bool-keep or additive — the BERT/ERNIE pattern)
+run in-kernel as an additive row; row-varying masks fall back to the
+XLA composite with the caller's dropout PRNG key preserved.
 """
 from __future__ import annotations
 
@@ -106,14 +108,26 @@ def _tile_live(q_idx, k_idx, block_q, block_k, offset, window):
     return below_diag & in_window
 
 
-def _fwd_kernel(*refs, causal, scale, offset, n_kb, window=0, dropout=0.0):
+def _unpack(refs, dropout, has_kmask, n_main):
+    """refs = [seed?] + main inputs + [kmask?] + outputs/scratch."""
+    i = 0
+    seed_ref = None
     if dropout > 0.0:
-        (seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-         acc_ref, m_ref, l_ref) = refs
-    else:
-        seed_ref = None
-        (q_ref, k_ref, v_ref, o_ref, lse_ref,
-         acc_ref, m_ref, l_ref) = refs
+        seed_ref = refs[0]
+        i = 1
+    main = refs[i:i + n_main]
+    i += n_main
+    km_ref = None
+    if has_kmask:
+        km_ref = refs[i]
+        i += 1
+    return (seed_ref, km_ref) + tuple(main) + tuple(refs[i:])
+
+
+def _fwd_kernel(*refs, causal, scale, offset, n_kb, window=0, dropout=0.0,
+                has_kmask=False):
+    (seed_ref, km_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+     acc_ref, m_ref, l_ref) = _unpack(refs, dropout, has_kmask, 3)
     b_idx = pl.program_id(0)
     q_idx = pl.program_id(1)
     k_idx = pl.program_id(2)
@@ -136,6 +150,8 @@ def _fwd_kernel(*refs, causal, scale, offset, n_kb, window=0, dropout=0.0):
         if causal:
             s = _causal_mask(s, q_idx, k_idx, block_q, block_k, offset,
                              window)
+        if has_kmask:
+            s = s + km_ref[0]  # [1, bk] additive key mask, row-broadcast
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -181,14 +197,9 @@ def _fwd_kernel(*refs, causal, scale, offset, n_kb, window=0, dropout=0.0):
 
 
 def _bwd_dq_kernel(*refs, causal, scale, offset, n_kb, window=0,
-                   dropout=0.0):
-    if dropout > 0.0:
-        (seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dq_ref, dq_acc_ref) = refs
-    else:
-        seed_ref = None
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dq_ref, dq_acc_ref) = refs
+                   dropout=0.0, has_kmask=False):
+    (seed_ref, km_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+     dq_ref, dq_acc_ref) = _unpack(refs, dropout, has_kmask, 6)
     b_idx = pl.program_id(0)
     q_idx = pl.program_id(1)
     k_idx = pl.program_id(2)
@@ -212,6 +223,8 @@ def _bwd_dq_kernel(*refs, causal, scale, offset, n_kb, window=0,
         if causal:
             s = _causal_mask(s, q_idx, k_idx, block_q, block_k, offset,
                              window)
+        if has_kmask:
+            s = s + km_ref[0]
         # no-valid-key rows have lse ~ NEG_INF; exp(s - lse) would blow up
         p = jnp.where(lse > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)  # [bq, bk]
         dp = jax.lax.dot_general(
@@ -240,17 +253,19 @@ def _bwd_dq_kernel(*refs, causal, scale, offset, n_kb, window=0,
 
 
 def _bwd_dkv_kernel(*refs, causal, scale, offset, n_qb, n_iters, window=0,
-                    dropout=0.0):
+                    dropout=0.0, has_kmask=False):
     """dk/dv accumulate over the q-minor grid dim, which iterates
     group × q-blocks under GQA (the same KV block serves every q head of
     its group; q_idx below is the position within one head's q blocks)."""
-    if dropout > 0.0:
-        (seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dk_acc_ref, dv_acc_ref) = refs
+    if has_kmask:
+        (seed_ref, km_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+         delta_ref, dk_ref, dv_ref, dm_ref, dk_acc_ref, dv_acc_ref,
+         dm_acc_ref) = _unpack(refs, dropout, True, 6)
     else:
-        seed_ref = None
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dk_acc_ref, dv_acc_ref) = refs
+        (seed_ref, km_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+         delta_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref) = _unpack(
+            refs, dropout, False, 6)
+        dm_ref = dm_acc_ref = None
     b_idx = pl.program_id(0)
     k_idx = pl.program_id(1)
     q_iter = pl.program_id(2)
@@ -262,6 +277,14 @@ def _bwd_dkv_kernel(*refs, causal, scale, offset, n_qb, n_iters, window=0,
     def _init():
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    if has_kmask:
+        # the mask cotangent accumulates PER Q HEAD (the mask rides per
+        # query head): reset at each head's first q-block, write at its
+        # last — q_iter sweeps group x q-blocks head-major
+        @pl.when(q_idx == 0)
+        def _dm_init():
+            dm_acc_ref[...] = jnp.zeros_like(dm_acc_ref)
 
     def _step():
         k = k_ref[0].astype(jnp.float32)
@@ -276,6 +299,8 @@ def _bwd_dkv_kernel(*refs, causal, scale, offset, n_qb, n_iters, window=0,
         if causal:
             s = _causal_mask(s, q_idx, k_idx, block_q, block_k, offset,
                              window)
+        if has_kmask:
+            s = s + km_ref[0]
         p = jnp.where(lse > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)
         if dropout > 0.0:
             # GQA: the mask was drawn per QUERY head in the forward
@@ -299,6 +324,13 @@ def _bwd_dkv_kernel(*refs, causal, scale, offset, n_qb, n_iters, window=0,
         dk_acc_ref[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if has_kmask:
+            # d(mask_j) = sum_i ds_ij / scale (the mask adds to s AFTER
+            # the scale multiply, and ds above carries one scale factor
+            # from d(s_pre_mask)/dq path — the additive-bias cotangent
+            # is sum_i dL/ds_ij = sum_i p*(dp - delta))
+            dm_acc_ref[0:1, :] += jnp.sum(ds / scale, axis=0,
+                                          keepdims=True)
 
     if causal:
         pl.when(_tile_live(q_idx, k_idx, block_q, block_k, offset,
@@ -311,6 +343,11 @@ def _bwd_dkv_kernel(*refs, causal, scale, offset, n_qb, n_iters, window=0,
         dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
+    if has_kmask:
+        @pl.when(q_idx == n_qb - 1)
+        def _dm_fini():
+            dm_ref[0] = dm_acc_ref[0:1, :].astype(dm_ref.dtype)
+
 
 def _pick_block(seq, target=512):
     b = min(seq, target)
@@ -319,16 +356,50 @@ def _pick_block(seq, target=512):
     return max(b, 1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_bhsd(q, k, v, causal, scale, interpret, block_q=None,
-                block_k=None, window=0):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash_call(q, k, v, seed, kmask, causal, scale, interpret,
+                block_q=None, block_k=None, window=0, dropout=0.0):
+    """The one differentiable entry all variants route through.
+    ``seed`` (int32[2] or None) enables in-kernel dropout; ``kmask``
+    ([bh, 1, sk] additive fp32 or None) enables the in-kernel key
+    mask."""
     out, _ = _flash_fwd(q, k, v, causal, scale, interpret, block_q,
-                        block_k, window)
+                        block_k, window, seed=seed, dropout=dropout,
+                        kmask=kmask)
     return out
 
 
+def _flash_call_fwd_rule(q, k, v, seed, kmask, causal, scale, interpret,
+                         block_q=None, block_k=None, window=0,
+                         dropout=0.0):
+    out, lse = _flash_fwd(q, k, v, causal, scale, interpret, block_q,
+                          block_k, window, seed=seed, dropout=dropout,
+                          kmask=kmask)
+    return out, (q, k, v, seed, kmask, out, lse)
+
+
+def _flash_call_bwd_rule(causal, scale, interpret, block_q, block_k,
+                         window, dropout, res, g):
+    q, k, v, seed, kmask, out, lse = res
+    dq, dk, dv, dmask = _flash_bwd_impl(q, k, v, out, lse, g, causal,
+                                        scale, interpret, block_q,
+                                        block_k, window, seed, dropout,
+                                        kmask=kmask)
+    return dq, dk, dv, None, dmask
+
+
+_flash_call.defvjp(_flash_call_fwd_rule, _flash_call_bwd_rule)
+
+
+def _flash_bhsd(q, k, v, causal, scale, interpret, block_q=None,
+                block_k=None, window=0):
+    return _flash_call(q, k, v, None, None, causal, scale, interpret,
+                       block_q, block_k, window, 0.0)
+
+
 def _flash_fwd(q, k, v, causal, scale, interpret, block_q=None,
-               block_k=None, window=0, seed=None, dropout=0.0):
+               block_k=None, window=0, seed=None, dropout=0.0,
+               kmask=None):
     """q: [bh, s, d], k/v: [bh_kv, s, d] with bh % bh_kv == 0 (GQA: each
     group of bh//bh_kv query heads shares one KV head — the K/V BlockSpec
     index maps divide the bh program index, so grouped heads stream the
@@ -348,7 +419,8 @@ def _flash_fwd(q, k, v, causal, scale, interpret, block_q=None,
     grid = (bh, sq // block_q, n_kb)
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
                                offset=sk - sq, n_kb=n_kb, window=window,
-                               dropout=dropout)
+                               dropout=dropout,
+                               has_kmask=kmask is not None)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_k, d),
@@ -357,6 +429,12 @@ def _flash_fwd(q, k, v, causal, scale, interpret, block_q=None,
                      lambda b, i, j: (b // group, j, 0)),
     ]
     args = (q, k, v)
+    if kmask is not None:
+        # additive key mask [bh, 1, sk]: middle singleton keeps the
+        # block 3-D so Mosaic's last-two-dims rule is satisfied
+        in_specs = in_specs + [
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j))]
+        args = args + (kmask,)
     if dropout > 0.0:
         in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + in_specs
         args = (seed,) + args
@@ -389,22 +467,8 @@ def _flash_fwd(q, k, v, causal, scale, interpret, block_q=None,
     return out, lse
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, interpret, block_q=None,
-                    block_k=None, window=0):
-    out, lse = _flash_fwd(q, k, v, causal, scale, interpret, block_q,
-                          block_k, window)
-    return out, (q, k, v, out, lse)
-
-
-def _flash_bwd_rule(causal, scale, interpret, block_q, block_k, window,
-                    res, g):
-    q, k, v, out, lse = res
-    return _flash_bwd_impl(q, k, v, out, lse, g, causal, scale, interpret,
-                           block_q, block_k, window, None, 0.0)
-
-
 def _flash_bwd_impl(q, k, v, out, lse, g, causal, scale, interpret,
-                    block_q, block_k, window, seed, dropout):
+                    block_q, block_k, window, seed, dropout, kmask=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
     bh_kv = k.shape[0]
@@ -433,13 +497,18 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, scale, interpret,
         pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
     ]
     dq_args = (q, k, v, g, lse, delta)
+    if kmask is not None:
+        dq_specs = dq_specs + [
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j))]
+        dq_args = dq_args + (kmask,)
     if dropout > 0.0:
         dq_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + dq_specs
         dq_args = (seed,) + dq_args
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
                           offset=offset, n_kb=n_kb, window=window,
-                          dropout=dropout),
+                          dropout=dropout,
+                          has_kmask=kmask is not None),
         grid=(bh, n_qb, n_kb),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -469,67 +538,63 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, scale, interpret,
                                       i % n_qb, 0)),
     ]
     dkv_args = (q, k, v, g, lse, delta)
+    dkv_out_specs = [
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+    ]
+    dkv_out_shape = [
+        jax.ShapeDtypeStruct((bh_kv, sk, d), k.dtype),
+        jax.ShapeDtypeStruct((bh_kv, sk, d), v.dtype),
+    ]
+    dkv_scratch = [
+        pltpu.VMEM((block_k, d), jnp.float32),
+        pltpu.VMEM((block_k, d), jnp.float32),
+    ]
+    if kmask is not None:
+        dkv_specs = dkv_specs + [
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, j, i: (b * group + i // n_qb, 0, j))]
+        dkv_args = dkv_args + (kmask,)
+        # third output: the mask cotangent, accumulated per q head
+        dkv_out_specs = dkv_out_specs + [
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, j, i: (b * group + i // n_qb, 0, j))]
+        dkv_out_shape = dkv_out_shape + [
+            jax.ShapeDtypeStruct((bh, 1, sk), jnp.float32)]
+        dkv_scratch = dkv_scratch + [
+            pltpu.VMEM((8, block_k), jnp.float32)]
     if dropout > 0.0:
         dkv_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + dkv_specs
         dkv_args = (seed,) + dkv_args
-    dk, dv = pl.pallas_call(
+    outs = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
                           offset=offset, n_qb=n_qb,
                           n_iters=group * n_qb, window=window,
-                          dropout=dropout),
+                          dropout=dropout,
+                          has_kmask=kmask is not None),
         grid=(bh_kv, n_kb, group * n_qb),
         in_specs=dkv_specs,
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh_kv, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh_kv, sk, d), v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
-        ],
+        out_specs=dkv_out_specs,
+        out_shape=dkv_out_shape,
+        scratch_shapes=dkv_scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY)),
         interpret=interpret,
     )(*dkv_args)
-    return dq, dk, dv
+    if kmask is not None:
+        dk, dv, dmask = outs
+        return dq, dk, dv, dmask
+    dk, dv = outs
+    return dq, dk, dv, None
 
 
-_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
 def _flash_bhsd_drop(q, k, v, seed, causal, scale, interpret,
                      block_q=None, block_k=None, window=0, dropout=0.0):
     """Dropout variant: `seed` is an int32[2] array (derived from the
     caller's dropout PRNG key) feeding the counter-hash mask — the same
     mask is regenerated in the backward kernels (see _keep_mask)."""
-    out, _ = _flash_fwd(q, k, v, causal, scale, interpret, block_q,
-                        block_k, window, seed=seed, dropout=dropout)
-    return out
-
-
-def _flash_fwd_rule_drop(q, k, v, seed, causal, scale, interpret,
-                         block_q=None, block_k=None, window=0,
-                         dropout=0.0):
-    out, lse = _flash_fwd(q, k, v, causal, scale, interpret, block_q,
-                          block_k, window, seed=seed, dropout=dropout)
-    return out, (q, k, v, seed, out, lse)
-
-
-def _flash_bwd_rule_drop(causal, scale, interpret, block_q, block_k,
-                         window, dropout, res, g):
-    q, k, v, seed, out, lse = res
-    dq, dk, dv = _flash_bwd_impl(q, k, v, out, lse, g, causal, scale,
-                                 interpret, block_q, block_k, window,
-                                 seed, dropout)
-    return dq, dk, dv, None
-
-
-_flash_bhsd_drop.defvjp(_flash_fwd_rule_drop, _flash_bwd_rule_drop)
+    return _flash_call(q, k, v, seed, None, causal, scale, interpret,
+                       block_q, block_k, window, dropout)
 
 
 def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
@@ -540,8 +605,9 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
     ``has_key`` the trailing operand is the dropout PRNG key's raw
     uint32 data; dropout then runs IN-KERNEL (reference
     flash_attn_kernel.cu supports in-kernel dropout — the round-4 gap
-    that forced every dropout>0 call onto the composite). Falls back to
-    ``default_fn`` for masks/odd shapes."""
+    that forced every dropout>0 call onto the composite). Key-padding
+    masks run in-kernel too (_key_padding_additive); row-varying masks
+    and odd shapes fall back to ``default_fn``."""
     dkey = None
     if has_key and rest:
         *head_rest, dkey = rest
@@ -559,7 +625,16 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
         return _sdpa_reference(q, k, v, *rest, causal=causal, dropout=dp,
                                dropout_key=key_arr)
 
-    if rest or (dropout > 0.0 and dkey is None):
+    kadd = None
+    if rest:
+        # key-PADDING masks ([b, 1, 1, sk], bool keep or additive float
+        # — the BERT/ERNIE right-pad pattern) run IN-KERNEL as an
+        # additive row; anything row-varying ([.., sq, sk]) falls back
+        if len(rest) == 1:
+            kadd = _key_padding_additive(rest[0], q.shape, k.shape)
+        if kadd is None:
+            return fallback(dropout)
+    if dropout > 0.0 and dkey is None:
         return fallback(dropout)
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -584,12 +659,12 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
 
     bq_t = bk_t = None
     if not interpret:
-        # dropout variants have no dedicated tune rows yet: demand 20%
-        # measured headroom over the composite before engaging the
-        # dropout kernel on a no-dropout measurement (the mask adds
-        # VPU hash+select work). The >=1024 heuristic rows measured
-        # 3.4-6.1x, far above the margin.
-        margin = 1.2 if dropout > 0.0 else 1.0
+        # dropout/mask variants have no dedicated tune rows yet: demand
+        # 20% measured headroom over the composite before engaging them
+        # on an unmasked no-dropout measurement (dropout adds VPU
+        # hash+select work; the mask adds an HBM operand per tile). The
+        # >=1024 heuristic rows measured 3.4-6.1x, far above the margin.
+        margin = 1.2 if (dropout > 0.0 or kadd is not None) else 1.0
         beats = _tune.kernel_beats_composite(sq, sk, d, causal,
                                              margin=margin)
         if beats is False:
@@ -607,15 +682,40 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)
+    seed = None
     if dropout > 0.0:
         seed = jax.lax.bitcast_convert_type(
             jnp.asarray(dkey).reshape(2), jnp.int32)
-        out = _flash_bhsd_drop(qt, kt, vt, seed, causal, scale, interpret,
-                               bq_t, bk_t, 0, dropout)
-    else:
-        out = _flash_bhsd(qt, kt, vt, causal, scale, interpret, bq_t,
-                          bk_t)
+    kmask = None
+    if kadd is not None:
+        # [b, 1, sk] -> per-query-head rows [bh, 1, sk]
+        kmask = jnp.broadcast_to(kadd[:, None],
+                                 (b, h, 1, sk)).reshape(b * h, 1, sk)
+    out = _flash_call(qt, kt, vt, seed, kmask, causal, scale, interpret,
+                      bq_t, bk_t, 0, dropout)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def _key_padding_additive(mask, q_shape, k_shape):
+    """[b, 1, 1, sk] (or [b, 1, sk] / [b, sk]) key-padding mask ->
+    additive fp32 [b, 1, sk], or None when the mask is row-varying /
+    head-varying (those fall back to the composite). Bool means KEEP;
+    floats are additive and clamped to NEG_INF so a fully-masked row
+    cannot produce inf - inf in the streaming softmax."""
+    b = q_shape[0]
+    sk = k_shape[1]
+    # ONLY [b, 1, 1, sk]: the composite's `logits + mask` broadcast
+    # gives 3-D/2-D shapes different (head-bound) semantics, so
+    # accepting them here would make semantics depend on which path
+    # engages
+    if tuple(mask.shape) != (b, 1, 1, sk):
+        return None
+    m = mask.reshape(b, 1, sk)
+    if m.dtype == jnp.bool_:
+        return jnp.where(m, 0.0, NEG_INF).astype(jnp.float32)
+    if not jnp.issubdtype(m.dtype, jnp.floating):
+        return None
+    return jnp.maximum(m.astype(jnp.float32), NEG_INF)
 
 
 def check_lowering():
@@ -654,6 +754,25 @@ def check_lowering():
 
     jax.export.export(jax.jit(swa), platforms=["tpu"])(q, kv, kv)
     jax.export.export(jax.jit(swa_bwd), platforms=["tpu"])(q, kv, kv)
+
+    # in-kernel key-padding mask variant
+    q = jnp.zeros((8, 1024, 128), jnp.bfloat16)
+    kv = jnp.zeros((8, 1024, 128), jnp.bfloat16)
+    km = jnp.zeros((8, 1, 1024), jnp.float32)
+
+    def masked(q, k, v, km):
+        return _flash_call(q, k, v, None, km, False,
+                           1.0 / math.sqrt(128.0), False, None, None, 0,
+                           0.0)
+
+    def masked_bwd(q, k, v, km):
+        return jax.grad(
+            lambda *a: masked(*a, km).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+
+    jax.export.export(jax.jit(masked), platforms=["tpu"])(q, kv, kv, km)
+    jax.export.export(jax.jit(masked_bwd), platforms=["tpu"])(q, kv, kv,
+                                                              km)
 
     # in-kernel dropout variant (counter-hash mask; uint32 VPU ops)
     seed = jnp.zeros((2,), jnp.int32)

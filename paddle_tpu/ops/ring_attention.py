@@ -210,9 +210,9 @@ def _ring_bwd_shard_flash(q, k, v, out, lse, g, *, axis, n, causal, scale,
 
         def run(flag):
             def go(_):
-                dq, dk, dv = fa._flash_bwd_rule(
-                    flag, scale, interpret, None, None, 0,
-                    (qt, ktt, vtt, outt, lse_bh), gt)
+                dq, dk, dv, _unused = fa._flash_bwd_impl(
+                    qt, ktt, vtt, outt, lse_bh, gt, flag, scale,
+                    interpret, None, None, 0, None, 0.0)
                 return (from_bh(dq).astype(jnp.float32),
                         from_bh(dk).astype(jnp.float32),
                         from_bh(dv).astype(jnp.float32))

@@ -280,13 +280,14 @@ def test_dropout_engages_kernel_via_dispatch(monkeypatch):
     from paddle_tpu.ops import registry
 
     calls = {"drop": 0}
-    orig = fa._flash_bhsd_drop
+    orig = fa._flash_call
 
     def counting(*a, **kw):
-        calls["drop"] += 1
+        if a[3] is not None:  # seed present = dropout kernel path
+            calls["drop"] += 1
         return orig(*a, **kw)
 
-    monkeypatch.setattr(fa, "_flash_bhsd_drop", counting)
+    monkeypatch.setattr(fa, "_flash_call", counting)
     fa.register(platform="cpu", interpret=True)
     try:
         q = pt.to_tensor(np.random.RandomState(0)
@@ -329,3 +330,142 @@ def test_dropout_keep_rate_and_determinism():
     out3 = _flash_bhsd_drop(q, q, vone, jnp.asarray([12, 5], jnp.int32),
                             False, scale, True, None, None, 0, rate)
     assert not np.allclose(np.asarray(out), np.asarray(out3))
+
+
+# ---- in-kernel key-padding masks ----
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("masktype", ["additive", "bool"])
+def test_key_padding_mask_parity(causal, masktype):
+    # [b, 1, 1, sk] padding masks run in-kernel; fwd+grads must match
+    # the composite with the same mask (partially-masked rows only —
+    # all-pad query rows are undefined garbage both ways)
+    b, s, h, d = 2, 64, 2, 16
+    rng = np.random.RandomState(0)
+    q = rng.randn(b, s, h, d).astype(np.float32)
+    k = rng.randn(b, s, h, d).astype(np.float32)
+    v = rng.randn(b, s, h, d).astype(np.float32)
+    lens = [48, 64]
+    import jax.numpy as jnp
+
+    keep = np.zeros((b, 1, 1, s), bool)
+    for i, ln in enumerate(lens):
+        keep[i, :, :, :ln] = True
+    if masktype == "bool":
+        mask = jnp.asarray(keep)
+    else:
+        mask = jnp.asarray(np.where(keep, 0.0, -1e30).astype(np.float32))
+
+    out = flash_attention_kernel(q, k, v, mask, causal=causal,
+                                 interpret=True)
+    ref = _sdpa_reference(q, k, v, mask, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+    g1 = jax.grad(lambda *a: (flash_attention_kernel(
+        *a, mask, causal=causal, interpret=True) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: (_sdpa_reference(
+        *a, mask, causal=causal) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-4)
+
+
+def test_key_padding_mask_with_dropout_runs_in_kernel(monkeypatch):
+    # the BERT training combo: padding mask AND dropout, one kernel call
+    import paddle_tpu as pt
+    import paddle_tpu.ops.pallas.flash_attention as fa
+    from paddle_tpu.ops import registry
+
+    calls = {"n": 0}
+    orig = fa._flash_call
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(fa, "_flash_call", counting)
+    fa.register(platform="cpu", interpret=True)
+    try:
+        q = pt.to_tensor(np.random.RandomState(0)
+                         .randn(2, 32, 2, 16).astype(np.float32))
+        mask = pt.to_tensor(
+            np.where(np.arange(32)[None, None, None, :] < 24, 0.0, -1e30)
+            .astype(np.float32).repeat(2, axis=0))
+        out = pt.nn.functional.scaled_dot_product_attention(
+            q, q, q, mask, dropout_p=0.2, is_causal=False, training=True)
+        assert calls["n"] == 1  # kernel engaged despite mask+dropout
+        assert np.isfinite(out.numpy()).all()
+    finally:
+        registry.deregister_kernel("flash_attention", "cpu")
+
+
+def test_row_varying_mask_still_falls_back():
+    b, s, h, d = 1, 32, 2, 16
+    rng = np.random.RandomState(1)
+    q = rng.randn(b, s, h, d).astype(np.float32)
+    mask = np.zeros((b, 1, s, s), np.float32)  # row-varying shape
+    mask[:, :, :, 20:] = -1e30
+    out = flash_attention_kernel(q, q, q, mask, causal=False,
+                                 interpret=True)
+    ref = _sdpa_reference(q, q, q, mask, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+
+@pytest.mark.parametrize("group", [1, 2])
+def test_key_padding_mask_gradient_parity(group):
+    # the mask cotangent (an extra dkv-kernel output) must match the
+    # composite's d(mask), incl. GQA and multiple q-blocks per head
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.flash_attention import _flash_call
+
+    b, s, h, d = 2, 64, 2 * group, 16
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, s, h // group, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, s, h // group, d).astype(np.float32))
+    keep = np.zeros((b, 1, 1, s), bool)
+    keep[0, :, :, :40] = True
+    keep[1] = True
+    mask = jnp.asarray(np.where(keep, 0.0, -1e30).astype(np.float32))
+
+    def loss_k(m):
+        return (flash_attention_kernel(q, k, v, m, causal=True,
+                                       interpret=True) ** 2).sum()
+
+    def loss_r(m):
+        return (_sdpa_reference(q, k, v, m, causal=True) ** 2).sum()
+
+    gk = jax.grad(loss_k)(mask)
+    gr = jax.grad(loss_r)(mask)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gr), atol=1e-4)
+
+    # multi-q-block path (block_q=16 -> 4 q-blocks/head): the per-head
+    # accumulate/reset cycle in the dkv kernel must not bleed across
+    scale = 1.0 / np.sqrt(d)
+
+    def to_bh(x):
+        bb, ss, hh, dd = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(bb * hh, ss, dd)
+
+    km_bh = jnp.broadcast_to(
+        jnp.asarray(np.where(keep, 0.0, -1e30).astype(np.float32))
+        .reshape(b, 1, s)[:, None], (b, h, 1, s)).reshape(b * h, 1, s)
+
+    def loss_blocks(km):
+        return (_flash_call(to_bh(q), to_bh(k), to_bh(v), None, km,
+                            True, scale, True, 16, 16, 0, 0.0) ** 2).sum()
+
+    g_small = jax.grad(loss_blocks)(km_bh)
+
+    def loss_big(km):
+        return (_flash_call(to_bh(q), to_bh(k), to_bh(v), None, km,
+                            True, scale, True, None, None, 0,
+                            0.0) ** 2).sum()
+
+    g_big = jax.grad(loss_big)(km_bh)
+    np.testing.assert_allclose(np.asarray(g_small), np.asarray(g_big),
+                               atol=1e-4)
