@@ -469,3 +469,42 @@ def test_key_padding_mask_gradient_parity(group):
     g_big = jax.grad(loss_big)(km_bh)
     np.testing.assert_allclose(np.asarray(g_small), np.asarray(g_big),
                                atol=1e-4)
+
+
+def test_bert_padded_batch_engages_kernel(monkeypatch):
+    # end-to-end: BertModel builds [b,1,1,s] additive padding masks —
+    # with in-kernel masks the whole padded forward runs the kernel
+    import paddle_tpu as pt
+    import paddle_tpu.ops.pallas.flash_attention as fa
+    from paddle_tpu.models.bert import BertConfig, BertModel
+    from paddle_tpu.ops import registry
+
+    calls = {"n": 0}
+    orig = fa._flash_call
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(fa, "_flash_call", counting)
+    fa.register(platform="cpu", interpret=True)
+    try:
+        pt.seed(0)
+        cfg = BertConfig.tiny()
+        model = BertModel(cfg)
+        model.eval()
+        ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 32))
+        am = np.ones((2, 32), np.int64)
+        am[0, 24:] = 0  # row 0 right-padded
+        h, pooled = model(pt.to_tensor(ids), attention_mask=pt.to_tensor(am))
+        assert calls["n"] == cfg.num_hidden_layers
+        assert np.isfinite(np.asarray(h.numpy())).all()
+        # padded positions of row 0 don't affect kept positions
+        ids2 = ids.copy()
+        ids2[0, 24:] = (ids2[0, 24:] + 7) % cfg.vocab_size
+        h2, _ = model(pt.to_tensor(ids2), attention_mask=pt.to_tensor(am))
+        np.testing.assert_allclose(np.asarray(h.numpy())[0, :24],
+                                   np.asarray(h2.numpy())[0, :24],
+                                   atol=1e-4)
+    finally:
+        registry.deregister_kernel("flash_attention", "cpu")
