@@ -665,8 +665,11 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
         # hash+select work; the mask adds an HBM operand per tile). The
         # >=1024 heuristic rows measured 3.4-6.1x, far above the margin.
         margin = 1.2 if (dropout > 0.0 or kadd is not None) else 1.0
-        beats = _tune.kernel_beats_composite(sq, sk, d, causal,
-                                             margin=margin)
+        # the dropout-variant row was measured WITHOUT a mask operand:
+        # it may replace the margin only when no mask rides along
+        beats = _tune.kernel_beats_composite(
+            sq, sk, d, causal, margin=margin,
+            dropout=0.0 if kadd is not None else dropout)
         if beats is False:
             return fallback(dropout)
         if beats is None and (max(sq, sk) < 1024 or not causal):

@@ -109,3 +109,56 @@ def test_tune_shape_smoke_interpret(cache):
         fa._flash_bhsd = fa_bhsd
     assert entry["block_q"] in (128,)
     assert autotune.lookup(128, 128, 8, True) is not None
+
+
+def test_dropout_variant_row_wins_over_margin(monkeypatch, tmp_path):
+    # a measured variant row replaces the 1.2x demand-headroom heuristic
+    import paddle_tpu.ops.pallas.autotune as tune
+
+    entries = {
+        tune._key(512, 512, 64, False): {
+            "sq": 512, "sk": 512, "d": 64, "causal": False,
+            "block_q": 512, "block_k": 512,
+            "ratio_fwd_bwd": 1.1,  # above 1.0, below the 1.2 margin
+        },
+        tune._key(512, 512, 64, False, 0.1): {
+            "sq": 512, "sk": 512, "d": 64, "causal": False,
+            "dropout": 0.1, "block_q": 512, "block_k": 512,
+            "ratio_fwd_bwd": 1.05,  # measured WITH dropout: kernel wins
+        },
+    }
+    monkeypatch.setattr(tune, "_device_entries", lambda: entries)
+    # no-dropout call: base row, margin 1.0 -> engage
+    assert tune.kernel_beats_composite(512, 512, 64, False) is True
+    # dropout call under margin heuristic alone would refuse (1.1 < 1.2)
+    assert tune.kernel_beats_composite(512, 512, 64, False,
+                                       margin=1.2) is False
+    # ...but the measured variant row says engage
+    assert tune.kernel_beats_composite(512, 512, 64, False, margin=1.2,
+                                       dropout=0.1) is True
+    # variant row absent at another rate -> falls back to margin
+    assert tune.kernel_beats_composite(512, 512, 64, False, margin=1.2,
+                                       dropout=0.3) is False
+
+
+def test_tune_variant_ratio_smoke(monkeypatch, tmp_path):
+    # CPU smoke: the variant tuner runs end-to-end and persists its row
+    # (interpret-mode kernel, as in test_tune_shape_smoke_interpret)
+    import paddle_tpu.ops.pallas.autotune as tune
+    import paddle_tpu.ops.pallas.flash_attention as fa
+
+    orig = fa._flash_bhsd_drop
+
+    def interp(q, k, v, seed, causal, scale, interpret, bq=None, bk=None,
+               window=0, dropout=0.0):
+        return orig(q, k, v, seed, causal, scale, True, bq, bk, window,
+                    dropout)
+
+    monkeypatch.setattr(fa, "_flash_bhsd_drop", interp)
+    monkeypatch.setattr(tune, "_CACHE_PATH", str(tmp_path / "t.json"))
+    monkeypatch.setattr(tune, "_cache", None)
+    e = tune.tune_variant_ratio(2, 32, 32, 16, True, 0.1, iters=2,
+                                verbose=False)
+    assert e["dropout"] == 0.1
+    cache = tune.load_cache()
+    assert tune._key(32, 32, 16, True, 0.1) in cache["entries"]

@@ -54,18 +54,29 @@ def main():
         entries.append(autotune.tune_shape(bh, sq, sk, d, causal,
                                            iters=args.iters))
 
+    for bh, sq, sk, d, causal, p_drop in autotune.VARIANT_SHAPES:
+        print(f"variant bh={bh} s={sq}x{sk} d={d} causal={causal} "
+              f"dropout={p_drop}", flush=True)
+        try:
+            entries.append(autotune.tune_variant_ratio(
+                bh, sq, sk, d, causal, p_drop, iters=args.iters))
+        except Exception as e:  # noqa: BLE001 — variants must not
+            print(f"  variant failed: {e}", flush=True)  # cost the base rows
+
     from paddle_tpu.utils import measurements as meas
 
-    wins = sum(1 for e in entries if e.get("ratio_fwd_bwd", 0) > 1.0)
+    base = [e for e in entries if not e.get("dropout")]
+    wins = sum(1 for e in base if e.get("ratio_fwd_bwd", 0) > 1.0)
     meas.record_or_warn(
         "flash_autotune_shapes_kernel_wins", float(wins), "shapes",
-        extra={"tuned": len(entries),
+        extra={"tuned": len(base), "variants": len(entries) - len(base),
                "entries": {
-                   autotune._key(e["sq"], e["sk"], e["d"], e["causal"]):
+                   autotune._key(e["sq"], e["sk"], e["d"], e["causal"],
+                                 e.get("dropout", 0.0)):
                    e.get("ratio_fwd_bwd") for e in entries}})
-    print(f"flash_autotune: {wins}/{len(entries)} shapes favor the "
-          f"kernel; cache at paddle_tpu/ops/pallas/flash_tune.json",
-          flush=True)
+    print(f"flash_autotune: {wins}/{len(base)} base shapes favor the "
+          f"kernel (+{len(entries) - len(base)} variant rows); cache at "
+          f"paddle_tpu/ops/pallas/flash_tune.json", flush=True)
     return 0
 
 
