@@ -215,3 +215,23 @@ def test_int8_weight_only_decode_close_to_fp():
                     int8_weights=True)
     np.testing.assert_array_equal(np.asarray(out.numpy()),
                                   np.asarray(out2.numpy()))
+
+
+def test_int8_decode_with_left_padding():
+    # int8 weight packs compose with the left-padded attention_mask path
+    import paddle_tpu as pt
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, generate
+
+    pt.seed(3)
+    cfg = LlamaConfig.tiny()
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(4, cfg.vocab_size, (2, 8))
+    am = np.ones((2, 8), np.int64)
+    am[0, :3] = 0  # row 0 left-padded
+    out = generate(m, pt.to_tensor(ids), max_new_tokens=4,
+                   attention_mask=pt.to_tensor(am), int8_weights=True)
+    arr = np.asarray(out.numpy())
+    assert arr.shape == (2, 4)
+    assert (arr >= 0).all() and (arr < cfg.vocab_size).all()
