@@ -174,6 +174,13 @@ def main():
     enable_compilation_cache()
 
     import paddle_tpu as pt
+    from paddle_tpu import monitor as _mon
+
+    if os.environ.get("PT_BENCH_MONITOR", "1") != "0":
+        # runtime telemetry (retraces / compiles / tunnel syncs) rides along
+        # in the JSON line; the cost is off the hot path — compiled steps
+        # bypass eager dispatch, so only tracing and sync fences count.
+        _mon.enable()
 
     # Pre-flight: Mosaic-lower every Pallas kernel before the timed run.
     # If a kernel fails to lower, fall back to the XLA composite path so
@@ -197,16 +204,30 @@ def main():
     ids = pt.to_tensor(np.random.randint(0, vocab, (batch, seq)))
     labels = pt.to_tensor(np.random.randint(0, vocab, (batch, seq)))
 
+    # step-metrics JSONL sink (opt-in: the default bench writes no files);
+    # per-step lines are async-dispatch timings, only the final loss syncs
+    slog = None
+    if _mon.enabled() and os.environ.get("PT_MONITOR", "0") not in ("", "0"):
+        slog = _mon.StepLogger(
+            os.environ.get("PT_MONITOR_SINK") or "bench_steps.jsonl",
+            meta={"source": "bench.py", "backend": backend,
+                  "batch": batch, "seq": seq})
+
     for _ in range(warmup):
         float(step(ids, labels).numpy())  # host transfer = real sync
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = step(ids, labels)
+        if slog is not None:
+            slog.log_step(num_samples=batch * seq)
     final_loss = float(loss.numpy())  # chained through params: syncs all
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss)
 
     tokens_per_sec = batch * seq * steps / dt
+    if slog is not None:
+        slog.close(loss=final_loss,
+                   tokens_per_sec=round(tokens_per_sec, 2))
     flops_tok = model.flops_per_token(seq)
     mfu = tokens_per_sec * flops_tok / _peak_flops(jax.devices()[0])
     extra = {"mfu": round(mfu, 4), "model_params_b": round(
@@ -279,6 +300,25 @@ def main():
         extra["note"] = "cpu smoke mode; not a TPU number"
     if pallas_note:
         extra["pallas"] = pallas_note
+    # runtime-health sub-object: a surprise retrace or a sync storm shows
+    # up next to the ips it explains (BENCH_r*.json keeps both)
+    try:
+        snap = _mon.snapshot()
+        c = snap.get("counters", {})
+        tel = {"retraces": c.get("jit/retraces", 0),
+               "compiles": c.get("jit/compiles", 0),
+               "sync_count": c.get("tunnel/syncs", 0)}
+        h = snap.get("histograms", {}).get("tunnel/sync_ms")
+        if h:
+            tel["sync_ms_p50"] = h["p50"]
+            tel["sync_ms_max"] = h["max"]
+        # per-step sink writes happen inside the timed loop: mark the
+        # record so A/B comparisons don't conflate sink overhead with a
+        # regression
+        tel["sink_active"] = slog is not None
+        extra["telemetry"] = tel
+    except Exception:  # noqa: BLE001 — telemetry must not break the line
+        pass
     _emit(round(tokens_per_sec, 2), round(mfu / 0.45, 4), **extra)
 
 

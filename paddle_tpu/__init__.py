@@ -121,6 +121,7 @@ _LAZY = {
     "reader": ".reader",
     "dataset": ".dataset",
     "cost_model": ".cost_model",
+    "monitor": ".monitor",
 }
 
 

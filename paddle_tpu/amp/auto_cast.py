@@ -15,12 +15,17 @@ weights in the optimizer.
 from __future__ import annotations
 
 import contextlib
+import sys
 import threading
 
 import jax.numpy as jnp
 
+from ..monitor import _register as _monitor_register
 from ..ops import dispatch
 from . import amp_lists
+
+# Telemetry slot (see paddle_tpu.monitor): counts autocast region entries.
+_monitor = None
 
 _state = threading.local()
 
@@ -108,6 +113,8 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
         raise ValueError(f"amp level must be O0|O1|O2, got {level!r}")
     cfg = _AmpConfig(enable and level.upper() != "O0", level, dtype,
                      custom_white_list, custom_black_list)
+    if _monitor is not None and cfg.enable:
+        _monitor.on_autocast_enter()
     stack = _ctx()
     stack.append(cfg)
     try:
@@ -157,3 +164,6 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
     if optimizers is None:
         return model_list[0] if single_model else model_list
     return (model_list[0] if single_model else model_list), out_opt
+
+
+_monitor_register(sys.modules[__name__])

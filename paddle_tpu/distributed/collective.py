@@ -23,10 +23,24 @@ produce.
 from __future__ import annotations
 
 import functools
+import sys
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
+
+from ..monitor import _register as _monitor_register
+
+# Telemetry slot (see paddle_tpu.monitor): when wired, each collective
+# reports one call + payload bytes. In-trace collectives count once per
+# *trace*, not per execution — XLA owns the executed schedule.
+_monitor = None
+
+
+def _mon_collective(name, arr):
+    m = _monitor
+    if m is not None:
+        m.on_collective(name, int(getattr(arr, "nbytes", 0) or 0))
 
 
 def shard_map(fn, mesh, in_specs, out_specs, check_rep=False):
@@ -227,6 +241,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     if g.nranks == 1:
         return tensor
     t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+    _mon_collective("all_reduce", t._data)
     if _axes_in_scope(g.axes):
         ax = g.axes if len(g.axes) > 1 else g.axes[0]
         red = {"sum": jax.lax.psum, "avg": jax.lax.pmean,
@@ -272,6 +287,8 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis=0):
     else:
         x = tensor_or_list
     t = x if isinstance(x, Tensor) else Tensor(x)
+    if g.nranks > 1:
+        _mon_collective("all_gather", t._data)
     if g.nranks == 1:
         gathered = t
     elif _axes_in_scope(g.axes):
@@ -302,6 +319,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
     if g.nranks == 1 or _axes_in_scope(g.axes):
         return t
+    _mon_collective("broadcast", t._data)
     e = env_mod.ensure_env()
     spec = _current_spec(t._data)
     parts = [None if _mentions(p, g.axes) else p for p in spec]
@@ -327,6 +345,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
     if g.nranks == 1 or _axes_in_scope(g.axes):
         return t
+    _mon_collective("scatter", t._data)
     e = env_mod.ensure_env()
     t._replace_(jax.device_put(
         _on_mesh(t._data), NamedSharding(e.mesh, _spec_on(t.ndim, g.axes, 0))))
@@ -352,6 +371,7 @@ def all_to_all(out_tensor_list, in_tensor_list=None, group=None, sync_op=True,
     t = x if isinstance(x, Tensor) else Tensor(x)
     if g.nranks == 1:
         return t
+    _mon_collective("all_to_all", t._data)
     ax = g.axes if len(g.axes) > 1 else g.axes[0]
     if _axes_in_scope(g.axes):
         return apply(
@@ -398,6 +418,7 @@ def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True, axis=0):
     t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
     if g.nranks == 1:
         return t
+    _mon_collective("reduce_scatter", t._data)
     ax = g.axes if len(g.axes) > 1 else g.axes[0]
     if _axes_in_scope(g.axes):
         return apply(
@@ -419,6 +440,7 @@ def ppermute(tensor, perm, group=None):
     g = get_group(group)
     ax = g.axes if len(g.axes) > 1 else g.axes[0]
     t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+    _mon_collective("ppermute", t._data)
     return apply("ppermute", lambda a: jax.lax.ppermute(a, ax, perm), (t,))
 
 
@@ -443,6 +465,7 @@ def barrier(group=None):
     *device* work, not hosts, so it is not sufficient (round-1 ADVICE).
     Single-process: a device round-trip flushes dispatched work.
     """
+    _mon_collective("barrier", None)
     e = env_mod.ensure_env()
     if jax.process_count() > 1:
         try:
@@ -592,3 +615,6 @@ def get_backend(group=None):
 def is_available():
     """Parity: paddle.distributed.is_available."""
     return True
+
+
+_monitor_register(sys.modules[__name__])

@@ -17,9 +17,17 @@ reference `fleet/layers/mpu/random.py`) on top of :func:`rng_scope`.
 from __future__ import annotations
 
 import contextlib
+import sys
 import threading
 
 import jax
+
+from ..monitor import _register as _monitor_register
+
+# Telemetry slot (see paddle_tpu.monitor): counts PRNG key splits — a
+# proxy for how much randomness (dropout masks, init draws) each step
+# threads through traced arguments.
+_monitor = None
 
 _state = threading.local()
 
@@ -67,6 +75,8 @@ def next_key():
     traced) key — this is how jit'd programs thread randomness through traced
     arguments. Outside any scope, keys come from the global generator.
     """
+    if _monitor is not None:
+        _monitor.on_key_split()
     scopes = _scopes()
     if scopes:
         key, sub = jax.random.split(scopes[-1][0])
@@ -92,3 +102,6 @@ def get_rng_state():
 
 def set_rng_state(key):
     _default_source().set_state(key)
+
+
+_monitor_register(sys.modules[__name__])

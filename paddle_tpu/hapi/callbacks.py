@@ -195,6 +195,43 @@ class LRSchedulerCallback(Callback):
             s.step()
 
 
+class MonitorCallback(Callback):
+    """Stream per-step runtime telemetry to a JSONL sink
+    (`paddle_tpu.monitor.StepLogger`): one line per train batch with loss,
+    ips, and the counter diff (retraces, tunnel syncs, collective bytes...)
+    attributable to that step. Auto-added by `config_callbacks` when the
+    monitor is enabled (``PT_MONITOR=1``); sink path from ``path`` or
+    ``PT_MONITOR_SINK``. Step ids are monotonic across epochs."""
+
+    def __init__(self, path=None):
+        self.path = path
+        self._logger = None
+
+    def on_train_begin(self, logs=None):
+        from ..monitor import StepLogger
+
+        params = getattr(self, "params", {}) or {}
+        self._logger = StepLogger(self.path, meta={
+            "source": "hapi.fit",
+            "epochs": params.get("epochs"),
+            "steps_per_epoch": params.get("steps"),
+            "batch_size": params.get("batch_size"),
+        })
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._logger is None:
+            return
+        logs = logs or {}
+        params = getattr(self, "params", {}) or {}
+        self._logger.log_step(loss=logs.get("loss"),
+                              num_samples=params.get("batch_size"))
+
+    def on_train_end(self, logs=None):
+        if self._logger is not None:
+            self._logger.close()
+            self._logger = None
+
+
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
                      steps=None, verbose=2, save_freq=1, save_dir=None,
                      metrics=None, mode="train"):
@@ -205,6 +242,12 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
         cbks.append(LRSchedulerCallback())
     if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
         cbks.append(ModelCheckpoint(save_freq, save_dir))
+    if mode == "train" and not any(isinstance(c, MonitorCallback)
+                                   for c in cbks):
+        from ..monitor import enabled as _monitor_enabled
+
+        if _monitor_enabled():
+            cbks.append(MonitorCallback())
     lst = CallbackList(cbks)
     lst.set_model(model)
     lst.set_params({

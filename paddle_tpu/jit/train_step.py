@@ -19,12 +19,19 @@ the multichip dry-run.
 """
 from __future__ import annotations
 
+import sys
+import time
+
 import jax
 import jax.numpy as jnp
 
 from ..autograd import tape
 from ..framework import random as rng
 from ..framework.core import Tensor
+from ..monitor import _register as _monitor_register
+
+# Telemetry slot (see paddle_tpu.monitor): None unless PT_MONITOR wired it.
+_monitor = None
 
 
 class TrainStep:
@@ -58,6 +65,7 @@ class TrainStep:
         self._masters: list = []
         self._step_count = 0
         self._cache = {}
+        self._retraced = False
 
     # -- functional per-param update mirroring Optimizer.step's eager loop --
     def _param_update(self, p, arr, g, state, master, lr, step):
@@ -227,7 +235,10 @@ class TrainStep:
         sig = (tuple((tuple(a.shape), str(a.dtype)) for a in arrays),
                training)
         fn = self._cache.get(sig)
+        self._retraced = fn is None
         if fn is None:
+            if _monitor is not None:
+                _monitor.on_retrace(id(self), len(self._cache) + 1)
             fn = self._cache[sig] = self._build(sig)
         return fn, arrays
 
@@ -246,6 +257,12 @@ class TrainStep:
                 return x
             return env_mod.put_replicated(x, e.mesh)
 
+        m = _monitor
+        # fresh signature: this dispatch pays trace + XLA compile; wall-time
+        # here is host-side compile cost (the call acks enqueue, so device
+        # execution is excluded on async backends)
+        t_compile = time.perf_counter() if (m is not None and
+                                            self._retraced) else None
         new_params, flat_state, new_buffers, loss = fn(
             [p._data for p in self._params],
             self._flatten_state(),
@@ -255,6 +272,11 @@ class TrainStep:
             place(rng.next_key()),
             [place(a) for a in arrays],
         )
+        if t_compile is not None:
+            m.on_compile_ms((time.perf_counter() - t_compile) * 1e3)
+        if m is not None and self._donate:
+            # donated buffers are dead after the call; every param rebinds
+            m.on_donation_rebind(len(self._params))
         for p, a in zip(self._params, new_params):
             p._data = a
             p._grad_node = None
@@ -296,3 +318,6 @@ class TrainStep:
             arrays,
         )
         return lowered.compile().memory_analysis()
+
+
+_monitor_register(sys.modules[__name__])

@@ -1,0 +1,199 @@
+"""Unified runtime telemetry: counters, step-metrics sink, trace correlation.
+
+What the profiler (`paddle_tpu/profiler`) does for *user code* — host event
+scopes, op timelines — this subsystem does for the *runtime itself*: jit
+retraces and compile wall-time, dispatch primitive-cache hits/misses,
+tunnel sync latency, collective traffic, PRNG key splits, autocast entries.
+These are exactly the signals that were invisible when rounds 1–3 lost
+bench truth to dead tunnels and surprise recompiles.
+
+Zero-overhead-when-off contract: instrumented modules (``ops/dispatch``,
+``jit/train_step``, ``utils/timing``, ``distributed/collective``,
+``framework/random``, ``amp/auto_cast``) each carry a module-global
+``_monitor`` slot that is ``None`` unless :func:`enable` installed this
+module into it. Their hot paths guard with ``if _monitor is not None`` —
+when monitoring is off no monitor callable is ever invoked (asserted by
+``tests/test_monitor.py``). Enablement: ``PT_MONITOR=1`` in the
+environment, or :func:`enable` programmatically.
+
+Emission path: :class:`StepLogger` writes one JSONL line per training step
+(loss, ips, counter diff) — wired into ``hapi`` fit loops via
+``hapi.callbacks.MonitorCallback`` and into ``bench.py``; sink path from
+``PT_MONITOR_SINK``. ``tools/monitor_report.py`` joins a JSONL run with a
+chrome trace from the profiler into one summary; the profiler also exports
+these counters as chrome-trace ``ph:"C"`` counter events so they render on
+the Perfetto timeline.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, Registry, diff_snapshots,
+)
+
+__all__ = [
+    "enable", "disable", "enabled", "counter", "gauge", "histogram",
+    "snapshot", "diff", "reset", "StepLogger",
+    "Counter", "Gauge", "Histogram", "Registry",
+]
+
+_registry = Registry()
+_enabled = False
+
+# every instrumented module registers itself here (see _register); enable()
+# installs this module into each site's `_monitor` slot, disable() clears it
+_SITES: list = []
+
+# hot-path metrics are pre-created so instrumentation pays one attribute
+# load + method call, never a registry lookup
+_c_op_apply = _registry.counter("dispatch/op_apply")
+_c_prim = {kind: _registry.counter(f"dispatch/prim_cache_{kind}")
+           for kind in ("hit", "miss", "uncacheable")}
+_c_retraces = _registry.counter("jit/retraces")
+_c_compiles = _registry.counter("jit/compiles")
+_h_compile_ms = _registry.histogram("jit/compile_ms")
+_g_cache_size = _registry.gauge("jit/signature_cache_size")
+_c_rebinds = _registry.counter("jit/donation_rebinds")
+_c_syncs = _registry.counter("tunnel/syncs")
+_h_sync_ms = _registry.histogram("tunnel/sync_ms")
+_c_coll_bytes = _registry.counter("collective/bytes")
+_c_key_splits = _registry.counter("rng/key_splits")
+_c_autocast = _registry.counter("amp/autocast_enters")
+
+
+# -- public metric access ----------------------------------------------------
+
+def counter(name: str) -> Counter:
+    """Get-or-create the process-wide counter ``name``
+    (e.g. ``monitor.counter("jit/retraces")``)."""
+    return _registry.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _registry.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    """Get-or-create a histogram (e.g. ``monitor.histogram("tunnel/sync_ms")``)."""
+    return _registry.histogram(name)
+
+
+def snapshot() -> dict:
+    """Typed snapshot ``{"counters", "gauges", "histograms"}`` of every
+    live metric."""
+    return _registry.snapshot()
+
+
+def diff(prev: dict, cur: dict | None = None) -> dict:
+    """Delta between ``prev`` and ``cur`` (default: a fresh snapshot)."""
+    return diff_snapshots(prev, cur if cur is not None else snapshot())
+
+
+def reset() -> None:
+    """Zero every metric (registered objects stay live)."""
+    _trainstep_cache_sizes.clear()
+    _registry.reset()
+
+
+# -- enablement --------------------------------------------------------------
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    """Install the instrumentation hooks (idempotent). Same effect as
+    starting the process with ``PT_MONITOR=1``."""
+    global _enabled
+    if _enabled:
+        return
+    _enabled = True
+    this = sys.modules[__name__]
+    for mod in _SITES:
+        mod._monitor = this
+
+
+def disable() -> None:
+    """Uninstall every hook: instrumented hot paths go back to a single
+    ``is None`` check with no monitor callables invoked."""
+    global _enabled
+    if not _enabled:
+        return
+    _enabled = False
+    for mod in _SITES:
+        mod._monitor = None
+
+
+def _register(mod) -> None:
+    """Called by each instrumented module at import: wires its ``_monitor``
+    slot to the current enablement state and keeps it in sync with later
+    enable()/disable() calls."""
+    if mod not in _SITES:
+        _SITES.append(mod)
+    mod._monitor = sys.modules[__name__] if _enabled else None
+
+
+# -- site callbacks (invoked ONLY while enabled) -----------------------------
+
+def on_op_apply(op_name: str) -> None:
+    _c_op_apply.inc()
+
+
+def on_prim_cache(kind: str) -> None:
+    _c_prim[kind].inc()
+
+
+# per-TrainStep-instance signature-cache sizes: the gauge is the SUM over
+# live instances (a single per-instance value would be clobbered when a run
+# holds several steps, e.g. train + eval)
+_trainstep_cache_sizes: dict = {}
+
+
+def on_retrace(owner_id: int, cache_size: int) -> None:
+    _c_retraces.inc()
+    _trainstep_cache_sizes[owner_id] = cache_size
+    _g_cache_size.set(sum(_trainstep_cache_sizes.values()))
+
+
+def on_compile_ms(ms: float) -> None:
+    """First dispatch of a fresh signature: trace + XLA compile wall-time
+    (the call returns after enqueue, so device execution is excluded on
+    async backends — this is host-side compile cost)."""
+    _c_compiles.inc()
+    _h_compile_ms.observe(ms)
+
+
+def on_donation_rebind(n: int) -> None:
+    _c_rebinds.inc(n)
+
+
+def on_tunnel_sync(ms: float) -> None:
+    """One host-transfer-backed device fence (utils/timing.device_sync) —
+    the only honest sync through tunneled PJRT (see CLAUDE.md timing
+    rules); its latency IS the tunnel round-trip."""
+    _c_syncs.inc()
+    _h_sync_ms.observe(ms)
+
+
+def on_collective(name: str, nbytes: int) -> None:
+    _registry.counter(f"collective/{name}").inc()
+    if nbytes:
+        _c_coll_bytes.inc(nbytes)
+
+
+def on_key_split() -> None:
+    _c_key_splits.inc()
+
+
+def on_autocast_enter() -> None:
+    _c_autocast.inc()
+
+
+from .step_logger import StepLogger  # noqa: E402,F401
+
+# PT_MONITOR=1 enables at import, before any instrumented module registers
+# (later registrants are wired inside _register)
+if os.environ.get("PT_MONITOR", "0") not in ("", "0"):
+    enable()

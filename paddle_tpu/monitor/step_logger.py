@@ -1,0 +1,107 @@
+"""Step-metrics JSONL sink.
+
+One line per training step: step id, wall time, loss, ips, and the monitor
+counter *diff* since the previous line — so a reader can see exactly which
+step retraced, synced the tunnel, or moved collective bytes. Bracketed by a
+``run_begin`` line (metadata) and a ``run_end`` line (cumulative totals,
+including full histogram percentiles). Every line is independently
+parseable JSON; ``tools/monitor_report.py`` renders a run summary from it,
+optionally joined with a profiler chrome trace.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def _default_path() -> str:
+    return os.environ.get("PT_MONITOR_SINK") or "monitor_steps.jsonl"
+
+
+class StepLogger:
+    """Append-mode JSONL writer with monotonic step ids.
+
+    Usage::
+
+        with monitor.StepLogger("run.jsonl", meta={"source": "fit"}) as log:
+            for batch in loader:
+                loss = step(*batch)
+                log.log_step(loss=float(loss.numpy()), num_samples=bs)
+
+    Works with monitoring disabled too (lines simply carry no counter
+    diffs), so explicit callers never crash on a missing ``PT_MONITOR=1``.
+    """
+
+    def __init__(self, path: str | None = None, meta: dict | None = None):
+        from paddle_tpu import monitor as _mon
+
+        self._mon = _mon
+        self.path = path or _default_path()
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(self.path, "a")
+        self._step = 0
+        self._t0 = self._t_last = time.perf_counter()
+        self._prev = _mon.snapshot()
+        self._write({
+            "event": "run_begin",
+            "ts": round(time.time(), 6),
+            "pid": os.getpid(),
+            "monitor_enabled": _mon.enabled(),
+            "meta": meta or {},
+        })
+
+    def _write(self, obj: dict) -> None:
+        self._f.write(json.dumps(obj) + "\n")
+        self._f.flush()
+
+    def log_step(self, loss=None, num_samples=None, **fields) -> dict:
+        """Emit one step line; returns the dict that was written.
+
+        ``dur_ms`` is host wall-time since the previous line — on async
+        backends that is dispatch time unless the caller synced (which is
+        exactly what a per-step `.numpy()` fetch of the loss does).
+        """
+        now = time.perf_counter()
+        dur = now - self._t_last
+        self._t_last = now
+        cur = self._mon.snapshot()
+        delta = self._mon.diff(self._prev, cur)
+        self._prev = cur
+        self._step += 1
+        line = {"step": self._step, "ts": round(time.time(), 6),
+                "dur_ms": round(dur * 1e3, 3)}
+        if loss is not None:
+            line["loss"] = float(loss)
+        if num_samples:
+            line["ips"] = round(num_samples / dur, 3) if dur > 0 else 0.0
+        for k, v in fields.items():
+            if v is not None:
+                line[k] = v
+        line.update(delta)
+        self._write(line)
+        return line
+
+    def close(self, **fields) -> None:
+        """Write the ``run_end`` totals line and close the file (idempotent)."""
+        if self._f is None:
+            return
+        line = {"event": "run_end", "ts": round(time.time(), 6),
+                "steps": self._step,
+                "wall_s": round(time.perf_counter() - self._t0, 3),
+                "totals": self._mon.snapshot()}
+        for k, v in fields.items():
+            if v is not None:
+                line[k] = v
+        self._write(line)
+        self._f.close()
+        self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -19,6 +19,7 @@ the CUDA stream. The hot path cost is a few Python frames + jax dispatch.
 from __future__ import annotations
 
 import functools
+import sys
 import weakref
 
 import jax
@@ -28,6 +29,12 @@ import numpy as np
 from . import registry
 from ..autograd import tape
 from ..framework.core import Tensor
+from ..monitor import _register as _monitor_register
+
+# Telemetry slot: None unless paddle_tpu.monitor.enable() installed the
+# monitor module here (PT_MONITOR=1). The hot path pays one is-None check
+# when off — no monitor callables execute (tests/test_monitor.py asserts).
+_monitor = None
 
 # AMP hook: set by paddle_tpu.amp. Signature: (op_name, arrays) -> arrays.
 # `_amp_active` is a cheap predicate consulted per op so an idle (imported
@@ -149,27 +156,38 @@ def _fn_key(fn, depth=0):
 
 
 def _get_primitive(op_name, fn, static):
+    m = _monitor
     fk = _fn_key(fn)
     if fk is _UNSAFE:
+        if m is not None:
+            m.on_prim_cache("uncacheable")
         return None
     try:
         key = (op_name, fk, tuple(sorted(static.items())))
         hash(key)
     except TypeError:
+        if m is not None:
+            m.on_prim_cache("uncacheable")
         return None
     ent = _prim_cache.get(key)
-    if ent is None:
-        def pure(*arrs):
-            out = fn(*arrs, **static)
-            return tuple(out) if isinstance(out, (tuple, list)) else out
+    if ent is not None:
+        if m is not None:
+            m.on_prim_cache("hit")
+        return ent
+    if m is not None:
+        m.on_prim_cache("miss")
 
-        fwd = jax.jit(pure)
+    def pure(*arrs):
+        out = fn(*arrs, **static)
+        return tuple(out) if isinstance(out, (tuple, list)) else out
 
-        @jax.jit
-        def bwd(arrs, g):
-            return jax.vjp(pure, *arrs)[1](g)
+    fwd = jax.jit(pure)
 
-        ent = _prim_cache[key] = (fwd, bwd)
+    @jax.jit
+    def bwd(arrs, g):
+        return jax.vjp(pure, *arrs)[1](g)
+
+    ent = _prim_cache[key] = (fwd, bwd)
     return ent
 
 
@@ -197,6 +215,8 @@ def apply(op_name, fn, operands, n_outputs=None, **static):
     Returns Tensor or tuple[Tensor] mirroring fn's output structure.
     """
     registry.count_call(op_name)
+    if _monitor is not None:
+        _monitor.on_op_apply(op_name)
     kernel = registry.lookup_kernel(op_name)
     if kernel is not None:
         if getattr(kernel, "wants_default", False):
@@ -307,6 +327,8 @@ def apply_nondiff(op_name, fn, operands, **static):
     """Dispatch with recording unconditionally off (comparisons, argsort
     indices, random masks...)."""
     registry.count_call(op_name)
+    if _monitor is not None:
+        _monitor.on_op_apply(op_name)
     arrays = [_unwrap(x) for x in operands]
     if _mesh_hook is not None:
         arrays = _mesh_hook(arrays)
@@ -319,3 +341,6 @@ def apply_nondiff(op_name, fn, operands, **static):
         _program_hook(op_name, fn, operands, static,
                       list(results) if isinstance(results, tuple) else [results])
     return results
+
+
+_monitor_register(sys.modules[__name__])

@@ -49,6 +49,10 @@ class ProfilerState:
 def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
     """Parity: `paddle.profiler.make_scheduler` — maps step number to state."""
     period = closed + ready + record
+    if period < 1:
+        raise ValueError(
+            f"make_scheduler needs closed+ready+record >= 1, got "
+            f"closed={closed} ready={ready} record={record}")
 
     def scheduler(step):
         if step < skip_first:
@@ -202,6 +206,7 @@ class Profiler:
     def stop(self):
         global _active_profiler
         self._uninstall()
+        self._emit_monitor_counters()
         self._t_stop = time.perf_counter()
         if self._on_trace_ready is not None:
             self._on_trace_ready(self)
@@ -212,6 +217,7 @@ class Profiler:
         if not self._scheduler or self._state in (
                 ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
             self._emit_memory_counter()
+            self._emit_monitor_counters()
         if self._scheduler:
             prev = self._state
             self._state = self._scheduler(self.step_num)
@@ -237,6 +243,33 @@ class Profiler:
                     "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
                 },
             })
+
+    def _emit_monitor_counters(self):
+        """Runtime-telemetry counters (`paddle_tpu.monitor`) as chrome-trace
+        ``ph:"C"`` counter events, so retraces / tunnel syncs / collective
+        bytes render as counter tracks on the same Perfetto timeline as the
+        host events. No-op when the monitor is disabled."""
+        from ..monitor import enabled as _mon_enabled, snapshot as _mon_snap
+
+        if not _mon_enabled():
+            return
+        snap = _mon_snap()
+        ts = time.perf_counter() * 1e6
+        pid = os.getpid()
+        events = []
+        for section in ("counters", "gauges"):
+            for name, v in snap.get(section, {}).items():
+                events.append({"name": f"monitor/{name}", "ph": "C",
+                               "ts": ts, "pid": pid, "cat": "monitor",
+                               "args": {"value": v}})
+        for name, h in snap.get("histograms", {}).items():
+            events.append({"name": f"monitor/{name}", "ph": "C", "ts": ts,
+                           "pid": pid, "cat": "monitor",
+                           "args": {"count": h["count"], "p50": h["p50"],
+                                    "p95": h["p95"]}})
+        if events:
+            with _recorder._lock:
+                _recorder.events.extend(events)
 
     def __enter__(self):
         self.start()

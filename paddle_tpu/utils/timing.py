@@ -9,7 +9,18 @@ backend, so every wall-clock measurement in this repo syncs through
 """
 from __future__ import annotations
 
+import sys
+import time
+
 import jax
+
+from ..monitor import _register as _monitor_register
+
+# Telemetry slot (see paddle_tpu.monitor): when wired, every device_sync
+# reports its transfer-fence latency to the tunnel/sync_ms histogram. The
+# measurement is the host transfer itself — exactly the sync the timing
+# rules above prescribe, never a block_until_ready.
+_monitor = None
 
 
 def device_sync(out):
@@ -28,5 +39,14 @@ def device_sync(out):
             leaf = leaf[(0,) * leaf.ndim]
         fetch.append(leaf)
     if fetch:
-        jax.device_get(fetch)
+        m = _monitor
+        if m is not None:
+            t0 = time.perf_counter()
+            jax.device_get(fetch)
+            m.on_tunnel_sync((time.perf_counter() - t0) * 1e3)
+        else:
+            jax.device_get(fetch)
     return out
+
+
+_monitor_register(sys.modules[__name__])

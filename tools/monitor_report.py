@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Join a monitor StepLogger JSONL run with a profiler chrome trace into
+one summary table.
+
+    python tools/monitor_report.py run.jsonl [--trace trace.json] [--top 10]
+
+Sections: run overview (steps, wall, loss, ips), counter totals, retrace
+timeline (which step retraced — the recompile smoking gun), tunnel-sync
+latency percentiles, and — when a chrome trace from
+`paddle_tpu.profiler.Profiler.export` is given — the top dispatched ops and
+the monitor counter tracks found on the timeline, so one report correlates
+the JSONL run with the trace.
+
+Pure stdlib: runs anywhere the artifacts land, no jax import.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_jsonl(path):
+    """(step_lines, begin, end) from a StepLogger file; tolerates junk
+    lines (a crashed run must still be reportable)."""
+    steps, begin, end = [], None, None
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                line = json.loads(raw)
+            except ValueError:
+                continue
+            if not isinstance(line, dict):
+                continue
+            if "step" in line:
+                steps.append(line)
+            elif line.get("event") == "run_begin" and begin is None:
+                begin = line
+            elif line.get("event") == "run_end":
+                end = line  # last one wins (appended runs)
+    return steps, begin, end
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024.0
+
+
+def _table(rows, widths):
+    out = []
+    for row in rows:
+        out.append("".join(
+            f"{str(c):<{w}}" if i == 0 else f"{str(c):>{w}}"
+            for i, (c, w) in enumerate(zip(row, widths))))
+    return out
+
+
+def _counter_totals(steps, end):
+    if end and end.get("totals", {}).get("counters"):
+        return dict(end["totals"]["counters"])
+    totals = {}
+    for s in steps:
+        for k, v in s.get("counters", {}).items():
+            totals[k] = totals.get(k, 0) + v
+    return totals
+
+
+def render(jsonl_path, trace_path=None, top=10):
+    steps, begin, end = load_jsonl(jsonl_path)
+    out = [f"== monitor run: {jsonl_path} =="]
+    if begin:
+        meta = begin.get("meta") or {}
+        if meta:
+            out.append("meta: " + ", ".join(
+                f"{k}={v}" for k, v in meta.items() if v is not None))
+
+    # -- run overview --
+    n = len(steps)
+    out.append("")
+    out.append("-- run --")
+    wall = (end or {}).get("wall_s")
+    if wall is None and n:
+        wall = sum(s.get("dur_ms", 0) for s in steps) / 1e3
+    out.append(f"steps: {n}   wall: {wall:.3f} s" if wall is not None
+               else f"steps: {n}")
+    if n:
+        durs = [s["dur_ms"] for s in steps if "dur_ms" in s]
+        if durs:
+            out.append(f"step dur_ms: mean {sum(durs) / len(durs):.3f}   "
+                       f"min {min(durs):.3f}   max {max(durs):.3f}")
+        losses = [(s["step"], s["loss"]) for s in steps if "loss" in s]
+        if losses:
+            out.append(f"loss: first {losses[0][1]:.6f} (step {losses[0][0]})"
+                       f" -> last {losses[-1][1]:.6f} (step {losses[-1][0]})")
+        elif end and end.get("loss") is not None:
+            out.append(f"final loss: {end['loss']:.6f}")
+        ips = [s["ips"] for s in steps if s.get("ips")]
+        if ips:
+            out.append(f"ips: mean {sum(ips) / len(ips):.2f}   "
+                       f"max {max(ips):.2f}")
+
+    # -- counter totals --
+    totals = _counter_totals(steps, end)
+    if totals:
+        out.append("")
+        out.append("-- counters (run total) --")
+        rows = []
+        for name in sorted(totals, key=lambda k: (-totals[k], k)):
+            val = totals[name]
+            rows.append((name, _fmt_bytes(val) if name.endswith("bytes")
+                         else val))
+        out.extend(_table(rows, (44, 16)))
+
+    # -- retrace timeline --
+    retraces = [(s["step"], s["counters"]["jit/retraces"]) for s in steps
+                if s.get("counters", {}).get("jit/retraces")]
+    out.append("")
+    out.append("-- retrace timeline --")
+    if retraces:
+        out.append("  ".join(f"step {st}: +{k}" for st, k in retraces))
+        if len(retraces) > 1:
+            out.append(f"WARNING: {len(retraces)} steps retraced — check "
+                       f"for shape churn (each retrace is an XLA compile)")
+    else:
+        out.append("no retraces inside the logged window")
+
+    # -- sync latency --
+    hists = (end or {}).get("totals", {}).get("histograms", {})
+    sync = hists.get("tunnel/sync_ms")
+    if sync:
+        out.append("")
+        out.append("-- tunnel sync latency (ms) --")
+        out.extend(_table(
+            [("count", sync["count"]), ("mean", sync["mean"]),
+             ("p50", sync["p50"]), ("p95", sync["p95"]),
+             ("max", sync["max"])], (10, 14)))
+    compile_h = hists.get("jit/compile_ms")
+    if compile_h:
+        out.append("")
+        out.append("-- compile wall-time (ms) --")
+        out.extend(_table(
+            [("count", compile_h["count"]), ("mean", compile_h["mean"]),
+             ("max", compile_h["max"])], (10, 14)))
+
+    # -- chrome trace join --
+    if trace_path:
+        out.append("")
+        out.append(f"-- chrome trace: {trace_path} --")
+        try:
+            with open(trace_path) as f:
+                trace = json.load(f)
+            events = trace.get("traceEvents", [])
+        except (OSError, ValueError) as e:
+            events = None
+            out.append(f"unreadable trace: {e}")
+        if events is not None:
+            op_counts = {}
+            for ev in events:
+                if ev.get("cat") in ("op", "op_dispatch"):
+                    name = ev.get("name", "?")
+                    op_counts[name] = op_counts.get(name, 0) + 1
+            counter_tracks = sorted({
+                ev.get("name", "?") for ev in events if ev.get("ph") == "C"})
+            out.append(f"events: {len(events)}   "
+                       f"counter tracks: {len(counter_tracks)}")
+            if op_counts:
+                out.append(f"top {top} dispatched ops:")
+                rows = sorted(op_counts.items(),
+                              key=lambda kv: (-kv[1], kv[0]))[:top]
+                out.extend(_table(rows, (44, 10)))
+            if counter_tracks:
+                out.append("counter tracks: " + ", ".join(counter_tracks))
+
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Summarize a monitor JSONL run, optionally joined "
+                    "with a profiler chrome trace.")
+    ap.add_argument("jsonl", help="StepLogger JSONL file")
+    ap.add_argument("--trace", default=None,
+                    help="chrome trace JSON from profiler.export")
+    ap.add_argument("--top", type=int, default=10,
+                    help="top-N ops from the trace (default 10)")
+    args = ap.parse_args(argv)
+    report = render(args.jsonl, trace_path=args.trace, top=args.top)
+    print(report)
+    return report
+
+
+if __name__ == "__main__":
+    main()
