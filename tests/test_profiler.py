@@ -41,12 +41,77 @@ class TestProfiler:
         assert states[2] == profiler.ProfilerState.RECORD
         assert states[3] == profiler.ProfilerState.RECORD_AND_RETURN
 
+    def test_scheduler_period_one(self):
+        # closed=0 ready=0 record=1: every step is the period's last ->
+        # RECORD_AND_RETURN forever (no repeat cap)
+        sched = profiler.make_scheduler()
+        assert [sched(i) for i in range(3)] == \
+            [profiler.ProfilerState.RECORD_AND_RETURN] * 3
+        # with repeat=2 the scheduler closes after 2 periods
+        sched = profiler.make_scheduler(record=1, repeat=2)
+        assert sched(0) == profiler.ProfilerState.RECORD_AND_RETURN
+        assert sched(1) == profiler.ProfilerState.RECORD_AND_RETURN
+        assert sched(2) == profiler.ProfilerState.CLOSED
+        assert sched(100) == profiler.ProfilerState.CLOSED
+
+    def test_scheduler_skip_first_repeat_interaction(self):
+        # repeat counts periods AFTER skip_first, not from step 0
+        sched = profiler.make_scheduler(closed=1, record=1, repeat=2,
+                                        skip_first=3)
+        assert [sched(i) for i in range(3)] == \
+            [profiler.ProfilerState.CLOSED] * 3          # skipped
+        assert sched(3) == profiler.ProfilerState.CLOSED  # period 1 closed
+        assert sched(4) == profiler.ProfilerState.RECORD_AND_RETURN
+        assert sched(5) == profiler.ProfilerState.CLOSED  # period 2 closed
+        assert sched(6) == profiler.ProfilerState.RECORD_AND_RETURN
+        assert sched(7) == profiler.ProfilerState.CLOSED  # repeat exhausted
+        assert sched(50) == profiler.ProfilerState.CLOSED
+
+    def test_scheduler_zero_period_raises(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            profiler.make_scheduler(closed=0, ready=0, record=0)
+
     def test_on_trace_ready_handler(self, tmp_path):
         handler = profiler.export_chrome_tracing(str(tmp_path))
         with profiler.Profiler(on_trace_ready=handler):
             paddle.ones([2]) + 1
         files = os.listdir(tmp_path)
         assert any(f.endswith(".json") for f in files)
+
+    def test_monitor_counters_exported_as_chrome_counter_events(
+            self, tmp_path):
+        from paddle_tpu import monitor
+
+        monitor.reset()
+        monitor.enable()
+        try:
+            p = profiler.Profiler()
+            p.start()
+            x = paddle.ones([2, 2])
+            x + 1
+            p.step()
+            p.stop()
+            path = p.export(str(tmp_path / "trace.json"))
+        finally:
+            monitor.disable()
+            monitor.reset()
+        data = json.load(open(path))
+        counters = [e for e in data["traceEvents"] if e.get("ph") == "C"
+                    and e["name"].startswith("monitor/")]
+        assert any(e["name"] == "monitor/dispatch/op_apply"
+                   for e in counters)
+        # Perfetto JSON-loader contract: every counter event carries
+        # name/ph/ts/pid and a flat numeric args dict
+        for e in counters:
+            assert isinstance(e["ts"], (int, float))
+            assert isinstance(e["pid"], int)
+            assert e["args"] and all(
+                isinstance(v, (int, float)) for v in e["args"].values())
+        # the whole file still round-trips as one JSON object with
+        # traceEvents (what Perfetto's JSON loader requires)
+        assert isinstance(data["traceEvents"], list)
 
     def test_benchmark_ips(self):
         b = profiler.Benchmark()
