@@ -19,7 +19,26 @@ import time
 
 import numpy as np
 
-_METRIC = "llama_train_tokens_per_sec_per_chip"
+# PT_BENCH_ASYNC A/B (docs/ASYNC_PIPELINE.md): unset = the default lazy
+# loop (dispatch all steps, one final sync); "1"/"on" = AsyncStepper with
+# a bounded in-flight window (depth PT_BENCH_ASYNC_DEPTH, default 2);
+# "sync"/"0" = materialize the loss EVERY step — the worst-case host-in-
+# the-critical-path baseline the async pipeline is measured against.
+# A/B runs record under suffixed metric names so the measurement store
+# keeps the three populations separate.
+_ASYNC_KNOB = os.environ.get("PT_BENCH_ASYNC", "").lower()
+_ASYNC_MODES = {"": "default", "1": "async", "on": "async", "async": "async",
+                "0": "sync", "sync": "sync"}
+if _ASYNC_KNOB not in _ASYNC_MODES:
+    # fail loudly: a typo'd A/B arm must not silently record into the
+    # unsuffixed headline population in PERF_MEASUREMENTS.json
+    raise SystemExit(
+        f"bench: unknown PT_BENCH_ASYNC={_ASYNC_KNOB!r} "
+        f"(expected one of {sorted(k for k in _ASYNC_MODES if k)})")
+_ASYNC_MODE = _ASYNC_MODES[_ASYNC_KNOB]
+
+_METRIC = "llama_train_tokens_per_sec_per_chip" + {
+    "default": "", "async": "_async", "sync": "_syncstep"}[_ASYNC_MODE]
 
 _PIN_PLATFORM = (
     "import os, jax\n"
@@ -213,13 +232,32 @@ def main():
             meta={"source": "bench.py", "backend": backend,
                   "batch": batch, "seq": seq})
 
+    stepper = step
+    if _ASYNC_MODE == "async":
+        from paddle_tpu.jit.train_step import AsyncStepper
+
+        stepper = AsyncStepper(step, max_in_flight=int(
+            os.environ.get("PT_BENCH_ASYNC_DEPTH", "2")))
+
     for _ in range(warmup):
         float(step(ids, labels).numpy())  # host transfer = real sync
+    # host_blocked: wall time the host spends inside step dispatch (+ the
+    # per-step materialization in sync mode, + drain in async mode) — the
+    # dispatch-gap number the PT_BENCH_ASYNC A/B compares
+    host_blocked = 0.0
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss = step(ids, labels)
+        t_h = time.perf_counter()
+        loss = stepper(ids, labels)
+        if _ASYNC_MODE == "sync":
+            float(loss.numpy())  # per-step host round-trip (the baseline)
+        host_blocked += time.perf_counter() - t_h
         if slog is not None:
             slog.log_step(num_samples=batch * seq)
+    if _ASYNC_MODE == "async":
+        t_h = time.perf_counter()
+        stepper.drain()
+        host_blocked += time.perf_counter() - t_h
     final_loss = float(loss.numpy())  # chained through params: syncs all
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss)
@@ -231,7 +269,11 @@ def main():
     flops_tok = model.flops_per_token(seq)
     mfu = tokens_per_sec * flops_tok / _peak_flops(jax.devices()[0])
     extra = {"mfu": round(mfu, 4), "model_params_b": round(
-        sum(int(np.prod(p.shape)) for p in model.parameters()) / 1e9, 3)}
+        sum(int(np.prod(p.shape)) for p in model.parameters()) / 1e9, 3),
+        "stepping": _ASYNC_MODE,
+        "host_blocked_ms_per_step": round(host_blocked / steps * 1e3, 3)}
+    if _ASYNC_MODE == "async":
+        extra["async_depth"] = stepper.max_in_flight
     if tpu_note:
         extra["note"] = tpu_note
         extra["see"] = "PERF.md records any TPU numbers measured earlier"
@@ -247,6 +289,9 @@ def main():
                                 "vs_baseline": round(mfu / 0.45, 4),
                                 "batch": batch, "seq": seq,
                                 "ce_chunk": model.config.ce_chunk_size,
+                                "stepping": _ASYNC_MODE,
+                                "host_blocked_ms_per_step":
+                                    extra["host_blocked_ms_per_step"],
                                 "model_params_b": extra["model_params_b"]})
         except Exception as e:  # noqa: BLE001
             print(f"bench: measurement persist failed: {e}",

@@ -44,8 +44,10 @@ def _mon_collective(name, arr):
 
 
 def shard_map(fn, mesh, in_specs, out_specs, check_rep=False):
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=check_rep)
+    from ..framework.jax_compat import shard_map as _shard_map
+
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=check_rep)
 
 from . import env as env_mod
 from ..framework.core import Tensor
@@ -483,7 +485,7 @@ def barrier(group=None):
         # host participate, so completion implies every host dispatched it
         f = _barrier_fns.get(e.mesh)
         if f is None:
-            from jax.experimental.shard_map import shard_map
+            from ..framework.jax_compat import shard_map
             from jax.sharding import PartitionSpec as P
 
             ax = tuple(e.mesh.axis_names)

@@ -71,16 +71,25 @@ def _sharded_put(arr, axis):
 def _host_put(arr):
     """Move `arr` to pinned host memory, keeping its (sharded) layout —
     the ZeRO offload placement (reference `group_sharded.py:43,61`
-    `offload=True`: optimizer states + fp32 masters live on CPU)."""
+    `offload=True`: optimizer states + fp32 masters live on CPU). On
+    backends without a "pinned_host" space (the CPU test backend only
+    addresses "unpinned_host") offload degrades to a no-op: state stays
+    in default memory, which IS host memory there."""
     s = getattr(arr, "sharding", None)
     if s is None or not hasattr(s, "with_memory_kind"):
         return arr
-    return jax.device_put(arr, s.with_memory_kind("pinned_host"))
+    try:
+        return jax.device_put(arr, s.with_memory_kind("pinned_host"))
+    except ValueError:
+        return arr
 
 
 def _dev_put(arr):
+    # stage back device-ward ONLY from the offload placement; comparing
+    # != "device" would misfire on the CPU backend's default
+    # "unpinned_host" kind (same trap as jit/train_step host_shardings)
     s = getattr(arr, "sharding", None)
-    if s is None or getattr(s, "memory_kind", "device") == "device":
+    if s is None or getattr(s, "memory_kind", None) != "pinned_host":
         return arr
     return jax.device_put(arr, s.with_memory_kind("device"))
 

@@ -1,0 +1,96 @@
+"""Version shims over jax API surfaces that moved between releases.
+
+Two surfaces this repo depends on changed addresses across jax versions:
+
+- ``shard_map``: new jax exposes ``jax.shard_map`` (kwargs ``check_vma``,
+  ``axis_names``); jax 0.4.x only has
+  ``jax.experimental.shard_map.shard_map`` (kwargs ``check_rep``,
+  ``auto``).  :func:`shard_map` below accepts the NEW spelling and
+  translates down when running on the experimental API.
+- ``jax.export``: public module since jax 0.4.30 but NOT imported by
+  ``import jax`` on 0.4.x — attribute access ``jax.export.export`` raises
+  ``AttributeError`` unless something imported the submodule first.  The
+  ``export`` name below is the resolved module (falling back to
+  ``jax.experimental.export`` on trees that predate the move).
+
+Callers (``distributed/collective.py``, ``ops/ring_attention.py``,
+``jit/__init__.py``) import from here instead of touching ``jax.*``
+directly, so a jax upgrade needs exactly one file to change.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "export", "pvary", "tpu_compiler_params"]
+
+
+def tpu_compiler_params(**kwargs):
+    """Pallas-TPU compiler params across the ``TPUCompilerParams`` →
+    ``CompilerParams`` rename (lazy import: pallas is heavy and optional)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+# -- shard_map ---------------------------------------------------------------
+
+_native_shard_map = getattr(jax, "shard_map", None)
+if _native_shard_map is None:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+else:
+    _exp_shard_map = None
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None, check_rep=None,
+              axis_names=None):
+    """``jax.shard_map`` with the new-API signature on every jax.
+
+    ``check_vma``/``check_rep`` are aliases (new/old name for the same
+    replication check); pass either.  ``axis_names`` (the manual-axes
+    subset) is dropped on the old API: its equivalent ``auto`` set raises
+    ``NotImplementedError`` in the old eager impl, and binding the extra
+    mesh axes manually is semantically equivalent for bodies that only
+    address their spec'd axes (unspec'd axes stay replicated).
+    """
+    check = check_vma if check_vma is not None else check_rep
+    if _native_shard_map is not None:
+        kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+        if check is not None:
+            kwargs["check_vma"] = check
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return _native_shard_map(f, **kwargs)
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if check is not None:
+        kwargs["check_rep"] = bool(check)
+    return _exp_shard_map(f, **kwargs)
+
+
+def pvary(x, axis_names):
+    """Mark ``x`` varying over ``axis_names`` inside shard_map.
+
+    New jax tracks a varying-mask (vma) per value and needs literals that
+    feed varying outputs cast explicitly (``jax.lax.pcast``/``pvary``).
+    Old shard_map has no vma system — its ``check_rep`` inference handles
+    replicated literals itself — so this is the identity there.
+    """
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, tuple(axis_names), to="varying")
+    pv = getattr(jax.lax, "pvary", None)
+    if pv is not None:
+        return pv(x, tuple(axis_names))
+    return x
+
+
+# -- jax.export --------------------------------------------------------------
+
+export = getattr(jax, "export", None)
+if export is None:
+    try:
+        # module exists on 0.4.30+ but isn't loaded by `import jax`
+        import jax.export as export  # noqa: F401
+    except ImportError:  # pragma: no cover — pre-0.4.30 trees
+        from jax.experimental import export  # noqa: F401

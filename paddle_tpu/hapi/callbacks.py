@@ -223,7 +223,14 @@ class MonitorCallback(Callback):
             return
         logs = logs or {}
         params = getattr(self, "params", {}) or {}
-        self._logger.log_step(loss=logs.get("loss"),
+        # deferred-sync contract (docs/ASYNC_PIPELINE.md): fit leaves the
+        # loss as a lazy device scalar between log windows; forcing it
+        # here would re-introduce the per-step host round-trip. Log the
+        # loss only on steps where fit already materialized it.
+        loss = logs.get("loss")
+        if not isinstance(loss, (int, float, np.floating, np.integer)):
+            loss = None
+        self._logger.log_step(loss=loss,
                               num_samples=params.get("batch_size"))
 
     def on_train_end(self, logs=None):
@@ -234,10 +241,12 @@ class MonitorCallback(Callback):
 
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
                      steps=None, verbose=2, save_freq=1, save_dir=None,
-                     metrics=None, mode="train"):
+                     metrics=None, mode="train", log_freq=1):
     cbks = list(callbacks or [])
     if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
-        cbks.append(ProgBarLogger(verbose=verbose))
+        # cadence matches fit's loss-materialization windows, so the
+        # printed values are host floats already — no extra device sync
+        cbks.append(ProgBarLogger(log_freq=log_freq, verbose=verbose))
     if not any(isinstance(c, LRSchedulerCallback) for c in cbks):
         cbks.append(LRSchedulerCallback())
     if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):

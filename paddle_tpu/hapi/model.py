@@ -13,6 +13,7 @@ metrics, DataLoader handling, save/load) keeps the reference surface.
 from __future__ import annotations
 
 import os
+import sys
 
 import numpy as np
 
@@ -21,7 +22,11 @@ from ..autograd.tape import no_grad
 from ..framework.core import Tensor
 from ..framework.io import load as _load, save as _save
 from ..io.reader import DataLoader
-from ..jit.train_step import TrainStep
+from ..jit.train_step import AsyncStepper, TrainStep
+from ..monitor import _register as _monitor_register
+
+# Telemetry slot (see paddle_tpu.monitor): None unless PT_MONITOR wired it.
+_monitor = None
 
 
 def _to_tensor_list(batch):
@@ -29,6 +34,100 @@ def _to_tensor_list(batch):
         return [b if isinstance(b, Tensor) else Tensor(np.asarray(b))
                 for b in batch]
     return [batch if isinstance(batch, Tensor) else Tensor(np.asarray(batch))]
+
+
+def _fetch_scalars(tensors):
+    """ONE counted host transfer for a batch of lazy device scalars
+    (``hapi/host_syncs`` is the guard metric for the ≤1-sync-per-window
+    contract) — the single sync primitive `fit`/`evaluate` share."""
+    import jax
+
+    m = _monitor
+    if m is not None:
+        m.on_host_sync()
+    return [float(np.asarray(a).reshape(-1)[0])
+            for a in jax.device_get([t._data for t in tensors])]
+
+
+class _LazyLoss:
+    """A deferred training metric: number-like, synced on first read.
+
+    `fit` hands these to callbacks between log windows so the loop never
+    blocks on the device — but a USER callback that reads the value
+    (``float(logs["loss"])``, ``np.asarray``, a comparison) must still
+    get honest number semantics, and that read IS a host sync, so it is
+    materialized on demand and counted via the same ``hapi/host_syncs``
+    hook as the deliberate window syncs. Reading every step (e.g. a
+    user-constructed ``ProgBarLogger(log_freq=1)``) therefore re-creates
+    per-step syncing — visibly, in the guard counter, as the user asked.
+    """
+
+    __slots__ = ("_tensor", "_value")
+
+    def __init__(self, tensor):
+        self._tensor = tensor
+        self._value = None
+
+    def _materialize(self):
+        if self._value is None:
+            self._value = _fetch_scalars([self._tensor])[0]
+        return self._value
+
+    def __float__(self):
+        return self._materialize()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._materialize())
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self):
+        return self._materialize()
+
+    def __lt__(self, other):
+        return self._materialize() < other
+
+    def __le__(self, other):
+        return self._materialize() <= other
+
+    def __gt__(self, other):
+        return self._materialize() > other
+
+    def __ge__(self, other):
+        return self._materialize() >= other
+
+    def __eq__(self, other):
+        return self._materialize() == other
+
+    def __hash__(self):
+        return object.__hash__(self)
+
+    def __repr__(self):
+        return (f"{self._value!r}" if self._value is not None
+                else "<lazy device scalar>")
+
+
+def _materialize_logs(logs):
+    """Fetch every lazy scalar in ``logs`` to the host in ONE transfer,
+    returning plain-float logs — everything downstream (ProgBarLogger,
+    MonitorCallback, user callbacks) sees host floats and cannot
+    accidentally re-sync."""
+    lazy = {k: v for k, v in logs.items()
+            if isinstance(v, (Tensor, _LazyLoss))}
+    if not lazy:
+        return dict(logs)
+    out = dict(logs)
+    pre = {k: v for k, v in lazy.items()
+           if isinstance(v, _LazyLoss) and v._value is not None}
+    todo = {k: v for k, v in lazy.items() if k not in pre}
+    for k, v in pre.items():
+        out[k] = v._value
+    if todo:
+        vals = _fetch_scalars([
+            v._tensor if isinstance(v, _LazyLoss) else v
+            for v in todo.values()])
+        for k, f in zip(todo, vals):
+            out[k] = f
+    return out
 
 
 class Model:
@@ -69,23 +168,41 @@ class Model:
         return loss.mean() if loss.ndim else loss
 
     # -- per-batch ops (parity: Model.train_batch / eval_batch / predict_batch) --
+    def _train_batch_lazy(self, inputs, labels=None):
+        """One compiled step; the loss comes back as a LAZY device scalar
+        (jax dispatch is async — no host round-trip here). `fit` consumes
+        this path and defers materialization to its log cadence."""
+        batch = _to_tensor_list(inputs) + (
+            _to_tensor_list(labels) if labels is not None else [])
+        return self._train_step(*batch)
+
     def train_batch(self, inputs, labels=None, update=True):
-        batch = _to_tensor_list(inputs) + (_to_tensor_list(labels) if labels is not None else [])
-        loss = self._train_step(*batch)
+        loss = self._train_batch_lazy(inputs, labels)
+        # Paddle-parity return type at the PUBLIC boundary: the one-off
+        # eager API hands back host numpy, and this .numpy() is the only
+        # sync on the path
         return [loss.numpy()]
 
     @no_grad()
-    def eval_batch(self, inputs, labels=None):
+    def _eval_batch_lazy(self, inputs, labels=None):
+        """Forward + loss with the loss left ON DEVICE; metric state still
+        updates eagerly (the Metric API is numpy-facing). Returns
+        (lazy mean-loss Tensor | None)."""
         batch = _to_tensor_list(inputs)
         labels = _to_tensor_list(labels) if labels is not None else []
         outs = self.network(*batch)
-        metrics = []
+        loss = None
         if self._loss is not None and labels:
             loss = self._loss(outs, *labels)
-            metrics.append(float(np.asarray(loss.numpy()).mean()))
+            loss = loss.mean() if loss.ndim else loss
         for m in self._metrics:
             m.update(*[np.asarray(x) for x in m.compute(outs, *labels)])
-        return metrics
+        return loss
+
+    def eval_batch(self, inputs, labels=None):
+        loss = self._eval_batch_lazy(inputs, labels)
+        # public boundary: materialize exactly here (Paddle-parity floats)
+        return [] if loss is None else [float(np.asarray(loss.numpy()))]
 
     @no_grad()
     def predict_batch(self, inputs):
@@ -98,7 +215,18 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, max_in_flight=2,
+            device_prefetch=0):
+        """Parity: `paddle.Model.fit` — with an asynchronous device
+        pipeline (docs/ASYNC_PIPELINE.md). Steps dispatch through an
+        :class:`AsyncStepper` keeping up to ``max_in_flight`` compiled
+        steps outstanding, and the per-step loss stays ON DEVICE: logs
+        carry lazy scalars that are materialized (one host transfer) only
+        every ``log_freq`` steps and at epoch end — not once per step,
+        which through the axon tunnel costs a ~70–95 ms round-trip
+        against a ~180 ms step. ``device_prefetch > 0`` additionally
+        wraps the loader in a :class:`~paddle_tpu.io.DevicePrefetchIterator`
+        staging that many batches ahead in device memory."""
         assert self._train_step is not None, "call prepare() first"
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
@@ -110,22 +238,47 @@ class Model:
         cbks = config_callbacks(
             callbacks, model=self, epochs=epochs, steps=steps,
             batch_size=batch_size, verbose=verbose, save_freq=save_freq,
-            save_dir=save_dir, metrics=[m.name() for m in self._metrics])
+            save_dir=save_dir, metrics=[m.name() for m in self._metrics],
+            log_freq=log_freq)
         self.stop_training = False
         cbks.on_train_begin()
         self.network.train()
+        stepper = AsyncStepper(self._train_step, max_in_flight=max_in_flight)
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
             it = 0
-            for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
-                batch = batch if isinstance(batch, (list, tuple)) else [batch]
-                loss = self._train_step(*_to_tensor_list(batch))
-                logs = {"loss": float(loss.numpy())}
-                cbks.on_train_batch_end(step, logs)
-                it += 1
-                if num_iters is not None and it >= num_iters:
-                    break
+            logs = {}
+            epoch_iter = enumerate(loader)
+            prefetch = None
+            if device_prefetch:
+                from ..io.prefetch import DevicePrefetchIterator
+
+                prefetch = DevicePrefetchIterator(
+                    loader, depth=device_prefetch)
+                epoch_iter = enumerate(prefetch)
+            try:
+                for step, batch in epoch_iter:
+                    cbks.on_train_batch_begin(step)
+                    batch = batch if isinstance(batch, (list, tuple)) \
+                        else [batch]
+                    loss = stepper(*_to_tensor_list(batch))
+                    # lazy between windows; number-like (counted,
+                    # sync-on-read) if a user callback touches it
+                    logs = {"loss": _LazyLoss(loss)}
+                    if step % log_freq == 0:
+                        # the window's one host sync — aligned with
+                        # ProgBarLogger's print cadence
+                        logs = _materialize_logs(logs)
+                    cbks.on_train_batch_end(step, logs)
+                    it += 1
+                    if num_iters is not None and it >= num_iters:
+                        break
+            finally:
+                if prefetch is not None:
+                    prefetch.close()
+            # exact final metrics: fence the pipeline, then one sync
+            stepper.drain()
+            logs = _materialize_logs(logs)
             cbks.on_epoch_end(epoch, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_data, batch_size=batch_size,
@@ -150,12 +303,14 @@ class Model:
         for step, batch in enumerate(loader):
             batch = batch if isinstance(batch, (list, tuple)) else [batch]
             n_in = len(batch) - 1 if len(batch) > 1 else 1
-            res = self.eval_batch(batch[:n_in], batch[n_in:])
-            if res:
-                losses.append(res[0])
+            res = self._eval_batch_lazy(batch[:n_in], batch[n_in:])
+            if res is not None:
+                losses.append(res)  # lazy device scalars
         logs = {}
         if losses:
-            logs["loss"] = float(np.mean(losses))
+            # one host transfer for the whole eval pass (counted as a
+            # single hapi/host_syncs), instead of one per batch
+            logs["loss"] = float(np.mean(_fetch_scalars(losses)))
         for m in self._metrics:
             acc = m.accumulate()
             names = m.name()  # paddle metrics return a list of names
@@ -212,3 +367,6 @@ class Model:
         from .summary import summary
 
         return summary(self.network, input_size, dtypes=dtype)
+
+
+_monitor_register(sys.modules[__name__])

@@ -31,13 +31,14 @@ from .sampler import (  # noqa: F401
     DistributedBatchSampler,
 )
 from .reader import DataLoader, default_collate_fn  # noqa: F401
+from .prefetch import DevicePrefetchIterator  # noqa: F401
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
     "ChainDataset", "Subset", "ConcatDataset", "random_split",
     "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
     "BatchSampler", "DistributedBatchSampler",
-    "DataLoader", "default_collate_fn",
+    "DataLoader", "default_collate_fn", "DevicePrefetchIterator",
 ]
 
 

@@ -18,6 +18,7 @@ import jax
 import numpy as np
 
 from ..framework.core import Tensor
+from ..framework.jax_compat import export as _jax_export
 from ..nn.layer.layers import Layer
 from .program import InputSpec, StaticFunction  # noqa: F401
 
@@ -84,7 +85,7 @@ def _spec_to_sds(spec, poly_names):
                 dims.append(name)
             else:
                 dims.append(str(d))
-        shape = jax.export.symbolic_shape(", ".join(dims))
+        shape = _jax_export.symbolic_shape(", ".join(dims))
     else:
         shape = spec.shape
     return jax.ShapeDtypeStruct(shape, np.dtype(spec.dtype))
@@ -129,7 +130,7 @@ def save(layer, path, input_spec=None, **configs):
 
     poly = []
     sds = [_spec_to_sds(s, poly) for s in specs]
-    exported = jax.export.export(jax.jit(infer))(*sds)
+    exported = _jax_export.export(jax.jit(infer))(*sds)
     blob = exported.serialize()
 
     d = os.path.dirname(path)
@@ -187,7 +188,7 @@ def load(path, **configs):
     from ..framework import io as fio
 
     with open(path + ".pdmodel", "rb") as f:
-        exported = jax.export.deserialize(f.read())
+        exported = _jax_export.deserialize(f.read())
     params = {}
     if os.path.exists(path + ".pdiparams"):
         params = fio.load(path + ".pdiparams")

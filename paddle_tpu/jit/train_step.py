@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import sys
 import time
+from collections import deque
 
 import jax
 import jax.numpy as jnp
@@ -168,9 +169,14 @@ class TrainStep:
         # program: host-placement annotations on SPMD outputs don't
         # lower on the CPU test backend, and peak HBM is identical
         # either way (the state is resident during the update).
+        # detect specifically the offload placement: ZeRO offload parks
+        # state in "pinned_host". Comparing != "device" is wrong off-TPU —
+        # the CPU backend's DEFAULT memory kind is "unpinned_host", which
+        # made every stateful-optimizer step try (and fail) to stage
+        # plain CPU state "device"-ward.
         host_shardings = [
             s.sharding if getattr(getattr(s, "sharding", None),
-                                  "memory_kind", "device") != "device"
+                                  "memory_kind", None) == "pinned_host"
             else None
             for s in self._flatten_state()]
 
@@ -318,6 +324,100 @@ class TrainStep:
             arrays,
         )
         return lowered.compile().memory_analysis()
+
+
+class AsyncStepper:
+    """Bounded in-flight pipelining over a :class:`TrainStep`.
+
+    Each ``__call__`` dispatches one compiled step and returns the loss as
+    a LAZY device array (a ``Tensor`` whose buffer is a future — jax
+    dispatch is asynchronous, so the host returns at enqueue). The stepper
+    keeps at most ``max_in_flight`` un-fenced steps outstanding: past the
+    bound it fences the OLDEST step's loss through a host transfer
+    (``utils/timing.device_sync`` — the only completion fence that is
+    honest through the tunnel) before dispatching further.
+
+    Why a bound: params and optimizer state are donated, so in-flight
+    steps chain through them without extra HBM — but each step's
+    *undonated* outputs (the loss, plus any staged batch still live) hold
+    device memory until fenced, and an unbounded host can race arbitrarily
+    far ahead of a slow device (unbounded HBM + a uselessly deep dispatch
+    queue). In steady state the (k−N)th step has already completed by the
+    time step k is dispatched, so the fence costs ~0 host time; the bound
+    only throttles when the host outruns the device by ≥ N steps — exactly
+    when it should. docs/ASYNC_PIPELINE.md covers the HBM-vs-latency
+    tradeoff of choosing N.
+
+    Donation, retrace, and compile-counter semantics are the wrapped
+    TrainStep's own — this class adds no step logic, only flow control.
+    Telemetry (zero-overhead off): ``async/steps_in_flight`` gauge,
+    ``async/bound_waits`` + ``async/bound_wait_ms`` when the bound blocks.
+    """
+
+    def __init__(self, train_step, max_in_flight=2):
+        if max_in_flight < 1:
+            raise ValueError(
+                f"AsyncStepper: max_in_flight must be >= 1 "
+                f"(got {max_in_flight})")
+        self._step = train_step
+        self._max = int(max_in_flight)
+        self._inflight: deque = deque()
+        # host-blocked seconds accumulated in fences (read by
+        # benchmarks/host_overhead_bench.py and bench.py's A/B)
+        self.host_blocked_s = 0.0
+
+    def _fence(self, loss):
+        """Block until `loss` has actually been computed (host transfer)."""
+        from ..utils.timing import device_sync
+
+        device_sync(loss._data if isinstance(loss, Tensor) else loss)
+
+    def __call__(self, *batch):
+        loss = self._step(*batch)
+        self._inflight.append(loss)
+        m = _monitor
+        if len(self._inflight) > self._max:
+            old = self._inflight.popleft()
+            t0 = time.perf_counter()
+            self._fence(old)
+            waited = time.perf_counter() - t0
+            self.host_blocked_s += waited
+            if m is not None:
+                m.on_async_bound_wait(waited * 1e3)
+        if m is not None:
+            m.on_async_inflight(len(self._inflight))
+        return loss
+
+    def drain(self):
+        """Fence every in-flight step; returns the most recent loss (still
+        lazy-typed, but guaranteed complete) or None if nothing is
+        outstanding. Call before checkpointing, timing boundaries, or
+        reading optimizer state snapshots."""
+        last = self._inflight[-1] if self._inflight else None
+        t0 = time.perf_counter()
+        while self._inflight:
+            self._fence(self._inflight.popleft())
+        self.host_blocked_s += time.perf_counter() - t0
+        m = _monitor
+        if m is not None:
+            m.on_async_inflight(0)
+        return last
+
+    @property
+    def in_flight(self):
+        return len(self._inflight)
+
+    @property
+    def max_in_flight(self):
+        return self._max
+
+    # introspection passthrough: callers treat this as a TrainStep
+    @property
+    def compiled_count(self):
+        return self._step.compiled_count
+
+    def memory_analysis(self, *batch):
+        return self._step.memory_analysis(*batch)
 
 
 _monitor_register(sys.modules[__name__])

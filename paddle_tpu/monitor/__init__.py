@@ -61,6 +61,16 @@ _h_sync_ms = _registry.histogram("tunnel/sync_ms")
 _c_coll_bytes = _registry.counter("collective/bytes")
 _c_key_splits = _registry.counter("rng/key_splits")
 _c_autocast = _registry.counter("amp/autocast_enters")
+# async-pipeline metrics (io/prefetch.py, jit/train_step.py AsyncStepper,
+# hapi/model.py deferred loss materialization — docs/ASYNC_PIPELINE.md)
+_c_prefetch_batches = _registry.counter("io/prefetch_batches")
+_g_prefetch_depth = _registry.gauge("io/prefetch_depth")
+_c_prefetch_starved = _registry.counter("io/prefetch_starvations")
+_h_prefetch_wait_ms = _registry.histogram("io/prefetch_wait_ms")
+_g_inflight = _registry.gauge("async/steps_in_flight")
+_c_bound_waits = _registry.counter("async/bound_waits")
+_h_bound_wait_ms = _registry.histogram("async/bound_wait_ms")
+_c_host_syncs = _registry.counter("hapi/host_syncs")
 
 
 # -- public metric access ----------------------------------------------------
@@ -189,6 +199,39 @@ def on_key_split() -> None:
 
 def on_autocast_enter() -> None:
     _c_autocast.inc()
+
+
+def on_prefetch_put(depth: int) -> None:
+    """Prefetch producer staged one batch device-ward; ``depth`` is the
+    buffer fill level after the put."""
+    _c_prefetch_batches.inc()
+    _g_prefetch_depth.set(depth)
+
+
+def on_prefetch_starved(wait_ms: float) -> None:
+    """Consumer found the prefetch buffer empty and blocked ``wait_ms`` —
+    the input pipeline, not the device, was the bottleneck for that step."""
+    _c_prefetch_starved.inc()
+    _h_prefetch_wait_ms.observe(wait_ms)
+
+
+def on_async_inflight(n: int) -> None:
+    _g_inflight.set(n)
+
+
+def on_async_bound_wait(ms: float) -> None:
+    """AsyncStepper hit its in-flight bound and fenced the oldest step;
+    ``ms`` is the host-blocked wait (≈0 in steady state when the device
+    keeps up)."""
+    _c_bound_waits.inc()
+    _h_bound_wait_ms.observe(ms)
+
+
+def on_host_sync(n: int = 1) -> None:
+    """One deliberate host materialization of deferred training metrics
+    (hapi fit's per-log-window loss fetch) — the guard metric for the
+    ≤1-sync-per-window contract."""
+    _c_host_syncs.inc(n)
 
 
 from .step_logger import StepLogger  # noqa: E402,F401

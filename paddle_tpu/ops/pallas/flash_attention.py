@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...framework.jax_compat import export as _jax_export, tpu_compiler_params
+
 from .. import registry
 
 NEG_INF = -1e30
@@ -455,8 +457,8 @@ def _flash_fwd(q, k, v, causal, scale, interpret, block_q=None,
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY)),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
             flops=int(4 * bh * sq * sk * d * (0.5 if causal else 1.0)),
@@ -514,8 +516,8 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, scale, interpret,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY)),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*dq_args)
 
@@ -577,8 +579,8 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, scale, interpret,
         out_specs=dkv_out_specs,
         out_shape=dkv_out_shape,
         scratch_shapes=dkv_scratch,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY)),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*dkv_args)
     if kmask is not None:
@@ -739,8 +741,8 @@ def check_lowering():
                 lambda *a: fwd(*a, _s=_s).astype(jnp.float32).sum(),
                 argnums=(0, 1, 2))(q, k, v)
 
-        jax.export.export(jax.jit(fwd), platforms=["tpu"])(q, kv, kv)
-        jax.export.export(jax.jit(bwd), platforms=["tpu"])(q, kv, kv)
+        _jax_export.export(jax.jit(fwd), platforms=["tpu"])(q, kv, kv)
+        _jax_export.export(jax.jit(bwd), platforms=["tpu"])(q, kv, kv)
 
     # sliding-window variant (window bands engage the tile-skip path)
     q = jnp.zeros((8, 1024, 128), jnp.bfloat16)
@@ -755,8 +757,8 @@ def check_lowering():
             lambda *a: swa(*a).astype(jnp.float32).sum(),
             argnums=(0, 1, 2))(q, k, v)
 
-    jax.export.export(jax.jit(swa), platforms=["tpu"])(q, kv, kv)
-    jax.export.export(jax.jit(swa_bwd), platforms=["tpu"])(q, kv, kv)
+    _jax_export.export(jax.jit(swa), platforms=["tpu"])(q, kv, kv)
+    _jax_export.export(jax.jit(swa_bwd), platforms=["tpu"])(q, kv, kv)
 
     # in-kernel key-padding mask variant
     q = jnp.zeros((8, 1024, 128), jnp.bfloat16)
@@ -773,8 +775,8 @@ def check_lowering():
             lambda *a: masked(*a, km).astype(jnp.float32).sum(),
             argnums=(0, 1, 2))(q, k, v)
 
-    jax.export.export(jax.jit(masked), platforms=["tpu"])(q, kv, kv, km)
-    jax.export.export(jax.jit(masked_bwd), platforms=["tpu"])(q, kv, kv,
+    _jax_export.export(jax.jit(masked), platforms=["tpu"])(q, kv, kv, km)
+    _jax_export.export(jax.jit(masked_bwd), platforms=["tpu"])(q, kv, kv,
                                                               km)
 
     # in-kernel dropout variant (counter-hash mask; uint32 VPU ops)
@@ -790,8 +792,8 @@ def check_lowering():
             lambda *a: drop(*a, seed).astype(jnp.float32).sum(),
             argnums=(0, 1, 2))(q, k, v)
 
-    jax.export.export(jax.jit(drop), platforms=["tpu"])(q, kv, kv, seed)
-    jax.export.export(jax.jit(drop_bwd), platforms=["tpu"])(q, kv, kv,
+    _jax_export.export(jax.jit(drop), platforms=["tpu"])(q, kv, kv, seed)
+    _jax_export.export(jax.jit(drop_bwd), platforms=["tpu"])(q, kv, kv,
                                                             seed)
 
 

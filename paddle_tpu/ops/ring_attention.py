@@ -24,6 +24,8 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ..framework.jax_compat import pvary, shard_map as _shard_map
+
 NEG_INF = -1e30
 
 
@@ -34,7 +36,7 @@ def _ring_fwd_shard(q, k, v, *, axis, n, causal, scale):
     qf = q.astype(jnp.float32) * scale
 
     def vary(x):
-        return jax.lax.pcast(x, (axis,), to="varying")
+        return pvary(x, (axis,))
 
     m = vary(jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32))
     l = vary(jnp.zeros((b, h, s_loc, 1), jnp.float32))
@@ -82,7 +84,7 @@ def _ring_bwd_shard(q, k, v, out, lse, g, *, axis, n, causal, scale):
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def vary(x):
-        return jax.lax.pcast(x, (axis,), to="varying")
+        return pvary(x, (axis,))
 
     dq = vary(jnp.zeros((b, s_loc, h, d), jnp.float32))
 
@@ -302,11 +304,11 @@ def make_ring_attention(mesh, axis="sep", causal=True, use_flash=None):
 
     # the jnp variant keeps check_vma; the flash variant cannot (pallas
     # out_shapes carry no vma tags for shard_map's varying-mask analysis)
-    fwd_mapped = jax.shard_map(
+    fwd_mapped = _shard_map(
         fwd_shard, mesh=mesh, in_specs=(seq_spec,) * 3,
         out_specs=(seq_spec, lse_spec), check_vma=True,
         axis_names=frozenset({axis}))
-    fwd_mapped_flash = jax.shard_map(
+    fwd_mapped_flash = _shard_map(
         fwd_shard_flash, mesh=mesh, in_specs=(seq_spec,) * 3,
         out_specs=(seq_spec, lse_spec), check_vma=False)
 
@@ -325,10 +327,10 @@ def make_ring_attention(mesh, axis="sep", causal=True, use_flash=None):
         in_specs=(seq_spec, seq_spec, seq_spec, seq_spec, lse_spec,
                   seq_spec),
         out_specs=(seq_spec,) * 3)
-    bwd_mapped = jax.shard_map(
+    bwd_mapped = _shard_map(
         bwd_shard, mesh=mesh, check_vma=True,
         axis_names=frozenset({axis}), **bwd_specs)
-    bwd_mapped_flash = jax.shard_map(
+    bwd_mapped_flash = _shard_map(
         bwd_shard_flash, mesh=mesh, check_vma=False, **bwd_specs)
 
     def place(x):

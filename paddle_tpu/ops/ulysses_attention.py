@@ -23,6 +23,8 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ..framework.jax_compat import shard_map as _shard_map
+
 
 def _local_attention(q, k, v, causal, scale, interpret, flash):
     """Full-sequence attention on local heads: [b, s, h_loc, d]."""
@@ -75,10 +77,10 @@ def make_ulysses_attention(mesh, axis="sep", causal=True, use_flash=None):
 
     # like ring attention: the jnp variant keeps shard_map's varying-mask
     # analysis; the Pallas variant cannot (kernel out_shapes carry no vma)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         make_shard_fn(False), mesh=mesh, in_specs=(seq_spec,) * 3,
         out_specs=seq_spec, check_vma=True, axis_names=frozenset({axis}))
-    mapped_flash = jax.shard_map(
+    mapped_flash = _shard_map(
         make_shard_fn(True), mesh=mesh, in_specs=(seq_spec,) * 3,
         out_specs=seq_spec, check_vma=False)
 

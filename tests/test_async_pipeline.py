@@ -1,0 +1,390 @@
+"""Async training pipeline tests (docs/ASYNC_PIPELINE.md).
+
+Covers the three layers of the deferred-host-sync discipline:
+`io.DevicePrefetchIterator` (ordering, exception propagation, clean
+StopIteration, starvation telemetry), `jit.train_step.AsyncStepper`
+(in-flight bound under a mocked slow device, drain semantics), and the
+hapi `fit` guard — with the monitor on, a CPU fit over ≥ 3 × log_freq
+steps performs ≤ 1 deliberate host sync per log window (vs 1 per STEP
+before this pipeline existed), counted via the ``hapi/host_syncs`` hook.
+Plus the zero-overhead-off contract for every new instrumentation site and
+the CPU smoke of benchmarks/host_overhead_bench.py (async dispatch gap
+strictly below the sync loop's).
+"""
+import importlib.util
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import monitor
+from paddle_tpu.framework.core import Tensor
+from paddle_tpu.io.prefetch import DevicePrefetchIterator
+from paddle_tpu.jit.train_step import AsyncStepper, TrainStep
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def mon(tmp_path, monkeypatch):
+    """Enabled monitor with clean metrics; restores disabled-off state.
+    Redirects the StepLogger sink (the auto-added MonitorCallback in fit
+    writes there) so tests never drop JSONL artifacts in the repo root."""
+    monkeypatch.setenv("PT_MONITOR_SINK", str(tmp_path / "steps.jsonl"))
+    monitor.reset()
+    monitor.enable()
+    yield monitor
+    monitor.disable()
+    monitor.reset()
+
+
+# -- DevicePrefetchIterator --------------------------------------------------
+
+class TestDevicePrefetch:
+    def test_order_and_values(self):
+        batches = [(np.full((2, 3), i, np.float32),
+                    np.full((2, 1), -i, np.float32)) for i in range(7)]
+        out = list(DevicePrefetchIterator(iter(batches), depth=3))
+        assert len(out) == 7
+        for i, (x, y) in enumerate(out):
+            assert isinstance(x, Tensor) and isinstance(y, Tensor)
+            np.testing.assert_array_equal(x.numpy(), batches[i][0])
+            np.testing.assert_array_equal(y.numpy(), batches[i][1])
+
+    def test_wraps_dataloader(self):
+        data = [(np.ones(4, np.float32) * i, np.int64(i)) for i in range(6)]
+        loader = pt.io.DataLoader(data, batch_size=2, shuffle=False)
+        out = list(DevicePrefetchIterator(loader, depth=2))
+        assert len(out) == 3
+        np.testing.assert_array_equal(out[0][1].numpy(), [0, 1])
+        np.testing.assert_array_equal(out[2][1].numpy(), [4, 5])
+
+    def test_exception_propagates_in_position(self):
+        """An inner-iterator error surfaces AFTER every earlier batch, and
+        iteration afterwards raises a clean StopIteration."""
+
+        def gen():
+            yield np.zeros(2, np.float32)
+            yield np.ones(2, np.float32)
+            raise ValueError("decode failed")
+
+        it = DevicePrefetchIterator(gen(), depth=4)
+        np.testing.assert_array_equal(next(it).numpy(), [0, 0])
+        np.testing.assert_array_equal(next(it).numpy(), [1, 1])
+        with pytest.raises(ValueError, match="decode failed"):
+            next(it)
+        with pytest.raises(StopIteration):
+            next(it)
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_clean_stopiteration_ordering(self):
+        it = DevicePrefetchIterator(iter([np.zeros(1, np.float32)]), depth=2)
+        next(it)
+        for _ in range(3):  # exhaustion is sticky, never an error
+            with pytest.raises(StopIteration):
+                next(it)
+
+    def test_depth_validation(self):
+        with pytest.raises(Exception, match="depth"):
+            DevicePrefetchIterator(iter([]), depth=0)
+
+    def test_nested_and_passthrough_leaves(self):
+        batch = {"x": np.ones((2, 2), np.float32),
+                 "meta": ("tag", 3),
+                 "pair": [np.zeros(2, np.float32), None]}
+        out = next(DevicePrefetchIterator(iter([batch]), depth=1))
+        assert isinstance(out["x"], Tensor)
+        assert out["meta"] == ("tag", 3)
+        assert isinstance(out["pair"][0], Tensor) and out["pair"][1] is None
+
+    def test_prefetch_telemetry(self, mon):
+        def slow_gen():
+            for i in range(3):
+                time.sleep(0.05)  # producer slower than consumer: starve
+                yield np.full(2, i, np.float32)
+
+        list(DevicePrefetchIterator(slow_gen(), depth=2))
+        c = mon.snapshot()["counters"]
+        assert c.get("io/prefetch_batches", 0) == 3
+        assert c.get("io/prefetch_starvations", 0) >= 1
+
+    def test_next_after_close_stops_cleanly(self):
+        """close() then next() must end in StopIteration, never hang on
+        the (stopped, sentinel-less) producer."""
+        it = DevicePrefetchIterator(
+            iter([np.zeros(1, np.float32) for _ in range(10)]), depth=2)
+        next(it)
+        it.close()
+        t0 = time.perf_counter()
+        with pytest.raises(StopIteration):
+            while True:  # staged batches may drain first; must terminate
+                next(it)
+        assert time.perf_counter() - t0 < 5.0
+
+    def test_close_stops_producer(self):
+        produced = []
+
+        def gen():
+            for i in range(100):
+                produced.append(i)
+                yield np.zeros(1, np.float32)
+
+        it = DevicePrefetchIterator(gen(), depth=2)
+        next(it)
+        it.close()
+        time.sleep(0.3)
+        n = len(produced)
+        time.sleep(0.2)
+        assert len(produced) == n  # producer actually stopped
+        assert n < 100
+
+
+# -- AsyncStepper ------------------------------------------------------------
+
+class _FakeSlowStep:
+    """TrainStep stand-in: returns lazy-looking Tensors immediately (async
+    dispatch) while 'device completion' is simulated by the fence log."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def __call__(self, *batch):
+        self.calls += 1
+        return Tensor(np.float32(self.calls))
+
+    @property
+    def compiled_count(self):
+        return 1
+
+
+class TestAsyncStepper:
+    def test_bound_respected_with_slow_device(self):
+        """in-flight never exceeds max_in_flight: once the bound is hit,
+        every dispatch first fences the OLDEST outstanding step."""
+        step = _FakeSlowStep()
+        stepper = AsyncStepper(step, max_in_flight=3)
+        fenced = []
+        stepper._fence = lambda loss: (time.sleep(0.01),
+                                       fenced.append(float(loss.numpy())))
+        results = [stepper(np.zeros(1)) for _ in range(10)]
+        assert len(results) == 10
+        assert stepper.in_flight == 3  # bound held
+        assert fenced == [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0]  # oldest-first
+        assert stepper.host_blocked_s > 0
+
+    def test_drain_fences_all_and_returns_last(self):
+        stepper = AsyncStepper(_FakeSlowStep(), max_in_flight=4)
+        fenced = []
+        stepper._fence = lambda loss: fenced.append(float(loss.numpy()))
+        for _ in range(3):
+            last_dispatched = stepper(np.zeros(1))
+        last = stepper.drain()
+        assert stepper.in_flight == 0
+        assert fenced == [1.0, 2.0, 3.0]
+        assert float(last.numpy()) == float(last_dispatched.numpy())
+        assert stepper.drain() is None  # idempotent when empty
+
+    def test_invalid_bound(self):
+        with pytest.raises(ValueError, match="max_in_flight"):
+            AsyncStepper(_FakeSlowStep(), max_in_flight=0)
+
+    def test_real_trainstep_roundtrip(self):
+        """End-to-end on the CPU backend: losses come back finite and
+        params actually update across in-flight steps."""
+        pt.seed(0)
+        net = pt.nn.Linear(4, 1)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+        step = TrainStep(net, opt, lambda m, x, y: ((m(x) - y) ** 2).mean())
+        stepper = AsyncStepper(step, max_in_flight=2)
+        x = pt.to_tensor(np.ones((4, 4), np.float32))
+        y = pt.to_tensor(np.zeros((4, 1), np.float32))
+        w0 = np.asarray(net.parameters()[0].numpy()).copy()
+        losses = [stepper(x, y) for _ in range(5)]
+        stepper.drain()
+        vals = [float(l.numpy()) for l in losses]
+        assert all(np.isfinite(v) for v in vals)
+        assert vals[0] > vals[-1]  # it learns
+        assert not np.allclose(w0, np.asarray(net.parameters()[0].numpy()))
+
+    def test_bound_wait_telemetry(self, mon):
+        stepper = AsyncStepper(_FakeSlowStep(), max_in_flight=1)
+        stepper._fence = lambda loss: None
+        for _ in range(4):
+            stepper(np.zeros(1))
+        c = mon.snapshot()["counters"]
+        assert c.get("async/bound_waits", 0) == 3
+        assert mon.snapshot()["gauges"]["async/steps_in_flight"] == 1
+
+
+# -- hapi fit: deferred host sync guard --------------------------------------
+
+class _RegDS:
+    def __init__(self, n=24):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(i)
+        return (rng.randn(8).astype(np.float32),
+                rng.randn(1).astype(np.float32))
+
+
+def _prep_model():
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(8, 8), pt.nn.ReLU(),
+                           pt.nn.Linear(8, 1))
+    model = pt.Model(net)
+    model.prepare(
+        pt.optimizer.Adam(learning_rate=1e-3, parameters=net.parameters()),
+        loss=pt.nn.MSELoss())
+    return model
+
+
+class TestFitDeferredSync:
+    def test_at_most_one_sync_per_log_window(self, mon):
+        """3 × log_freq steps: ≤ 1 deliberate host sync per log window
+        (+1 exact epoch-end materialization) — the tentpole guarantee.
+        Before the async pipeline this was 1 sync per STEP (12 here)."""
+        model = _prep_model()
+        log_freq, steps = 4, 12
+        before = mon.snapshot()["counters"].get("hapi/host_syncs", 0)
+        model.fit(_RegDS(steps * 2), batch_size=2, epochs=1,
+                  log_freq=log_freq, verbose=0)
+        syncs = mon.snapshot()["counters"].get("hapi/host_syncs", 0) - before
+        windows = steps // log_freq
+        assert syncs <= windows + 1, \
+            f"{syncs} host syncs for {windows} log windows"
+        assert syncs >= 1  # the epoch-end exact-metrics sync must happen
+
+    def test_progbar_sees_floats_at_cadence(self, mon, capsys):
+        model = _prep_model()
+        model.fit(_RegDS(16), batch_size=2, epochs=1, log_freq=4, verbose=2)
+        out = capsys.readouterr().out
+        assert "loss:" in out  # materialized window values printed
+
+    def test_monitor_callback_logs_only_materialized_loss(self, mon,
+                                                          tmp_path):
+        import json
+
+        from paddle_tpu.hapi.callbacks import MonitorCallback
+
+        path = str(tmp_path / "fit.jsonl")
+        model = _prep_model()
+        model.fit(_RegDS(16), batch_size=2, epochs=1, log_freq=4, verbose=0,
+                  callbacks=[MonitorCallback(path)])
+        lines = [json.loads(ln) for ln in open(path)]
+        steps = [ln for ln in lines if "step" in ln]
+        assert len(steps) == 8
+        with_loss = [ln for ln in steps if "loss" in ln]
+        # loss appears exactly at fit's materialization cadence (steps
+        # 0,4 of each window) — never forced per step by the callback
+        assert 0 < len(with_loss) < len(steps)
+        assert all(isinstance(ln["loss"], float) for ln in with_loss)
+
+    def test_user_callback_lazy_loss_is_numeric_and_counted(self, mon):
+        """A user callback reading logs['loss'] on a non-window step gets
+        honest number semantics, and that read IS counted as a host
+        sync (no silent uncounted per-step round-trips)."""
+        from paddle_tpu.hapi.callbacks import Callback
+
+        seen = []
+
+        class Reader(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                v = logs["loss"]
+                assert float(v) == float(np.asarray(v))
+                assert v >= 0.0  # comparison works too
+                seen.append(float(v))
+
+        model = _prep_model()
+        before = mon.snapshot()["counters"].get("hapi/host_syncs", 0)
+        model.fit(_RegDS(8), batch_size=2, epochs=1, log_freq=4, verbose=0,
+                  callbacks=[Reader()])
+        syncs = mon.snapshot()["counters"].get("hapi/host_syncs", 0) - before
+        assert len(seen) == 4 and all(np.isfinite(v) for v in seen)
+        # every per-step read shows up in the guard counter (one sync per
+        # step read + windows dedup via the cached value)
+        assert syncs >= 4
+
+    def test_fit_with_device_prefetch(self, mon):
+        model = _prep_model()
+        model.fit(_RegDS(16), batch_size=2, epochs=1, log_freq=4, verbose=0,
+                  device_prefetch=2)
+        assert mon.snapshot()["counters"].get("io/prefetch_batches", 0) == 8
+
+    def test_train_batch_public_boundary_is_numpy(self):
+        model = _prep_model()
+        out = model.train_batch(np.random.randn(2, 8).astype(np.float32),
+                                np.random.randn(2, 1).astype(np.float32))
+        assert isinstance(out, list) and isinstance(out[0], np.ndarray)
+
+    def test_eval_batch_public_boundary_is_float(self):
+        model = _prep_model()
+        out = model.eval_batch([pt.to_tensor(
+            np.random.randn(2, 8).astype(np.float32))],
+            [pt.to_tensor(np.random.randn(2, 1).astype(np.float32))])
+        assert isinstance(out[0], float)
+
+    def test_evaluate_single_sync(self, mon):
+        model = _prep_model()
+        before = mon.snapshot()["counters"].get("hapi/host_syncs", 0)
+        logs = model.evaluate(_RegDS(16), batch_size=2, verbose=0)
+        syncs = mon.snapshot()["counters"].get("hapi/host_syncs", 0) - before
+        assert syncs == 1  # whole eval pass: one host transfer
+        assert np.isfinite(logs["loss"])
+
+
+# -- zero-overhead-off contract ----------------------------------------------
+
+class TestZeroOverheadOff:
+    def test_slots_none_when_disabled(self):
+        from paddle_tpu.hapi import model as hapi_model
+        from paddle_tpu.io import prefetch as io_prefetch
+        from paddle_tpu.jit import train_step as jit_train_step
+        from paddle_tpu.ops import dispatch
+
+        monitor.disable()
+        for mod in (io_prefetch, jit_train_step, hapi_model, dispatch):
+            assert mod._monitor is None, mod.__name__
+        monitor.enable()
+        try:
+            for mod in (io_prefetch, jit_train_step, hapi_model, dispatch):
+                assert mod._monitor is monitor, mod.__name__
+        finally:
+            monitor.disable()
+
+
+# -- host overhead bench smoke (the CI-measurable dispatch-gap win) ----------
+
+def _load_host_bench():
+    spec = importlib.util.spec_from_file_location(
+        "host_overhead_bench",
+        os.path.join(_ROOT, "benchmarks", "host_overhead_bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_host_overhead_smoke_async_beats_sync():
+    """Acceptance criterion: the async stepper's per-step host-blocked
+    time is strictly below the sync loop's, measured on CPU."""
+    bench = _load_host_bench()
+    # shape picked for the tier-1 env (highest-precision matmuls on the
+    # virtual 8-device CPU mesh): compute/step small enough that the
+    # host-side step bookkeeping is a meaningful overlap win — measured
+    # margin 5–10x across repeated runs. Compare MEDIANS of 3 runs: the
+    # structural property must win, a single noisy-neighbor spike on the
+    # shared 2-core box must not flake the tier.
+    runs = [bench.run(steps=25, max_in_flight=4, hidden=128, depth=2,
+                      batch=128) for _ in range(3)]
+    sync_med = float(np.median(
+        [r["sync_host_blocked_ms_per_step"] for r in runs]))
+    async_med = float(np.median(
+        [r["async_host_blocked_ms_per_step"] for r in runs]))
+    assert async_med < sync_med, runs

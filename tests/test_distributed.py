@@ -104,8 +104,10 @@ class TestCollectives:
             y = dist.all_reduce(pt.Tensor(x), group="mp")
             return y._data
 
-        fn = jax.shard_map(f, mesh=e.mesh, in_specs=P("mp"),
-                           out_specs=P(), check_vma=False)
+        from paddle_tpu.framework.jax_compat import shard_map
+
+        fn = shard_map(f, mesh=e.mesh, in_specs=P("mp"),
+                       out_specs=P(), check_vma=False)
         res = jax.jit(fn)(np.ones((8,), np.float32))
         # out_spec P(): per-shard shape (8/4,) with the mp-sum values
         np.testing.assert_allclose(np.asarray(res), 4.0 * np.ones(2))

@@ -145,7 +145,8 @@ class TestLayers:
         assert nn.AdaptiveAvgPool2D(1)(x).shape == [2, 3, 1, 1]
         out = nn.AdaptiveAvgPool2D(1)(x)
         np.testing.assert_allclose(
-            out.numpy()[..., 0, 0], x.numpy().mean((-1, -2)), rtol=1e-5
+            out.numpy()[..., 0, 0], x.numpy().mean((-1, -2)), rtol=1e-5,
+            atol=1e-7,  # CPU reduce-order drift: 1.5e-8 abs on this build
         )
 
     def test_maxpool_matches_numpy(self):

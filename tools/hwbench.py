@@ -24,6 +24,14 @@ BENCHES = [
     # "Next round"): clean-tree headline + loss curve first, then 7B
     # geometry, then the ResNet layout A/B, then the rest.
     ("headline", [sys.executable, "bench.py"], 2700, None),
+    # async-pipeline A/B (docs/ASYNC_PIPELINE.md): bounded in-flight
+    # stepping vs per-step host sync. Each records under its own metric
+    # suffix (…_async / …_syncstep) with host_blocked_ms_per_step, so the
+    # tunnel-RTT-off-the-critical-path claim gets a hardware number.
+    ("headline_async", [sys.executable, "bench.py"], 2700,
+     {"PT_BENCH_ASYNC": "1"}),
+    ("headline_syncstep", [sys.executable, "bench.py"], 2700,
+     {"PT_BENCH_ASYNC": "sync"}),
     ("loss_curve", [sys.executable, "tools/loss_curve.py",
                     "--steps", "200"], 2700, None),
     ("llama7b", [sys.executable, "benchmarks/llama7b_geometry.py"],
@@ -42,6 +50,8 @@ BENCHES = [
     ("ernie", [sys.executable, "benchmarks/ernie_bench.py"], 1800, None),
     ("longcontext", [sys.executable, "benchmarks/longcontext_bench.py"],
      2400, None),
+    ("host_overhead", [sys.executable,
+                       "benchmarks/host_overhead_bench.py"], 1200, None),
     ("flashtune", [sys.executable, "tools/flash_autotune.py"], 2400, None),
     ("profile", [sys.executable, "tools/profile_train_step.py"], 1800,
      None),
