@@ -100,6 +100,36 @@ def _probe_backend(timeout_s: int = 240) -> str:
     raise RuntimeError(f"jax backend unavailable after retries: {last_err}")
 
 
+def _load_perf_guard():
+    """tools/perf_guard.py as a module (tools/ is not a package; the
+    guard stays pure-stdlib so the report boxes can run it too)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "perf_guard.py")
+    spec = importlib.util.spec_from_file_location("perf_guard", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _guard_verdict(line: dict, on_cpu: bool, baseline) -> dict:
+    """Judge this run's line against ``baseline``; on a CPU smoke the
+    hardware comparison is skipped but the runtime-health checks (retrace
+    storm, starvation, error) still gate.
+
+    ``baseline`` must be the last-good record captured BEFORE this run
+    persisted its own (main() does; None = no baseline) — re-reading the
+    store here would hand back the run itself as "last good" and the
+    drop gate would compare the number to itself."""
+    guard = _load_perf_guard()
+    verdict = guard.evaluate(line, baseline, hardware=not on_cpu)
+    if not verdict["ok"]:
+        print(guard.format_verdict(line["metric"], verdict),
+              file=sys.stderr, flush=True)
+    return verdict
+
+
 def enable_compilation_cache():
     """Persistent XLA compilation cache: a brief tunnel window must
     suffice, so never pay the same compile twice across invocations."""
@@ -241,6 +271,20 @@ def main():
 
     for _ in range(warmup):
         float(step(ids, labels).numpy())  # host transfer = real sync
+    # post-warmup retrace baseline + live watchpoint: a retrace INSIDE the
+    # timed loop means the throughput number includes an XLA compile — the
+    # warning fires mid-run (tools/perf_guard.py re-checks it post-hoc)
+    retrace_base = starved_base = None
+    if _mon.enabled():
+        _c0 = _mon.snapshot().get("counters", {})
+        retrace_base = _c0.get("jit/retraces", 0)
+        # warmup starvations (cold loader) must not gate the timed loop
+        starved_base = _c0.get("io/prefetch_starvations", 0)
+        _mon.watchpoint(
+            "jit/retraces", retrace_base,
+            message="bench: post-warmup retrace storm — a batch signature "
+                    "changed inside the timed loop; this run's throughput "
+                    "includes an XLA compile")
     # host_blocked: wall time the host spends inside step dispatch (+ the
     # per-step materialization in sync mode, + drain in async mode) — the
     # dispatch-gap number the PT_BENCH_ASYNC A/B compares
@@ -265,13 +309,20 @@ def main():
     tokens_per_sec = batch * seq * steps / dt
     if slog is not None:
         slog.close(loss=final_loss,
-                   tokens_per_sec=round(tokens_per_sec, 2))
+                   tokens_per_sec=round(tokens_per_sec, 2),
+                   host_blocked_ms_per_step=round(
+                       host_blocked / steps * 1e3, 3))
     flops_tok = model.flops_per_token(seq)
     mfu = tokens_per_sec * flops_tok / _peak_flops(jax.devices()[0])
     extra = {"mfu": round(mfu, 4), "model_params_b": round(
         sum(int(np.prod(p.shape)) for p in model.parameters()) / 1e9, 3),
         "stepping": _ASYNC_MODE,
-        "host_blocked_ms_per_step": round(host_blocked / steps * 1e3, 3)}
+        "host_blocked_ms_per_step": round(host_blocked / steps * 1e3, 3),
+        # the sweep config in the line: what this number measured, and
+        # the guard's baseline filter (a b16 sweep record must not judge
+        # a b8 run)
+        "batch": batch, "seq": seq,
+        "ce_chunk": model.config.ce_chunk_size}
     if _ASYNC_MODE == "async":
         extra["async_depth"] = stepper.max_in_flight
     if tpu_note:
@@ -279,6 +330,18 @@ def main():
         extra["see"] = "PERF.md records any TPU numbers measured earlier"
 
     from paddle_tpu.utils import measurements as _meas
+
+    # the guard's baseline MUST be read before this run's record lands in
+    # the store — otherwise last_good returns the run itself and the
+    # throughput gate compares the number to itself (always-pass) — and
+    # at THIS run's sweep config, so A/B points at other batch/seq/chunk
+    # settings are never a false baseline
+    try:
+        guard_baseline = _meas.last_good(_METRIC, match={
+            "batch": batch, "seq": seq,
+            "ce_chunk": model.config.ce_chunk_size})
+    except Exception:  # noqa: BLE001
+        guard_baseline = None
 
     if not on_cpu:
         # Persist the hardware number the moment it exists — a tunnel that
@@ -352,7 +415,14 @@ def main():
         c = snap.get("counters", {})
         tel = {"retraces": c.get("jit/retraces", 0),
                "compiles": c.get("jit/compiles", 0),
-               "sync_count": c.get("tunnel/syncs", 0)}
+               "sync_count": c.get("tunnel/syncs", 0),
+               "steps": steps}
+        if retrace_base is not None:
+            tel["post_warmup_retraces"] = (
+                c.get("jit/retraces", 0) - retrace_base)
+        starved = c.get("io/prefetch_starvations", 0) - (starved_base or 0)
+        if starved:
+            tel["prefetch_starvations"] = starved
         h = snap.get("histograms", {}).get("tunnel/sync_ms")
         if h:
             tel["sync_ms_p50"] = h["p50"]
@@ -364,6 +434,16 @@ def main():
         extra["telemetry"] = tel
     except Exception:  # noqa: BLE001 — telemetry must not break the line
         pass
+    # regression-guard verdict rides along in the line (tools/perf_guard.py
+    # is also a standalone CLI gate; embedding means BENCH_r*.json carries
+    # the pass/fail next to the number it judges)
+    try:
+        extra["guard"] = _guard_verdict(
+            {"metric": _METRIC, "value": round(tokens_per_sec, 2),
+             "unit": "tokens/s", **extra}, on_cpu,
+            baseline=guard_baseline)
+    except Exception as e:  # noqa: BLE001 — the guard must not break the line
+        print(f"bench: perf guard failed: {e}", file=sys.stderr, flush=True)
     _emit(round(tokens_per_sec, 2), round(mfu / 0.45, 4), **extra)
 
 

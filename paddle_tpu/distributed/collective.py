@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
@@ -31,16 +32,40 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ..monitor import _register as _monitor_register
 
-# Telemetry slot (see paddle_tpu.monitor): when wired, each collective
-# reports one call + payload bytes. In-trace collectives count once per
-# *trace*, not per execution — XLA owns the executed schedule.
+# Telemetry slots (see paddle_tpu.monitor): when wired, each collective
+# reports one call + payload bytes, and `_spans` (monitor/spans.py) gets
+# one `dispatch` span per eager collective's host-side enqueue. In-trace
+# collectives count once per *trace*, not per execution — XLA owns the
+# executed schedule.
 _monitor = None
+_spans = None
 
 
 def _mon_collective(name, arr):
     m = _monitor
     if m is not None:
         m.on_collective(name, int(getattr(arr, "nbytes", 0) or 0))
+
+
+def _traced_collective(fn):
+    """Span-record the collective's host-side wall time (program-cache
+    lookup + dispatch enqueue; compile on a fresh shape). Off, the wrapper
+    costs one ``is None`` check — the counter path (`_mon_collective`)
+    stays where it is, past the trivial early returns."""
+    name = f"collective/{fn.__name__}"
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        sp = _spans
+        if sp is None:
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            sp.record(name, "dispatch", t0)
+
+    return wrapper
 
 
 def shard_map(fn, mesh, in_specs, out_specs, check_rep=False):
@@ -236,6 +261,7 @@ def _prod_reduce(x, ax):
     return jnp.where(any_zero, 0.0, mag * sign).astype(x.dtype)
 
 
+@_traced_collective
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """Parity: `paddle.distributed.all_reduce`. In-place on the Tensor shell
     (rebinds the buffer), also returns it."""
@@ -278,6 +304,7 @@ def _gather_program(mesh, axes, dim, shape, dtype, in_spec_key):
     return jax.jit(fn)
 
 
+@_traced_collective
 def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis=0):
     """Parity: `paddle.distributed.all_gather(tensor_list, tensor)`. Also
     callable functional-style: `all_gather(tensor)` returns the gathered
@@ -314,6 +341,7 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis=0):
     return gathered
 
 
+@_traced_collective
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """Parity: `paddle.distributed.broadcast`. SPMD: a global array is
     already consistent across the mesh; replicate it over the group's axes."""
@@ -335,6 +363,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return all_reduce(tensor, op=op, group=group)
 
 
+@_traced_collective
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     """Parity: `paddle.distributed.scatter`. SPMD: shard dim 0 over the
     group's axes (src is irrelevant — data is global)."""
@@ -354,6 +383,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return t
 
 
+@_traced_collective
 def all_to_all(out_tensor_list, in_tensor_list=None, group=None, sync_op=True,
                split_axis=0, concat_axis=0):
     """Parity: `paddle.distributed.alltoall`. Functional form
@@ -413,6 +443,7 @@ def _a2a_program(mesh, axes, ndim, split_axis, concat_axis):
 alltoall = all_to_all
 
 
+@_traced_collective
 def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True, axis=0):
     """Parity: `paddle.distributed.reduce_scatter` — XLA ReduceScatter HLO
     in-trace; eager form shards the summed result along ``axis``."""
@@ -436,6 +467,7 @@ def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True, axis=0):
     return red
 
 
+@_traced_collective
 def ppermute(tensor, perm, group=None):
     """`jax.lax.ppermute` exposed for pipeline schedules (reference p2p
     send/recv, `pp_utils/p2p_communication.py`). In-trace only."""
@@ -457,6 +489,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
 recv = send
 
 
+@_traced_collective
 def barrier(group=None):
     """Parity: `paddle.distributed.barrier`.
 

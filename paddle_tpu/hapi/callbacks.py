@@ -25,6 +25,13 @@ class Callback:
     def on_train_end(self, logs=None):
         pass
 
+    def on_train_error(self, error=None):
+        """Fired (before the exception re-raises) when the fit loop dies —
+        the hook that lets sinks flush a terminal record instead of
+        leaving a truncated artifact. ``on_train_end`` is NOT called on
+        the error path (parity: the reference only ends clean runs)."""
+        pass
+
     def on_eval_begin(self, logs=None):
         pass
 
@@ -236,6 +243,13 @@ class MonitorCallback(Callback):
     def on_train_end(self, logs=None):
         if self._logger is not None:
             self._logger.close()
+            self._logger = None
+
+    def on_train_error(self, error=None):
+        # flush the terminal run_end line with the error, so the JSONL
+        # distinguishes "crashed at step N" from "file truncated at N"
+        if self._logger is not None:
+            self._logger.close(error=error)
             self._logger = None
 
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 
 import numpy as np
 
@@ -25,8 +26,11 @@ from ..io.reader import DataLoader
 from ..jit.train_step import AsyncStepper, TrainStep
 from ..monitor import _register as _monitor_register
 
-# Telemetry slot (see paddle_tpu.monitor): None unless PT_MONITOR wired it.
+# Telemetry slots (see paddle_tpu.monitor): None unless PT_MONITOR wired
+# them. `_spans` (monitor/spans.py) records fit/evaluate phase brackets
+# and the deliberate metric materializations as `sync` attribution spans.
 _monitor = None
+_spans = None
 
 
 def _to_tensor_list(batch):
@@ -45,8 +49,14 @@ def _fetch_scalars(tensors):
     m = _monitor
     if m is not None:
         m.on_host_sync()
-    return [float(np.asarray(a).reshape(-1)[0])
-            for a in jax.device_get([t._data for t in tensors])]
+    sp = _spans
+    t0 = time.perf_counter() if sp is not None else None
+    out = [float(np.asarray(a).reshape(-1)[0])
+           for a in jax.device_get([t._data for t in tensors])]
+    if sp is not None:
+        sp.record("hapi/fetch_scalars", "sync", t0, lane="sync_fences",
+                  args={"n": len(tensors)})
+    return out
 
 
 class _LazyLoss:
@@ -244,48 +254,57 @@ class Model:
         cbks.on_train_begin()
         self.network.train()
         stepper = AsyncStepper(self._train_step, max_in_flight=max_in_flight)
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            it = 0
-            logs = {}
-            epoch_iter = enumerate(loader)
-            prefetch = None
-            if device_prefetch:
-                from ..io.prefetch import DevicePrefetchIterator
+        try:
+            for epoch in range(epochs):
+                cbks.on_epoch_begin(epoch)
+                sp = _spans
+                t_epoch = time.perf_counter() if sp is not None else None
+                it = 0
+                logs = {}
+                epoch_iter = enumerate(loader)
+                prefetch = None
+                if device_prefetch:
+                    from ..io.prefetch import DevicePrefetchIterator
 
-                prefetch = DevicePrefetchIterator(
-                    loader, depth=device_prefetch)
-                epoch_iter = enumerate(prefetch)
-            try:
-                for step, batch in epoch_iter:
-                    cbks.on_train_batch_begin(step)
-                    batch = batch if isinstance(batch, (list, tuple)) \
-                        else [batch]
-                    loss = stepper(*_to_tensor_list(batch))
-                    # lazy between windows; number-like (counted,
-                    # sync-on-read) if a user callback touches it
-                    logs = {"loss": _LazyLoss(loss)}
-                    if step % log_freq == 0:
-                        # the window's one host sync — aligned with
-                        # ProgBarLogger's print cadence
-                        logs = _materialize_logs(logs)
-                    cbks.on_train_batch_end(step, logs)
-                    it += 1
-                    if num_iters is not None and it >= num_iters:
-                        break
-            finally:
-                if prefetch is not None:
-                    prefetch.close()
-            # exact final metrics: fence the pipeline, then one sync
-            stepper.drain()
-            logs = _materialize_logs(logs)
-            cbks.on_epoch_end(epoch, logs)
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size,
-                              verbose=verbose, callbacks=callbacks)
-                self.network.train()
-            if self.stop_training:
-                break
+                    prefetch = DevicePrefetchIterator(
+                        loader, depth=device_prefetch)
+                    epoch_iter = enumerate(prefetch)
+                try:
+                    for step, batch in epoch_iter:
+                        cbks.on_train_batch_begin(step)
+                        batch = batch if isinstance(batch, (list, tuple)) \
+                            else [batch]
+                        loss = stepper(*_to_tensor_list(batch))
+                        # lazy between windows; number-like (counted,
+                        # sync-on-read) if a user callback touches it
+                        logs = {"loss": _LazyLoss(loss)}
+                        if step % log_freq == 0:
+                            # the window's one host sync — aligned with
+                            # ProgBarLogger's print cadence
+                            logs = _materialize_logs(logs)
+                        cbks.on_train_batch_end(step, logs)
+                        it += 1
+                        if num_iters is not None and it >= num_iters:
+                            break
+                finally:
+                    if prefetch is not None:
+                        prefetch.close()
+                # exact final metrics: fence the pipeline, then one sync
+                stepper.drain()
+                logs = _materialize_logs(logs)
+                if sp is not None:
+                    sp.record("hapi/fit_epoch", "phase", t_epoch,
+                              args={"epoch": epoch})
+                cbks.on_epoch_end(epoch, logs)
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    self.evaluate(eval_data, batch_size=batch_size,
+                                  verbose=verbose, callbacks=callbacks)
+                    self.network.train()
+                if self.stop_training:
+                    break
+        except BaseException as e:  # noqa: BLE001 — flush sinks, re-raise
+            cbks.on_train_error(f"{type(e).__name__}: {e}")
+            raise
         cbks.on_train_end()
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
@@ -299,6 +318,8 @@ class Model:
         for m in self._metrics:
             m.reset()
         losses = []
+        sp = _spans
+        t_eval = time.perf_counter() if sp is not None else None
         cbks.on_eval_begin()
         for step, batch in enumerate(loader):
             batch = batch if isinstance(batch, (list, tuple)) else [batch]
@@ -319,6 +340,8 @@ class Model:
                 logs.update(zip(names, vals))
             else:
                 logs[names] = acc
+        if sp is not None:
+            sp.record("hapi/evaluate", "phase", t_eval)
         cbks.on_eval_end(logs)
         return logs
 

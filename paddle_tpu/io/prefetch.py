@@ -19,7 +19,10 @@ instead of replicating through one chip.
 Telemetry (``paddle_tpu/monitor``, zero-overhead off): buffer depth after
 each stage (``io/prefetch_depth``), batches staged
 (``io/prefetch_batches``), and starvation events with their host-blocked
-wait (``io/prefetch_starvations``, ``io/prefetch_wait_ms``).
+wait (``io/prefetch_starvations``, ``io/prefetch_wait_ms``). Span lanes
+(``monitor/spans.py``): producer ``device_put`` staging on the
+``prefetch_producer`` lane, consumer starved waits as
+``prefetch_starvation`` attribution spans on the consuming thread's lane.
 """
 from __future__ import annotations
 
@@ -33,8 +36,10 @@ import numpy as np
 from ..framework.core import Tensor
 from ..monitor import _register as _monitor_register
 
-# Telemetry slot (see paddle_tpu.monitor): None unless PT_MONITOR wired it.
+# Telemetry slots (see paddle_tpu.monitor): None unless PT_MONITOR wired
+# them. `_spans` is the flight-recorder ring (monitor/spans.py).
 _monitor = None
+_spans = None
 
 __all__ = ["DevicePrefetchIterator"]
 
@@ -140,11 +145,17 @@ class DevicePrefetchIterator:
             except BaseException as e:  # noqa: BLE001 — crosses the thread
                 self._offer(self._ERR, e)
                 return
+            sp = _spans
+            t_stage = time.perf_counter() if sp is not None else None
             try:
                 staged = self._place(batch)
             except BaseException as e:  # noqa: BLE001 — device_put failed
                 self._offer(self._ERR, e)
                 return
+            if sp is not None:
+                # the producer's async device_put enqueue, on its own lane
+                sp.record("prefetch/stage", "prefetch_stage", t_stage,
+                          lane="prefetch_producer")
             if self._offer(self._ITEM, staged):
                 m = _monitor
                 if m is not None:
@@ -176,6 +187,11 @@ class DevicePrefetchIterator:
                     continue
             if m is not None:
                 m.on_prefetch_starved((time.perf_counter() - t0) * 1e3)
+            sp = _spans
+            if sp is not None:
+                # consumer-side host-blocked wait: the input pipeline was
+                # the bottleneck for this slice of the step gap
+                sp.record("prefetch/starved_wait", "prefetch_starvation", t0)
         if kind is self._ITEM:
             return payload
         self._exhausted = True

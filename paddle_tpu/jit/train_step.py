@@ -31,8 +31,11 @@ from ..framework import random as rng
 from ..framework.core import Tensor
 from ..monitor import _register as _monitor_register
 
-# Telemetry slot (see paddle_tpu.monitor): None unless PT_MONITOR wired it.
+# Telemetry slots (see paddle_tpu.monitor): None unless PT_MONITOR wired
+# them. `_spans` feeds the flight recorder (monitor/spans.py): step
+# dispatch vs trace+compile, donation rebinds, AsyncStepper fence waits.
 _monitor = None
+_spans = None
 
 
 class TrainStep:
@@ -264,11 +267,13 @@ class TrainStep:
             return env_mod.put_replicated(x, e.mesh)
 
         m = _monitor
+        sp = _spans
         # fresh signature: this dispatch pays trace + XLA compile; wall-time
         # here is host-side compile cost (the call acks enqueue, so device
         # execution is excluded on async backends)
         t_compile = time.perf_counter() if (m is not None and
                                             self._retraced) else None
+        t_dispatch = time.perf_counter() if sp is not None else None
         new_params, flat_state, new_buffers, loss = fn(
             [p._data for p in self._params],
             self._flatten_state(),
@@ -278,11 +283,20 @@ class TrainStep:
             place(rng.next_key()),
             [place(a) for a in arrays],
         )
+        if sp is not None:
+            # one span per fn() call, categorized by what the wall time
+            # actually was: trace+compile on a fresh signature, pure
+            # dispatch (enqueue) on a cache hit — no nested double count
+            if self._retraced:
+                sp.record("jit/trace_compile", "compile", t_dispatch)
+            else:
+                sp.record("jit/step_dispatch", "dispatch", t_dispatch)
         if t_compile is not None:
             m.on_compile_ms((time.perf_counter() - t_compile) * 1e3)
         if m is not None and self._donate:
             # donated buffers are dead after the call; every param rebinds
             m.on_donation_rebind(len(self._params))
+        t_rebind = time.perf_counter() if sp is not None else None
         for p, a in zip(self._params, new_params):
             p._data = a
             p._grad_node = None
@@ -297,6 +311,9 @@ class TrainStep:
         for b, a in zip(self._buffers, new_buffers):
             b._data = a
         self._sync_optimizer()
+        if sp is not None:
+            sp.record("jit/donation_rebind" if self._donate
+                      else "jit/state_rebind", "dispatch", t_rebind)
         return Tensor(loss)
 
     # -- introspection --
@@ -384,6 +401,11 @@ class AsyncStepper:
             self.host_blocked_s += waited
             if m is not None:
                 m.on_async_bound_wait(waited * 1e3)
+            sp = _spans
+            if sp is not None:
+                # outranks the nested device_sync span in attribution
+                # (monitor/spans.py ATTRIBUTION_CATEGORIES priority)
+                sp.record("async/bound_wait", "fence_wait", t0)
         if m is not None:
             m.on_async_inflight(len(self._inflight))
         return loss
@@ -394,6 +416,7 @@ class AsyncStepper:
         outstanding. Call before checkpointing, timing boundaries, or
         reading optimizer state snapshots."""
         last = self._inflight[-1] if self._inflight else None
+        had_inflight = bool(self._inflight)
         t0 = time.perf_counter()
         while self._inflight:
             self._fence(self._inflight.popleft())
@@ -401,6 +424,9 @@ class AsyncStepper:
         m = _monitor
         if m is not None:
             m.on_async_inflight(0)
+        sp = _spans
+        if sp is not None and had_inflight:
+            sp.record("async/drain", "fence_wait", t0)
         return last
 
     @property

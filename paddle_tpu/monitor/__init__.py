@@ -32,18 +32,27 @@ import sys
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, Registry, diff_snapshots,
 )
+from .spans import SpanRecorder  # noqa: F401
 
 __all__ = [
     "enable", "disable", "enabled", "counter", "gauge", "histogram",
     "snapshot", "diff", "reset", "StepLogger",
     "Counter", "Gauge", "Histogram", "Registry",
+    "SpanRecorder", "spans", "record_span", "span_events", "export_spans",
+    "watchpoint", "clear_watchpoints",
 ]
 
 _registry = Registry()
 _enabled = False
 
+# the flight recorder behind every module's `_spans` slot (monitor/spans.py);
+# one process-wide ring so all lanes land on one timeline
+_span_recorder = SpanRecorder()
+
 # every instrumented module registers itself here (see _register); enable()
-# installs this module into each site's `_monitor` slot, disable() clears it
+# installs this module into each site's `_monitor` slot, disable() clears it.
+# Modules that also record spans declare a module-global `_spans` slot,
+# wired to the ring recorder under the same enable/disable lifecycle.
 _SITES: list = []
 
 # hot-path metrics are pre-created so instrumentation pays one attribute
@@ -102,9 +111,94 @@ def diff(prev: dict, cur: dict | None = None) -> dict:
 
 
 def reset() -> None:
-    """Zero every metric (registered objects stay live)."""
+    """Zero every metric, drop recorded spans and armed watchpoints
+    (registered objects stay live)."""
     _trainstep_cache_sizes.clear()
     _registry.reset()
+    _span_recorder.clear()
+    _watchpoints.clear()
+
+
+# -- spans (monitor/spans.py) ------------------------------------------------
+
+def spans() -> SpanRecorder:
+    """The process-wide span ring (live regardless of enablement; the
+    instrumented sites only *feed* it while enabled)."""
+    return _span_recorder
+
+
+def record_span(name, cat, t0, t1=None, lane=None, args=None) -> None:
+    """Record one completed span — no-op unless the monitor is enabled
+    (explicit emitters like StepLogger share the sites' off-is-free
+    contract)."""
+    if _enabled:
+        _span_recorder.record(name, cat, t0, t1, lane=lane, args=args)
+
+
+def span_events() -> list:
+    """Retained spans as chrome-trace events (``ph:"X"`` + lane
+    metadata) on the profiler's clock epoch."""
+    return _span_recorder.chrome_events()
+
+
+def export_spans(path: str) -> str:
+    """Write the retained spans as a standalone chrome trace. For a trace
+    merged with the op timeline and counter tracks, export through
+    ``profiler.Profiler.export`` instead."""
+    return _span_recorder.export_chrome(path)
+
+
+# -- watchpoints -------------------------------------------------------------
+
+# name -> {"ceiling", "message", "callback", "fired"}: armed by callers
+# (bench.py arms jit/retraces after warmup), checked inline by the site
+# callbacks below — so the warning fires live, mid-run, not in post-hoc
+# report reading. Only consulted while enabled, and the common case
+# (no watchpoints armed) is one falsy dict check.
+_watchpoints: dict = {}
+
+# the counters whose site callbacks call _check_watchpoint — arming
+# anything else would silently never fire, so watchpoint() refuses it
+WATCHABLE_COUNTERS = frozenset({
+    "jit/retraces", "io/prefetch_starvations", "tunnel/syncs",
+    "async/bound_waits", "hapi/host_syncs",
+})
+
+
+def watchpoint(name: str, ceiling: float, message: str | None = None,
+               callback=None) -> None:
+    """Arm a one-shot alarm: the first time counter ``name`` exceeds
+    ``ceiling``, print ``message`` to stderr (and invoke
+    ``callback(name, value)`` if given). Re-arming replaces the old
+    watchpoint. Only :data:`WATCHABLE_COUNTERS` are checked live by
+    their site callbacks; any other name raises instead of silently
+    never firing."""
+    if name not in WATCHABLE_COUNTERS:
+        raise ValueError(
+            f"watchpoint: {name!r} is not checked live by any "
+            f"instrumentation site; watchable counters: "
+            f"{sorted(WATCHABLE_COUNTERS)}")
+    _watchpoints[name] = {"ceiling": float(ceiling), "message": message,
+                          "callback": callback, "fired": False}
+
+
+def clear_watchpoints() -> None:
+    _watchpoints.clear()
+
+
+def _check_watchpoint(name: str, value: float) -> None:
+    w = _watchpoints.get(name)
+    if w is None or w["fired"] or value <= w["ceiling"]:
+        return
+    w["fired"] = True
+    msg = w["message"] or (f"monitor watchpoint: {name} = {value} "
+                           f"exceeded {w['ceiling']}")
+    print(f"WARNING: {msg}", file=sys.stderr, flush=True)
+    if w["callback"] is not None:
+        try:
+            w["callback"](name, value)
+        except Exception:  # noqa: BLE001 — a watcher must not kill the run
+            pass
 
 
 # -- enablement --------------------------------------------------------------
@@ -123,6 +217,8 @@ def enable() -> None:
     this = sys.modules[__name__]
     for mod in _SITES:
         mod._monitor = this
+        if hasattr(mod, "_spans"):
+            mod._spans = _span_recorder
 
 
 def disable() -> None:
@@ -134,15 +230,20 @@ def disable() -> None:
     _enabled = False
     for mod in _SITES:
         mod._monitor = None
+        if hasattr(mod, "_spans"):
+            mod._spans = None
 
 
 def _register(mod) -> None:
     """Called by each instrumented module at import: wires its ``_monitor``
-    slot to the current enablement state and keeps it in sync with later
+    slot (and its ``_spans`` slot, when the module declares one) to the
+    current enablement state and keeps them in sync with later
     enable()/disable() calls."""
     if mod not in _SITES:
         _SITES.append(mod)
     mod._monitor = sys.modules[__name__] if _enabled else None
+    if hasattr(mod, "_spans"):
+        mod._spans = _span_recorder if _enabled else None
 
 
 # -- site callbacks (invoked ONLY while enabled) -----------------------------
@@ -165,6 +266,8 @@ def on_retrace(owner_id: int, cache_size: int) -> None:
     _c_retraces.inc()
     _trainstep_cache_sizes[owner_id] = cache_size
     _g_cache_size.set(sum(_trainstep_cache_sizes.values()))
+    if _watchpoints:
+        _check_watchpoint("jit/retraces", _c_retraces.value)
 
 
 def on_compile_ms(ms: float) -> None:
@@ -185,6 +288,8 @@ def on_tunnel_sync(ms: float) -> None:
     rules); its latency IS the tunnel round-trip."""
     _c_syncs.inc()
     _h_sync_ms.observe(ms)
+    if _watchpoints:
+        _check_watchpoint("tunnel/syncs", _c_syncs.value)
 
 
 def on_collective(name: str, nbytes: int) -> None:
@@ -213,6 +318,8 @@ def on_prefetch_starved(wait_ms: float) -> None:
     the input pipeline, not the device, was the bottleneck for that step."""
     _c_prefetch_starved.inc()
     _h_prefetch_wait_ms.observe(wait_ms)
+    if _watchpoints:
+        _check_watchpoint("io/prefetch_starvations", _c_prefetch_starved.value)
 
 
 def on_async_inflight(n: int) -> None:
@@ -225,6 +332,8 @@ def on_async_bound_wait(ms: float) -> None:
     keeps up)."""
     _c_bound_waits.inc()
     _h_bound_wait_ms.observe(ms)
+    if _watchpoints:
+        _check_watchpoint("async/bound_waits", _c_bound_waits.value)
 
 
 def on_host_sync(n: int = 1) -> None:
@@ -232,6 +341,8 @@ def on_host_sync(n: int = 1) -> None:
     (hapi fit's per-log-window loss fetch) — the guard metric for the
     ≤1-sync-per-window contract."""
     _c_host_syncs.inc(n)
+    if _watchpoints:
+        _check_watchpoint("hapi/host_syncs", _c_host_syncs.value)
 
 
 from .step_logger import StepLogger  # noqa: E402,F401

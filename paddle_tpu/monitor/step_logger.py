@@ -65,12 +65,17 @@ class StepLogger:
         exactly what a per-step `.numpy()` fetch of the loss does).
         """
         now = time.perf_counter()
-        dur = now - self._t_last
+        t_prev = self._t_last
+        dur = now - t_prev
         self._t_last = now
         cur = self._mon.snapshot()
         delta = self._mon.diff(self._prev, cur)
         self._prev = cur
         self._step += 1
+        # step marker span on its own lane: the window the --spans
+        # attribution pass decomposes (no-op when the monitor is off)
+        self._mon.record_span(f"step/{self._step}", "step", t_prev, now,
+                              lane="steps")
         line = {"step": self._step, "ts": round(time.time(), 6),
                 "dur_ms": round(dur * 1e3, 3)}
         if loss is not None:
@@ -84,14 +89,19 @@ class StepLogger:
         self._write(line)
         return line
 
-    def close(self, **fields) -> None:
-        """Write the ``run_end`` totals line and close the file (idempotent)."""
+    def close(self, error=None, **fields) -> None:
+        """Write the ``run_end`` totals line and close the file
+        (idempotent). ``error`` marks a run that died mid-loop — the
+        terminal line still lands, so a crashed run's JSONL is
+        distinguishable from a truncated one."""
         if self._f is None:
             return
         line = {"event": "run_end", "ts": round(time.time(), 6),
                 "steps": self._step,
                 "wall_s": round(time.perf_counter() - self._t0, 3),
                 "totals": self._mon.snapshot()}
+        if error is not None:
+            line["error"] = str(error)[:500]
         for k, v in fields.items():
             if v is not None:
                 line[k] = v
@@ -102,6 +112,9 @@ class StepLogger:
     def __enter__(self):
         return self
 
-    def __exit__(self, *exc):
-        self.close()
+    def __exit__(self, exc_type, exc, tb):
+        # an exception crossing the `with` still gets its run_end line,
+        # tagged with the error that ended the run
+        self.close(error=None if exc_type is None
+                   else f"{exc_type.__name__}: {exc}")
         return False

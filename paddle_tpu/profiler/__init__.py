@@ -281,8 +281,20 @@ class Profiler:
 
     # -- results --
     def export(self, path, format="json"):  # noqa: A002
+        """Chrome-trace JSON: host events + monitor ``ph:"C"`` counter
+        tracks, merged with the monitor's flight-recorder spans
+        (``monitor/spans.py`` — same ``perf_counter`` clock epoch, so the
+        span lanes line up with the op timeline)."""
+        with _recorder._lock:
+            events = list(_recorder.events)
+        from ..monitor import span_events
+
+        # unconditional: the ring retains spans across disable() (a
+        # teardown that toggled the monitor off must not erase what the
+        # run recorded), and an empty ring contributes nothing
+        events.extend(span_events())
         with open(path, "w") as f:
-            json.dump({"traceEvents": _recorder.events,
+            json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms"}, f)
         return path
 

@@ -16,11 +16,14 @@ import jax
 
 from ..monitor import _register as _monitor_register
 
-# Telemetry slot (see paddle_tpu.monitor): when wired, every device_sync
-# reports its transfer-fence latency to the tunnel/sync_ms histogram. The
-# measurement is the host transfer itself — exactly the sync the timing
-# rules above prescribe, never a block_until_ready.
+# Telemetry slots (see paddle_tpu.monitor): when wired, every device_sync
+# reports its transfer-fence latency to the tunnel/sync_ms histogram and a
+# `sync`-category span to the flight recorder (monitor/spans.py) on the
+# logical "sync_fences" lane — fences from any thread collect on one
+# timeline row. The measurement is the host transfer itself — exactly the
+# sync the timing rules above prescribe, never a block_until_ready.
 _monitor = None
+_spans = None
 
 
 def device_sync(out):
@@ -44,6 +47,10 @@ def device_sync(out):
             t0 = time.perf_counter()
             jax.device_get(fetch)
             m.on_tunnel_sync((time.perf_counter() - t0) * 1e3)
+            sp = _spans
+            if sp is not None:
+                sp.record("tunnel/device_sync", "sync", t0,
+                          lane="sync_fences")
         else:
             jax.device_get(fetch)
     return out

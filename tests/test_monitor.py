@@ -113,14 +113,46 @@ class TestMetricsPrimitives:
 class TestZeroOverheadWhenOff:
     def test_hooks_none_when_disabled(self):
         """PT_MONITOR=0 contract: the dispatch hot path holds no monitor
-        callable — the slot is None, guarded at registration."""
+        callable — the slot is None, guarded at registration. Covers
+        every instrumentation site, including the PR 2 async-pipeline
+        modules (io/prefetch, AsyncStepper's module, hapi) and the new
+        `_spans` flight-recorder slots (monitor/spans.py)."""
         assert not monitor.enabled()
         assert dispatch._monitor is None
-        from paddle_tpu.utils import timing
-        from paddle_tpu.jit import train_step as ts_mod
+        import importlib
 
-        assert timing._monitor is None
-        assert ts_mod._monitor is None
+        ac_mod = importlib.import_module("paddle_tpu.amp.auto_cast")
+        rng_mod = importlib.import_module("paddle_tpu.framework.random")
+        from paddle_tpu.distributed import collective
+        from paddle_tpu.hapi import model as hapi_model
+        from paddle_tpu.io import prefetch
+        from paddle_tpu.jit import train_step as ts_mod
+        from paddle_tpu.utils import timing
+
+        for mod in (timing, ts_mod, prefetch, hapi_model, collective,
+                    rng_mod, ac_mod):
+            assert mod._monitor is None, mod.__name__
+        # every module that records spans: the span slot is None too
+        for mod in (timing, ts_mod, prefetch, hapi_model, collective):
+            assert mod._spans is None, mod.__name__
+
+    def test_enable_wires_all_sites_disable_clears(self):
+        from paddle_tpu.distributed import collective
+        from paddle_tpu.hapi import model as hapi_model
+        from paddle_tpu.io import prefetch
+        from paddle_tpu.jit import train_step as ts_mod
+        from paddle_tpu.utils import timing
+
+        sites = (timing, ts_mod, prefetch, hapi_model, collective)
+        monitor.enable()
+        try:
+            for mod in sites:
+                assert mod._monitor is monitor, mod.__name__
+                assert mod._spans is monitor.spans(), mod.__name__
+        finally:
+            monitor.disable()
+        for mod in sites:
+            assert mod._monitor is None and mod._spans is None, mod.__name__
 
     def test_counter_code_not_invoked_when_off(self):
         monitor.reset()

@@ -58,6 +58,43 @@ BENCHES = [
 ]
 
 
+def _guard_check(name: str, stdout: str):
+    """Run tools/perf_guard.py over a finished bench's stdout: judge the
+    fresh line against the last-good record BEFORE the next bench runs,
+    so a regression is called out while the chip is still up to re-measure.
+    Returns True/False, or None when the output carries no bench line."""
+    try:
+        if ROOT not in sys.path:
+            sys.path.insert(0, ROOT)
+        from bench import _load_perf_guard
+
+        guard = _load_perf_guard()
+        fresh = guard.find_bench_line(stdout)
+        if fresh is None:
+            return None
+        # bench.py embeds its own verdict (judged against the pre-record
+        # baseline); for benches that don't embed, judge here — passing
+        # `fresh` so the record the bench just persisted is not used as
+        # its own baseline, and its sweep config so other-config A/B
+        # points are skipped
+        verdict = fresh.get("guard") or guard.evaluate(
+            fresh, guard.last_good(guard._default_store(), fresh["metric"],
+                                   fresh=fresh,
+                                   match=guard.config_match(fresh)))
+        ok = bool(verdict.get("ok"))
+        if not ok:
+            fails = [c["name"] for c in verdict.get("checks", [])
+                     if not c.get("ok")]
+            print(f"hwbench: {name} PERF GUARD FAILED "
+                  f"({', '.join(fails) or 'unknown'})", flush=True)
+        else:
+            print(f"hwbench: {name} guard ok", flush=True)
+        return ok
+    except Exception as e:  # noqa: BLE001 — the guard must not stop the sweep
+        print(f"hwbench: {name} guard errored: {e}", flush=True)
+        return None
+
+
 def probe() -> str:
     """Reuse bench.py's probe: it pins the platform config past the host
     sitecustomize override and retries transient UNAVAILABLE with backoff —
@@ -105,6 +142,8 @@ def main() -> int:
                   f"({results[name]['secs']}s)", flush=True)
             for ln in out[-3:]:
                 print(f"  {ln}", flush=True)
+            if proc.returncode == 0:
+                results[name]["guard_ok"] = _guard_check(name, proc.stdout)
             if proc.returncode != 0:
                 for ln in tail:
                     print(f"  [stderr] {ln}", flush=True)

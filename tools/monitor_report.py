@@ -3,13 +3,24 @@
 one summary table.
 
     python tools/monitor_report.py run.jsonl [--trace trace.json] [--top 10]
+    python tools/monitor_report.py run.jsonl --trace trace.json --spans
 
-Sections: run overview (steps, wall, loss, ips), counter totals, retrace
-timeline (which step retraced — the recompile smoking gun), tunnel-sync
-latency percentiles, and — when a chrome trace from
-`paddle_tpu.profiler.Profiler.export` is given — the top dispatched ops and
-the monitor counter tracks found on the timeline, so one report correlates
-the JSONL run with the trace.
+Sections: run overview (steps, wall, loss, ips), counter totals, the async
+pipeline (prefetch staging/starvation, AsyncStepper bound waits, hapi host
+syncs, host_blocked_ms_per_step), retrace timeline (which step retraced —
+the recompile smoking gun), tunnel-sync latency percentiles, and — when a
+chrome trace from `paddle_tpu.profiler.Profiler.export` (or
+`monitor.export_spans`) is given — the top dispatched ops and the monitor
+counter tracks found on the timeline, so one report correlates the JSONL
+run with the trace.
+
+`--spans` adds the host-blocked-time attribution pass: the flight
+recorder's `ph:"X"` spans (`paddle_tpu/monitor/spans.py`) are decomposed
+per StepLogger step window into {sync, fence_wait, prefetch_starvation,
+compile, dispatch, other} by a priority sweep (nested spans — a
+device_sync inside an AsyncStepper fence — count once, under the outer
+category), which is exactly the breakdown that explains a bench line's
+`host_blocked_ms_per_step`.
 
 Pure stdlib: runs anywhere the artifacts land, no jax import.
 """
@@ -18,6 +29,14 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+# attribution buckets in priority order (an overlapping slice counts under
+# the earliest matching category) — mirrors
+# paddle_tpu/monitor/spans.py:ATTRIBUTION_CATEGORIES, restated here so the
+# tool stays stdlib-only with no package import
+ATTRIBUTION_CATEGORIES = (
+    "fence_wait", "prefetch_starvation", "compile", "dispatch", "sync",
+)
 
 
 def load_jsonl(path):
@@ -70,7 +89,159 @@ def _counter_totals(steps, end):
     return totals
 
 
-def render(jsonl_path, trace_path=None, top=10):
+# -- span attribution --------------------------------------------------------
+
+def _merge_intervals(iv):
+    """Union of (lo, hi) intervals as a sorted, disjoint list."""
+    out = []
+    for lo, hi in sorted(iv):
+        if hi <= lo:
+            continue
+        if out and lo <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], hi))
+        else:
+            out.append((lo, hi))
+    return out
+
+
+def _measure(iv):
+    return sum(hi - lo for lo, hi in iv)
+
+
+def _clip(iv, lo, hi):
+    return [(max(a, lo), min(b, hi)) for a, b in iv
+            if b > lo and a < hi]
+
+
+def _subtract(iv, claimed):
+    """`iv` minus `claimed` (both merged/disjoint, sorted)."""
+    out = []
+    for lo, hi in iv:
+        cur = lo
+        for c0, c1 in claimed:
+            if c1 <= cur or c0 >= hi:
+                continue
+            if c0 > cur:
+                out.append((cur, c0))
+            cur = max(cur, c1)
+            if cur >= hi:
+                break
+        if cur < hi:
+            out.append((cur, hi))
+    return out
+
+
+def load_spans(trace_path):
+    """(step_windows, intervals_by_cat) from a chrome trace's ``ph:"X"``
+    span events, in trace-clock milliseconds. ``step_windows`` are the
+    StepLogger step-marker spans; ``intervals_by_cat`` holds the
+    attribution-bucket spans."""
+    with open(trace_path) as f:
+        trace = json.load(f)
+    steps, by_cat = [], {c: [] for c in ATTRIBUTION_CATEGORIES}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        cat = ev.get("cat")
+        try:
+            t0 = float(ev["ts"]) / 1e3
+            t1 = t0 + float(ev.get("dur", 0)) / 1e3
+        except (KeyError, TypeError, ValueError):
+            continue
+        if cat == "step":
+            steps.append((ev.get("name", "step/?"), t0, t1))
+        elif cat in by_cat:
+            by_cat[cat].append((t0, t1))
+    steps.sort(key=lambda s: s[1])
+    return steps, {c: _merge_intervals(v) for c, v in by_cat.items()}
+
+
+def attribute_spans(steps, by_cat):
+    """Decompose each step window into the attribution buckets.
+
+    Priority sweep: categories claim time in ATTRIBUTION_CATEGORIES
+    order, so a slice covered by several nested spans counts exactly
+    once — bucket sums can never exceed the window. Without step markers
+    the whole span extent is one window. Returns
+    ``{"per_step": [...], "totals": {...}, "wall_ms": float}``.
+    """
+    if not steps:
+        allspans = [iv for v in by_cat.values() for iv in v]
+        if not allspans:
+            return {"per_step": [], "totals": {}, "wall_ms": 0.0}
+        lo = min(a for a, _ in allspans)
+        hi = max(b for _, b in allspans)
+        steps = [("run", lo, hi)]
+    per_step = []
+    totals = {c: 0.0 for c in ATTRIBUTION_CATEGORIES}
+    wall = 0.0
+    for name, lo, hi in steps:
+        dur = hi - lo
+        wall += dur
+        claimed = []
+        row = {"step": name, "dur_ms": dur}
+        for cat in ATTRIBUTION_CATEGORIES:
+            take = _subtract(_clip(by_cat.get(cat, []), lo, hi), claimed)
+            got = _measure(take)
+            row[cat] = got
+            totals[cat] += got
+            if take:
+                claimed = _merge_intervals(claimed + take)
+        row["other"] = max(0.0, dur - sum(row[c]
+                                          for c in ATTRIBUTION_CATEGORIES))
+        per_step.append(row)
+    totals["other"] = max(0.0, wall - sum(totals.values()))
+    return {"per_step": per_step, "totals": totals, "wall_ms": wall}
+
+
+_VERDICTS = {
+    "prefetch_starvation": "input-bound: the loader starved the step — "
+                           "raise prefetch depth / loader workers",
+    "fence_wait": "device-bound: the host out-ran the device to the "
+                  "in-flight bound (healthy pipelining; the device is "
+                  "the limiter)",
+    "sync": "sync-bound: metric materializations dominate — check "
+            "log_freq or a per-step .numpy() in a callback",
+    "compile": "compile-bound: retrace storm — check for shape churn",
+    "dispatch": "dispatch-bound: host-side enqueue cost dominates",
+    "other": "mostly unattributed host time (python bookkeeping between "
+             "instrumented regions)",
+}
+
+
+def render_attribution(att, out):
+    out.append("")
+    out.append("-- span attribution (host wall decomposition) --")
+    totals, wall = att["totals"], att["wall_ms"]
+    if not totals or wall <= 0:
+        out.append("no spans found (was PT_MONITOR=1 set for the run?)")
+        return
+    n = len(att["per_step"])
+    out.append(f"windows: {n}   wall: {wall:.3f} ms")
+    rows = []
+    for cat in (*ATTRIBUTION_CATEGORIES, "other"):
+        ms = totals.get(cat, 0.0)
+        rows.append((cat, f"{ms:.3f} ms", f"{ms / wall * 100:5.1f}%"))
+    out.extend(_table(rows, (24, 16, 10)))
+    attributed = wall - totals.get("other", 0.0)
+    out.append(f"attributed: {attributed / wall * 100:.1f}% of "
+               f"host wall across {n} window(s)")
+    # the dominant category is the verdict
+    dom = max(totals, key=lambda c: totals[c])
+    if totals[dom] > 0.2 * wall:
+        out.append(f"verdict: {_VERDICTS[dom]}")
+    worst = [r for r in att["per_step"]
+             if r["dur_ms"] > 0 and r["step"] != "run"]
+    if worst:
+        w = max(worst, key=lambda r: r["dur_ms"] - r["other"])
+        parts = ", ".join(
+            f"{c} {w[c]:.2f}ms" for c in ATTRIBUTION_CATEGORIES if w[c] > 0)
+        if parts:
+            out.append(f"worst window: {w['step']} "
+                       f"(dur {w['dur_ms']:.2f}ms: {parts})")
+
+
+def render(jsonl_path, trace_path=None, top=10, spans=False):
     steps, begin, end = load_jsonl(jsonl_path)
     out = [f"== monitor run: {jsonl_path} =="]
     if begin:
@@ -115,6 +286,55 @@ def render(jsonl_path, trace_path=None, top=10):
             rows.append((name, _fmt_bytes(val) if name.endswith("bytes")
                          else val))
         out.extend(_table(rows, (44, 16)))
+
+    # -- async pipeline (PR 2 instrumentation: prefetch, AsyncStepper,
+    #    hapi deferred syncs) --
+    hists = (end or {}).get("totals", {}).get("histograms", {})
+    gauges = (end or {}).get("totals", {}).get("gauges", {})
+    pipe = []
+    if totals.get("io/prefetch_batches") or totals.get(
+            "io/prefetch_starvations"):
+        staged = totals.get("io/prefetch_batches", 0)
+        starved = totals.get("io/prefetch_starvations", 0)
+        line = (f"prefetch: staged {staged}   starvations {starved}")
+        if staged:
+            line += f"   starvation rate {starved / staged:.3f}/batch"
+        pipe.append(line)
+        w = hists.get("io/prefetch_wait_ms")
+        if w:
+            pipe.append(f"  starved wait ms: p50 {w['p50']}   "
+                        f"p95 {w['p95']}   max {w['max']}")
+        depth = gauges.get("io/prefetch_depth")
+        if depth is not None:
+            pipe.append(f"  buffer depth (last): {depth:g}")
+    if totals.get("async/bound_waits") or "async/steps_in_flight" in gauges:
+        waits = totals.get("async/bound_waits", 0)
+        line = f"async: bound waits {waits}"
+        if n:
+            line += f" over {n} steps ({waits / n:.2f}/step)"
+        pipe.append(line)
+        w = hists.get("async/bound_wait_ms")
+        if w:
+            pipe.append(f"  bound wait ms: p50 {w['p50']}   "
+                        f"p95 {w['p95']}   max {w['max']}")
+    if totals.get("hapi/host_syncs"):
+        syncs = totals["hapi/host_syncs"]
+        line = f"hapi host syncs: {syncs}"
+        if n:
+            line += (f"   ({n / syncs:.1f} steps/sync — the "
+                     f"≤ 1-per-log-window guard)")
+        pipe.append(line)
+    hb = (end or {}).get("host_blocked_ms_per_step")
+    if hb is None:
+        hbs = [s["host_blocked_ms_per_step"] for s in steps
+               if "host_blocked_ms_per_step" in s]
+        hb = hbs[-1] if hbs else None
+    if hb is not None:
+        pipe.append(f"host_blocked_ms_per_step: {hb}")
+    if pipe:
+        out.append("")
+        out.append("-- async pipeline --")
+        out.extend(pipe)
 
     # -- retrace timeline --
     retraces = [(s["step"], s["counters"]["jit/retraces"]) for s in steps
@@ -175,6 +395,27 @@ def render(jsonl_path, trace_path=None, top=10):
                 out.extend(_table(rows, (44, 10)))
             if counter_tracks:
                 out.append("counter tracks: " + ", ".join(counter_tracks))
+            lanes = sorted({
+                (ev.get("args") or {}).get("name", "?") for ev in events
+                if ev.get("ph") == "M"
+                and ev.get("name") == "thread_name"})
+            if lanes:
+                out.append("span lanes: " + ", ".join(lanes))
+
+    # -- span attribution --
+    if spans:
+        span_src = spans if isinstance(spans, str) else trace_path
+        if not span_src:
+            out.append("")
+            out.append("--spans needs a trace (pass --trace, or "
+                       "--spans PATH)")
+        else:
+            try:
+                st, by_cat = load_spans(span_src)
+                render_attribution(attribute_spans(st, by_cat), out)
+            except (OSError, ValueError) as e:
+                out.append("")
+                out.append(f"unreadable span trace: {e}")
 
     return "\n".join(out)
 
@@ -185,11 +426,19 @@ def main(argv=None):
                     "with a profiler chrome trace.")
     ap.add_argument("jsonl", help="StepLogger JSONL file")
     ap.add_argument("--trace", default=None,
-                    help="chrome trace JSON from profiler.export")
+                    help="chrome trace JSON from profiler.export or "
+                         "monitor.export_spans")
     ap.add_argument("--top", type=int, default=10,
                     help="top-N ops from the trace (default 10)")
+    ap.add_argument("--spans", nargs="?", const=True, default=False,
+                    metavar="TRACE",
+                    help="attribute host wall time per step into "
+                         "{sync, fence_wait, prefetch_starvation, compile, "
+                         "dispatch, other} from the flight-recorder spans "
+                         "(in --trace, or in the given file)")
     args = ap.parse_args(argv)
-    report = render(args.jsonl, trace_path=args.trace, top=args.top)
+    report = render(args.jsonl, trace_path=args.trace, top=args.top,
+                    spans=args.spans)
     print(report)
     return report
 

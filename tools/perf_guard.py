@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""Perf regression guard: fresh bench line vs the last-good hardware record.
+
+    python tools/perf_guard.py fresh.json [--store PERF_MEASUREMENTS.json]
+    some_bench | tail -1 | python tools/perf_guard.py -
+
+``fresh.json`` holds a bench's one-line JSON (the last parseable object
+with a ``metric`` key wins, so a whole bench log can be piped in; ``-``
+reads stdin). The guard compares it against the most recent real-hardware
+record for the same metric in the measurement store
+(``PERF_MEASUREMENTS.json`` — see ``paddle_tpu/utils/measurements.py``)
+and exits nonzero with a human-readable verdict when the run regressed:
+
+- throughput below last-good by more than ``--throughput-drop`` (10%)
+- MFU below last-good by more than ``--mfu-drop`` (10%)
+- any post-warmup retrace (``telemetry.post_warmup_retraces`` > 0): a
+  shape changed inside the timed loop, so the number includes an XLA
+  compile and the next run won't reproduce it
+- prefetch starvation rate above ``--max-starvation-rate``: the loader,
+  not the device, bounded the measurement
+- a zero/absent value or an embedded ``error`` field (the bench died)
+
+CPU smoke lines (dead tunnel) skip the hardware comparisons — a laptop
+number vs a TPU record is not a regression — but still fail on retrace
+storms and errors. ``bench.py`` embeds this module's verdict in its JSON
+line (``"guard"`` sub-object) and ``tools/hwbench.py`` prints it per
+bench, so a silent regression can't land in the measurement store
+unnoticed.
+
+Pure stdlib: runs anywhere the artifacts land, no jax import.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_THRESHOLDS = {
+    # fractional drop vs last-good before the check fails
+    "throughput_drop": 0.10,
+    "mfu_drop": 0.10,
+    # any retrace after warmup is a storm: the timed loop recompiled
+    "max_post_warmup_retraces": 0,
+    # starvations per timed step before the run counts as input-bound
+    "max_starvation_rate": 0.25,
+}
+
+
+def _default_store() -> str:
+    override = os.environ.get("PT_MEASUREMENTS_PATH")
+    if override:
+        return override
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(os.path.dirname(here), "PERF_MEASUREMENTS.json")
+
+
+def find_bench_line(text: str) -> dict | None:
+    """Last parseable JSON object with a ``metric`` key in ``text`` —
+    tolerates a bench's full stdout log. The ONE scanner for bench lines
+    (the CLI and tools/hwbench.py both call it, so the format can't
+    drift between them)."""
+    found = None
+    for raw in text.splitlines():
+        raw = raw.strip()
+        if not raw.startswith("{"):
+            continue
+        try:
+            obj = json.loads(raw)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            found = obj
+    return found
+
+
+def load_fresh(path: str) -> dict:
+    """:func:`find_bench_line` over a file (``-`` = stdin)."""
+    text = sys.stdin.read() if path == "-" else open(path).read()
+    found = find_bench_line(text)
+    if found is None:
+        raise ValueError(f"no bench JSON line (object with a 'metric' "
+                         f"key) found in {path!r}")
+    return found
+
+
+# sweep knobs that change what the number measures: a baseline is only
+# comparable at the same config (CLAUDE.md PT_BENCH_BATCH / ce-chunk A/Bs
+# persist under the SAME metric name)
+CONFIG_KEYS = ("batch", "seq", "ce_chunk")
+
+
+def config_match(fresh: dict) -> dict:
+    """The sweep-config filter a fresh line implies: ``{key: value}`` for
+    every :data:`CONFIG_KEYS` entry the line carries."""
+    return {k: fresh[k] for k in CONFIG_KEYS if k in fresh}
+
+
+def last_good(store_path: str, metric: str, fresh: dict | None = None,
+              match: dict | None = None) -> dict | None:
+    """Most recent real-hardware record for ``metric`` — the stdlib twin
+    of ``utils/measurements.last_good`` (this tool must run with no
+    package import, e.g. on a box that only has the artifacts).
+
+    Benches persist their number BEFORE the guard runs (a dying tunnel
+    must not erase the measurement), so when judging a line that may
+    already be in the store pass it as ``fresh``: the newest records
+    whose value matches it are skipped — comparing a run to itself would
+    make the gate always-pass. ``match`` filters on the record's
+    ``extra`` fields (e.g. ``{"batch": 8, "seq": 1024}``) so A/B sweep
+    points at other configs are skipped instead of becoming a false
+    baseline."""
+    try:
+        with open(store_path) as f:
+            data = json.load(f)
+        records = data.get("records", [])
+    except (OSError, ValueError):
+        return None
+    skipping_self = fresh is not None
+    for rec in reversed(records):
+        if not (isinstance(rec, dict) and rec.get("metric") == metric
+                and rec.get("backend") not in (None, "cpu", "unknown")):
+            continue
+        ex = rec.get("extra") or {}
+        if match and any(ex.get(k) != v for k, v in match.items()):
+            continue
+        if skipping_self and rec.get("value") == fresh.get("value"):
+            continue
+        # past the newest self-matching records, stop skipping: an older
+        # record that happens to share the value is a real baseline
+        skipping_self = False
+        return rec
+    return None
+
+
+def _is_cpu_smoke(fresh: dict) -> bool:
+    note = str(fresh.get("note", ""))
+    return ("cpu smoke" in note or "tpu unavailable" in note
+            or "last_good_tpu" in fresh)
+
+
+def evaluate(fresh: dict, baseline: dict | None, thresholds: dict | None
+             = None, hardware: bool | None = None) -> dict:
+    """Check a fresh bench line; returns the verdict dict.
+
+    ``hardware=False`` (default: inferred from the line's CPU-smoke
+    markers) skips the throughput/MFU comparison — the runtime-health
+    checks (error, retrace storm, starvation) always apply.
+    """
+    th = dict(DEFAULT_THRESHOLDS)
+    if thresholds:
+        th.update(thresholds)
+    if hardware is None:
+        hardware = not _is_cpu_smoke(fresh)
+    checks = []
+
+    def check(name, ok, detail):
+        checks.append({"name": name, "ok": bool(ok), "detail": detail})
+
+    err = fresh.get("error")
+    value = fresh.get("value") or 0.0
+    check("emitted", err is None and value > 0,
+          f"error: {err}" if err is not None else f"value {value}")
+
+    tel = fresh.get("telemetry") or {}
+    pwr = tel.get("post_warmup_retraces")
+    if pwr is not None:
+        check("retraces", pwr <= th["max_post_warmup_retraces"],
+              f"{pwr} post-warmup retrace(s)" + (
+                  " — the timed loop recompiled (shape churn); the "
+                  "number includes an XLA compile" if pwr else ""))
+    starved = tel.get("prefetch_starvations")
+    steps = tel.get("steps")
+    if starved is not None and steps:
+        rate = starved / steps
+        check("starvation", rate <= th["max_starvation_rate"],
+              f"{starved} starvation(s) / {steps} steps = {rate:.2f} "
+              f"(max {th['max_starvation_rate']})")
+
+    compared = False
+    if hardware and baseline is not None and baseline.get("value"):
+        compared = True
+        base_v = baseline["value"]
+        drop = 1.0 - value / base_v
+        check("throughput", drop <= th["throughput_drop"],
+              f"{value:.2f} vs last-good {base_v:.2f} "
+              f"({'-' if drop > 0 else '+'}{abs(drop) * 100:.1f}%, "
+              f"max drop {th['throughput_drop'] * 100:.0f}%)")
+        mfu = fresh.get("mfu")
+        base_mfu = (baseline.get("extra") or {}).get("mfu")
+        if mfu and base_mfu:
+            mdrop = 1.0 - mfu / base_mfu
+            check("mfu", mdrop <= th["mfu_drop"],
+                  f"{mfu:.4f} vs last-good {base_mfu:.4f} "
+                  f"({'-' if mdrop > 0 else '+'}{abs(mdrop) * 100:.1f}%)")
+    elif not hardware:
+        check("hardware", True,
+              "cpu smoke line — throughput not compared to the TPU record")
+
+    verdict = {
+        "ok": all(c["ok"] for c in checks),
+        "checks": checks,
+        "compared": compared,
+    }
+    if baseline is not None:
+        verdict["baseline"] = {
+            "value": baseline.get("value"),
+            "commit": baseline.get("commit"),
+            "timestamp": baseline.get("timestamp"),
+        }
+    return verdict
+
+
+def format_verdict(metric: str, verdict: dict) -> str:
+    lines = [f"== perf guard: {metric} =="]
+    for c in verdict["checks"]:
+        mark = "ok  " if c["ok"] else "FAIL"
+        lines.append(f"  [{mark}] {c['name']:<12} {c['detail']}")
+    base = verdict.get("baseline")
+    if base:
+        lines.append(f"  baseline: {base['value']} "
+                     f"@ {base.get('commit', '?')} ({base.get('timestamp')})")
+    elif verdict["compared"] is False:
+        lines.append("  no last-good hardware baseline in the store")
+    lines.append("verdict: " + (
+        "PASS" if verdict["ok"]
+        else "REGRESSION — do not trust/land this number"))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Gate a fresh bench JSON line against the last-good "
+                    "record in PERF_MEASUREMENTS.json.")
+    ap.add_argument("fresh", help="file with the bench JSON line ('-' = "
+                                  "stdin; a full bench log is fine)")
+    ap.add_argument("--store", default=None,
+                    help="measurement store (default: repo-root "
+                         "PERF_MEASUREMENTS.json, or $PT_MEASUREMENTS_PATH)")
+    ap.add_argument("--throughput-drop", type=float,
+                    default=DEFAULT_THRESHOLDS["throughput_drop"],
+                    help="max fractional throughput drop (default 0.10)")
+    ap.add_argument("--mfu-drop", type=float,
+                    default=DEFAULT_THRESHOLDS["mfu_drop"],
+                    help="max fractional MFU drop (default 0.10)")
+    ap.add_argument("--max-starvation-rate", type=float,
+                    default=DEFAULT_THRESHOLDS["max_starvation_rate"],
+                    help="max prefetch starvations per step (default 0.25)")
+    ap.add_argument("--require-baseline", action="store_true",
+                    help="fail when the store has no last-good hardware "
+                         "record for the metric")
+    ap.add_argument("--hardware", choices=("auto", "yes", "no"),
+                    default="auto",
+                    help="treat the fresh line as a hardware number "
+                         "(default: infer from its cpu-smoke markers)")
+    args = ap.parse_args(argv)
+    try:
+        fresh = load_fresh(args.fresh)
+    except (OSError, ValueError) as e:
+        print(f"perf_guard: {e}", file=sys.stderr)
+        return 2
+    store = args.store or _default_store()
+    # pass the fresh line so its own already-persisted record (benches
+    # write the store before the guard runs) is never its baseline, and
+    # its sweep config so other-config A/B points are skipped
+    baseline = last_good(store, fresh["metric"], fresh=fresh,
+                         match=config_match(fresh))
+    hardware = {"auto": None, "yes": True, "no": False}[args.hardware]
+    verdict = evaluate(
+        fresh, baseline,
+        thresholds={"throughput_drop": args.throughput_drop,
+                    "mfu_drop": args.mfu_drop,
+                    "max_starvation_rate": args.max_starvation_rate},
+        hardware=hardware)
+    if args.require_baseline and baseline is None:
+        verdict["ok"] = False
+        verdict["checks"].append({
+            "name": "baseline", "ok": False,
+            "detail": f"no hardware record for {fresh['metric']!r} "
+                      f"in {store}"})
+    print(format_verdict(fresh["metric"], verdict))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
