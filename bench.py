@@ -224,11 +224,17 @@ def main():
 
     import paddle_tpu as pt
     from paddle_tpu import monitor as _mon
+    from paddle_tpu.monitor import memory as _memobs
+    from paddle_tpu.monitor import numerics as _numerics
 
     if os.environ.get("PT_BENCH_MONITOR", "1") != "0":
         # runtime telemetry (retraces / compiles / tunnel syncs) rides along
         # in the JSON line; the cost is off the hot path — compiled steps
         # bypass eager dispatch, so only tracing and sync fences count.
+        # The memory observatory is NOT armed here: its per-step census
+        # (a live-array walk inside log_step) would ride inside the
+        # timed loop — opt in with PT_MONITOR_MEM=1; the `memory`
+        # sub-object below takes one census AFTER the loop either way.
         _mon.enable()
 
     # Pre-flight: Mosaic-lower every Pallas kernel before the timed run.
@@ -307,11 +313,6 @@ def main():
     assert np.isfinite(final_loss)
 
     tokens_per_sec = batch * seq * steps / dt
-    if slog is not None:
-        slog.close(loss=final_loss,
-                   tokens_per_sec=round(tokens_per_sec, 2),
-                   host_blocked_ms_per_step=round(
-                       host_blocked / steps * 1e3, 3))
     flops_tok = model.flops_per_token(seq)
     mfu = tokens_per_sec * flops_tok / _peak_flops(jax.devices()[0])
     extra = {"mfu": round(mfu, 4), "model_params_b": round(
@@ -343,19 +344,44 @@ def main():
     except Exception:  # noqa: BLE001
         guard_baseline = None
 
+    # memory sub-object (cheap views first, so the persisted record can
+    # carry peak HBM even if the expensive AOT accounting below times out)
+    mem_obj = {"nan_check": _numerics.enabled()}
+    try:
+        led = _memobs.ledger()
+        if led is not None:
+            # observatory armed (PT_MONITOR_MEM=1): per-step censuses ran;
+            # one more post-loop, then report the honest running peak
+            led.census(tag="bench_end")
+            mem_obj["peak_live_gib"] = round(led.peak_live_bytes / 2**30, 3)
+        else:
+            # one census AFTER the timed loop (never inside it): the
+            # end-state live bytes, not a peak
+            mem_obj["live_gib_end"] = round(
+                _memobs.live_census()["live_bytes"] / 2**30, 3)
+        cheap_peak = _memobs.device_peak_gib()
+        if cheap_peak is not None:
+            mem_obj["peak_hbm_gib"] = cheap_peak
+    except Exception:  # noqa: BLE001 — memory views must not break the line
+        pass
+
     if not on_cpu:
         # Persist the hardware number the moment it exists — a tunnel that
         # dies after this line can no longer erase the round's truth.
+        rec_extra = {"mfu": round(mfu, 4),
+                     "vs_baseline": round(mfu / 0.45, 4),
+                     "batch": batch, "seq": seq,
+                     "ce_chunk": model.config.ce_chunk_size,
+                     "stepping": _ASYNC_MODE,
+                     "host_blocked_ms_per_step":
+                         extra["host_blocked_ms_per_step"],
+                     "model_params_b": extra["model_params_b"],
+                     "nan_check": _numerics.enabled()}
+        if mem_obj.get("peak_hbm_gib") is not None:
+            rec_extra["peak_hbm_gib"] = mem_obj["peak_hbm_gib"]
         try:
             _meas.record(_METRIC, round(tokens_per_sec, 2), "tokens/s",
-                         extra={"mfu": round(mfu, 4),
-                                "vs_baseline": round(mfu / 0.45, 4),
-                                "batch": batch, "seq": seq,
-                                "ce_chunk": model.config.ce_chunk_size,
-                                "stepping": _ASYNC_MODE,
-                                "host_blocked_ms_per_step":
-                                    extra["host_blocked_ms_per_step"],
-                                "model_params_b": extra["model_params_b"]})
+                         extra=rec_extra)
         except Exception as e:  # noqa: BLE001
             print(f"bench: measurement persist failed: {e}",
                   file=sys.stderr, flush=True)
@@ -381,10 +407,8 @@ def main():
         return prev, remaining
 
     try:
-        stats = jax.devices()[0].memory_stats() or {}
-        peak = stats.get("peak_bytes_in_use")
-        if peak is not None:
-            extra["peak_hbm_gib"] = round(peak / 2**30, 2)
+        if mem_obj.get("peak_hbm_gib") is not None:
+            extra["peak_hbm_gib"] = mem_obj["peak_hbm_gib"]
         elif not on_cpu:
             # tunneled PJRT plugin exposes no allocator stats — use XLA's
             # own executable memory accounting (args incl. donated params
@@ -392,16 +416,24 @@ def main():
             prev, remaining = _timeboxed_alarm(600)
             t_ma = time.monotonic()
             try:
-                ma = step.memory_analysis(ids, labels)
+                ma_rec = _memobs.executable_record(step, ids, labels,
+                                                   name="bench/headline")
             finally:
                 elapsed = int(time.monotonic() - t_ma)
                 signal.signal(signal.SIGALRM, prev)
                 signal.alarm(max(remaining - elapsed, 60) if remaining else 0)
-            peak = ma.argument_size_in_bytes + ma.temp_size_in_bytes
-            extra["peak_hbm_gib"] = round(peak / 2**30, 2)
-            extra["hbm_args_gib"] = round(
-                ma.argument_size_in_bytes / 2**30, 2)
-            extra["hbm_temp_gib"] = round(ma.temp_size_in_bytes / 2**30, 2)
+            extra["peak_hbm_gib"] = round(ma_rec["peak_bytes"] / 2**30, 2)
+            extra["hbm_args_gib"] = round(ma_rec["args_bytes"] / 2**30, 2)
+            extra["hbm_temp_gib"] = round(ma_rec["temp_bytes"] / 2**30, 2)
+            mem_obj["peak_hbm_gib"] = extra["peak_hbm_gib"]
+            mem_obj["source"] = "xla_analysis"
+            mem_obj["executable"] = ma_rec
+            # back-fill the already-persisted record: on the tunneled
+            # chip this analysis is the ONLY peak-HBM source, and the
+            # perf guard's HBM gate needs it on the baseline
+            _meas.annotate_last(
+                _METRIC, {"peak_hbm_gib": extra["peak_hbm_gib"]},
+                value=round(tokens_per_sec, 2))
     except Exception:
         pass
     if on_cpu and "note" not in extra:
@@ -431,9 +463,15 @@ def main():
         # record so A/B comparisons don't conflate sink overhead with a
         # regression
         tel["sink_active"] = slog is not None
+        nan_checks = c.get("numerics/checks", 0)
+        if nan_checks:
+            tel["nan_checks"] = nan_checks
         extra["telemetry"] = tel
     except Exception:  # noqa: BLE001 — telemetry must not break the line
         pass
+    # device-memory sub-object rides next to telemetry: the peak the run
+    # actually held, where the number came from, and the sentinel state
+    extra["memory"] = mem_obj
     # regression-guard verdict rides along in the line (tools/perf_guard.py
     # is also a standalone CLI gate; embedding means BENCH_r*.json carries
     # the pass/fail next to the number it judges)
@@ -444,6 +482,13 @@ def main():
             baseline=guard_baseline)
     except Exception as e:  # noqa: BLE001 — the guard must not break the line
         print(f"bench: perf guard failed: {e}", file=sys.stderr, flush=True)
+    if slog is not None:
+        # run_end carries the guard verdict + memory account so
+        # tools/monitor_report.py can render them from the JSONL alone
+        slog.close(loss=final_loss,
+                   tokens_per_sec=round(tokens_per_sec, 2),
+                   host_blocked_ms_per_step=extra["host_blocked_ms_per_step"],
+                   memory=mem_obj, guard=extra.get("guard"))
     _emit(round(tokens_per_sec, 2), round(mfu / 0.45, 4), **extra)
 
 

@@ -26,8 +26,25 @@ from ..ops.dispatch import apply
 
 __all__ = [
     "PartitionSpec", "shard_tensor", "sharding_constraint", "replicate",
-    "get_sharding", "shard_parameter",
+    "get_sharding", "shard_parameter", "per_shard_bytes",
 ]
+
+
+def per_shard_bytes(x) -> int:
+    """Bytes ONE device holds for ``x`` under its current sharding — the
+    per-device accounting unit of the memory observatory's census
+    (``monitor/memory.py:live_census(per_device=True)``). A replicated
+    (or unsharded) array costs its full ``nbytes`` on every device; a
+    sharded one costs its largest addressable shard (uneven splits bill
+    the worst shard, which is the one that OOMs)."""
+    arr = x._data if isinstance(x, Tensor) else x
+    try:
+        shards = arr.addressable_shards
+        if shards:
+            return max(int(s.data.nbytes) for s in shards)
+    except Exception:  # noqa: BLE001 — non-jax inputs fall through
+        pass
+    return int(getattr(arr, "nbytes", 0))
 
 
 def _named_sharding(*spec) -> NamedSharding:

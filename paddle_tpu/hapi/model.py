@@ -25,6 +25,7 @@ from ..framework.io import load as _load, save as _save
 from ..io.reader import DataLoader
 from ..jit.train_step import AsyncStepper, TrainStep
 from ..monitor import _register as _monitor_register
+from ..monitor import memory as _memory
 
 # Telemetry slots (see paddle_tpu.monitor): None unless PT_MONITOR wired
 # them. `_spans` (monitor/spans.py) records fit/evaluate phase brackets
@@ -226,7 +227,7 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, max_in_flight=2,
-            device_prefetch=0):
+            device_prefetch=0, nan_check=None):
         """Parity: `paddle.Model.fit` — with an asynchronous device
         pipeline (docs/ASYNC_PIPELINE.md). Steps dispatch through an
         :class:`AsyncStepper` keeping up to ``max_in_flight`` compiled
@@ -236,7 +237,16 @@ class Model:
         which through the axon tunnel costs a ~70–95 ms round-trip
         against a ~180 ms step. ``device_prefetch > 0`` additionally
         wraps the loader in a :class:`~paddle_tpu.io.DevicePrefetchIterator`
-        staging that many batches ahead in device memory."""
+        staging that many batches ahead in device memory.
+
+        ``nan_check=True`` arms the numerics sentinel FOR THIS FIT on
+        the model's TrainStep (monitor/numerics.py): one fused
+        finite-flag scalar per step; on first failure the loop dies with
+        a :class:`~paddle_tpu.monitor.numerics.NonFiniteError` naming
+        the step and first bad leaf, after ``Callback.on_train_error``
+        fired. ``None`` (default) follows the global ``PT_NANCHECK``
+        state; ``False`` forces it off for this fit. The TrainStep's
+        own ``nan_check`` setting is restored when fit returns."""
         assert self._train_step is not None, "call prepare() first"
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
@@ -254,6 +264,12 @@ class Model:
         cbks.on_train_begin()
         self.network.train()
         stepper = AsyncStepper(self._train_step, max_in_flight=max_in_flight)
+        # per-fit sentinel override, applied only now that setup can no
+        # longer raise outside the restoring finally below (a failed
+        # loader/callback/stepper init must not leak the override)
+        prev_nan_check = self._train_step._nan_check
+        if nan_check is not None:
+            self._train_step._nan_check = bool(nan_check)
         try:
             for epoch in range(epochs):
                 cbks.on_epoch_begin(epoch)
@@ -292,6 +308,11 @@ class Model:
                 # exact final metrics: fence the pipeline, then one sync
                 stepper.drain()
                 logs = _materialize_logs(logs)
+                led = _memory._ledger
+                if led is not None:
+                    # phase-bracket census: post-drain live buffers are
+                    # the epoch's steady-state footprint
+                    led.census(tag="hapi/fit_epoch")
                 if sp is not None:
                     sp.record("hapi/fit_epoch", "phase", t_epoch,
                               args={"epoch": epoch})
@@ -305,6 +326,10 @@ class Model:
         except BaseException as e:  # noqa: BLE001 — flush sinks, re-raise
             cbks.on_train_error(f"{type(e).__name__}: {e}")
             raise
+        finally:
+            # per-fit override only: later fits follow the global state
+            # again unless they pass their own nan_check
+            self._train_step._nan_check = prev_nan_check
         cbks.on_train_end()
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
@@ -340,6 +365,9 @@ class Model:
                 logs.update(zip(names, vals))
             else:
                 logs[names] = acc
+        led = _memory._ledger
+        if led is not None:
+            led.census(tag="hapi/evaluate")
         if sp is not None:
             sp.record("hapi/evaluate", "phase", t_eval)
         cbks.on_eval_end(logs)

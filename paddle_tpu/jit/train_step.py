@@ -30,12 +30,17 @@ from ..autograd import tape
 from ..framework import random as rng
 from ..framework.core import Tensor
 from ..monitor import _register as _monitor_register
+from ..monitor import numerics as _numerics
 
 # Telemetry slots (see paddle_tpu.monitor): None unless PT_MONITOR wired
 # them. `_spans` feeds the flight recorder (monitor/spans.py): step
 # dispatch vs trace+compile, donation rebinds, AsyncStepper fence waits.
+# `_nancheck` is the numerics sentinel's slot (monitor/numerics.py):
+# None unless PT_NANCHECK armed it — per-instance `nan_check=True`
+# overrides it without touching the global slot.
 _monitor = None
 _spans = None
+_nancheck = None
 
 
 class TrainStep:
@@ -53,13 +58,23 @@ class TrainStep:
     arrays snapshotted between steps (e.g. a held state_dict) are
     invalidated by the next call, so keep it off when checkpointing
     mid-run from external references.
+
+    nan_check=True arms the numerics sentinel for this instance
+    (monitor/numerics.py): the compiled step returns one extra fused
+    isfinite scalar over loss/grads/updates, fetched per step; the first
+    failure replays the batch and raises NonFiniteError naming the first
+    bad leaf. None (default) follows the global PT_NANCHECK state.
+    While armed, donation is suspended — replay needs the pre-step
+    params intact.
     """
 
-    def __init__(self, model, optimizer, loss_fn=None, donate=False):
+    def __init__(self, model, optimizer, loss_fn=None, donate=False,
+                 nan_check=None):
         self._model = model
         self._opt = optimizer
         self._loss_fn = loss_fn or (lambda m, *batch: m(*batch))
         self._donate = donate
+        self._nan_check = nan_check
         self._params = [
             p for p in model.parameters() if not p.stop_gradient
         ]
@@ -159,7 +174,15 @@ class TrainStep:
                 pos += 1
         return state, masters
 
-    def _build(self, batch_sig):
+    def _nan_active(self) -> bool:
+        """The sentinel state this step compiles/checks under: instance
+        override first, else the global `_nancheck` slot (None-slot
+        contract: off costs one attribute check)."""
+        if self._nan_check is not None:
+            return bool(self._nan_check)
+        return _nancheck is not None
+
+    def _build(self, batch_sig, nan_check=False):
         params, buffers = self._params, self._buffers
         model, opt = self._model, self._opt
         loss_fn = self._loss_fn
@@ -224,35 +247,52 @@ class TrainStep:
                     for k in sorted(st):
                         flat_state.append(st[k])
                 flat_state.extend(m for m in new_masters if m is not None)
+                if nan_check:
+                    # the sentinel's one extra output: a fused isfinite
+                    # reduction over everything this step produced —
+                    # checked as ONE host scalar, never per-tensor
+                    finite = _numerics.finite_all(
+                        [loss._data]
+                        + [g._data for _, g in pg if g is not None]
+                        + new_params + flat_state)
+                    return (new_params, flat_state, new_buffers,
+                            loss._data, finite)
                 return new_params, flat_state, new_buffers, loss._data
             finally:
                 for t, a, gn in saved:
                     t._data = a
                     t._grad_node = gn
 
-        donate = (0, 1, 2) if self._donate else ()
+        # donation suspended while the sentinel is armed: a failing step
+        # is replayed against the pre-step params, which donation would
+        # have invalidated
+        donate = (0, 1, 2) if (self._donate and not nan_check) else ()
         return jax.jit(step_fn, donate_argnums=donate)
 
     def _get_compiled(self, batch):
-        """Normalize batch to arrays and return (jitted_fn, arrays) from
-        the signature cache — shared by __call__ and memory_analysis so
-        the analyzed executable is the one that actually runs."""
+        """Normalize batch to arrays and return (jitted_fn, arrays,
+        nan_check) from the signature cache — shared by __call__ and
+        memory_analysis so the analyzed executable is the one that
+        actually runs. ``nan_check`` is returned rather than re-read by
+        the caller: it decides the executable's output arity, and the
+        global slot may flip between two reads."""
         self._ensure_state()
         arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(b)
                   for b in batch]
         training = getattr(self._model, "training", True)
+        nan_check = self._nan_active()
         sig = (tuple((tuple(a.shape), str(a.dtype)) for a in arrays),
-               training)
+               training, nan_check)
         fn = self._cache.get(sig)
         self._retraced = fn is None
         if fn is None:
             if _monitor is not None:
                 _monitor.on_retrace(id(self), len(self._cache) + 1)
-            fn = self._cache[sig] = self._build(sig)
-        return fn, arrays
+            fn = self._cache[sig] = self._build(sig, nan_check=nan_check)
+        return fn, arrays, nan_check
 
     def __call__(self, *batch):
-        fn, arrays = self._get_compiled(batch)
+        fn, arrays, nan_check = self._get_compiled(batch)
         lr = self._opt.get_lr()
         self._step_count += 1
 
@@ -274,15 +314,23 @@ class TrainStep:
         t_compile = time.perf_counter() if (m is not None and
                                             self._retraced) else None
         t_dispatch = time.perf_counter() if sp is not None else None
-        new_params, flat_state, new_buffers, loss = fn(
+        # key split AFTER the span timestamps (it is a real device op —
+        # its cost belongs in the dispatch span, not "other"); kept in a
+        # local so a sentinel replay can reuse the exact key
+        prng = rng.next_key()
+        outs = fn(
             [p._data for p in self._params],
             self._flatten_state(),
             [b._data for b in self._buffers],
             place(jnp.asarray(lr, jnp.float32)),
             place(jnp.asarray(self._step_count, jnp.int32)),
-            place(rng.next_key()),
+            place(prng),
             [place(a) for a in arrays],
         )
+        if nan_check:
+            new_params, flat_state, new_buffers, loss, finite = outs
+        else:
+            new_params, flat_state, new_buffers, loss = outs
         if sp is not None:
             # one span per fn() call, categorized by what the wall time
             # actually was: trace+compile on a fresh signature, pure
@@ -293,9 +341,28 @@ class TrainStep:
                 sp.record("jit/step_dispatch", "dispatch", t_dispatch)
         if t_compile is not None:
             m.on_compile_ms((time.perf_counter() - t_compile) * 1e3)
-        if m is not None and self._donate:
+        if m is not None and self._donate and not nan_check:
             # donated buffers are dead after the call; every param rebinds
             m.on_donation_rebind(len(self._params))
+        if nan_check:
+            t_check = time.perf_counter()
+            # ONE host scalar per step — the sentinel's whole healthy-path
+            # cost, counted into the hapi/host_syncs guard counter
+            ok = bool(finite)
+            if m is not None:
+                m.on_nan_check()
+            if not ok:
+                if m is not None:
+                    m.on_nan_failure()
+                # pre-step params are still bound (rebind happens below,
+                # donation is off under the sentinel) — replay the batch
+                # eagerly and name the first bad leaf
+                leaf, kind = _numerics.isolate(self, arrays, prng, lr)
+                if sp is not None:
+                    sp.record("numerics/first_bad_step", "numerics",
+                              t_check, args={"step": self._step_count,
+                                             "leaf": leaf, "kind": kind})
+                raise _numerics.NonFiniteError(self._step_count, leaf, kind)
         t_rebind = time.perf_counter() if sp is not None else None
         for p, a in zip(self._params, new_params):
             p._data = a
@@ -327,18 +394,31 @@ class TrainStep:
         by the executable). The HBM-footprint source of truth on platforms
         whose PJRT plugin returns no allocator stats
         (``device.memory_stats() is None`` over the tunneled chip). Pays
-        one AOT compile — the in-process jit cache is separate."""
-        fn, arrays = self._get_compiled(batch)
+        one AOT compile — the in-process jit cache is separate. For SPMD
+        executables under a mesh the reported sizes are per-device."""
+        fn, arrays, _nan = self._get_compiled(batch)
+
+        def place(x):
+            # same mesh placement as __call__: under a mesh, lowering
+            # with single-device scalars against mesh-sharded params
+            # raises "incompatible devices"
+            from ..distributed import env as env_mod
+
+            e = env_mod.get_env()
+            if e is None or e.mesh.size == 1:
+                return x
+            return env_mod.put_replicated(x, e.mesh)
+
         lowered = fn.lower(
             [p._data for p in self._params],
             self._flatten_state(),
             [b._data for b in self._buffers],
-            jnp.asarray(self._opt.get_lr(), jnp.float32),
-            jnp.asarray(self._step_count, jnp.int32),
+            place(jnp.asarray(self._opt.get_lr(), jnp.float32)),
+            place(jnp.asarray(self._step_count, jnp.int32)),
             # only the key's aval matters for lowering; a fixed key keeps
             # this introspection free of global-PRNG side effects
-            jax.random.key(0),
-            arrays,
+            place(jax.random.key(0)),
+            [place(a) for a in arrays],
         )
         return lowered.compile().memory_analysis()
 
@@ -447,3 +527,4 @@ class AsyncStepper:
 
 
 _monitor_register(sys.modules[__name__])
+_numerics._register(sys.modules[__name__])

@@ -40,7 +40,24 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "Registry",
     "SpanRecorder", "spans", "record_span", "span_events", "export_spans",
     "watchpoint", "clear_watchpoints",
+    "memory", "numerics", "INSTRUMENTED_MODULES",
 ]
+
+# The canonical audit list for the zero-overhead contract: every module
+# that carries a `_monitor` slot (and, where declared, `_spans` /
+# `_nancheck` siblings). tests/test_memory_numerics.py asserts each is
+# import-time-inert while PT_MONITOR / PT_NANCHECK / PT_MONITOR_MEM are
+# unset — add new instrumentation sites HERE so the audit covers them.
+INSTRUMENTED_MODULES = (
+    "paddle_tpu.ops.dispatch",
+    "paddle_tpu.jit.train_step",
+    "paddle_tpu.utils.timing",
+    "paddle_tpu.distributed.collective",
+    "paddle_tpu.framework.random",
+    "paddle_tpu.amp.auto_cast",
+    "paddle_tpu.io.prefetch",
+    "paddle_tpu.hapi.model",
+)
 
 _registry = Registry()
 _enabled = False
@@ -80,6 +97,11 @@ _g_inflight = _registry.gauge("async/steps_in_flight")
 _c_bound_waits = _registry.counter("async/bound_waits")
 _h_bound_wait_ms = _registry.histogram("async/bound_wait_ms")
 _c_host_syncs = _registry.counter("hapi/host_syncs")
+# numerics sentinel (monitor/numerics.py via jit/train_step.py): one
+# check = one extra host scalar fetch — it also counts into the
+# hapi/host_syncs guard counter so the ≤1-extra-per-step bound is provable
+_c_nan_checks = _registry.counter("numerics/checks")
+_c_nan_failures = _registry.counter("numerics/failures")
 
 
 # -- public metric access ----------------------------------------------------
@@ -345,9 +367,33 @@ def on_host_sync(n: int = 1) -> None:
         _check_watchpoint("hapi/host_syncs", _c_host_syncs.value)
 
 
+def on_nan_check() -> None:
+    """The numerics sentinel fetched its one finite-flag scalar for a
+    step. Counts into ``hapi/host_syncs`` too: the fetch IS a deliberate
+    host materialization, and the shared counter is how the
+    ≤1-extra-fetch-per-step contract stays provable."""
+    _c_nan_checks.inc()
+    _c_host_syncs.inc()
+    if _watchpoints:
+        _check_watchpoint("hapi/host_syncs", _c_host_syncs.value)
+
+
+def on_nan_failure() -> None:
+    _c_nan_failures.inc()
+
+
+from . import memory  # noqa: E402  — device memory observatory
+from . import numerics  # noqa: E402  — first-bad-step NaN isolation
 from .step_logger import StepLogger  # noqa: E402,F401
 
 # PT_MONITOR=1 enables at import, before any instrumented module registers
 # (later registrants are wired inside _register)
 if os.environ.get("PT_MONITOR", "0") not in ("", "0"):
     enable()
+# the sibling subsystems carry their own knobs: censuses are O(live
+# arrays) and the sentinel costs one host fetch per step, so neither
+# rides PT_MONITOR implicitly
+if os.environ.get("PT_MONITOR_MEM", "0") not in ("", "0"):
+    memory.enable()
+if os.environ.get("PT_NANCHECK", "0") not in ("", "0"):
+    numerics.enable()

@@ -1,0 +1,191 @@
+"""Numerics sentinel: first-bad-step NaN/Inf isolation for the train step.
+
+Reference parity: Paddle's ``FLAGS_check_nan_inf`` kernel-output checker
+(``fluid/framework/details/nan_inf_utils``) — there, every kernel's output
+is scanned eagerly. Inside one fused XLA train step there are no kernel
+boundaries to hook, so the TPU-shaped design is two-phase:
+
+1. **Cheap in-graph guard** (every step while armed): the compiled step
+   additionally returns ``isfinite(x).all()`` reduced over the loss, every
+   gradient, the updated params and optimizer state — ONE extra boolean
+   scalar, fused into the program. The host fetches that single scalar per
+   step (counted via the ``hapi/host_syncs`` guard counter, so the
+   ≤ 1-extra-fetch-per-step contract is provable), never a per-tensor
+   sync. Buffer donation is disabled while armed — the pre-step params
+   must survive for phase 2.
+2. **Replay isolation** (only on first failure): the offending batch is
+   replayed *eagerly* against the still-intact pre-step params with the
+   SAME PRNG key, checking leaves in causal order — loss, then each
+   grad, then each updated param and optimizer-state entry — and the
+   first non-finite leaf is named by its parameter path. The raised
+   :class:`NonFiniteError` carries ``step``/``leaf``/``kind``; hapi's fit
+   loop turns it into ``Callback.on_train_error`` + a StepLogger
+   ``run_end`` error line.
+
+Zero-overhead-when-off: ``jit/train_step.py`` carries a module-global
+``_nancheck`` slot that is ``None`` unless :func:`enable` armed it
+(``PT_NANCHECK=1`` at import, or ``fit(nan_check=True)`` per-instance) —
+the hot path pays one ``is None`` check, and the compiled step is the
+exact program it would be without this module (the finite reduction is
+only traced into nan-check signatures).
+"""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["NonFiniteError", "enable", "disable", "enabled",
+           "finite_all", "isolate"]
+
+_enabled = False
+
+# instrumented modules carrying a `_nancheck` slot (today: jit/train_step)
+_SITES: list = []
+
+
+class NonFiniteError(RuntimeError):
+    """The sentinel tripped: ``step`` (1-based train-step index), ``leaf``
+    (named path of the first non-finite leaf, e.g. ``grad/linear.weight``)
+    and ``kind`` (``loss`` | ``grad`` | ``param`` | ``opt_state`` |
+    ``unknown``)."""
+
+    def __init__(self, step: int, leaf: str, kind: str):
+        self.step = step
+        self.leaf = leaf
+        self.kind = kind
+        super().__init__(
+            f"non-finite value at train step {step}: first bad leaf "
+            f"{leaf!r} ({kind}). The offending batch was replayed with "
+            f"per-leaf checks; params were NOT updated by this step.")
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    """Arm the sentinel globally (idempotent). Same effect as starting
+    the process with ``PT_NANCHECK=1``. Already-compiled non-checking
+    signatures stay cached; the next step compiles a checking one."""
+    global _enabled
+    if _enabled:
+        return
+    _enabled = True
+    this = sys.modules[__name__]
+    for mod in _SITES:
+        mod._nancheck = this
+
+
+def disable() -> None:
+    global _enabled
+    if not _enabled:
+        return
+    _enabled = False
+    for mod in _SITES:
+        mod._nancheck = None
+
+
+def _register(mod) -> None:
+    """Called by each instrumented module at import (sibling of
+    ``monitor._register``): wires its ``_nancheck`` slot to the current
+    armed state."""
+    if mod not in _SITES:
+        _SITES.append(mod)
+    mod._nancheck = sys.modules[__name__] if _enabled else None
+
+
+# -- in-graph guard ----------------------------------------------------------
+
+def finite_all(arrays):
+    """One fused boolean: every inexact-dtype leaf in ``arrays`` is
+    finite. Traced into the compiled step — integer leaves are skipped
+    (always finite), and an all-integer list reduces to a constant
+    True."""
+    import jax.numpy as jnp
+
+    flag = None
+    for a in arrays:
+        if not jnp.issubdtype(a.dtype, jnp.inexact):
+            continue
+        ok = jnp.isfinite(a).all()
+        flag = ok if flag is None else jnp.logical_and(flag, ok)
+    return jnp.bool_(True) if flag is None else flag
+
+
+# -- replay isolation --------------------------------------------------------
+
+def _is_finite_host(arr) -> bool:
+    import numpy as np
+
+    try:
+        a = np.asarray(arr)
+    except Exception:  # noqa: BLE001 — unfetchable leaf: don't blame it
+        return True
+    if not np.issubdtype(a.dtype, np.inexact):
+        return True
+    return bool(np.isfinite(a).all())
+
+
+def isolate(train_step, arrays, key, lr) -> tuple:
+    """Replay the offending batch eagerly against the PRE-step params
+    (the caller must not have rebound them) and return
+    ``(leaf_name, kind)`` for the first non-finite leaf in causal order.
+
+    ``arrays`` are the un-placed batch arrays the failing dispatch used,
+    ``key`` the exact PRNG key it consumed, ``lr`` its learning rate —
+    so dropout masks and the optimizer math reproduce the compiled
+    step's values (modulo accumulation order)."""
+    from ..autograd import tape
+    from ..framework import random as rng
+    from ..framework.core import Tensor
+
+    model = train_step._model
+    names: dict = {}
+    try:
+        for n, p in model.named_parameters():
+            names[id(p)] = n
+    except Exception:  # noqa: BLE001 — fall back to positional names
+        pass
+
+    def name_of(p, i):
+        return names.get(id(p), f"param[{i}]")
+
+    # the replay itself must never out-crash the diagnosis: an op that
+    # only behaves under jit (or a mesh-placement mismatch on the eager
+    # path) still leaves the caller a NonFiniteError with the step index
+    try:
+        batch = [Tensor(a) for a in arrays]
+        with rng.rng_scope(key), tape.enable_grad():
+            loss = train_step._loss_fn(model, *batch)
+        if not _is_finite_host(loss._data):
+            return ("loss", "loss")
+        grads = tape.grad(loss, train_step._params, allow_unused=True,
+                          retain_graph=False)
+    except Exception as e:  # noqa: BLE001
+        return (f"<replay failed: {type(e).__name__}>", "unknown")
+    for i, (p, g) in enumerate(zip(train_step._params, grads)):
+        if g is not None and not _is_finite_host(g._data):
+            return (f"grad/{name_of(p, i)}", "grad")
+    # raw leaves were finite: the corruption is in clipping / the update
+    pg = list(zip(train_step._params, grads))
+    if train_step._opt._grad_clip is not None:
+        try:
+            pg = train_step._opt._grad_clip(pg)
+        except Exception:  # noqa: BLE001 — diagnosis must not crash
+            return ("<grad_clip raised during replay>", "unknown")
+    train_step._ensure_state()
+    step_no = train_step._step_count
+    for i, ((p, g), st, m) in enumerate(zip(pg, train_step._state,
+                                            train_step._masters)):
+        if g is None:
+            continue
+        try:
+            new_p, new_st, _ = train_step._param_update(
+                p, p._data, g._data, st, m, lr, step_no)
+        except Exception:  # noqa: BLE001
+            continue
+        if not _is_finite_host(new_p):
+            return (f"param/{name_of(p, i)}", "param")
+        for k in sorted(new_st):
+            if not _is_finite_host(new_st[k]):
+                return (f"opt_state/{name_of(p, i)}/{k}", "opt_state")
+    return ("<unlocated>", "unknown")

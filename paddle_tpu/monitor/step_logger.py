@@ -35,8 +35,10 @@ class StepLogger:
 
     def __init__(self, path: str | None = None, meta: dict | None = None):
         from paddle_tpu import monitor as _mon
+        from paddle_tpu.monitor import memory as _memory
 
         self._mon = _mon
+        self._memory = _memory
         self.path = path or _default_path()
         d = os.path.dirname(self.path)
         if d:
@@ -85,6 +87,12 @@ class StepLogger:
         for k, v in fields.items():
             if v is not None:
                 line[k] = v
+        led = self._memory._ledger
+        if led is not None:
+            # step-boundary census: live bytes + running peak land on
+            # every step line (and, via the memory/* gauges, in the
+            # profiler's ph:"C" counter tracks)
+            line["memory"] = led.step_census()
         line.update(delta)
         self._write(line)
         return line
@@ -100,6 +108,10 @@ class StepLogger:
                 "steps": self._step,
                 "wall_s": round(time.perf_counter() - self._t0, 3),
                 "totals": self._mon.snapshot()}
+        led = self._memory._ledger
+        if led is not None and "memory" not in fields:
+            # run-level memory account: peak HBM + per-executable records
+            line["memory"] = led.snapshot()
         if error is not None:
             line["error"] = str(error)[:500]
         for k, v in fields.items():

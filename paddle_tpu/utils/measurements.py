@@ -24,7 +24,8 @@ import time
 from typing import Any, Dict, List, Optional
 
 __all__ = ["measurements_path", "record", "record_or_warn",
-           "record_rec_or_warn", "last_good", "all_latest"]
+           "record_rec_or_warn", "annotate_last", "last_good",
+           "all_latest"]
 
 _ENV_PATH = "PT_MEASUREMENTS_PATH"
 
@@ -242,6 +243,31 @@ def record_rec_or_warn(rec: Dict[str, Any], **kw) -> Optional[Dict[str, Any]]:
              if k not in ("metric", "value", "unit")}
     return record_or_warn(rec["metric"], rec["value"], rec["unit"],
                           extra=extra or None, **kw)
+
+
+def annotate_last(metric: str, extra_updates: Dict[str, Any],
+                  value: Optional[float] = None) -> bool:
+    """Merge ``extra_updates`` into the MOST RECENT record for ``metric``
+    (optionally matching ``value`` so only the run's own record is
+    touched). How benches back-fill expensive statistics — e.g. the
+    tunneled TPU's XLA memory accounting, which is only computed AFTER
+    the throughput record was persisted (records land the moment the
+    number exists; the peak-HBM baseline must still end up on them or
+    the perf guard's HBM gate can never fire). Returns True when a
+    record was updated."""
+    with _StoreLock(measurements_path()):
+        data = _load()
+        for rec in reversed(data["records"]):
+            if rec.get("metric") != metric:
+                continue
+            if value is not None and rec.get("value") != value:
+                continue
+            ex = rec.get("extra") or {}
+            ex.update(extra_updates)
+            rec["extra"] = ex
+            _atomic_write(data)
+            return True
+    return False
 
 
 def _is_hw(rec: Dict[str, Any]) -> bool:

@@ -371,20 +371,42 @@ def _load_host_bench():
     return mod
 
 
+# the margin the async stepper must show over the sync loop: async's
+# median host-blocked time must be under this fraction of sync's.
+# Measured margin is 5–10x (ratio ≈ 0.1–0.2), so 0.8 is a wide
+# structural bound — not a bare `<` that a scheduler hiccup on the
+# shared 2-core box can flip.
+ASYNC_VS_SYNC_MAX_RATIO = 0.8
+# known-flaky on 1-CPU boxes: one full retry (fresh median-of-3) before
+# the assertion is allowed to fail the tier
+_RETRIES = 1
+
+
 def test_host_overhead_smoke_async_beats_sync():
     """Acceptance criterion: the async stepper's per-step host-blocked
-    time is strictly below the sync loop's, measured on CPU."""
+    time is below the sync loop's by ASYNC_VS_SYNC_MAX_RATIO, measured
+    on CPU."""
     bench = _load_host_bench()
-    # shape picked for the tier-1 env (highest-precision matmuls on the
-    # virtual 8-device CPU mesh): compute/step small enough that the
-    # host-side step bookkeeping is a meaningful overlap win — measured
-    # margin 5–10x across repeated runs. Compare MEDIANS of 3 runs: the
-    # structural property must win, a single noisy-neighbor spike on the
-    # shared 2-core box must not flake the tier.
-    runs = [bench.run(steps=25, max_in_flight=4, hidden=128, depth=2,
-                      batch=128) for _ in range(3)]
-    sync_med = float(np.median(
-        [r["sync_host_blocked_ms_per_step"] for r in runs]))
-    async_med = float(np.median(
-        [r["async_host_blocked_ms_per_step"] for r in runs]))
-    assert async_med < sync_med, runs
+
+    def medians():
+        # shape picked for the tier-1 env (highest-precision matmuls on
+        # the virtual 8-device CPU mesh): compute/step small enough that
+        # the host-side step bookkeeping is a meaningful overlap win.
+        # Compare MEDIANS of 3 inner trials: the structural property
+        # must win, a single noisy-neighbor spike must not flake the tier.
+        runs = [bench.run(steps=25, max_in_flight=4, hidden=128, depth=2,
+                          batch=128) for _ in range(3)]
+        sync_med = float(np.median(
+            [r["sync_host_blocked_ms_per_step"] for r in runs]))
+        async_med = float(np.median(
+            [r["async_host_blocked_ms_per_step"] for r in runs]))
+        return sync_med, async_med, runs
+
+    for attempt in range(_RETRIES + 1):
+        sync_med, async_med, runs = medians()
+        if async_med < sync_med * ASYNC_VS_SYNC_MAX_RATIO:
+            return
+    assert async_med < sync_med * ASYNC_VS_SYNC_MAX_RATIO, (
+        f"async {async_med:.3f} ms/step vs sync {sync_med:.3f} ms/step "
+        f"(required ratio < {ASYNC_VS_SYNC_MAX_RATIO}) after "
+        f"{_RETRIES + 1} attempts: {runs}")

@@ -1,0 +1,468 @@
+"""Device memory observatory + numerics sentinel tests.
+
+Covers the memory ledger (live-buffer censuses at StepLogger step
+boundaries, per-executable records with mesh annotation on the virtual
+8-device mesh), the OOM preflight planner (fits/doesn't-fit verdicts from
+lowering-only cost data + the CLI smoke), the numerics sentinel (an
+injected non-finite grad at a chosen step is caught and named, loss-level
+failures isolate to "loss", the healthy path costs ≤ 1 extra host scalar
+fetch per step — proven via the ``hapi/host_syncs`` guard counter), and
+the extended zero-overhead audit: every module registering monitor slots
+is import-time-inert while PT_MONITOR / PT_NANCHECK / PT_MONITOR_MEM are
+unset.
+"""
+import importlib
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import monitor
+from paddle_tpu.jit.train_step import TrainStep
+from paddle_tpu.monitor import memory as memobs
+from paddle_tpu.monitor import numerics
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def mon(tmp_path, monkeypatch):
+    """Enabled monitor with clean metrics; restores disabled-off state."""
+    monkeypatch.setenv("PT_MONITOR_SINK", str(tmp_path / "steps.jsonl"))
+    monitor.reset()
+    monitor.enable()
+    yield monitor
+    monitor.disable()
+    monitor.reset()
+
+
+@pytest.fixture
+def mem():
+    """Enabled memory observatory; always torn down."""
+    led = memobs.enable()
+    yield led
+    memobs.disable()
+
+
+@pytest.fixture
+def mesh():
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4,
+                               "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    yield
+    from paddle_tpu.distributed import env as env_mod
+
+    env_mod.reset_env()
+
+
+def _linear_step(donate=False, nan_check=None, lr=0.1):
+    net = pt.nn.Linear(4, 4)
+    opt = pt.optimizer.SGD(learning_rate=lr, parameters=net.parameters())
+    return net, TrainStep(net, opt,
+                          lambda m, x, y: ((m(x) - y) ** 2).mean(),
+                          donate=donate, nan_check=nan_check)
+
+
+# -- memory observatory ------------------------------------------------------
+
+class TestMemoryLedger:
+    def test_live_census_counts_buffers(self):
+        keep = pt.to_tensor(np.ones((64, 64), np.float32))
+        c = memobs.live_census()
+        assert c["live_bytes"] >= 64 * 64 * 4
+        assert c["live_buffers"] >= 1
+        del keep
+
+    def test_ledger_census_tracks_peak(self, mem):
+        c1 = mem.census()
+        big = pt.to_tensor(np.ones((256, 256), np.float32))
+        c2 = mem.census()
+        assert c2["live_bytes"] >= c1["live_bytes"] + 256 * 256 * 4
+        assert mem.peak_live_bytes >= c2["live_bytes"]
+        del big
+        c3 = mem.census(tag="after_free")
+        # peak survives the free; the live number drops
+        assert mem.peak_live_bytes >= c3["live_bytes"]
+        assert c3["tag"] == "after_free"
+        assert mem.census_count == 3
+
+    def test_census_sets_gauges(self, mon, mem):
+        mem.census()
+        g = mon.snapshot()["gauges"]
+        assert g["memory/live_bytes"] > 0
+        assert g["memory/peak_live_bytes"] >= g["memory/live_bytes"]
+
+    def test_steplogger_embeds_census_per_step(self, mon, mem, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        _, step = _linear_step()
+        x = pt.to_tensor(np.ones((2, 4), np.float32))
+        y = pt.to_tensor(np.zeros((2, 4), np.float32))
+        with monitor.StepLogger(path) as log:
+            for _ in range(3):
+                loss = step(x, y)
+                log.log_step(loss=float(loss.numpy()), num_samples=2)
+        lines = [json.loads(ln) for ln in open(path)]
+        steps = [ln for ln in lines if "step" in ln]
+        assert len(steps) == 3
+        for s in steps:
+            assert s["memory"]["live_bytes"] > 0
+            assert s["memory"]["peak_live_bytes"] >= s["memory"]["live_bytes"]
+        end = lines[-1]
+        assert end["event"] == "run_end"
+        assert end["memory"]["peak_live_bytes"] > 0
+        assert end["memory"]["censuses"] >= 3
+
+    def test_steplogger_no_memory_when_off(self, mon, tmp_path):
+        assert memobs._ledger is None
+        path = str(tmp_path / "off.jsonl")
+        with monitor.StepLogger(path) as log:
+            log.log_step(loss=1.0)
+        lines = [json.loads(ln) for ln in open(path)]
+        assert all("memory" not in ln for ln in lines)
+
+    def test_executable_record_structure(self, mem):
+        _, step = _linear_step()
+        x = pt.to_tensor(np.ones((2, 4), np.float32))
+        y = pt.to_tensor(np.zeros((2, 4), np.float32))
+        rec = memobs.executable_record(step, x, y, name="linear")
+        assert rec["name"] == "linear"
+        for k in ("args_bytes", "output_bytes", "temp_bytes",
+                  "generated_code_bytes", "peak_bytes"):
+            assert rec[k] >= 0, k
+        assert rec["peak_bytes"] == rec["args_bytes"] + rec["temp_bytes"]
+        assert rec["peak_bytes"] > 0
+        # landed in the ledger, and the run_end snapshot carries it
+        snap = mem.snapshot()
+        assert any(e.get("name") == "linear" for e in snap["executables"])
+
+    def test_executable_record_mesh_annotation(self, mesh):
+        net = pt.nn.Linear(8, 8)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+        step = TrainStep(net, opt,
+                         lambda m, x, y: ((m(x) - y) ** 2).mean())
+        x = pt.to_tensor(np.ones((4, 8), np.float32))
+        y = pt.to_tensor(np.zeros((4, 8), np.float32))
+        rec = memobs.executable_record(step, x, y, name="mesh_step")
+        assert rec["per_shard"] is True
+        assert rec["mesh"] == {"dp": 2, "mp": 4}
+        assert rec["peak_bytes"] > 0
+
+    def test_fit_phase_bracket_census(self, mon, mem):
+        net = pt.nn.Linear(4, 2)
+        model = pt.Model(net)
+        model.prepare(
+            pt.optimizer.SGD(learning_rate=0.1,
+                             parameters=net.parameters()),
+            pt.nn.MSELoss())
+        xs = np.ones((8, 4), np.float32)
+        ys = np.zeros((8, 2), np.float32)
+        ds = [(xs[i], ys[i]) for i in range(8)]
+        before = mem.census_count
+        model.fit(ds, batch_size=4, epochs=1, verbose=0)
+        # at least the epoch-end phase bracket census fired (plus the
+        # MonitorCallback's per-step ones)
+        assert mem.census_count > before
+
+    def test_per_shard_bytes_helper(self, mesh):
+        from paddle_tpu.distributed.shard import per_shard_bytes, \
+            shard_tensor
+
+        t = pt.to_tensor(np.ones((8, 8), np.float32))
+        assert per_shard_bytes(t) == 8 * 8 * 4  # unsharded: full cost
+        s = shard_tensor(t, spec=("dp", "mp"))
+        assert per_shard_bytes(s) == 8 * 8 * 4 // 8  # 2x4 mesh split
+
+    def test_per_device_census_counts_shards_not_globals(self, mesh):
+        from paddle_tpu.distributed.shard import shard_tensor
+
+        t = pt.to_tensor(np.ones((64, 64), np.float32))
+        s = shard_tensor(t, spec=("dp", "mp"))
+        c = memobs.live_census(per_device=True)
+        # the sharded array bills one shard toward the per-device bound,
+        # its full size toward the global total
+        assert c["max_device_bytes"] < c["live_bytes"]
+        assert c["max_device_bytes"] > 0
+        del t, s
+
+
+# -- OOM preflight planner ---------------------------------------------------
+
+class TestMemoryPlanner:
+    @pytest.fixture(scope="class")
+    def planner(self):
+        return _load_tool("memory_planner")
+
+    def _args(self, planner, **over):
+        argv = ["--hbm-gb", str(over.pop("hbm_gb", 16.0)),
+                "--configs", over.pop("configs", "dp8,dp4xmp2,dp2xmp4"),
+                "--hidden", "64", "--layers", "2", "--heads", "4",
+                "--seq", "32", "--vocab", "512", "--batches", "8"]
+        return planner.build_argparser().parse_args(argv)
+
+    def test_mesh_token_parsing(self, planner):
+        assert planner.parse_mesh("dp4xmp2") == {"dp": 4, "mp": 2}
+        assert planner.parse_mesh("dp8") == {"dp": 8, "mp": 1}
+        assert planner.parse_mesh("mp8") == {"dp": 1, "mp": 8}
+        with pytest.raises(ValueError, match="bad mesh token"):
+            planner.parse_mesh("pp2")
+
+    def test_bad_factorization_refused(self, planner):
+        args = self._args(planner, configs="dp4xmp2")
+        with pytest.raises(ValueError, match="factorize"):
+            planner.candidates(args, 16)
+
+    def test_plan_verdicts_on_virtual_mesh(self, planner):
+        args = self._args(planner)
+        rows = planner.plan(args, 8)
+        assert len(rows) >= 3
+        assert all("error" not in r for r in rows), rows
+        assert all(r["fits"] for r in rows)  # tiny model, 16 GiB budget
+        # sharding works: more mp -> smaller per-device args
+        by_mp = {r["mp"]: r["args_bytes"] for r in rows}
+        assert by_mp[4] < by_mp[1]
+        # a budget nothing meets flips every verdict, same cost data
+        args_tiny = self._args(planner, hbm_gb=1e-6)
+        rows_tiny = planner.plan(args_tiny, 8)
+        assert not any(r.get("fits") for r in rows_tiny)
+        out = planner.render(rows_tiny, 1e-6, 8)
+        assert "DOES NOT FIT" in out and "0/3" in out
+
+    def test_cli_smoke(self):
+        """The acceptance-criterion invocation: the CLI on the virtual
+        8-device mesh prints a fits table for ≥ 3 candidates, from
+        lowering-only data, rc 0."""
+        proc = subprocess.run(
+            [sys.executable, "tools/memory_planner.py",
+             "--hbm-gb", "16", "--smoke"],
+            cwd=_ROOT, capture_output=True, text=True, timeout=900)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = proc.stdout
+        assert out.count("FITS") >= 3
+        assert "memory planner: budget 16.00 GiB/device" in out
+        assert "3/3 candidate config(s) fit" in out
+
+
+# -- numerics sentinel -------------------------------------------------------
+
+class _ScaledSum(pt.nn.Layer):
+    """Scalar-weight model whose FORWARD stays finite on a huge batch
+    (w * x is scaled down before the sum) while the GRADIENT wrt w is
+    sum(x) — which overflows to inf for x = 4 × 3e38. The injected
+    non-finite grad of the acceptance criterion."""
+
+    def __init__(self):
+        super().__init__()
+        self.w = self.create_parameter(
+            [1], default_initializer=pt.nn.initializer.Constant(1e-3))
+
+    def forward(self, x):
+        return (self.w * x).sum()
+
+
+_POISON = np.full((4,), 3e38, np.float32)  # sum overflows fp32
+_CLEAN = np.ones((4,), np.float32)
+
+
+def _scaled_step(nan_check=True):
+    net = _ScaledSum()
+    opt = pt.optimizer.SGD(learning_rate=1e-4,
+                           parameters=net.parameters())
+    return net, TrainStep(net, opt, lambda m, x: m(x),
+                          nan_check=nan_check)
+
+
+class TestNumericsSentinel:
+    def test_injected_inf_grad_names_step_and_leaf(self):
+        _, step = _scaled_step()
+        for _ in range(2):
+            step(pt.to_tensor(_CLEAN))
+        with pytest.raises(numerics.NonFiniteError) as ei:
+            step(pt.to_tensor(_POISON))
+        e = ei.value
+        assert e.step == 3
+        assert e.leaf == "grad/w"
+        assert e.kind == "grad"
+        assert "step 3" in str(e) and "grad/w" in str(e)
+
+    def test_forward_inf_names_loss(self):
+        net = pt.nn.Linear(4, 1)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+        step = TrainStep(net, opt, lambda m, x: m(x).sum(),
+                         nan_check=True)
+        bad = pt.to_tensor(np.full((2, 4), np.inf, np.float32))
+        with pytest.raises(numerics.NonFiniteError) as ei:
+            step(bad)
+        assert ei.value.kind == "loss"
+        assert ei.value.step == 1
+
+    def test_params_not_updated_by_failing_step(self):
+        net, step = _scaled_step()
+        step(pt.to_tensor(_CLEAN))
+        w_before = float(np.asarray(net.w.numpy())[0])
+        with pytest.raises(numerics.NonFiniteError):
+            step(pt.to_tensor(_POISON))
+        assert float(np.asarray(net.w.numpy())[0]) == w_before
+
+    def test_healthy_run_one_extra_fetch_per_step(self, mon):
+        """The ≤ 1-extra-host-scalar-fetch-per-step contract, proven via
+        the hapi/host_syncs guard counter on a direct-step run (no fit
+        windows: every sync here is the sentinel's)."""
+        _, step = _scaled_step()
+        x = pt.to_tensor(_CLEAN)
+        before = mon.snapshot()["counters"].get("hapi/host_syncs", 0)
+        for _ in range(5):
+            step(x)
+        c = mon.snapshot()["counters"]
+        assert c["numerics/checks"] == 5
+        assert c.get("numerics/failures", 0) == 0
+        assert c.get("hapi/host_syncs", 0) - before == 5  # exactly 1/step
+        # and one retrace total: the nan-check signature compiled once
+        assert c["jit/retraces"] == 1
+
+    def test_failure_counted_and_span_recorded(self, mon):
+        _, step = _scaled_step()
+        with pytest.raises(numerics.NonFiniteError):
+            step(pt.to_tensor(_POISON))
+        c = mon.snapshot()["counters"]
+        assert c["numerics/failures"] == 1
+        names = [s[0] for s in monitor.spans().snapshot()]
+        assert "numerics/first_bad_step" in names
+
+    def test_global_enable_wires_slot(self):
+        from paddle_tpu.jit import train_step as ts_mod
+
+        assert ts_mod._nancheck is None
+        numerics.enable()
+        try:
+            assert ts_mod._nancheck is numerics
+            assert numerics.enabled()
+            # a step built with no instance flag follows the global
+            _, step = _linear_step()
+            assert step._nan_active() is True
+        finally:
+            numerics.disable()
+        assert ts_mod._nancheck is None
+        _, step = _linear_step()
+        assert step._nan_active() is False
+
+    def test_instance_false_overrides_global(self):
+        numerics.enable()
+        try:
+            _, step = _linear_step(nan_check=False)
+            assert step._nan_active() is False
+        finally:
+            numerics.disable()
+
+    def test_donation_suspended_while_armed(self):
+        """Replay needs the pre-step params: donate=True + nan_check
+        must not invalidate them (the failing-step test above already
+        read them; here the healthy path keeps stepping)."""
+        net, step = _linear_step(donate=True, nan_check=True)
+        x = pt.to_tensor(np.ones((2, 4), np.float32))
+        y = pt.to_tensor(np.zeros((2, 4), np.float32))
+        l1 = float(step(x, y).numpy())
+        l2 = float(step(x, y).numpy())
+        assert np.isfinite([l1, l2]).all() and l2 < l1
+
+    def test_fit_nan_check_catches_and_fires_on_train_error(self, mon,
+                                                           tmp_path):
+        from paddle_tpu.hapi.callbacks import Callback, MonitorCallback
+
+        errors = []
+
+        class Recorder(Callback):
+            def on_train_error(self, error=None):
+                errors.append(error)
+
+        net = _ScaledSum()
+        model = pt.Model(net)
+        model.prepare(
+            pt.optimizer.SGD(learning_rate=1e-4,
+                             parameters=net.parameters()),
+            loss=lambda outs, label: outs)
+        data = [(_CLEAN, np.zeros(1, np.float32)) for _ in range(6)]
+        data[3] = (_POISON, np.zeros(1, np.float32))  # poison step 4
+        path = str(tmp_path / "nan_fit.jsonl")
+        with pytest.raises(numerics.NonFiniteError) as ei:
+            model.fit(data, batch_size=1, epochs=1, shuffle=False,
+                      verbose=0, nan_check=True,
+                      callbacks=[Recorder(), MonitorCallback(path)])
+        assert ei.value.step == 4
+        assert ei.value.leaf == "grad/w"
+        # Callback.on_train_error fired with the sentinel's message
+        assert len(errors) == 1 and "grad/w" in errors[0]
+        # the StepLogger run_end line records the error (crashed-run
+        # JSONL is distinguishable from a truncated one)
+        lines = [json.loads(ln) for ln in open(path)]
+        end = lines[-1]
+        assert end["event"] == "run_end"
+        assert "NonFiniteError" in end["error"]
+        # fit's nan_check=True is per-fit: the TrainStep's own setting
+        # is restored even on the error path
+        assert model._train_step._nan_check is None
+
+    def test_fit_nan_check_false_overrides_env(self, mon):
+        numerics.enable()
+        try:
+            net = pt.nn.Linear(4, 2)
+            model = pt.Model(net)
+            model.prepare(
+                pt.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters()),
+                pt.nn.MSELoss())
+            xs = np.ones((4, 4), np.float32)
+            ys = np.zeros((4, 2), np.float32)
+            model.fit([(xs[i], ys[i]) for i in range(4)], batch_size=2,
+                      epochs=1, verbose=0, nan_check=False)
+            assert mon.snapshot()["counters"].get("numerics/checks", 0) == 0
+        finally:
+            numerics.disable()
+
+
+# -- zero-overhead audit (extended: every slot-carrying module) --------------
+
+@pytest.mark.parametrize("modname", monitor.INSTRUMENTED_MODULES)
+def test_zero_overhead_audit_import_time_inert(modname):
+    """Single parametrized audit over monitor.INSTRUMENTED_MODULES: with
+    PT_MONITOR / PT_NANCHECK / PT_MONITOR_MEM unset (tier-1 default),
+    every registered slot on every instrumented module is None — no
+    monitor/sentinel callable is reachable from any hot path. New
+    instrumentation sites must join INSTRUMENTED_MODULES, so this audit
+    covers them without edits here."""
+    assert not monitor.enabled()
+    assert not numerics.enabled()
+    assert memobs._ledger is None
+    mod = importlib.import_module(modname)
+    assert mod._monitor is None, f"{modname}._monitor"
+    if hasattr(mod, "_spans"):
+        assert mod._spans is None, f"{modname}._spans"
+    if hasattr(mod, "_nancheck"):
+        assert mod._nancheck is None, f"{modname}._nancheck"
+
+
+def test_audit_list_covers_all_registered_sites():
+    """Every module that actually registered a monitor slot is in the
+    audit list — a new `_register` call can't silently dodge the audit."""
+    registered = {m.__name__ for m in monitor._SITES}
+    assert registered <= set(monitor.INSTRUMENTED_MODULES), (
+        registered - set(monitor.INSTRUMENTED_MODULES))
+    nan_sites = {m.__name__ for m in numerics._SITES}
+    assert nan_sites <= set(monitor.INSTRUMENTED_MODULES), nan_sites

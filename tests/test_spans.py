@@ -207,53 +207,72 @@ class TestFitTraceExport:
     chrome trace with ≥3 distinct thread lanes whose spans are well-formed
     and whose attribution buckets sum to ≤ the measured wall time."""
 
+    # fraction of the step-window wall the named buckets must explain.
+    # "other" is legitimate python bookkeeping PLUS whatever the OS
+    # scheduler steals on the shared 2-core box, so (like the
+    # host-overhead smoke) the bound gets one noisy-neighbor retry with
+    # a fresh run before it may fail the tier.
+    WALL_COVERAGE_MIN = 0.75
+    _RETRIES = 1
+
     def test_fit_trace_lanes_wellformed_and_attribution(self, mon,
                                                         tmp_path):
-        _run_fit(tmp_path)
-        trace_path = str(tmp_path / "fit_trace.json")
-        monitor.export_spans(trace_path)
-        with open(trace_path) as f:
-            trace = json.load(f)
-        events = trace["traceEvents"]
-        lanes = {e["args"]["name"]: e["tid"] for e in events
-                 if e.get("ph") == "M" and e["name"] == "thread_name"}
-        # producer thread, main/stepper, sync fences (+ the steps lane)
-        assert len(lanes) >= 3
-        assert {"main", "prefetch_producer", "sync_fences"} <= set(lanes)
-        tids = set(lanes.values())
-        xs = [e for e in events if e.get("ph") == "X"]
-        assert xs
-        for e in xs:
-            assert e["name"] and "ts" in e and "dur" in e
-            assert e["dur"] >= 0
-            assert e["tid"] in tids
+        for attempt in range(self._RETRIES + 1):
+            _run_fit(tmp_path)
+            trace_path = str(tmp_path / "fit_trace.json")
+            monitor.export_spans(trace_path)
+            with open(trace_path) as f:
+                trace = json.load(f)
+            events = trace["traceEvents"]
+            lanes = {e["args"]["name"]: e["tid"] for e in events
+                     if e.get("ph") == "M" and e["name"] == "thread_name"}
+            # producer thread, main/stepper, sync fences (+ steps lane)
+            assert len(lanes) >= 3
+            assert {"main", "prefetch_producer", "sync_fences"} \
+                <= set(lanes)
+            tids = set(lanes.values())
+            xs = [e for e in events if e.get("ph") == "X"]
+            assert xs
+            for e in xs:
+                assert e["name"] and "ts" in e and "dur" in e
+                assert e["dur"] >= 0
+                assert e["tid"] in tids
 
-        # attribution: buckets never exceed the step wall they decompose
-        tool = _load_report_tool()
-        steps, by_cat = tool.load_spans(trace_path)
-        att = tool.attribute_spans(steps, by_cat)
-        assert att["wall_ms"] > 0
-        bucket_sum = sum(att["totals"][c] for c in ATTRIBUTION_CATEGORIES)
-        assert bucket_sum <= att["wall_ms"] + 1e-6
-        for row in att["per_step"]:
-            assert row["other"] >= 0
-            assert sum(row[c] for c in ATTRIBUTION_CATEGORIES) \
-                <= row["dur_ms"] + 1e-6
-        # the named categories must explain ≥90% of the MEASURED
-        # host-blocked time (the same regions the counter histograms
-        # time: transfer fences, bound waits, starved waits, compiles) —
-        # per-step python bookkeeping is legitimately "other"
-        hists = monitor.snapshot().get("histograms", {})
-        blocked_ms = sum(
-            hists.get(h, {"sum": 0.0})["sum"]
-            for h in ("tunnel/sync_ms", "async/bound_wait_ms",
-                      "io/prefetch_wait_ms")
-        ) + hists.get("jit/compile_ms", {"sum": 0.0})["sum"]
-        assert blocked_ms > 0
-        assert bucket_sum >= 0.9 * min(blocked_ms, att["wall_ms"]), (
-            att["totals"], blocked_ms)
-        # and the instrumented regions still cover the bulk of the wall
-        assert bucket_sum >= 0.75 * att["wall_ms"], att["totals"]
+            # attribution: buckets never exceed the step wall they
+            # decompose
+            tool = _load_report_tool()
+            steps, by_cat = tool.load_spans(trace_path)
+            att = tool.attribute_spans(steps, by_cat)
+            assert att["wall_ms"] > 0
+            bucket_sum = sum(att["totals"][c]
+                             for c in ATTRIBUTION_CATEGORIES)
+            assert bucket_sum <= att["wall_ms"] + 1e-6
+            for row in att["per_step"]:
+                assert row["other"] >= 0
+                assert sum(row[c] for c in ATTRIBUTION_CATEGORIES) \
+                    <= row["dur_ms"] + 1e-6
+            # the named categories must explain ≥90% of the MEASURED
+            # host-blocked time (the same regions the counter histograms
+            # time: transfer fences, bound waits, starved waits,
+            # compiles) — per-step python bookkeeping is legitimately
+            # "other"
+            hists = monitor.snapshot().get("histograms", {})
+            blocked_ms = sum(
+                hists.get(h, {"sum": 0.0})["sum"]
+                for h in ("tunnel/sync_ms", "async/bound_wait_ms",
+                          "io/prefetch_wait_ms")
+            ) + hists.get("jit/compile_ms", {"sum": 0.0})["sum"]
+            assert blocked_ms > 0
+            assert bucket_sum >= 0.9 * min(blocked_ms, att["wall_ms"]), (
+                att["totals"], blocked_ms)
+            # the instrumented regions still cover the bulk of the wall
+            # — the one load-sensitive bound, retried on a clean slate
+            if bucket_sum >= self.WALL_COVERAGE_MIN * att["wall_ms"]:
+                return
+            if attempt < self._RETRIES:
+                monitor.reset()
+        assert bucket_sum >= self.WALL_COVERAGE_MIN * att["wall_ms"], (
+            att["totals"])
 
     def test_report_cli_spans_section(self, mon, tmp_path, capsys):
         _run_fit(tmp_path, steps=8)

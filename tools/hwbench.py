@@ -95,6 +95,44 @@ def _guard_check(name: str, stdout: str):
         return None
 
 
+def _memory_status(name: str, stdout: str):
+    """Peak-HBM + numerics-sentinel status from a finished bench's JSON
+    line — printed per bench and returned for the summary, so memory
+    regressions get the same while-the-chip-is-up visibility as
+    throughput. (The benches themselves persist these fields into their
+    PERF_MEASUREMENTS.json records; this is the live readout.)"""
+    try:
+        if ROOT not in sys.path:
+            sys.path.insert(0, ROOT)
+        from bench import _load_perf_guard
+
+        guard = _load_perf_guard()
+        line = guard.find_bench_line(stdout)
+        if line is None:
+            return None
+        out = {}
+        hbm = guard.peak_hbm_of(line)
+        if hbm is not None:
+            out["peak_hbm_gib"] = hbm
+        mem = line.get("memory") or {}
+        if "nan_check" in mem:
+            out["nan_check"] = mem["nan_check"]
+        elif "nan_check" in line:
+            out["nan_check"] = line["nan_check"]
+        if out:
+            parts = []
+            if "peak_hbm_gib" in out:
+                parts.append(f"peak HBM {out['peak_hbm_gib']} GiB")
+            if "nan_check" in out:
+                parts.append("nan-check "
+                             + ("armed" if out["nan_check"] else "off"))
+            print(f"hwbench: {name} memory: {', '.join(parts)}", flush=True)
+        return out or None
+    except Exception as e:  # noqa: BLE001 — a readout, never a gate
+        print(f"hwbench: {name} memory status errored: {e}", flush=True)
+        return None
+
+
 def probe() -> str:
     """Reuse bench.py's probe: it pins the platform config past the host
     sitecustomize override and retries transient UNAVAILABLE with backoff —
@@ -144,6 +182,9 @@ def main() -> int:
                 print(f"  {ln}", flush=True)
             if proc.returncode == 0:
                 results[name]["guard_ok"] = _guard_check(name, proc.stdout)
+                mem = _memory_status(name, proc.stdout)
+                if mem:
+                    results[name]["memory"] = mem
             if proc.returncode != 0:
                 for ln in tail:
                     print(f"  [stderr] {ln}", flush=True)
@@ -152,8 +193,12 @@ def main() -> int:
                              "lines": ["timeout"]}
             print(f"hwbench: {name} TIMED OUT after {timeout_s}s",
                   flush=True)
-    print(json.dumps({"hwbench_summary": {
-        k: v["rc"] for k, v in results.items()}}), flush=True)
+    summary = {"hwbench_summary": {
+        k: v["rc"] for k, v in results.items()}}
+    mem_map = {k: v["memory"] for k, v in results.items() if "memory" in v}
+    if mem_map:
+        summary["hwbench_memory"] = mem_map
+    print(json.dumps(summary), flush=True)
     # a run in which nothing was measured must be retryable by exit code
     if not results or all(v["rc"] != 0 for v in results.values()):
         return 2

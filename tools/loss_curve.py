@@ -105,6 +105,38 @@ def main() -> int:
         "losses": [round(x, 4) for x in losses],
         "wall_s": round(wall, 1),
     }
+    # memory + numerics provenance: peak HBM and sentinel status ride in
+    # the persisted record like throughput does (allocator stats first,
+    # XLA executable accounting as fallback — both best-effort: a flaky
+    # tunnel must not cost the loss series)
+    from paddle_tpu.monitor import memory as _memobs
+    from paddle_tpu.monitor import numerics as _numerics
+
+    rec["nan_check"] = _numerics.enabled()
+    rec["losses_finite"] = bool(np.isfinite(losses).all())
+    try:
+        peak = _memobs.device_peak_gib()
+        if peak is None:
+            # AOT-compile fallback, SIGALRM-timeboxed: a tunnel that
+            # hangs here must not cost the already-measured loss series
+            # (the record below has not been persisted yet)
+            import signal
+
+            prev = signal.signal(
+                signal.SIGALRM,
+                lambda *_: (_ for _ in ()).throw(TimeoutError()))
+            signal.alarm(300)
+            try:
+                mrec = _memobs.executable_record(
+                    step, ids, labels, name="loss_curve/headline")
+            finally:
+                signal.signal(signal.SIGALRM, prev)
+                signal.alarm(0)
+            peak = round(mrec["peak_bytes"] / 2**30, 3)
+        rec["peak_hbm_gib"] = peak
+    except Exception as e:  # noqa: BLE001
+        print(f"loss_curve: memory accounting unavailable: {e}",
+              file=sys.stderr, flush=True)
     if smoke:
         rec["note"] = "cpu smoke; the hardware artifact needs the chip"
     else:

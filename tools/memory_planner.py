@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""OOM preflight planner: fits/doesn't-fit per sharding/batch config,
+from lowering-only cost data — no execution, no tunnel round-trips paid
+per candidate beyond the AOT compile.
+
+    python tools/memory_planner.py --hbm-gb 16
+    python tools/memory_planner.py --hbm-gb 16 --devices 8 \
+        --configs dp8,dp4xmp2,dp2xmp4 --batches 4,8 --hidden 512 --layers 4
+
+For each candidate (dp × mp mesh split, batch size) the planner builds
+the model under that mesh, AOT-compiles the full train step
+(fwd+bwd+optimizer — `jit/train_step.py`), and reads XLA's own
+executable memory accounting (`monitor/memory.py:executable_record`;
+per-device for SPMD executables) against the ``--hbm-gb`` budget. A
+90 s tunnel compile that would end in an OOM becomes a table row
+instead (PAPERS: *GSPMD*, *Memory-efficient array redistribution* — the
+sharding choice IS the memory plan).
+
+The number judged is ``args + temp`` bytes per device: parameters,
+optimizer state, batch, and every XLA temporary live during the step —
+the high-water mark that has to fit. Host-side RAM is used to
+materialize parameters for lowering; the device never runs.
+
+Exit code: 0 when at least one candidate fits, 3 when none do, 2 on
+setup errors — so a driver can gate a launch on the verdict.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def parse_mesh(token: str) -> dict:
+    """``dp4xmp2`` -> {"dp": 4, "mp": 2} (either axis optional)."""
+    out = {"dp": 1, "mp": 1}
+    for part in token.lower().split("x"):
+        part = part.strip()
+        if not part:
+            continue
+        for axis in ("dp", "mp"):
+            if part.startswith(axis):
+                out[axis] = int(part[len(axis):])
+                break
+        else:
+            raise ValueError(f"memory_planner: bad mesh token {part!r} "
+                             f"in {token!r} (expected dpN / mpN / dpNxmpM)")
+    return out
+
+
+def default_meshes(n_devices: int) -> list:
+    """(dp, mp) factorizations of the device count, dp-heavy first."""
+    out = []
+    mp = 1
+    while mp <= n_devices:
+        if n_devices % mp == 0:
+            out.append({"dp": n_devices // mp, "mp": mp})
+        mp *= 2
+    return out
+
+
+def candidates(args, n_devices: int) -> list:
+    meshes = ([parse_mesh(t) for t in args.configs.split(",")]
+              if args.configs else default_meshes(n_devices))
+    batches = [int(b) for b in str(args.batches).split(",")]
+    out = []
+    for m in meshes:
+        if m["dp"] * m["mp"] != n_devices:
+            raise ValueError(
+                f"memory_planner: dp{m['dp']}xmp{m['mp']} does not "
+                f"factorize {n_devices} devices")
+        for b in batches:
+            out.append({**m, "batch": b})
+    return out
+
+
+def plan_one(cand: dict, args) -> dict:
+    """One candidate: mesh init -> model -> AOT compile -> per-device
+    memory record -> verdict. Tears the mesh down before returning."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.distributed import env as env_mod, fleet
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.monitor import memory as memobs
+
+    dp, mp, batch = cand["dp"], cand["mp"], cand["batch"]
+    label = f"dp{dp}·mp{mp} b{batch}"
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": dp, "mp_degree": mp, "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        cfg = LlamaConfig(
+            vocab_size=args.vocab, hidden_size=args.hidden,
+            intermediate_size=args.intermediate or args.hidden * 3,
+            num_hidden_layers=args.layers, num_attention_heads=args.heads,
+            max_position_embeddings=args.seq,
+            sequence_parallel=mp > 1,
+            use_parallel_cross_entropy=mp > 1)
+        pt.seed(0)
+        model = LlamaForCausalLM(cfg)
+        opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+        step = TrainStep(model, opt, lambda m, i, l: m(i, l))
+        ids = pt.to_tensor(np.random.randint(
+            0, cfg.vocab_size, (batch, args.seq)))
+        rec = memobs.executable_record(step, ids, ids, name=label)
+        rec.update(cand)
+        rec["label"] = label
+        rec["fits"] = rec["peak_bytes"] <= args.hbm_gb * 2**30
+        return rec
+    finally:
+        env_mod.reset_env()
+
+
+def render(rows: list, hbm_gb: float, n_devices: int) -> str:
+    out = [f"== memory planner: budget {hbm_gb:.2f} GiB/device, "
+           f"{n_devices} devices =="]
+    hdr = (f"{'config':<18}{'per-dev peak':>14}{'args':>10}{'temp':>10}"
+           f"{'out':>10}  verdict")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for r in rows:
+        if "error" in r:
+            out.append(f"{r['label']:<18}{'—':>14}{'—':>10}{'—':>10}"
+                       f"{'—':>10}  ERROR ({r['error'][:40]})")
+            continue
+        gib = 2**30
+        out.append(
+            f"{r['label']:<18}"
+            f"{r['peak_bytes'] / gib:>11.3f} GiB"
+            f"{r['args_bytes'] / gib:>10.3f}"
+            f"{r['temp_bytes'] / gib:>10.3f}"
+            f"{r['output_bytes'] / gib:>10.3f}"
+            f"  {'FITS' if r['fits'] else 'DOES NOT FIT'}")
+    n_fit = sum(1 for r in rows if r.get("fits"))
+    out.append(f"verdict: {n_fit}/{len(rows)} candidate config(s) fit in "
+               f"{hbm_gb:.2f} GiB/device")
+    return "\n".join(out)
+
+
+def plan(args, n_devices: int) -> list:
+    rows = []
+    for cand in candidates(args, n_devices):
+        try:
+            rows.append(plan_one(cand, args))
+        except Exception as e:  # noqa: BLE001 — one broken candidate
+            # must not hide the others' verdicts
+            rows.append({"label": f"dp{cand['dp']}·mp{cand['mp']} "
+                                  f"b{cand['batch']}",
+                         **cand, "error": f"{type(e).__name__}: {e}"})
+    return rows
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        description="Fits/doesn't-fit preflight over sharding/batch "
+                    "candidates from lowering-only memory accounting.")
+    ap.add_argument("--hbm-gb", type=float, default=16.0,
+                    help="per-device HBM budget in GiB (default 16 — one "
+                         "v5e chip)")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="mesh size; a virtual CPU mesh of this many "
+                         "devices is forced (default 8)")
+    ap.add_argument("--configs", default=None,
+                    help="comma list of mesh splits, e.g. "
+                         "'dp8,dp4xmp2,dp2xmp4' (default: all power-of-2 "
+                         "dp×mp factorizations of --devices)")
+    ap.add_argument("--batches", default="8",
+                    help="comma list of global batch sizes (default 8)")
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--intermediate", type=int, default=0,
+                    help="FFN width (default 3*hidden)")
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--vocab", type=int, default=2048)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny model + 3 mesh candidates (CI smoke)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON line with the rows as well")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    if args.smoke:
+        args.hidden, args.layers, args.heads = 64, 2, 4
+        args.seq, args.vocab, args.batches = 32, 512, "8"
+        if not args.configs:
+            args.configs = "dp8,dp4xmp2,dp2xmp4"
+
+    # the planner needs its virtual mesh BEFORE jax initializes a
+    # backend; the host sitecustomize pins the tunneled TPU at
+    # interpreter start, so (like __graft_entry__.dryrun_multichip)
+    # re-exec in a corrected child environment
+    if os.environ.get("_PT_PLANNER_CHILD") != "1":
+        env = dict(os.environ)
+        env["_PT_PLANNER_CHILD"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(
+            f"--xla_force_host_platform_device_count={args.devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+        code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+                "import sys; sys.path.insert(0, %r); "
+                "sys.path.insert(0, %r); "
+                "import importlib.util; "
+                "spec = importlib.util.spec_from_file_location("
+                "'memory_planner', %r); "
+                "mod = importlib.util.module_from_spec(spec); "
+                "spec.loader.exec_module(mod); "
+                "sys.exit(mod.main(%r))"
+                % (ROOT, os.path.join(ROOT, "tools"),
+                   os.path.abspath(__file__),
+                   argv if argv is not None else sys.argv[1:]))
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              cwd=ROOT, timeout=1800)
+        return proc.returncode
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    n = len(jax.devices())
+    if n < args.devices:
+        print(f"memory_planner: need {args.devices} devices, have {n}",
+              file=sys.stderr)
+        return 2
+    sys.path.insert(0, ROOT)
+    try:
+        rows = plan(args, args.devices)
+    except ValueError as e:
+        # bad --configs tokens / factorizations: name the problem, rc 2
+        msg = str(e)
+        print(msg if msg.startswith("memory_planner:")
+              else f"memory_planner: {msg}", file=sys.stderr)
+        return 2
+    print(render(rows, args.hbm_gb, args.devices), flush=True)
+    if args.json:
+        print(json.dumps({"memory_planner": {
+            "hbm_gb": args.hbm_gb, "devices": args.devices,
+            "rows": rows}}), flush=True)
+    if not rows:
+        return 2
+    return 0 if any(r.get("fits") for r in rows) else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,15 +4,19 @@ one summary table.
 
     python tools/monitor_report.py run.jsonl [--trace trace.json] [--top 10]
     python tools/monitor_report.py run.jsonl --trace trace.json --spans
+    python tools/monitor_report.py run.jsonl --bench bench.log
 
 Sections: run overview (steps, wall, loss, ips), counter totals, the async
 pipeline (prefetch staging/starvation, AsyncStepper bound waits, hapi host
-syncs, host_blocked_ms_per_step), retrace timeline (which step retraced —
-the recompile smoking gun), tunnel-sync latency percentiles, and — when a
-chrome trace from `paddle_tpu.profiler.Profiler.export` (or
-`monitor.export_spans`) is given — the top dispatched ops and the monitor
-counter tracks found on the timeline, so one report correlates the JSONL
-run with the trace.
+syncs, host_blocked_ms_per_step), device memory (peak HBM / live-census
+peaks from the memory observatory, per-executable breakdown), the perf
+guard verdict (the `guard` sub-object bench.py embeds — rendered from the
+run_end line, or from a bench log via `--bench`), retrace timeline (which
+step retraced — the recompile smoking gun), tunnel-sync latency
+percentiles, and — when a chrome trace from
+`paddle_tpu.profiler.Profiler.export` (or `monitor.export_spans`) is
+given — the top dispatched ops and the monitor counter tracks found on
+the timeline, so one report correlates the JSONL run with the trace.
 
 `--spans` adds the host-blocked-time attribution pass: the flight
 recorder's `ph:"X"` spans (`paddle_tpu/monitor/spans.py`) are decomposed
@@ -87,6 +91,92 @@ def _counter_totals(steps, end):
         for k, v in s.get("counters", {}).items():
             totals[k] = totals.get(k, 0) + v
     return totals
+
+
+def _fmt_gib(n_bytes):
+    return f"{n_bytes / 2**30:.3f} GiB"
+
+
+def find_bench_line(text):
+    """tools/perf_guard.py:find_bench_line — THE one scanner for bench
+    lines (its contract) — loaded from the sibling file so the scan rule
+    cannot drift between the guard, hwbench, and this report. Still
+    stdlib-only: tools/ is not a package, so load by path."""
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "perf_guard.py")
+    spec = importlib.util.spec_from_file_location("perf_guard", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.find_bench_line(text)
+
+
+def render_guard(guard, out, source=""):
+    """The perf-guard verdict sub-object (`tools/perf_guard.py` schema:
+    {ok, checks: [{name, ok, detail}], compared, baseline?})."""
+    out.append("")
+    out.append(f"-- perf guard{source} --")
+    for c in guard.get("checks", []):
+        mark = "ok  " if c.get("ok") else "FAIL"
+        out.append(f"  [{mark}] {c.get('name', '?'):<12} "
+                   f"{c.get('detail', '')}")
+    base = guard.get("baseline")
+    if base:
+        out.append(f"  baseline: {base.get('value')} "
+                   f"@ {base.get('commit', '?')} ({base.get('timestamp')})")
+    elif not guard.get("compared"):
+        out.append("  (no hardware baseline compared)")
+    out.append("verdict: " + ("PASS" if guard.get("ok")
+                              else "REGRESSION — do not trust/land "
+                                   "this number"))
+
+
+def render_memory(mem, out, steps=(), source=""):
+    """The memory observatory's account: run-level peaks (+ sentinel
+    state) and the per-step live-census trajectory when step lines
+    carry `memory` sub-objects."""
+    out.append("")
+    out.append(f"-- device memory{source} --")
+    peak = mem.get("peak_hbm_gib")
+    if peak is not None:
+        out.append(f"peak HBM: {peak:.3f} GiB"
+                   + (f"   (source: {mem['source']})"
+                      if mem.get("source") else ""))
+    for key, label in (("peak_live_bytes", "peak live bytes (census)"),
+                       ("peak_backend_bytes", "peak bytes (allocator)")):
+        if mem.get(key):
+            out.append(f"{label}: {_fmt_gib(mem[key])}")
+    if mem.get("peak_live_gib") is not None and "peak_live_bytes" not in mem:
+        out.append(f"peak live (census): {mem['peak_live_gib']:.3f} GiB")
+    if mem.get("censuses"):
+        out.append(f"censuses: {mem['censuses']}")
+    if "nan_check" in mem:
+        out.append(f"numerics sentinel: "
+                   f"{'armed' if mem['nan_check'] else 'off'}")
+    execs = mem.get("executables") or (
+        [mem["executable"]] if mem.get("executable") else [])
+    if execs:
+        out.append("per-executable (args / temp / out -> peak):")
+        for e in execs:
+            out.append(f"  {e.get('name', '?'):<28}"
+                       f"{_fmt_gib(e.get('args_bytes', 0)):>12} /"
+                       f"{_fmt_gib(e.get('temp_bytes', 0)):>12} /"
+                       f"{_fmt_gib(e.get('output_bytes', 0)):>12} -> "
+                       f"{_fmt_gib(e.get('peak_bytes', 0))}"
+                       + ("  (per-shard)" if e.get("per_shard") else ""))
+    # per-step live-census trajectory from the step lines
+    series = [(s["step"], s["memory"]) for s in steps
+              if isinstance(s.get("memory"), dict)]
+    if series:
+        live = [m.get("live_bytes", 0) for _, m in series]
+        peaks = [m.get("peak_live_bytes", 0) for _, m in series]
+        hi_step = max(series, key=lambda sm: sm[1].get("live_bytes", 0))
+        out.append(f"step census: {len(series)} step(s)   "
+                   f"live min {_fmt_gib(min(live))}   "
+                   f"max {_fmt_gib(max(live))} (step {hi_step[0]})   "
+                   f"run peak {_fmt_gib(max(peaks))}")
 
 
 # -- span attribution --------------------------------------------------------
@@ -241,7 +331,8 @@ def render_attribution(att, out):
                        f"(dur {w['dur_ms']:.2f}ms: {parts})")
 
 
-def render(jsonl_path, trace_path=None, top=10, spans=False):
+def render(jsonl_path, trace_path=None, top=10, spans=False,
+           bench_path=None):
     steps, begin, end = load_jsonl(jsonl_path)
     out = [f"== monitor run: {jsonl_path} =="]
     if begin:
@@ -335,6 +426,46 @@ def render(jsonl_path, trace_path=None, top=10, spans=False):
         out.append("")
         out.append("-- async pipeline --")
         out.extend(pipe)
+
+    # -- device memory (observatory run_end sub-object and/or per-step
+    #    censuses) --
+    mem = (end or {}).get("memory")
+    has_step_mem = any(isinstance(s.get("memory"), dict) for s in steps)
+    if mem or has_step_mem:
+        render_memory(mem or {}, out, steps=steps)
+
+    # -- perf guard verdict (bench.py embeds it in run_end) --
+    guard = (end or {}).get("guard")
+    if guard:
+        render_guard(guard, out)
+
+    # -- bench line join (--bench): guard + memory from a bench log --
+    if bench_path:
+        read_ok = True
+        try:
+            line = find_bench_line(open(bench_path).read())
+        except OSError as e:
+            line = None
+            read_ok = False
+            out.append("")
+            out.append(f"unreadable bench log: {e}")
+        if line is not None:
+            out.append("")
+            out.append(f"-- bench line: {bench_path} --")
+            out.append(f"{line.get('metric')}: {line.get('value')} "
+                       f"{line.get('unit', '')}"
+                       + (f"   mfu {line['mfu']}" if line.get("mfu")
+                          else ""))
+            mem_b = dict(line.get("memory") or {})
+            if line.get("peak_hbm_gib") is not None:
+                mem_b.setdefault("peak_hbm_gib", line["peak_hbm_gib"])
+            if mem_b:
+                render_memory(mem_b, out, source=" (bench)")
+            if line.get("guard"):
+                render_guard(line["guard"], out, source=" (bench)")
+        elif read_ok:
+            out.append("")
+            out.append(f"no bench JSON line found in {bench_path!r}")
 
     # -- retrace timeline --
     retraces = [(s["step"], s["counters"]["jit/retraces"]) for s in steps
@@ -436,9 +567,12 @@ def main(argv=None):
                          "{sync, fence_wait, prefetch_starvation, compile, "
                          "dispatch, other} from the flight-recorder spans "
                          "(in --trace, or in the given file)")
+    ap.add_argument("--bench", default=None, metavar="LOG",
+                    help="bench log/JSON line: render its guard verdict "
+                         "and memory sub-object next to the run")
     args = ap.parse_args(argv)
     report = render(args.jsonl, trace_path=args.trace, top=args.top,
-                    spans=args.spans)
+                    spans=args.spans, bench_path=args.bench)
     print(report)
     return report
 

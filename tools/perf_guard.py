@@ -13,6 +13,10 @@ and exits nonzero with a human-readable verdict when the run regressed:
 
 - throughput below last-good by more than ``--throughput-drop`` (10%)
 - MFU below last-good by more than ``--mfu-drop`` (10%)
+- peak HBM above last-good by more than ``--hbm-growth`` (10%): the step
+  got hungrier — the config that fit yesterday may OOM tomorrow
+  (``peak_hbm_gib`` from the line or its ``memory`` sub-object, vs the
+  baseline record's ``extra.peak_hbm_gib``)
 - any post-warmup retrace (``telemetry.post_warmup_retraces`` > 0): a
   shape changed inside the timed loop, so the number includes an XLA
   compile and the next run won't reproduce it
@@ -44,7 +48,18 @@ DEFAULT_THRESHOLDS = {
     "max_post_warmup_retraces": 0,
     # starvations per timed step before the run counts as input-bound
     "max_starvation_rate": 0.25,
+    # fractional peak-HBM growth vs last-good before the check fails
+    "hbm_growth": 0.10,
 }
+
+
+def peak_hbm_of(line: dict) -> float | None:
+    """``peak_hbm_gib`` from a bench line (top level or the ``memory``
+    sub-object) — the one accessor both the gate and the report use."""
+    v = line.get("peak_hbm_gib")
+    if v is None:
+        v = (line.get("memory") or {}).get("peak_hbm_gib")
+    return v
 
 
 def _default_store() -> str:
@@ -193,6 +208,14 @@ def evaluate(fresh: dict, baseline: dict | None, thresholds: dict | None
             check("mfu", mdrop <= th["mfu_drop"],
                   f"{mfu:.4f} vs last-good {base_mfu:.4f} "
                   f"({'-' if mdrop > 0 else '+'}{abs(mdrop) * 100:.1f}%)")
+        hbm = peak_hbm_of(fresh)
+        base_hbm = (baseline.get("extra") or {}).get("peak_hbm_gib")
+        if hbm and base_hbm:
+            growth = hbm / base_hbm - 1.0
+            check("peak_hbm", growth <= th["hbm_growth"],
+                  f"{hbm:.2f} GiB vs last-good {base_hbm:.2f} GiB "
+                  f"({'+' if growth > 0 else '-'}{abs(growth) * 100:.1f}%, "
+                  f"max growth {th['hbm_growth'] * 100:.0f}%)")
     elif not hardware:
         check("hardware", True,
               "cpu smoke line — throughput not compared to the TPU record")
@@ -246,6 +269,9 @@ def main(argv=None) -> int:
     ap.add_argument("--max-starvation-rate", type=float,
                     default=DEFAULT_THRESHOLDS["max_starvation_rate"],
                     help="max prefetch starvations per step (default 0.25)")
+    ap.add_argument("--hbm-growth", type=float,
+                    default=DEFAULT_THRESHOLDS["hbm_growth"],
+                    help="max fractional peak-HBM growth (default 0.10)")
     ap.add_argument("--require-baseline", action="store_true",
                     help="fail when the store has no last-good hardware "
                          "record for the metric")
@@ -270,7 +296,8 @@ def main(argv=None) -> int:
         fresh, baseline,
         thresholds={"throughput_drop": args.throughput_drop,
                     "mfu_drop": args.mfu_drop,
-                    "max_starvation_rate": args.max_starvation_rate},
+                    "max_starvation_rate": args.max_starvation_rate,
+                    "hbm_growth": args.hbm_growth},
         hardware=hardware)
     if args.require_baseline and baseline is None:
         verdict["ok"] = False
