@@ -13,15 +13,24 @@ Two surfaces this repo depends on changed addresses across jax versions:
   ``export`` name below is the resolved module (falling back to
   ``jax.experimental.export`` on trees that predate the move).
 
+- executable serialization: ``jax.experimental.serialize_executable``
+  (pickle-able AOT-compiled executables — the on-disk tier of
+  ``jit/exec_cache.py``) has lived at the same address for a while but is
+  experimental; :func:`serialize_executable` /
+  :func:`deserialize_executable` below are the one indirection point for
+  when it moves.
+
 Callers (``distributed/collective.py``, ``ops/ring_attention.py``,
-``jit/__init__.py``) import from here instead of touching ``jax.*``
-directly, so a jax upgrade needs exactly one file to change.
+``jit/__init__.py``, ``jit/exec_cache.py``) import from here instead of
+touching ``jax.*`` directly, so a jax upgrade needs exactly one file to
+change.
 """
 from __future__ import annotations
 
 import jax
 
-__all__ = ["shard_map", "export", "pvary", "tpu_compiler_params"]
+__all__ = ["shard_map", "export", "pvary", "tpu_compiler_params",
+           "serialize_executable", "deserialize_executable"]
 
 
 def tpu_compiler_params(**kwargs):
@@ -83,6 +92,26 @@ def pvary(x, axis_names):
     if pv is not None:
         return pv(x, tuple(axis_names))
     return x
+
+
+# -- executable serialization ------------------------------------------------
+
+def serialize_executable(compiled):
+    """``(payload, in_tree, out_tree)`` for a ``jax.stages.Compiled`` —
+    the persistable form of an AOT-compiled executable (lazy import: the
+    module drags in pickle glue callers may never need)."""
+    from jax.experimental import serialize_executable as _se
+
+    return _se.serialize(compiled)
+
+
+def deserialize_executable(payload, in_tree, out_tree):
+    """Rehydrate :func:`serialize_executable` output into a loaded,
+    callable executable on the current backend. Raises on any
+    payload/topology mismatch — callers treat that as a cache miss."""
+    from jax.experimental import serialize_executable as _se
+
+    return _se.deserialize_and_load(payload, in_tree, out_tree)
 
 
 # -- jax.export --------------------------------------------------------------

@@ -149,6 +149,13 @@ class Predictor:
         self._outputs: dict = {}
         self._out_names: list = []
         self._exec = self._build_executable()
+        # AOT executable for the exported static signature, via the
+        # process-wide exec cache (jit/exec_cache.py): a warm
+        # PT_EXEC_CACHE start deserializes instead of recompiling — the
+        # server cold-start path. None when shapes are dynamic or the
+        # warmup is off; run() falls back to the jitted path then.
+        self._aot = None
+        self._aot_sig = None
         if config._warmup:
             self._warmup_compile()
 
@@ -183,6 +190,15 @@ class Predictor:
             kw["device"] = _lookup(dev)
         return jax.jit(run, **kw)
 
+    def _blob_fingerprint(self):
+        """sha256 of the exported .pdmodel bytes — the program identity
+        component of the predictor's exec-cache key (params are baked
+        into the blob, so the hash covers them too)."""
+        import hashlib
+
+        with open(self._config.prog_file(), "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+
     def _warmup_compile(self):
         shapes = [m["shape"] for m in self._meta.get("inputs", [])]
         if any(d is None for s in shapes for d in s):
@@ -190,10 +206,28 @@ class Predictor:
         zeros = [np.zeros(s, dt)
                  for s, dt in zip(shapes, self._in_dtypes)]
         try:
-            outs = self._exec(*zeros)
-            jax.block_until_ready(outs)
+            from ..jit import exec_cache
+
+            sig = tuple((tuple(int(d) for d in s), np.dtype(dt).name)
+                        for s, dt in zip(shapes, self._in_dtypes))
+            key = None
+            if exec_cache.enabled():
+                key = {"kind": "predictor",
+                       "blob": self._blob_fingerprint(),
+                       "inputs": sig,
+                       "precision": self._config._precision,
+                       "donate": bool(self._config._donate),
+                       "device": str(self._config._device),
+                       "mesh": exec_cache.mesh_spec()}
+            entry = exec_cache.get_or_compile(
+                key, lambda: self._exec.lower(*zeros), label="predictor")
+            self._aot = entry
+            self._aot_sig = sig
         except Exception:
-            pass  # warmup is best-effort; real run surfaces real errors
+            # warmup is best-effort; real runs go through the jitted
+            # fallback and surface real errors
+            self._aot = None
+            self._aot_sig = None
 
     # -- handle API --
     def get_input_names(self):
@@ -218,7 +252,22 @@ class Predictor:
             ]
         else:
             arrays = [self._inputs[n].copy_to_cpu() for n in self._in_names]
-        outs = self._exec(*arrays)
+        outs = None
+        if self._aot is not None and self._aot_sig == tuple(
+                (tuple(int(d) for d in a.shape), np.dtype(a.dtype).name)
+                for a in arrays):
+            # exact exported signature -> the AOT (possibly deserialized)
+            # executable; anything else recompiles via the jitted fallback
+            try:
+                outs = self._aot(*arrays)
+            except Exception:  # noqa: BLE001 — a deserialized artifact
+                # that loads but dies at call time must only ever cost a
+                # retry: drop to the jitted path (fresh compile) and stop
+                # retrying the broken artifact
+                self._aot = None
+                outs = None
+        if outs is None:
+            outs = self._exec(*arrays)
         outs = [np.asarray(o) for o in outs]
         self._out_names = [f"output_{i}" for i in range(len(outs))]
         self._outputs = {}

@@ -269,13 +269,114 @@ class TrainStep:
         donate = (0, 1, 2) if (self._donate and not nan_check) else ()
         return jax.jit(step_fn, donate_argnums=donate)
 
+    def _place(self, x):
+        # host-side scalars/batches join the params' mesh (replicated;
+        # multihost-safe via env.put_replicated)
+        from ..distributed import env as env_mod
+
+        e = env_mod.get_env()
+        if e is None or e.mesh.size == 1:
+            return x
+        return env_mod.put_replicated(x, e.mesh)
+
+    def _lowered_for(self, arrays, nan_check):
+        """Trace + lower the step against the CURRENT params/state/batch
+        placements — the lowering the exec cache compiles, and the one
+        whose avals every later __call__ must match (lr/step/prng are
+        runtime args; only their avals are fixed here)."""
+        jitted = self._build(None, nan_check=nan_check)
+        place = self._place
+        return jitted.lower(
+            [p._data for p in self._params],
+            self._flatten_state(),
+            [b._data for b in self._buffers],
+            place(jnp.asarray(self._opt.get_lr(), jnp.float32)),
+            place(jnp.asarray(self._step_count, jnp.int32)),
+            # only the key's aval matters for lowering; a fixed key keeps
+            # compilation free of global-PRNG side effects
+            place(jax.random.key(0)),
+            [place(a) for a in arrays],
+        )
+
+    def _cache_key(self, arrays, training, nan_check):
+        """The executable-cache fingerprint: everything the traced
+        program is a function of beyond the batch avals — model identity
+        + config scalars, param/buffer/optimizer-state avals + shardings,
+        values that get BAKED as constants (frozen params, ASP masks,
+        per-param lr factors), optimizer + loss_fn identity, the
+        donation/sentinel/training flags, and the mesh topology. Built
+        only while the cache is enabled (key=None otherwise)."""
+        from . import exec_cache as ec
+
+        model, opt = self._model, self._opt
+        params_spec, frozen = [], []
+        for name, p in model.named_parameters():
+            if p.stop_gradient:
+                # closed over at trace time -> a program constant
+                frozen.append((name, ec.array_digest(p._data)))
+                continue
+            attrs = getattr(p, "optimize_attr", None) or {}
+            params_spec.append(
+                (name, ec.array_spec(p._data),
+                 float(attrs.get("learning_rate", 1.0)),
+                 ec.freeze_attrs(getattr(p, "regularizer", None))))
+        masks = getattr(opt, "_param_masks", None) or {}
+        mask_spec = tuple(
+            (i, ec.array_digest(masks[id(p)]))
+            for i, p in enumerate(self._params) if id(p) in masks)
+        # out-of-tree model/sublayer classes are invisible to the
+        # package fingerprint — key their method bytecode explicitly so
+        # an edited forward() can never serve a stale disk artifact
+        layer_classes = {type(la) for la in (
+            model.sublayers(include_self=True)
+            if hasattr(model, "sublayers") else [model])}
+        model_code = tuple(sorted(
+            (fp for c in layer_classes if (fp := ec.fingerprint_class(c))),
+            key=repr))
+        return {
+            "kind": "train_step",
+            "model": type(model).__module__ + "." + type(model).__qualname__,
+            "model_code": model_code,
+            "config": ec.freeze_attrs(getattr(model, "config", None)),
+            "params": tuple(params_spec),
+            "frozen": tuple(frozen),
+            "buffers": tuple((n, ec.array_spec(b._data))
+                             for n, b in model.named_buffers()),
+            "state": tuple(ec.array_spec(a) for a in self._flatten_state()),
+            # id(p)-keyed runtime dicts are excluded: their keys are
+            # per-process addresses (contents are keyed elsewhere —
+            # state avals above, masks below, params by name)
+            "opt": (type(opt).__module__ + "." + type(opt).__qualname__,
+                    ec.fingerprint_class(type(opt)),
+                    ec.freeze_attrs(opt, exclude=(
+                        "_global_step", "_accumulators", "_step_counts",
+                        "_master_weights", "_param_masks",
+                        "_parameter_list")),
+                    ec.freeze_attrs(getattr(opt, "_grad_clip", None))),
+            "masks": mask_spec,
+            "loss_fn": ec.fingerprint_callable(self._loss_fn),
+            "donate": bool(self._donate),
+            "nan_check": bool(nan_check),
+            "training": bool(training),
+            # full spec (not just shape/dtype): a batch committed to a
+            # different placement is a different lowering, and the
+            # stale-placement retry relies on the key seeing that
+            "batch": tuple(ec.array_spec(a) for a in arrays),
+            "mesh": ec.mesh_spec(),
+        }
+
     def _get_compiled(self, batch):
-        """Normalize batch to arrays and return (jitted_fn, arrays,
+        """Normalize batch to arrays and return (executable, arrays,
         nan_check) from the signature cache — shared by __call__ and
         memory_analysis so the analyzed executable is the one that
-        actually runs. ``nan_check`` is returned rather than re-read by
-        the caller: it decides the executable's output arity, and the
-        global slot may flip between two reads."""
+        actually runs. A per-instance miss routes through the process-
+        wide exec cache (jit/exec_cache.py): AOT trace+lower+compile, or
+        a deserialized on-disk artifact with zero fresh XLA compiles.
+        ``nan_check`` is returned rather than re-read by the caller: it
+        decides the executable's output arity, and the global slot may
+        flip between two reads."""
+        from . import exec_cache
+
         self._ensure_state()
         arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(b)
                   for b in batch]
@@ -288,37 +389,29 @@ class TrainStep:
         if fn is None:
             if _monitor is not None:
                 _monitor.on_retrace(id(self), len(self._cache) + 1)
-            fn = self._cache[sig] = self._build(sig, nan_check=nan_check)
+            key = (self._cache_key(arrays, training, nan_check)
+                   if exec_cache.enabled() else None)
+            fn = self._cache[sig] = exec_cache.get_or_compile(
+                key, lambda: self._lowered_for(arrays, nan_check),
+                label=f"train_step/{type(self._model).__name__}")
         return fn, arrays, nan_check
 
     def __call__(self, *batch):
+        m = _monitor
+        sp = _spans
+        # span clock starts BEFORE _get_compiled: a fresh signature pays
+        # trace + XLA compile (or a cache-tier load) inside it, and that
+        # cost belongs to this call's compile span, not "other"
+        t_dispatch = time.perf_counter() if sp is not None else None
         fn, arrays, nan_check = self._get_compiled(batch)
         lr = self._opt.get_lr()
         self._step_count += 1
-
-        def place(x):
-            # host-side scalars/batches join the params' mesh (replicated;
-            # multihost-safe via env.put_replicated)
-            from ..distributed import env as env_mod
-
-            e = env_mod.get_env()
-            if e is None or e.mesh.size == 1:
-                return x
-            return env_mod.put_replicated(x, e.mesh)
-
-        m = _monitor
-        sp = _spans
-        # fresh signature: this dispatch pays trace + XLA compile; wall-time
-        # here is host-side compile cost (the call acks enqueue, so device
-        # execution is excluded on async backends)
-        t_compile = time.perf_counter() if (m is not None and
-                                            self._retraced) else None
-        t_dispatch = time.perf_counter() if sp is not None else None
-        # key split AFTER the span timestamps (it is a real device op —
+        place = self._place
+        # key split AFTER the span timestamp (it is a real device op —
         # its cost belongs in the dispatch span, not "other"); kept in a
         # local so a sentinel replay can reuse the exact key
         prng = rng.next_key()
-        outs = fn(
+        step_args = (
             [p._data for p in self._params],
             self._flatten_state(),
             [b._data for b in self._buffers],
@@ -327,6 +420,35 @@ class TrainStep:
             place(prng),
             [place(a) for a in arrays],
         )
+        try:
+            outs = fn(*step_args)
+        except Exception as e:
+            # a mid-execution failure under donation has already consumed
+            # the input buffers — retrying would mask the real error
+            # behind a secondary "array deleted"; placement-mismatch
+            # errors fail BEFORE donation, so live inputs are the test
+            dead = any(
+                getattr(a, "is_deleted", lambda: False)()
+                for part in step_args[:3] for a in part)
+            # only a stale-placement dispatch earns the retry: a device
+            # OOM or tunnel fault on a cached signature must surface
+            # as-is, not cost a second compile + re-execution and a
+            # needlessly emptied signature cache
+            msg = str(e).lower()
+            stale = any(t in msg for t in (
+                "sharding", "placement", "incompatible device",
+                "different input device", "memory kind", "committed"))
+            if self._retraced or dead or not stale:
+                raise
+            # an AOT executable freezes the placements it was lowered
+            # against; re-placed params or a mesh change since this
+            # signature was cached surface here as a sharding mismatch.
+            # jax.jit used to recompile transparently — restore that:
+            # drop the stale per-instance entries (ALL are suspect once
+            # placements moved) and retry once against current ones
+            self._cache.clear()
+            fn, _, nan_check = self._get_compiled(batch)
+            outs = fn(*step_args)
         if nan_check:
             new_params, flat_state, new_buffers, loss, finite = outs
         else:
@@ -339,8 +461,6 @@ class TrainStep:
                 sp.record("jit/trace_compile", "compile", t_dispatch)
             else:
                 sp.record("jit/step_dispatch", "dispatch", t_dispatch)
-        if t_compile is not None:
-            m.on_compile_ms((time.perf_counter() - t_compile) * 1e3)
         if m is not None and self._donate and not nan_check:
             # donated buffers are dead after the call; every param rebinds
             m.on_donation_rebind(len(self._params))
@@ -393,34 +513,14 @@ class TrainStep:
         shapes (``argument/output/temp/generated_code`` bytes, as reported
         by the executable). The HBM-footprint source of truth on platforms
         whose PJRT plugin returns no allocator stats
-        (``device.memory_stats() is None`` over the tunneled chip). Pays
-        one AOT compile — the in-process jit cache is separate. For SPMD
-        executables under a mesh the reported sizes are per-device."""
-        fn, arrays, _nan = self._get_compiled(batch)
-
-        def place(x):
-            # same mesh placement as __call__: under a mesh, lowering
-            # with single-device scalars against mesh-sharded params
-            # raises "incompatible devices"
-            from ..distributed import env as env_mod
-
-            e = env_mod.get_env()
-            if e is None or e.mesh.size == 1:
-                return x
-            return env_mod.put_replicated(x, e.mesh)
-
-        lowered = fn.lower(
-            [p._data for p in self._params],
-            self._flatten_state(),
-            [b._data for b in self._buffers],
-            place(jnp.asarray(self._opt.get_lr(), jnp.float32)),
-            place(jnp.asarray(self._step_count, jnp.int32)),
-            # only the key's aval matters for lowering; a fixed key keeps
-            # this introspection free of global-PRNG side effects
-            place(jax.random.key(0)),
-            [place(a) for a in arrays],
-        )
-        return lowered.compile().memory_analysis()
+        (``device.memory_stats() is None`` over the tunneled chip).
+        Served from the same executable cache __call__ runs — an
+        already-stepped signature is accounted for FREE (no second AOT
+        compile), and so is a warm ``PT_EXEC_CACHE`` start: deserialized
+        executables keep their ``memory_analysis``. For SPMD executables
+        under a mesh the reported sizes are per-device."""
+        fn, _arrays, _nan = self._get_compiled(batch)
+        return fn.memory_analysis()
 
 
 class AsyncStepper:

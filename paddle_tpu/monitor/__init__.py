@@ -51,6 +51,7 @@ __all__ = [
 INSTRUMENTED_MODULES = (
     "paddle_tpu.ops.dispatch",
     "paddle_tpu.jit.train_step",
+    "paddle_tpu.jit.exec_cache",
     "paddle_tpu.utils.timing",
     "paddle_tpu.distributed.collective",
     "paddle_tpu.framework.random",
@@ -102,6 +103,15 @@ _c_host_syncs = _registry.counter("hapi/host_syncs")
 # hapi/host_syncs guard counter so the ≤1-extra-per-step bound is provable
 _c_nan_checks = _registry.counter("numerics/checks")
 _c_nan_failures = _registry.counter("numerics/failures")
+# AOT executable cache (jit/exec_cache.py): hits span both tiers;
+# deserialize/serialize time is the disk tier's cost, saved_ms the
+# compile wall-time a disk hit avoided (the original build's measured
+# compile_ms, carried inside the artifact)
+_c_exec_hit = _registry.counter("jit/exec_cache_hit")
+_c_exec_miss = _registry.counter("jit/exec_cache_miss")
+_h_exec_deserialize_ms = _registry.histogram("jit/exec_cache_deserialize_ms")
+_h_exec_serialize_ms = _registry.histogram("jit/exec_cache_serialize_ms")
+_h_exec_saved_ms = _registry.histogram("jit/exec_cache_saved_ms")
 
 
 # -- public metric access ----------------------------------------------------
@@ -380,6 +390,27 @@ def on_nan_check() -> None:
 
 def on_nan_failure() -> None:
     _c_nan_failures.inc()
+
+
+def on_exec_cache_hit(tier: str, saved_ms: float | None = None) -> None:
+    """The executable cache served a compiled executable without an XLA
+    compile; ``tier`` is ``"mem"`` or ``"disk"``. ``saved_ms`` (disk
+    hits) is the original build's compile wall-time the hit avoided."""
+    _c_exec_hit.inc()
+    if saved_ms:
+        _h_exec_saved_ms.observe(saved_ms)
+
+
+def on_exec_cache_miss() -> None:
+    _c_exec_miss.inc()
+
+
+def on_exec_cache_deserialize_ms(ms: float) -> None:
+    _h_exec_deserialize_ms.observe(ms)
+
+
+def on_exec_cache_serialize_ms(ms: float) -> None:
+    _h_exec_serialize_ms.observe(ms)
 
 
 from . import memory  # noqa: E402  — device memory observatory

@@ -1,0 +1,671 @@
+"""AOT executable cache (`paddle_tpu/jit/exec_cache.py`) tests.
+
+The acceptance proof is the two-process test: a cold process with
+``PT_EXEC_CACHE`` compiles + serializes the TrainStep executable, a warm
+process deserializes it with ZERO fresh XLA compiles (``jit/compiles``
+stays 0, ``jit/exec_cache_hit`` fires) and produces bitwise-identical
+losses and post-step parameters. The in-process tests cover the tier
+mechanics: mem-tier sharing across TrainStep instances, disk-tier
+round-trip, key distinctness (nan_check / donation / batch / mesh /
+loss_fn), graceful fallback on corrupted or version-skewed artifacts,
+and the zero-overhead-off contract (the module is in
+``monitor.INSTRUMENTED_MODULES``; the parametrized audit in
+tests/test_memory_numerics.py covers import-time inertness).
+"""
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import monitor, nn
+from paddle_tpu.jit import exec_cache
+from paddle_tpu.jit.train_step import TrainStep
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    """Arm the cache at a fresh tmp dir; restore the prior state after."""
+    prev = exec_cache.cache_dir()
+    exec_cache.clear()
+    d = str(tmp_path / "ptxc")
+    exec_cache.enable(d)
+    yield d
+    if prev is None:
+        exec_cache.disable()
+    else:
+        exec_cache.enable(prev)
+    exec_cache.clear()
+
+
+class TinyModel(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 8)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+# ONE loss fn shared by every step in this module: identical-code lambdas
+# fingerprint equal, so sharing it makes cross-instance hits explicit
+def _mse(m, x, y):
+    return ((m(x) - y) ** 2).mean()
+
+
+def _build_step(donate=False, nan_check=None):
+    pt.seed(77)
+    np.random.seed(77)
+    model = TinyModel()
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    return model, TrainStep(model, opt, _mse, donate=donate,
+                            nan_check=nan_check)
+
+
+def _batch():
+    x = pt.to_tensor(np.random.RandomState(3).randn(4, 8).astype("float32"))
+    y = pt.to_tensor(np.random.RandomState(4).randn(4, 8).astype("float32"))
+    return x, y
+
+
+# -- two-process warm start (the acceptance criterion) -----------------------
+
+def _run_worker(cache_d):
+    env = dict(os.environ)
+    env["PT_EXEC_CACHE"] = cache_d
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tests",
+                                      "exec_cache_worker.py")],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert p.returncode == 0, p.stderr
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
+def test_two_process_warm_start(tmp_path):
+    cache_d = str(tmp_path / "ptxc")
+    cold = _run_worker(cache_d)
+    warm = _run_worker(cache_d)
+
+    # cold: a real XLA compile happened and was serialized to disk
+    assert cold["counters"].get("jit/compiles", 0) >= 1
+    assert cold["counters"].get("jit/exec_cache_miss", 0) >= 1
+    assert cold["exec_cache"]["misses"] >= 1
+    assert cold["exec_cache"]["serialized"] >= 1
+    assert any(f.endswith(".ptxc") for f in os.listdir(cache_d))
+
+    # warm: ZERO fresh XLA compiles — the disk tier served the executable
+    assert warm["counters"].get("jit/compiles", 0) == 0
+    assert warm["counters"].get("jit/exec_cache_hit", 0) >= 1
+    assert warm["exec_cache"]["disk_hits"] >= 1
+    assert warm["exec_cache"]["misses"] == 0
+    assert warm["exec_cache"]["compile_ms_saved"] > 0
+
+    # identical numerics: losses and post-step params are bitwise equal
+    assert cold["losses"] == warm["losses"]
+    assert cold["param_digest"] == warm["param_digest"]
+
+
+# -- tier mechanics ----------------------------------------------------------
+
+def test_mem_tier_shared_across_instances(cache_dir):
+    _, step1 = _build_step()
+    x, y = _batch()
+    l1 = float(step1(x, y).numpy())
+    assert exec_cache.stats()["misses"] == 1
+
+    _, step2 = _build_step()  # same avals/config/loss -> same key
+    l2 = float(step2(x, y).numpy())
+    st = exec_cache.stats()
+    assert st["mem_hits"] == 1 and st["misses"] == 1
+    assert l1 == l2  # identical seeds -> identical params -> same loss
+
+
+def test_disk_tier_roundtrip_in_process(cache_dir):
+    _, step1 = _build_step()
+    x, y = _batch()
+    l1 = float(step1(x, y).numpy())
+    files = [f for f in os.listdir(cache_dir) if f.endswith(".ptxc")]
+    assert len(files) == 1
+
+    exec_cache.clear()  # drop the mem tier; the artifact stays on disk
+    _, step2 = _build_step()
+    l2 = float(step2(x, y).numpy())
+    st = exec_cache.stats()
+    assert st["disk_hits"] == 1 and st["misses"] == 0
+    assert st["compile_ms_saved"] > 0
+    assert l1 == l2
+
+
+def test_corrupted_artifact_falls_back_to_compile(cache_dir):
+    _, step1 = _build_step()
+    x, y = _batch()
+    l1 = float(step1(x, y).numpy())
+    (path,) = [os.path.join(cache_dir, f) for f in os.listdir(cache_dir)
+               if f.endswith(".ptxc")]
+    with open(path, "wb") as f:
+        f.write(b"not a pickle, definitely not an executable")
+
+    exec_cache.clear()
+    _, step2 = _build_step()
+    l2 = float(step2(x, y).numpy())
+    st = exec_cache.stats()
+    assert st["errors"] >= 1 and st["misses"] == 1 and st["disk_hits"] == 0
+    assert l1 == l2  # fresh compile, same program
+    # the bad artifact was replaced by a good one
+    with open(path, "rb") as f:
+        assert pickle.load(f)["format"] == exec_cache.FORMAT
+
+
+def test_version_skew_falls_back_to_compile(cache_dir):
+    _, step1 = _build_step()
+    x, y = _batch()
+    float(step1(x, y).numpy())
+    (path,) = [os.path.join(cache_dir, f) for f in os.listdir(cache_dir)
+               if f.endswith(".ptxc")]
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    blob["format"] = exec_cache.FORMAT + 999  # a future layout
+    with open(path, "wb") as f:
+        pickle.dump(blob, f)
+
+    exec_cache.clear()
+    _, step2 = _build_step()
+    assert np.isfinite(float(step2(x, y).numpy()))
+    st = exec_cache.stats()
+    assert st["errors"] >= 1 and st["misses"] == 1 and st["disk_hits"] == 0
+
+
+def test_mem_tier_lru_bound(cache_dir, monkeypatch):
+    """The mem tier evicts least-recently-used past _MAX_MEM_ENTRIES;
+    callers hold their own entry references, so an evicted executable
+    keeps working through them."""
+    monkeypatch.setattr(exec_cache, "_MAX_MEM_ENTRIES", 2)
+    _, step = _build_step()
+    x, y = _batch()
+    l1 = float(step(x, y).numpy())  # real entry, pinned by step._cache
+
+    exec_cache._mem_put("k2", object())
+    exec_cache._mem_hit(next(iter(exec_cache._mem)))  # touch oldest -> MRU
+    exec_cache._mem_put("k3", object())  # evicts k2, the true LRU
+    assert exec_cache.stats()["mem_entries"] == 2
+    assert "k2" not in exec_cache._mem and "k3" in exec_cache._mem
+
+    exec_cache._mem_put("k4", object())  # now the real entry is LRU: gone
+    assert len(exec_cache._mem) == 2
+    # the evicted executable still runs via the TrainStep's own reference
+    l2 = float(step(x, y).numpy())
+    assert np.isfinite(l2) and l2 < l1  # second SGD step, loss decreases
+
+
+def test_monitor_counters_fire_on_tiers(cache_dir):
+    was_enabled = monitor.enabled()
+    monitor.enable()
+    try:
+        monitor.reset()
+        _, step1 = _build_step()
+        x, y = _batch()
+        step1(x, y)
+        c = monitor.snapshot()["counters"]
+        assert c.get("jit/exec_cache_miss", 0) == 1
+        assert c.get("jit/compiles", 0) == 1
+
+        exec_cache.clear()
+        monitor.reset()
+        _, step2 = _build_step()
+        step2(x, y)
+        snap = monitor.snapshot()
+        assert snap["counters"].get("jit/exec_cache_hit", 0) == 1
+        assert snap["counters"].get("jit/compiles", 0) == 0
+        assert snap["histograms"][
+            "jit/exec_cache_deserialize_ms"]["count"] == 1
+        assert snap["histograms"]["jit/exec_cache_saved_ms"]["count"] == 1
+    finally:
+        monitor.reset()
+        if not was_enabled:
+            monitor.disable()
+
+
+def test_memory_analysis_served_from_cache(cache_dir):
+    _, step = _build_step()
+    x, y = _batch()
+    step(x, y)
+    misses = exec_cache.stats()["misses"]
+    ma = step.memory_analysis(x, y)  # same signature -> no new compile
+    assert exec_cache.stats()["misses"] == misses
+    assert ma.temp_size_in_bytes >= 0
+
+
+def test_predictor_warmup_uses_cache(cache_dir, tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.jit import InputSpec, save
+
+    pt.seed(5)
+    net = TinyModel()
+    path = str(tmp_path / "net")
+    save(net, path, input_spec=[InputSpec([2, 8], "float32", "x")])
+    x = np.random.RandomState(0).randn(2, 8).astype("float32")
+    ref = net(pt.to_tensor(x)).numpy()
+
+    pred = create_predictor(Config(path))
+    assert exec_cache.stats()["misses"] == 1
+    assert pred._aot is not None  # warmup AOT-compiled via the cache
+    np.testing.assert_allclose(pred.run([x])[0], ref, atol=1e-5)
+
+    # a second predictor over the same exported blob: mem-tier hit
+    pred2 = create_predictor(Config(path))
+    assert exec_cache.stats()["mem_hits"] == 1
+    np.testing.assert_allclose(pred2.run([x])[0], ref, atol=1e-5)
+
+
+# -- key anatomy -------------------------------------------------------------
+
+def test_key_distinct_on_flags_and_shapes(cache_dir):
+    _, step = _build_step()
+    x, y = _batch()
+    arrays = [x._data, y._data]
+    base = step._cache_key(arrays, True, False)
+    h = exec_cache.key_hash
+
+    assert h(base)[1] == h(step._cache_key(arrays, True, False))[1]
+    # nan_check changes output arity; donation changes buffer aliasing;
+    # training mode and batch avals change the traced program
+    assert h(base)[1] != h(step._cache_key(arrays, True, True))[1]
+    assert h(base)[1] != h(step._cache_key(arrays, False, False))[1]
+    small = [a[:2] for a in arrays]
+    assert h(base)[1] != h(step._cache_key(small, True, False))[1]
+
+    _, donated = _build_step(donate=True)
+    assert (h(base)[1]
+            != h(donated._cache_key(arrays, True, False))[1])
+
+    # partitioned executables are topology-specific
+    meshed = dict(base, mesh=(("dp",), (8,)))
+    assert h(base)[1] != h(meshed)[1]
+
+    # a different loss fn is a different traced program
+    other = dict(base, loss_fn=exec_cache.fingerprint_callable(
+        lambda m, x, y: ((m(x) - y) ** 2).sum()))
+    assert h(base)[1] != h(other)[1]
+
+
+def test_key_folds_in_codegen_config():
+    """A matmul-precision (or x64) flip compiles a different program for
+    the same caller key — conftest pins 'highest', bench doesn't; they
+    must never share artifacts."""
+    import jax
+
+    base = exec_cache.key_hash({"k": 1})[1]
+    prev = jax.config.jax_default_matmul_precision
+    jax.config.update("jax_default_matmul_precision", "bfloat16")
+    try:
+        assert exec_cache.key_hash({"k": 1})[1] != base
+    finally:
+        jax.config.update("jax_default_matmul_precision", prev)
+    assert exec_cache.key_hash({"k": 1})[1] == base
+
+
+def test_freeze_strips_addresses():
+    """Unknown objects in a key must not embed 'at 0x...' addresses —
+    they'd flip the disk-tier hash every process."""
+    class Opaque:
+        pass
+
+    frozen = exec_cache._freeze({"obj": Opaque(), "n": 1})
+    assert "0x" not in repr(frozen)
+
+
+def test_disk_tier_prunes_oldest(cache_dir, monkeypatch):
+    monkeypatch.setattr(exec_cache, "_MAX_DISK_ENTRIES", 3)
+    os.makedirs(cache_dir, exist_ok=True)
+    for i in range(6):
+        p = os.path.join(cache_dir, f"{i:032x}.ptxc")
+        with open(p, "wb") as f:
+            f.write(b"x")
+        os.utime(p, (i, i))  # staggered mtimes: 0 oldest
+    exec_cache._prune_disk()
+    left = sorted(os.listdir(cache_dir))
+    assert len(left) == 3
+    assert left == [f"{i:032x}.ptxc" for i in (3, 4, 5)]
+
+
+def test_key_hash_canonicalizes_dict_order():
+    a = {"x": 1, "y": (2, 3), "z": {"k": "v"}}
+    b = {"z": {"k": "v"}, "y": [2, 3], "x": 1}  # list/tuple freeze equal
+    assert exec_cache.key_hash(a)[1] == exec_cache.key_hash(b)[1]
+    assert (exec_cache.key_hash(a)[1]
+            != exec_cache.key_hash(dict(a, x=2))[1])
+
+
+def test_fingerprint_callable_sees_consts_and_closures():
+    fp = exec_cache.fingerprint_callable
+    f1 = lambda v: v * 2  # noqa: E731
+    f2 = lambda v: v * 2  # noqa: E731 — same code, same fingerprint
+    f3 = lambda v: v * 3  # noqa: E731
+    assert fp(f1) == fp(f2)
+    assert fp(f1) != fp(f3)
+
+    def outer(scale):
+        return lambda v: v * scale
+
+    assert fp(outer(2.0)) != fp(outer(3.0))  # closure scalar is keyed
+
+
+def test_fingerprint_bound_methods_and_arrays():
+    """Trace-time constants beyond bytecode must re-key: bound-method
+    instance scalars, __call__-object attrs, and closed-over array
+    CONTENTS (all baked into the compiled program)."""
+    fp = exec_cache.fingerprint_callable
+
+    class Loss:
+        def __init__(self, weight):
+            self.weight = weight
+
+        def compute(self, v):
+            return v * self.weight
+
+        def __call__(self, v):
+            return v * self.weight
+
+    assert fp(Loss(0.5).compute) != fp(Loss(2.0).compute)
+    assert fp(Loss(0.5).compute) == fp(Loss(0.5).compute)
+    assert fp(Loss(0.5)) != fp(Loss(2.0))  # __call__ object
+
+    def closing_over(arr):
+        return lambda v: v + arr
+
+    a = np.zeros(4, np.float32)
+    b = np.ones(4, np.float32)  # same shape/dtype, different contents
+    assert fp(closing_over(a)) != fp(closing_over(b))
+    assert fp(closing_over(a)) == fp(closing_over(a.copy()))
+
+    # a recursive lambda closing over itself must not hang
+    fact = None
+    fact = lambda n: 1 if n == 0 else n * fact(n - 1)  # noqa: E731
+    assert fp(fact)
+
+
+def test_fingerprint_nested_lambda_stable():
+    """repr() of a code object embeds its memory address; nested code in
+    co_consts must hash structurally or the disk-tier key flips every
+    process (and even between two definitions in one process)."""
+    fp = exec_cache.fingerprint_callable
+
+    def build(src):
+        ns = {}
+        exec(compile(src, "<fp>", "exec"), ns)  # noqa: S102 — fresh code
+        return ns["f"]                          # object every call
+
+    src2 = "f = lambda v: (lambda u: u * 2)(v)"
+    src3 = "f = lambda v: (lambda u: u * 3)(v)"
+    assert fp(build(src2)) == fp(build(src2))  # distinct objects, same code
+    assert fp(build(src2)) != fp(build(src3))
+
+
+def test_fingerprint_keys_callable_instance_state():
+    """A bound method (or __call__ object) reading a callable attr bakes
+    that callable's program in — hapi's Model._loss_fn reads self._loss;
+    two Models differing only in loss layer must not collide."""
+    fp = exec_cache.fingerprint_callable
+
+    class SquaredError:
+        def __call__(self, d):
+            return d * d
+
+    class AbsError:
+        def __call__(self, d):
+            return abs(d)
+
+    class ModelLike:
+        def __init__(self, loss):
+            self._loss = loss
+
+        def loss_fn(self, net, x, y):
+            return self._loss(net(x) - y)
+
+    a = ModelLike(SquaredError())
+    b = ModelLike(AbsError())
+    assert fp(a.loss_fn) != fp(b.loss_fn)
+    assert fp(a.loss_fn) == fp(ModelLike(SquaredError()).loss_fn)
+
+    # hyperparams living in a container attr (nn losses keep theirs in
+    # self._args) are program identity too
+    assert (fp(nn.CrossEntropyLoss())
+            != fp(nn.CrossEntropyLoss(label_smoothing=0.3)))
+    assert fp(nn.CrossEntropyLoss()) == fp(nn.CrossEntropyLoss())
+
+
+def test_fingerprint_defaults_and_partials():
+    """Argument defaults and functools.partial bindings are trace-time
+    constants exactly like closure cells: the hyperparam-sweep idioms
+    ``lambda m,x,y,w=w: ...`` and ``partial(loss, alpha=...)`` must not
+    share a key (they compile different programs)."""
+    import functools
+
+    fp = exec_cache.fingerprint_callable
+
+    fns = [(lambda m, x, y, w=w: w) for w in (0.1, 0.2)]  # noqa: E731
+    assert fp(fns[0]) != fp(fns[1])
+
+    def kw_only(m, x, y, *, alpha=0.1):
+        return alpha
+
+    def kw_only2(m, x, y, *, alpha=0.2):
+        return alpha
+
+    assert fp(kw_only) != fp(kw_only2)
+
+    def base(m, x, y, alpha):
+        return alpha
+
+    def other(m, x, y, alpha):
+        return -alpha
+
+    assert (fp(functools.partial(base, alpha=0.1))
+            != fp(functools.partial(base, alpha=0.2)))
+    assert (fp(functools.partial(base, alpha=0.1))
+            != fp(functools.partial(other, alpha=0.1)))
+    assert (fp(functools.partial(base, 0.5))
+            != fp(functools.partial(base, 0.7)))
+    # distinct partial objects over the same binding hash equal (the
+    # disk tier needs cross-process stability)
+    assert (fp(functools.partial(base, alpha=0.1))
+            == fp(functools.partial(base, alpha=0.1)))
+
+
+def test_fingerprint_class_keys_out_of_tree_model_code():
+    """The package size+mtime walk can't see a user's model.py; an
+    edited out-of-tree forward() must invalidate through the key, while
+    in-package classes contribute nothing (already covered)."""
+    fpc = exec_cache.fingerprint_class
+
+    def fwd2(self, x):
+        return x * 2
+
+    def fwd3(self, x):
+        return x * 3
+
+    a = type("UserModel", (), {"forward": fwd2})
+    b = type("UserModel", (), {"forward": fwd3})  # same name, new code
+    assert fpc(a) != fpc(b)
+    assert fpc(a) == fpc(type("UserModel", (), {"forward": fwd2}))
+    assert fpc(nn.CrossEntropyLoss) == ()  # in-package: package-walk's job
+
+    # and the TrainStep key carries it: this test module is out-of-tree,
+    # so TinyModel's (and its Linear sublayers' — in-tree, empty) code
+    # lands in the key
+    _, step = _build_step()
+    x, y = _batch()
+    key = step._cache_key([x._data, y._data], True, False)
+    assert key["model_code"]
+    assert any("TinyModel" in repr(fp) for fp in key["model_code"])
+
+
+def test_trainstep_retries_stale_placement_entry():
+    """An AOT executable freezes placements; a per-instance signature
+    hit whose dispatch fails (params re-placed / mesh changed) must be
+    evicted and recompiled — what jax.jit did transparently."""
+    _, step = _build_step()
+    x, y = _batch()
+    l1 = float(step(x, y).numpy())
+
+    class Raises:
+        def __call__(self, *a):
+            raise ValueError("sharding mismatch (simulated)")
+
+    (sig,) = step._cache
+    step._cache[sig] = Raises()
+    l2 = float(step(x, y).numpy())  # evict + recompile, not a crash
+    assert np.isfinite(l2)
+    assert not isinstance(step._cache[sig], Raises)
+
+
+def test_trainstep_no_retry_on_non_placement_error():
+    """Only a stale-placement dispatch earns the evict+recompile retry:
+    a device OOM (or any other runtime fault) must surface as-is — not
+    cost a full recompile, a re-execution of the failing step, and the
+    rest of the signature cache."""
+    _, step = _build_step()
+    x, y = _batch()
+    step(x, y)
+
+    class Raises:
+        def __call__(self, *a):
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory while trying to "
+                "allocate 1234 bytes")
+
+    (sig,) = step._cache
+    step._cache[sig] = Raises()
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        step(x, y)
+    assert isinstance(step._cache[sig], Raises)  # no blanket eviction
+
+
+def test_predictor_falls_back_on_broken_aot(tmp_path):
+    """A deserialized artifact that loads but dies at call time costs a
+    retry through the jitted path, never a serving crash."""
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.jit import InputSpec, save
+
+    pt.seed(5)
+    net = TinyModel()
+    path = str(tmp_path / "net")
+    save(net, path, input_spec=[InputSpec([2, 8], "float32", "x")])
+    x = np.random.RandomState(0).randn(2, 8).astype("float32")
+    ref = net(pt.to_tensor(x)).numpy()
+
+    pred = create_predictor(Config(path))
+
+    class Broken:
+        def __call__(self, *a):
+            raise RuntimeError("Symbols not found (simulated)")
+
+    pred._aot = Broken()
+    pred._aot_sig = tuple((tuple(int(d) for d in x.shape),
+                           np.dtype(x.dtype).name) for x in [x])
+    np.testing.assert_allclose(pred.run([x])[0], ref, atol=1e-5)
+    assert pred._aot is None  # the broken artifact is not retried
+
+
+def test_array_digest_memoized_per_object():
+    a = np.arange(8, dtype=np.float32)
+    d1 = exec_cache.array_digest(a)
+    assert exec_cache._digest_memo[id(a)][2] == d1
+    assert exec_cache.array_digest(a) == d1  # served from the memo
+    # same contents, different object: same digest either way
+    assert exec_cache.array_digest(a.copy()) == d1
+    a2 = a + 1
+    assert exec_cache.array_digest(a2) != d1
+
+
+# -- off-is-free contract ----------------------------------------------------
+
+def test_module_is_audited():
+    assert "paddle_tpu.jit.exec_cache" in monitor.INSTRUMENTED_MODULES
+
+
+def test_disabled_cache_builds_no_keys_and_stores_nothing(tmp_path):
+    prev = exec_cache.cache_dir()
+    exec_cache.disable()
+    exec_cache.clear()
+    try:
+        assert not exec_cache.enabled()
+        _, step = _build_step()
+        x, y = _batch()
+        assert np.isfinite(float(step(x, y).numpy()))
+        st = exec_cache.stats()
+        assert (st["misses"] == st["mem_hits"] == st["disk_hits"]
+                == st["serialized"] == 0)
+        assert st["mem_entries"] == 0
+    finally:
+        if prev is not None:
+            exec_cache.enable(prev)
+
+
+def test_monitor_slot_none_when_off():
+    was_enabled = monitor.enabled()
+    monitor.disable()
+    try:
+        assert exec_cache._monitor is None
+    finally:
+        if was_enabled:
+            monitor.enable()
+
+
+# -- report rendering --------------------------------------------------------
+
+def test_monitor_report_renders_cache_section(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "monitor_report", os.path.join(_ROOT, "tools", "monitor_report.py"))
+    report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report)
+
+    jsonl = tmp_path / "run.jsonl"
+    jsonl.write_text(json.dumps({
+        "event": "run_end", "wall_s": 1.0,
+        "totals": {
+            "counters": {"jit/exec_cache_hit": 3, "jit/exec_cache_miss": 1},
+            "histograms": {"jit/exec_cache_saved_ms": {
+                "count": 2, "sum": 4200.0, "mean": 2100.0,
+                "p50": 2000.0, "p95": 2200.0, "max": 2200.0}},
+        }}) + "\n")
+    out = report.render(str(jsonl))
+    assert "exec cache" in out
+    assert "hit rate 0.75" in out
+    assert "4200" in out
+
+    bench = tmp_path / "bench.log"
+    bench.write_text(json.dumps({
+        "metric": "m", "value": 1.0, "telemetry": {
+            "compile_ms_total": 12.5, "compile_count": 1,
+            "exec_cache": {"mem_hits": 0, "disk_hits": 2, "misses": 0,
+                           "serialized": 0, "errors": 0,
+                           "compile_ms_saved": 880.0, "enabled": True,
+                           "dir": "/tmp/x", "mem_entries": 2}}}) + "\n")
+    out = report.render(str(jsonl), bench_path=str(bench))
+    assert "exec cache (AOT executables) (bench)" in out
+    assert "compile ms paid this run: 12.5" in out
+    assert "880" in out
+
+    # a cache-off line (monitor on, no exec_cache traffic) still renders
+    # the compile-cost line — the cold-vs-warm A/B needs it
+    off = tmp_path / "bench_off.log"
+    off.write_text(json.dumps({
+        "metric": "m", "value": 1.0, "telemetry": {
+            "compile_ms_total": 5064.0, "compile_count": 2}}) + "\n")
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps({
+        "event": "run_end", "wall_s": 1.0,
+        "totals": {"counters": {}, "histograms": {}}}) + "\n")
+    out = report.render(str(empty), bench_path=str(off))
+    assert "compile ms paid this run: 5064.0" in out

@@ -90,6 +90,73 @@ class TestEvaluate:
         assert any(c["name"] == "starvation" and not c["ok"]
                    for c in v["checks"])
 
+    def test_flags_cold_compile_regression(self, guard, store):
+        # baseline recorded a warm exec-cache start (~1s of compiles);
+        # the fresh run paid a full cold compile — the cache regressed
+        base = dict(guard.last_good(store, _METRIC))
+        base["extra"] = {**base["extra"], "compile_ms_total": 1000.0}
+        v = guard.evaluate(_fresh(compile_ms_total=90000.0), base,
+                           hardware=True)
+        assert not v["ok"]
+        fail = next(c for c in v["checks"]
+                    if c["name"] == "compile_ms" and not c["ok"])
+        assert "exec cache" in fail["detail"]
+
+    def test_compile_growth_within_slack_passes(self, guard, store):
+        base = dict(guard.last_good(store, _METRIC))
+        base["extra"] = {**base["extra"], "compile_ms_total": 100.0}
+        # 10x growth but only +900 ms absolute: inside the slack — small
+        # compile times are too noisy to gate fractionally
+        v = guard.evaluate(_fresh(compile_ms_total=1000.0), base,
+                           hardware=True)
+        assert v["ok"]
+        assert any(c["name"] == "compile_ms" and c["ok"]
+                   for c in v["checks"])
+        # modest fractional growth over a big baseline also passes
+        base["extra"]["compile_ms_total"] = 80000.0
+        assert guard.evaluate(_fresh(compile_ms_total=90000.0), base,
+                              hardware=True)["ok"]
+
+    def test_zero_warm_baseline_still_gates(self, guard, store):
+        # a warm exec-cache run persists compile_ms_total = 0.0; a later
+        # cold start past the slack must still fail (0.0 is presence,
+        # not absence — the gate's whole point)
+        base = dict(guard.last_good(store, _METRIC))
+        base["extra"] = {**base["extra"], "compile_ms_total": 0.0}
+        v = guard.evaluate(_fresh(compile_ms_total=90000.0), base,
+                           hardware=True)
+        assert not v["ok"]
+        assert any(c["name"] == "compile_ms" and not c["ok"]
+                   for c in v["checks"])
+        # a warm fresh run vs the warm baseline passes
+        assert guard.evaluate(_fresh(compile_ms_total=0.0), base,
+                              hardware=True)["ok"]
+
+    def test_compile_gate_skips_on_cache_state_mismatch(self, guard, store):
+        # cache-on vs cache-off is an A/B dimension: a cache-off run
+        # (no telemetry.exec_cache) judged against a warm-cache 0 ms
+        # baseline is not a regression — the knob was just unset
+        base = dict(guard.last_good(store, _METRIC))
+        base["extra"] = {**base["extra"], "compile_ms_total": 0.0,
+                         "exec_cache_enabled": True}
+        v = guard.evaluate(_fresh(compile_ms_total=5000.0), base,
+                           hardware=True)
+        assert v["ok"]
+        assert not any(c["name"] == "compile_ms" for c in v["checks"])
+        # matching states still gate
+        fresh = _fresh(compile_ms_total=5000.0,
+                       exec_cache={"disk_hits": 0, "misses": 1})
+        v = guard.evaluate(fresh, base, hardware=True)
+        assert not v["ok"]
+        assert any(c["name"] == "compile_ms" and not c["ok"]
+                   for c in v["checks"])
+
+    def test_no_compile_baseline_skips_gate(self, guard, store):
+        v = guard.evaluate(_fresh(compile_ms_total=90000.0),
+                           guard.last_good(store, _METRIC), hardware=True)
+        assert v["ok"]
+        assert not any(c["name"] == "compile_ms" for c in v["checks"])
+
     def test_flags_error_line(self, guard, store):
         fresh = {"metric": _METRIC, "value": 0.0, "unit": "tokens/s",
                  "error": "bench watchdog fired"}

@@ -21,6 +21,12 @@ optimizer state, batch, and every XLA temporary live during the step —
 the high-water mark that has to fit. Host-side RAM is used to
 materialize parameters for lowering; the device never runs.
 
+With ``PT_EXEC_CACHE=<dir>`` in the environment (or ``--exec-cache``),
+candidate executables come from the AOT executable cache
+(``paddle_tpu/jit/exec_cache.py``): a repeated sweep — the planner's
+normal usage — deserializes every already-seen candidate instead of
+recompiling it, and each row says which (``exec_cache: hit|miss``).
+
 Exit code: 0 when at least one candidate fits, 3 when none do, 2 on
 setup errors — so a driver can gate a launch on the verdict.
 """
@@ -110,10 +116,18 @@ def plan_one(cand: dict, args) -> dict:
         step = TrainStep(model, opt, lambda m, i, l: m(i, l))
         ids = pt.to_tensor(np.random.randint(
             0, cfg.vocab_size, (batch, args.seq)))
+        from paddle_tpu.jit import exec_cache
+
+        hits_before = (exec_cache.stats()["mem_hits"]
+                       + exec_cache.stats()["disk_hits"])
         rec = memobs.executable_record(step, ids, ids, name=label)
         rec.update(cand)
         rec["label"] = label
         rec["fits"] = rec["peak_bytes"] <= args.hbm_gb * 2**30
+        if exec_cache.enabled():
+            st = exec_cache.stats()
+            rec["exec_cache"] = ("hit" if st["mem_hits"] + st["disk_hits"]
+                                 > hits_before else "miss")
         return rec
     finally:
         env_mod.reset_env()
@@ -185,6 +199,11 @@ def build_argparser() -> argparse.ArgumentParser:
                     help="tiny model + 3 mesh candidates (CI smoke)")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON line with the rows as well")
+    ap.add_argument("--exec-cache", default=None, metavar="DIR",
+                    help="AOT executable cache dir for the candidate "
+                         "compiles (default: inherit PT_EXEC_CACHE) — a "
+                         "repeated sweep then deserializes instead of "
+                         "recompiling every (dp×mp, batch) candidate")
     return ap
 
 
@@ -204,6 +223,12 @@ def main(argv=None) -> int:
         env = dict(os.environ)
         env["_PT_PLANNER_CHILD"] = "1"
         env["JAX_PLATFORMS"] = "cpu"
+        # PT_EXEC_CACHE rides into the child (dict(os.environ) carries an
+        # inherited value; --exec-cache overrides) so the planner's normal
+        # usage — repeated sweeps — pays XLA compilation once per candidate
+        # signature EVER, not once per invocation
+        if args.exec_cache:
+            env["PT_EXEC_CACHE"] = os.path.abspath(args.exec_cache)
         flags = [f for f in env.get("XLA_FLAGS", "").split()
                  if "xla_force_host_platform_device_count" not in f]
         flags.append(
@@ -243,10 +268,26 @@ def main(argv=None) -> int:
               else f"memory_planner: {msg}", file=sys.stderr)
         return 2
     print(render(rows, args.hbm_gb, args.devices), flush=True)
+    cache_stats = None
+    try:
+        from paddle_tpu.jit import exec_cache
+
+        if exec_cache.enabled():
+            cache_stats = exec_cache.stats()
+            print(f"exec cache: {cache_stats['disk_hits']} disk hit(s), "
+                  f"{cache_stats['mem_hits']} mem hit(s), "
+                  f"{cache_stats['misses']} miss(es), "
+                  f"{cache_stats['compile_ms_saved']:.0f} compile-ms "
+                  f"saved ({cache_stats['dir']})", flush=True)
+    except Exception:  # noqa: BLE001 — stats must not break the verdict
+        pass
     if args.json:
-        print(json.dumps({"memory_planner": {
+        obj = {"memory_planner": {
             "hbm_gb": args.hbm_gb, "devices": args.devices,
-            "rows": rows}}), flush=True)
+            "rows": rows}}
+        if cache_stats is not None:
+            obj["memory_planner"]["exec_cache"] = cache_stats
+        print(json.dumps(obj), flush=True)
     if not rows:
         return 2
     return 0 if any(r.get("fits") for r in rows) else 3

@@ -8,7 +8,10 @@ one summary table.
 
 Sections: run overview (steps, wall, loss, ips), counter totals, the async
 pipeline (prefetch staging/starvation, AsyncStepper bound waits, hapi host
-syncs, host_blocked_ms_per_step), device memory (peak HBM / live-census
+syncs, host_blocked_ms_per_step), the AOT executable cache (hit rate,
+compile-ms saved/paid, tier + serialization latencies — from the
+`jit/exec_cache_*` metrics or a bench line's `telemetry.exec_cache`),
+device memory (peak HBM / live-census
 peaks from the memory observatory, per-executable breakdown), the perf
 guard verdict (the `guard` sub-object bench.py embeds — rendered from the
 run_end line, or from a bench log via `--bench`), retrace timeline (which
@@ -131,6 +134,51 @@ def render_guard(guard, out, source=""):
     out.append("verdict: " + ("PASS" if guard.get("ok")
                               else "REGRESSION — do not trust/land "
                                    "this number"))
+
+
+def render_exec_cache(out, totals=None, hists=None, bench_tel=None,
+                      source=""):
+    """The AOT executable cache's account (``jit/exec_cache_*`` counters
+    and histograms from a monitor run, and/or the ``telemetry.exec_cache``
+    stats sub-object a bench line carries): hit rate and the compile
+    wall-time the cache saved."""
+    totals, hists = totals or {}, hists or {}
+    tel = bench_tel or {}
+    ec = tel.get("exec_cache") or {}
+    hits = totals.get("jit/exec_cache_hit", 0) or (
+        ec.get("mem_hits", 0) + ec.get("disk_hits", 0))
+    misses = totals.get("jit/exec_cache_miss", 0) or ec.get("misses", 0)
+    # a cache-off monitor run still carries compile_ms_total — the
+    # cold-vs-warm A/B needs the cost line even with zero cache traffic
+    if not (hits or misses or ec or "compile_ms_total" in tel):
+        return
+    out.append("")
+    out.append(f"-- exec cache (AOT executables){source} --")
+    line = f"hits {hits}   misses {misses}"
+    if hits or misses:
+        line += f"   hit rate {hits / (hits + misses):.2f}"
+    out.append(line)
+    if ec:
+        out.append(f"  tiers: mem {ec.get('mem_hits', 0)}   "
+                   f"disk {ec.get('disk_hits', 0)}   "
+                   f"serialized {ec.get('serialized', 0)}   "
+                   f"errors {ec.get('errors', 0)}"
+                   + (f"   dir {ec['dir']}" if ec.get("dir") else ""))
+    saved = hists.get("jit/exec_cache_saved_ms")
+    saved_ms = (saved["sum"] if saved
+                else ec.get("compile_ms_saved") or 0.0)
+    if saved_ms:
+        out.append(f"compile ms saved (warm hits): {saved_ms:.0f}")
+    if "compile_ms_total" in tel:
+        out.append(f"compile ms paid this run: {tel['compile_ms_total']}"
+                   + (f" ({tel.get('compile_count')} compile(s))"
+                      if tel.get("compile_count") is not None else ""))
+    for name, label in (("jit/exec_cache_deserialize_ms", "deserialize"),
+                        ("jit/exec_cache_serialize_ms", "serialize")):
+        h = hists.get(name)
+        if h:
+            out.append(f"  {label} ms: p50 {h['p50']}   max {h['max']} "
+                       f"({h['count']} file(s))")
 
 
 def render_memory(mem, out, steps=(), source=""):
@@ -427,6 +475,11 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
         out.append("-- async pipeline --")
         out.extend(pipe)
 
+    # -- exec cache (jit/exec_cache_* from the run's counters) --
+    render_exec_cache(out, totals=totals,
+                      hists=(end or {}).get("totals", {})
+                      .get("histograms", {}))
+
     # -- device memory (observatory run_end sub-object and/or per-step
     #    censuses) --
     mem = (end or {}).get("memory")
@@ -461,6 +514,9 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
                 mem_b.setdefault("peak_hbm_gib", line["peak_hbm_gib"])
             if mem_b:
                 render_memory(mem_b, out, source=" (bench)")
+            tel_b = line.get("telemetry") or {}
+            if tel_b.get("exec_cache") or "compile_ms_total" in tel_b:
+                render_exec_cache(out, bench_tel=tel_b, source=" (bench)")
             if line.get("guard"):
                 render_guard(line["guard"], out, source=" (bench)")
         elif read_ok:

@@ -17,6 +17,14 @@ and exits nonzero with a human-readable verdict when the run regressed:
   got hungrier — the config that fit yesterday may OOM tomorrow
   (``peak_hbm_gib`` from the line or its ``memory`` sub-object, vs the
   baseline record's ``extra.peak_hbm_gib``)
+- cold-start compile wall-time above last-good by more than
+  ``--compile-growth`` (50%) **and** ``--compile-slack-ms`` (2000 ms)
+  absolute: ``telemetry.compile_ms_total`` vs the baseline record's
+  ``extra.compile_ms_total``. This is the executable-cache regression
+  gate (``jit/exec_cache.py``): a warm ``PT_EXEC_CACHE`` run pays ~0
+  compile ms, so a cache that stops hitting (key churn, serialization
+  break) fails the bench the same way a throughput drop does; the
+  absolute slack keeps sub-second compile noise from tripping it
 - any post-warmup retrace (``telemetry.post_warmup_retraces`` > 0): a
   shape changed inside the timed loop, so the number includes an XLA
   compile and the next run won't reproduce it
@@ -50,6 +58,11 @@ DEFAULT_THRESHOLDS = {
     "max_starvation_rate": 0.25,
     # fractional peak-HBM growth vs last-good before the check fails
     "hbm_growth": 0.10,
+    # cold-start compile wall-time vs last-good: fails only past BOTH the
+    # fractional growth and the absolute slack (compile time is noisy at
+    # small absolute values; a lost exec-cache warm start is neither)
+    "compile_growth": 0.50,
+    "compile_slack_ms": 2000.0,
 }
 
 
@@ -208,6 +221,39 @@ def evaluate(fresh: dict, baseline: dict | None, thresholds: dict | None
             check("mfu", mdrop <= th["mfu_drop"],
                   f"{mfu:.4f} vs last-good {base_mfu:.4f} "
                   f"({'-' if mdrop > 0 else '+'}{abs(mdrop) * 100:.1f}%)")
+        cms = tel.get("compile_ms_total")
+        base_cms = (baseline.get("extra") or {}).get("compile_ms_total")
+        # cache-on vs cache-off is an A/B dimension like batch size: a
+        # run without PT_EXEC_CACHE judged against a warm-cache 0 ms
+        # baseline would false-fail, so mismatched states skip the gate
+        base_ec = (baseline.get("extra") or {}).get("exec_cache_enabled")
+        if base_ec is not None and bool(base_ec) != bool(
+                tel.get("exec_cache")):
+            cms = None
+        # presence check, not truthiness: 0.0 is the HEALTHY warm-cache
+        # baseline, and the zero→huge cold start is exactly the
+        # regression this gate exists to catch (growth is undefined
+        # there — gate on the absolute slack alone)
+        if cms is not None and base_cms is not None:
+            over = cms - base_cms
+            if base_cms > 0:
+                growth = cms / base_cms - 1.0
+                failed = (growth > th["compile_growth"]
+                          and over > th["compile_slack_ms"])
+                detail = (f"{cms:.0f} ms vs last-good {base_cms:.0f} ms "
+                          f"({'+' if growth > 0 else '-'}"
+                          f"{abs(growth) * 100:.1f}%, max growth "
+                          f"{th['compile_growth'] * 100:.0f}% past "
+                          f"{th['compile_slack_ms']:.0f} ms slack)")
+            else:
+                failed = over > th["compile_slack_ms"]
+                detail = (f"{cms:.0f} ms vs last-good 0 ms (warm-cache "
+                          f"baseline; max {th['compile_slack_ms']:.0f} ms "
+                          f"slack)")
+            check("compile_ms", not failed, detail
+                  + (" — exec cache stopped saving compiles "
+                     "(jit/exec_cache.py key churn or a dead disk tier?)"
+                     if failed else ""))
         hbm = peak_hbm_of(fresh)
         base_hbm = (baseline.get("extra") or {}).get("peak_hbm_gib")
         if hbm and base_hbm:
@@ -272,6 +318,15 @@ def main(argv=None) -> int:
     ap.add_argument("--hbm-growth", type=float,
                     default=DEFAULT_THRESHOLDS["hbm_growth"],
                     help="max fractional peak-HBM growth (default 0.10)")
+    ap.add_argument("--compile-growth", type=float,
+                    default=DEFAULT_THRESHOLDS["compile_growth"],
+                    help="max fractional compile wall-time growth vs "
+                         "last-good (default 0.50; only fails past "
+                         "--compile-slack-ms absolute)")
+    ap.add_argument("--compile-slack-ms", type=float,
+                    default=DEFAULT_THRESHOLDS["compile_slack_ms"],
+                    help="absolute compile-ms headroom before the growth "
+                         "gate can fail (default 2000)")
     ap.add_argument("--require-baseline", action="store_true",
                     help="fail when the store has no last-good hardware "
                          "record for the metric")
@@ -297,7 +352,9 @@ def main(argv=None) -> int:
         thresholds={"throughput_drop": args.throughput_drop,
                     "mfu_drop": args.mfu_drop,
                     "max_starvation_rate": args.max_starvation_rate,
-                    "hbm_growth": args.hbm_growth},
+                    "hbm_growth": args.hbm_growth,
+                    "compile_growth": args.compile_growth,
+                    "compile_slack_ms": args.compile_slack_ms},
         hardware=hardware)
     if args.require_baseline and baseline is None:
         verdict["ok"] = False
