@@ -1,0 +1,246 @@
+"""Request-level serving throughput + latency for the continuous-
+batching engine (`paddle_tpu/serving`), judged against the decode HBM
+roofline (`benchmarks/decode_bench.py`'s byte model).
+
+Replays a SEEDED Poisson arrival trace (exponential inter-arrivals,
+uniform prompt/output lengths — same seed, same trace, every run) and
+reports aggregate ``tokens/s`` plus p50/p99 time-to-first-token and
+per-token decode latency in the standard one-JSON-line format.
+
+Run: python benchmarks/serving_bench.py [--smoke]
+Prints one JSON line: {"metric": "serving_tokens_per_sec", ...} with
+``tokens_per_sec`` / ``ttft_ms_p50`` / ``ttft_ms_p99`` / ``tpot_ms_*``.
+
+Knobs (seeded defaults; smoke mode shrinks everything):
+  PT_SERVE_BENCH_REQUESTS (64)   trace length
+  PT_SERVE_BENCH_RATE     (4.0)  Poisson arrival rate, requests/s
+  PT_SERVE_BENCH_SEED     (0)    trace seed
+  PT_SERVE_*                     engine geometry (docs/SERVING.md)
+  PT_DECODE_INT8=1               weight-only int8 decode A/B
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_decode_bench():
+    """The HBM roofline helpers live in decode_bench (the ONE byte model
+    both decode benches are judged against) — load by path, benchmarks/
+    is not a package."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "decode_bench.py")
+    spec = importlib.util.spec_from_file_location("decode_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def build_trace(n, rate, vocab, prompt_rng, new_rng, seed=0):
+    """Seeded Poisson trace: ``[(arrival_s, prompt_ids, max_new)]``,
+    arrival-sorted by construction. Deterministic for a (seed, n, rate,
+    length-range) tuple — the replayable-input contract the scheduler
+    property tests lean on."""
+    rng = np.random.RandomState(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n))
+    trace = []
+    for i in range(n):
+        plen = int(rng.randint(prompt_rng[0], prompt_rng[1] + 1))
+        new = int(rng.randint(new_rng[0], new_rng[1] + 1))
+        prompt = rng.randint(0, vocab, size=(plen,)).astype(np.int32)
+        trace.append((float(arrivals[i]), prompt, new))
+    return trace
+
+
+def percentile(values, q):
+    return float(np.percentile(np.asarray(values, np.float64), q)) \
+        if values else None
+
+
+def main():
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from bench import enable_compilation_cache
+
+    enable_compilation_cache()
+    smoke = "--smoke" in sys.argv or jax.default_backend() == "cpu"
+    print(f"serving_bench: backend={jax.default_backend()} smoke={smoke}",
+          file=sys.stderr, flush=True)
+
+    import paddle_tpu as pt
+    from paddle_tpu import monitor as _mon
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    if os.environ.get("PT_BENCH_MONITOR", "1") != "0":
+        # same telemetry ride-along as bench.py: compile wall-time and
+        # the serving/* counters land in the JSON line's telemetry
+        _mon.enable()
+
+    pt.seed(0)
+    if smoke:
+        cfg = LlamaConfig.tiny()
+        n_req = int(os.environ.get("PT_SERVE_BENCH_REQUESTS", "8"))
+        rate = float(os.environ.get("PT_SERVE_BENCH_RATE", "50"))
+        prompt_rng, new_rng = (3, 12), (4, 12)
+        serve_cfg = ServingConfig(
+            max_lanes=int(os.environ.get("PT_SERVE_LANES", "4")),
+            block_size=int(os.environ.get("PT_SERVE_BLOCK", "4")),
+            prefill_chunk=int(
+                os.environ.get("PT_SERVE_PREFILL_CHUNK", "8")),
+            max_seq_len=int(os.environ.get("PT_SERVE_MAX_LEN", "32")))
+    else:
+        # the headline-bench decode model (~0.44B, one v5e chip)
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_hidden_layers=12, num_attention_heads=12,
+            max_position_embeddings=2048, dtype="bfloat16",
+            use_parallel_cross_entropy=False)
+        n_req = int(os.environ.get("PT_SERVE_BENCH_REQUESTS", "64"))
+        rate = float(os.environ.get("PT_SERVE_BENCH_RATE", "4"))
+        prompt_rng, new_rng = (64, 192), (64, 256)
+        serve_cfg = ServingConfig(max_seq_len=int(
+            os.environ.get("PT_SERVE_MAX_LEN", "512")))
+    seed = int(os.environ.get("PT_SERVE_BENCH_SEED", "0"))
+
+    model = LlamaForCausalLM(cfg)
+    if cfg.dtype == "bfloat16":
+        for p in model.parameters():
+            p._data = p._data.astype("bfloat16")
+    model.eval()
+
+    engine = ServingEngine(model, serve_cfg)
+    trace = build_trace(n_req, rate, cfg.vocab_size, prompt_rng, new_rng,
+                        seed=seed)
+    engine.warmup()  # compiles (or exec-cache-loads) outside the clock
+
+    # replay: submit each request when its arrival time passes, step the
+    # engine whenever it has work. Request timestamps (TTFT, per-token)
+    # come from the engine's own perf_counter clock; a host transfer per
+    # decode round makes the timing honest through the tunnel (the
+    # emitted token IS fetched — CLAUDE.md timing rules).
+    reqs = []
+    t0 = time.perf_counter()
+    i = 0
+    while i < len(trace) or engine.has_work():
+        now = time.perf_counter() - t0
+        while i < len(trace) and trace[i][0] <= now:
+            _, prompt, new = trace[i]
+            reqs.append(engine.submit(prompt, max_new_tokens=new))
+            i += 1
+        if engine.has_work():
+            engine.step()
+        elif i < len(trace):
+            time.sleep(min(trace[i][0] - now, 0.02))
+    wall = time.perf_counter() - t0
+
+    stats = engine.stats()
+    tokens = sum(len(r.output) for r in reqs)
+    tps = tokens / wall if wall > 0 else 0.0
+    ttft = [(r.t_first - r.t_submit) * 1e3 for r in reqs
+            if r.t_first is not None]
+    tpot = [(r.t_done - r.t_first) * 1e3 / (len(r.output) - 1)
+            for r in reqs if r.t_done is not None and len(r.output) > 1]
+
+    # HBM roofline (decode_bench's byte model on the decode phase): per
+    # step the chip reads every matmul weight once (lanes share the
+    # read) + each live lane's KV prefix, writes one KV token per
+    # layer/lane. kv_read_tokens is the engine's live-prefix count — the
+    # bytes a paged-attention kernel would move; the XLA gathered step
+    # reads whole tables, so measured-vs-model gap = paging overhead.
+    db = _load_decode_bench()
+    # byte-size facts from the engine's OWN param arrays — re-running
+    # _collect_params would materialize a duplicate full weight copy
+    # (~GBs held live in a bench whose point is HBM headroom)
+    params = engine._params
+    embed_nbytes = params["embed"].nbytes
+    lane_rows = (stats["decoded_tokens"] / max(stats["decode_steps"], 1))
+    embed_row_bytes = lane_rows * cfg.hidden_size \
+        * params["embed"].dtype.itemsize
+    param_bytes = sum(
+        x.nbytes for x in jax.tree_util.tree_leaves(params)
+    ) - embed_nbytes + embed_row_bytes
+    kv_el_bytes = 2 if cfg.dtype == "bfloat16" else 4
+    nkv = cfg.num_key_value_heads or cfg.num_attention_heads
+    head_dim = cfg.hidden_size // cfg.num_attention_heads
+    tok_kv_bytes = 2 * cfg.num_hidden_layers * nkv * head_dim * kv_el_bytes
+    decode_bytes = (stats["decode_steps"] * param_bytes
+                    + stats["kv_read_tokens"] * tok_kv_bytes
+                    + stats["decoded_tokens"] * tok_kv_bytes)
+    decode_wall = stats["decode_wall_s"] or 1e-9
+    achieved_gbps = decode_bytes / decode_wall / 1e9
+    peak = db._peak_hbm_gbps(jax.devices()[0])
+
+    rec = {"metric": "serving_tokens_per_sec",
+           "value": round(tps, 1), "unit": "tokens/s",
+           "tokens_per_sec": round(tps, 1),
+           "decode_tokens_per_sec": round(
+               stats["decoded_tokens"] / decode_wall, 1),
+           "ttft_ms_p50": round(percentile(ttft, 50), 2) if ttft else None,
+           "ttft_ms_p99": round(percentile(ttft, 99), 2) if ttft else None,
+           "tpot_ms_p50": round(percentile(tpot, 50), 3) if tpot else None,
+           "tpot_ms_p99": round(percentile(tpot, 99), 3) if tpot else None,
+           "requests": len(reqs),
+           "completed": stats["finished"],
+           "generated_tokens": tokens,
+           "arrival_rate_per_s": rate,
+           "trace_seed": seed,
+           "lanes": stats["lanes"],
+           "block_size": stats["block_size"],
+           "num_blocks": stats["num_blocks"],
+           "prefill_chunk": stats["prefill_chunk"],
+           "preemptions": stats["preemptions"],
+           "decode_steps": stats["decode_steps"],
+           "prefill_chunks": stats["prefill_chunks"],
+           "hbm_gb_per_s": round(achieved_gbps, 1),
+           "hbm_model_bytes_per_step": int(
+               decode_bytes / max(stats["decode_steps"], 1)),
+           "hbm_peak_gb_per_s": peak,
+           "hbm_util": (round(achieved_gbps / peak, 4) if peak else None),
+           "int8_weights": serve_cfg.int8_weights}
+    # runtime telemetry rides along like bench.py's line: compile cost
+    # actually paid + exec-cache traffic (the warm-server-start proof)
+    try:
+        from paddle_tpu import monitor as _mon
+        from paddle_tpu.jit import exec_cache as _ec
+
+        tel = {}
+        snap = _mon.snapshot()
+        _ch = snap["histograms"].get("jit/compile_ms")
+        tel["compile_ms_total"] = round(_ch["sum"], 1) if _ch else 0.0
+        # top-level too (→ the persisted record's extra): perf_guard's
+        # --compile-growth gate reads baseline extra.compile_ms_total,
+        # and exec_cache_enabled keeps cache-on/off runs from
+        # false-judging each other — same shape as bench.py's record
+        rec["compile_ms_total"] = tel["compile_ms_total"]
+        rec["exec_cache_enabled"] = _ec.enabled()
+        serv = {k.split("/", 1)[1]: v
+                for k, v in snap["counters"].items()
+                if k.startswith("serving/") and v}
+        if serv:
+            tel["serving"] = serv
+        if _ec.enabled():
+            tel["exec_cache"] = _ec.stats()
+        rec["telemetry"] = tel
+    except Exception:  # noqa: BLE001 — telemetry must not break the line
+        pass
+    if smoke:
+        rec["note"] = "cpu smoke mode; not a TPU number"
+    else:
+        from paddle_tpu.utils import measurements as _meas
+
+        _meas.record_rec_or_warn(rec)
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -122,6 +122,7 @@ _LAZY = {
     "dataset": ".dataset",
     "cost_model": ".cost_model",
     "monitor": ".monitor",
+    "serving": ".serving",
 }
 
 

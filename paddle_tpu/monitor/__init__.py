@@ -58,6 +58,7 @@ INSTRUMENTED_MODULES = (
     "paddle_tpu.amp.auto_cast",
     "paddle_tpu.io.prefetch",
     "paddle_tpu.hapi.model",
+    "paddle_tpu.serving.engine",
 )
 
 _registry = Registry()
@@ -112,6 +113,20 @@ _c_exec_miss = _registry.counter("jit/exec_cache_miss")
 _h_exec_deserialize_ms = _registry.histogram("jit/exec_cache_deserialize_ms")
 _h_exec_serialize_ms = _registry.histogram("jit/exec_cache_serialize_ms")
 _h_exec_saved_ms = _registry.histogram("jit/exec_cache_saved_ms")
+# continuous-batching serving runtime (serving/engine.py — docs/SERVING.md):
+# lane/block bookkeeping between shared decode steps. `evictions` counts
+# finished-lane reclamations; `preemptions`/`requeues` the capacity-
+# pressure evictions (recompute policy requeues every preempted request,
+# so the two track together — both exist so a report reads either way)
+_c_serve_admits = _registry.counter("serving/admits")
+_c_serve_evictions = _registry.counter("serving/evictions")
+_c_serve_preempt = _registry.counter("serving/preemptions")
+_c_serve_requeue = _registry.counter("serving/requeues")
+_c_serve_prefill = _registry.counter("serving/prefill_steps")
+_c_serve_decode = _registry.counter("serving/decode_steps")
+_g_serve_lanes = _registry.gauge("serving/lanes_occupied")
+_g_serve_free_blocks = _registry.gauge("serving/free_blocks")
+_h_serve_queue_wait = _registry.histogram("serving/queue_wait_ms")
 
 
 # -- public metric access ----------------------------------------------------
@@ -411,6 +426,38 @@ def on_exec_cache_deserialize_ms(ms: float) -> None:
 
 def on_exec_cache_serialize_ms(ms: float) -> None:
     _h_exec_serialize_ms.observe(ms)
+
+
+def on_serving_admit(queue_wait_ms: float) -> None:
+    """The scheduler moved a waiting request onto a free lane;
+    ``queue_wait_ms`` is its submit→admit latency (the queue-pressure
+    signal — TTFT is queue wait + prefill)."""
+    _c_serve_admits.inc()
+    _h_serve_queue_wait.observe(queue_wait_ms)
+
+
+def on_serving_evict() -> None:
+    """A finished lane was reclaimed (KV blocks + lane slot freed)."""
+    _c_serve_evictions.inc()
+
+
+def on_serving_preempt() -> None:
+    """Capacity pressure evicted a running lane; the recompute policy
+    requeues it at the waiting front, so requeues ride along."""
+    _c_serve_preempt.inc()
+    _c_serve_requeue.inc()
+
+
+def on_serving_prefill(chunks: int) -> None:
+    """One lane's (re-)prefill ran ``chunks`` compiled chunk calls."""
+    _c_serve_prefill.inc(chunks)
+
+
+def on_serving_decode(lanes_active: int, free_blocks: int) -> None:
+    """One shared decode step advanced ``lanes_active`` lanes."""
+    _c_serve_decode.inc()
+    _g_serve_lanes.set(lanes_active)
+    _g_serve_free_blocks.set(free_blocks)
 
 
 from . import memory  # noqa: E402  — device memory observatory

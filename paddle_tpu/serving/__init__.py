@@ -1,0 +1,27 @@
+"""Continuous-batching decode serving runtime (docs/SERVING.md).
+
+Composes the piecemeal serving levers — weight-only int8
+(``PT_DECODE_INT8``), the compiled KV-cache decode loop
+(``models/generation.py``), the AOT exec cache (``jit/exec_cache.py``)
+— into one request-level engine: block/paged KV cache, FCFS continuous
+batching with preemption, chunked prefill + shared decode step.
+
+    from paddle_tpu.serving import ServingEngine, ServingConfig
+
+    engine = ServingEngine(model, ServingConfig(max_lanes=8))
+    req = engine.submit(prompt_ids, max_new_tokens=64)
+    outputs = engine.run()   # {request_id: generated token ids}
+
+Benchmark: ``python benchmarks/serving_bench.py [--smoke]`` replays a
+seeded Poisson arrival trace and reports tokens/s + p50/p99 TTFT.
+"""
+from .engine import ServingConfig, ServingEngine  # noqa: F401
+from .kv_cache import BlockPool, blocks_needed  # noqa: F401
+from .scheduler import (  # noqa: F401
+    FINISHED, RUNNING, WAITING, FCFSScheduler, Request,
+)
+
+__all__ = [
+    "ServingConfig", "ServingEngine", "BlockPool", "blocks_needed",
+    "FCFSScheduler", "Request", "WAITING", "RUNNING", "FINISHED",
+]

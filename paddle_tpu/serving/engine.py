@@ -1,0 +1,522 @@
+"""Continuous-batching decode engine over the block KV pool.
+
+The millions-of-users path (ROADMAP item 1): requests of unequal prompt
+and output lengths share ONE compiled decode step — per-lane block
+tables and valid lengths are runtime *data*, so admission, eviction, and
+growth never retrace. Two compiled programs serve the whole lifetime:
+
+- **prefill chunk** ``[1, C]``: one lane's context enters the pool C
+  tokens at a time (padded tail chunks write only below the context
+  length — pads are redirected to the null block), and the final chunk
+  samples the first generated token from the last real position.
+- **decode step** ``[L, 1]``: every occupied lane advances one token —
+  write the pending token's K/V at ``pool_len``, attend over the lane's
+  gathered blocks masked to ``slot <= pos``, greedy-sample the next.
+
+Both compile through :func:`paddle_tpu.jit.exec_cache.get_or_compile`
+(keyed on generation config, param avals, pool geometry, lane count and
+mesh), so a warm ``PT_EXEC_CACHE`` server start pays zero fresh XLA
+compiles. The attention/RoPE/MLP math reuses
+``models/generation.py``'s helpers (``_rms``/``_mm``/``_rope_at``) and
+mirrors its ``_attend`` line for line — engine outputs are
+token-identical to per-request ``generate()`` calls
+(tests/test_serving.py proves it, padding included, because masked
+slots contribute exactly-zero softmax weight).
+
+Reference lineage: the static-graph serving surface this replaces is
+`paddle_infer.Predictor` (`paddle/fluid/inference/api/
+analysis_predictor.h:94` — see ``paddle_tpu/inference``); request-level
+continuous batching + block KV follow the Orca/vLLM iteration-level
+scheduling + PagedAttention memory model (docs/SERVING.md).
+
+Monitor contract: this module carries a ``_monitor`` None-slot
+(``serving/*`` counters, ``monitor.INSTRUMENTED_MODULES``) — when
+monitoring is off no monitor callable is ever invoked; the always-on
+plain-int ``ServingEngine.counters`` feed the serving bench instead.
+
+Greedy decode only for now: per-request sampling params would ride as
+traced lane vectors (same no-retrace discipline); left for a later PR.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..models.generation import (
+    _GenCfg, _collect_params, _mm, _rms, _rope_at,
+)
+from ..monitor import _register as _monitor_register
+from .kv_cache import BlockPool, blocks_needed
+from .scheduler import RUNNING, FCFSScheduler, Request
+
+__all__ = ["ServingConfig", "ServingEngine"]
+
+# telemetry slot (paddle_tpu.monitor None-slot contract): None unless
+# PT_MONITOR wired it
+_monitor = None
+
+
+def _env_int(name, default):
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+class ServingConfig:
+    """Engine geometry. Every field has a ``PT_SERVE_*`` env default so a
+    server deploy tunes without code (CLAUDE.md knob table):
+
+    - ``max_lanes`` (``PT_SERVE_LANES``, 8): decode-batch width — lanes
+      are the compiled step's batch dimension.
+    - ``block_size`` (``PT_SERVE_BLOCK``, 16): tokens per KV block.
+    - ``num_blocks`` (``PT_SERVE_BLOCKS``): pool size incl. the reserved
+      null block; default sizes every lane for ``max_seq_len`` (no
+      preemption pressure — shrink it to trade HBM for requeues).
+    - ``prefill_chunk`` (``PT_SERVE_PREFILL_CHUNK``, 32): prefill
+      program width; prompts enter in ceil(len/chunk) calls.
+    - ``max_seq_len`` (``PT_SERVE_MAX_LEN``): per-request prompt+output
+      ceiling; defaults to the model's max_position_embeddings.
+    - ``int8_weights`` (``PT_DECODE_INT8``): weight-only int8 matmuls,
+      same lever as ``generate()``.
+    """
+
+    def __init__(self, max_lanes=None, block_size=None, num_blocks=None,
+                 prefill_chunk=None, max_seq_len=None, int8_weights=None):
+        self.max_lanes = max_lanes if max_lanes is not None \
+            else _env_int("PT_SERVE_LANES", 8)
+        self.block_size = block_size if block_size is not None \
+            else _env_int("PT_SERVE_BLOCK", 16)
+        self.num_blocks = num_blocks if num_blocks is not None \
+            else _env_int("PT_SERVE_BLOCKS", 0) or None
+        self.prefill_chunk = prefill_chunk if prefill_chunk is not None \
+            else _env_int("PT_SERVE_PREFILL_CHUNK", 32)
+        self.max_seq_len = max_seq_len if max_seq_len is not None \
+            else _env_int("PT_SERVE_MAX_LEN", 0) or None
+        if int8_weights is None:
+            int8_weights = os.environ.get("PT_DECODE_INT8") == "1"
+        self.int8_weights = bool(int8_weights)
+        for name in ("max_lanes", "block_size", "prefill_chunk"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} must be >= 1, "
+                                 f"got {getattr(self, name)}")
+
+
+# -- compiled phases ----------------------------------------------------------
+
+def _attend_lanes(q, kc, vc, pos, nh, nkv, sliding_window=0):
+    """``models/generation.py:_attend`` with PER-TOKEN positions: q
+    [b, s, nh, d] against the gathered block slots kc/vc [b, L, nkv, d].
+    Slot ``l`` is visible to the query at absolute position ``p =
+    pos[b, t]`` iff ``l <= p`` — block tables lay a lane's positions out
+    in order, so slot index == absolute position for every allocated
+    slot, and unallocated/pad slots sit above every real ``p``. The math
+    (fp32 einsum, 1/sqrt(d), -1e30 mask, fp32 softmax/AV) mirrors
+    ``_attend`` exactly so masked slots carry exactly-zero weight and
+    engine outputs stay token-identical to ``generate()``."""
+    b, s, _, d = q.shape
+    L = kc.shape[1]
+    g = nh // nkv
+    qg = q.reshape(b, s, nkv, g, d)
+    logits = jnp.einsum("bskgd,blkd->bskgl", qg.astype(jnp.float32),
+                        kc.astype(jnp.float32)) / np.sqrt(d)
+    vis = jnp.arange(L)[None, None, :] <= pos[:, :, None]  # [b, s, L]
+    if sliding_window > 0:
+        vis &= jnp.arange(L)[None, None, :] > pos[:, :, None] \
+            - sliding_window
+    logits = jnp.where(vis[:, :, None, None, :], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bskgl,blkd->bskgd", p, vc.astype(jnp.float32))
+    return out.reshape(b, s, nh, d).astype(q.dtype)
+
+
+def _pool_forward(params, kpool, vpool, tables, ids, pos, wlimit, cfg):
+    """Forward ``ids`` [b, s] at absolute positions ``pos`` [b, s]
+    against the block pool: per layer, write each token's K/V into its
+    lane's block at ``pos`` (writes at positions >= ``wlimit[b]`` — pad
+    tail of a final prefill chunk, idle decode lanes — are redirected to
+    null block 0 so they can never clobber live KV), then attend over
+    the lane's whole gathered table. Layer math is
+    ``models/generation.py:_block`` on the pooled layout. Returns
+    (x [b, s, hidden], kpool, vpool)."""
+    b, s = ids.shape
+    nh = cfg.num_attention_heads
+    nkv = cfg.num_key_value_heads or nh
+    d = cfg.hidden_size // nh
+    B = kpool.shape[2]
+    M = tables.shape[1]
+    x = params["embed"][ids].astype(jnp.dtype(cfg.dtype))
+    idx = jnp.minimum(pos // B, M - 1)  # pad pos can run past the table
+    blk = jnp.take_along_axis(tables, idx, axis=1)
+    ok = pos < wlimit[:, None]
+    blk = jnp.where(ok, blk, 0)
+    off = jnp.where(ok, pos % B, 0)
+    n_layers = params["ln1"].shape[0]
+
+    def body(carry, li):
+        x, kp, vp = carry
+        layer_p = {k: jax.tree_util.tree_map(lambda a: a[li], params[k])
+                   for k in
+                   ("ln1", "qkv", "o", "ln2", "gate_up", "down")}
+        h = _rms(x, layer_p["ln1"], cfg.rms_norm_eps)
+        qkv = _mm(h, layer_p["qkv"])
+        q, k, v = jnp.split(qkv, [nh * d, nh * d + nkv * d], axis=-1)
+        q = q.reshape(b, s, nh, d)
+        k = k.reshape(b, s, nkv, d)
+        v = v.reshape(b, s, nkv, d)
+        q, k = _rope_at(q, k, pos, cfg.rope_theta)
+        kp = kp.at[li, blk, off].set(k)
+        vp = vp.at[li, blk, off].set(v)
+        kc = kp[li][tables].reshape(b, M * B, nkv, d)
+        vc = vp[li][tables].reshape(b, M * B, nkv, d)
+        out = _attend_lanes(q, kc, vc, pos, nh, nkv,
+                            sliding_window=cfg.sliding_window)
+        x = x + _mm(out.reshape(b, s, nh * d), layer_p["o"])
+        h2 = _rms(x, layer_p["ln2"], cfg.rms_norm_eps)
+        gu = _mm(h2, layer_p["gate_up"])
+        gate, up = jnp.split(gu, 2, axis=-1)
+        x = x + _mm(jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype)
+                    * up, layer_p["down"])
+        return (x, kp, vp), None
+
+    (x, kpool, vpool), _ = jax.lax.scan(
+        body, (x, kpool, vpool), jnp.arange(n_layers))
+    return x, kpool, vpool
+
+
+def _prefill_chunk(params, kpool, vpool, table, ids, start, ctx_len,
+                   last_idx, *, cfg):
+    """One lane's prefill chunk: ``ids`` [1, C] at positions
+    [start, start+C); greedy-samples from position ``last_idx`` within
+    the chunk (the overall last real token on the final chunk; ignored
+    by the caller otherwise). Returns (tok [1], kpool, vpool)."""
+    C = ids.shape[1]
+    pos = (start + jnp.arange(C, dtype=jnp.int32))[None, :]
+    x, kpool, vpool = _pool_forward(
+        params, kpool, vpool, table, ids, pos,
+        jnp.reshape(ctx_len, (1,)), cfg)
+    x = _rms(x, params["norm"], cfg.rms_norm_eps)
+    h = jax.lax.dynamic_index_in_dim(x, last_idx, axis=1, keepdims=False)
+    logits = _mm(h, params["lm_head"]).astype(jnp.float32)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), kpool, vpool
+
+
+def _decode_step(params, kpool, vpool, tables, cur_len, last_tok, *, cfg):
+    """The shared decode step: every lane feeds its pending token at
+    position ``cur_len`` (write-then-attend, so the token sees itself
+    like ``generate()``'s step does) and greedy-samples the next. Idle
+    lanes (cur_len 0, table row 0) write to the null block and their
+    outputs are ignored host-side. Returns (tok [L], kpool, vpool)."""
+    pos = cur_len[:, None]
+    x, kpool, vpool = _pool_forward(
+        params, kpool, vpool, tables, last_tok[:, None], pos,
+        cur_len + 1, cfg)
+    x = _rms(x, params["norm"], cfg.rms_norm_eps)
+    logits = _mm(x[:, -1], params["lm_head"]).astype(jnp.float32)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), kpool, vpool
+
+
+# -- the engine ---------------------------------------------------------------
+
+class ServingEngine:
+    """Submit requests, call :meth:`step` (or :meth:`run`) — the engine
+    admits, prefills, decodes, and reclaims between steps. See the
+    module docstring for the execution model and docs/SERVING.md for
+    the operational guide."""
+
+    def __init__(self, model, config: ServingConfig | None = None):
+        if getattr(model.config, "moe_num_experts", 0) > 1:
+            from ..framework.errors import UnimplementedError
+
+            raise UnimplementedError(
+                "ServingEngine does not decode MoE Llama configs yet "
+                "(same gap as models/generation.generate)")
+        self.model = model
+        self.config = config or ServingConfig()
+        cfg = self.config
+        self._gcfg = _GenCfg(model.config)
+        self._params = _collect_params(model,
+                                       int8_weights=cfg.int8_weights)
+        self.max_seq_len = int(cfg.max_seq_len
+                               or model.config.max_position_embeddings)
+        self.blocks_per_lane = blocks_needed(self.max_seq_len,
+                                             cfg.block_size)
+        num_blocks = int(cfg.num_blocks
+                         or cfg.max_lanes * self.blocks_per_lane + 1)
+        nh = self._gcfg.num_attention_heads
+        nkv = self._gcfg.num_key_value_heads or nh
+        d = self._gcfg.hidden_size // nh
+        layers = self._params["ln1"].shape[0]
+        dt = jnp.dtype(self._gcfg.dtype)
+        self._kpool = jnp.zeros(
+            (layers, num_blocks, cfg.block_size, nkv, d), dt)
+        self._vpool = jnp.zeros_like(self._kpool)
+        self.scheduler = FCFSScheduler(
+            BlockPool(num_blocks, cfg.block_size), cfg.max_lanes,
+            self.blocks_per_lane, self.max_seq_len)
+        # live (waiting/running) requests only; finished ones move to
+        # _finished until collected — a long-running server must not
+        # grow with its request history
+        self._requests: dict = {}
+        self._finished: dict = {}
+        self._prefill_exec = None
+        self._decode_exec = None
+        # always-on plain-int accounting (the serving bench's source of
+        # truth; independent of the monitor like exec_cache._stats)
+        self.counters = {
+            "admits": 0, "finished": 0, "preemptions": 0,
+            "prefill_chunks": 0, "decode_steps": 0, "decoded_tokens": 0,
+            "kv_read_tokens": 0, "decode_wall_s": 0.0,
+        }
+
+    # -- intake --------------------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
+               request_id=None) -> Request:
+        """Queue one request (prompt as a 1-D int Tensor/array/list).
+        Returns the :class:`Request`; drive it with :meth:`step` /
+        :meth:`run`."""
+        if isinstance(prompt_ids, Tensor):
+            prompt_ids = prompt_ids.numpy()
+        req = Request(prompt_ids, max_new_tokens=max_new_tokens,
+                      eos_token_id=eos_token_id, request_id=request_id)
+        if (req.request_id in self._requests
+                or req.request_id in self._finished):
+            raise ValueError(
+                f"duplicate request_id {req.request_id!r} (live or "
+                f"finished-but-uncollected — pop_finished() first)")
+        req.t_submit = time.perf_counter()
+        self.scheduler.submit(req)
+        self._requests[req.request_id] = req
+        return req
+
+    # -- compilation ---------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Compile (or exec-cache-load) both phase programs now, so the
+        first request — and the bench's timed window — pays no XLA
+        compile."""
+        self._ensure_compiled()
+
+    def _ensure_compiled(self) -> None:
+        if self._decode_exec is not None:
+            return
+        from ..jit import exec_cache
+
+        cfgv = self.config
+        L, M, C = cfgv.max_lanes, self.blocks_per_lane, cfgv.prefill_chunk
+        i32 = jnp.int32
+        # donation halves pool HBM traffic; XLA:CPU can't donate these
+        # and would warn per call
+        donate = jax.default_backend() != "cpu"
+        kw = {"static_argnames": ("cfg",)}
+        if donate:
+            kw["donate_argnums"] = (1, 2)
+        pspec = jax.ShapeDtypeStruct(self._kpool.shape, self._kpool.dtype)
+
+        def key(kind, **extra):
+            if not exec_cache.enabled():
+                return None
+            return {"kind": kind, "gen_cfg": self._gcfg._key(),
+                    "params": [exec_cache.array_spec(a) for a in
+                               jax.tree_util.tree_leaves(self._params)],
+                    "pool": (tuple(int(x) for x in self._kpool.shape),
+                             str(self._kpool.dtype)),
+                    "donate": donate,
+                    "mesh": exec_cache.mesh_spec(), **extra}
+
+        dec = jax.jit(_decode_step, **kw)
+        self._decode_exec = exec_cache.get_or_compile(
+            key("serving_decode", lanes=L, m=M),
+            lambda: dec.lower(
+                self._params, pspec, pspec,
+                jax.ShapeDtypeStruct((L, M), i32),
+                jax.ShapeDtypeStruct((L,), i32),
+                jax.ShapeDtypeStruct((L,), i32), cfg=self._gcfg),
+            label="serving/decode")
+        pre = jax.jit(_prefill_chunk, **kw)
+        scal = jax.ShapeDtypeStruct((), i32)
+        self._prefill_exec = exec_cache.get_or_compile(
+            key("serving_prefill", m=M, chunk=C),
+            lambda: pre.lower(
+                self._params, pspec, pspec,
+                jax.ShapeDtypeStruct((1, M), i32),
+                jax.ShapeDtypeStruct((1, C), i32),
+                scal, scal, scal, cfg=self._gcfg),
+            label="serving/prefill")
+
+    # -- the step loop -------------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduling round: admit + prefill newly admitted lanes
+        (they join this same round's decode — continuous batching), run
+        the shared decode step, emit/reclaim. Returns whether any work
+        was done."""
+        self._ensure_compiled()
+        now = time.perf_counter()
+        admitted = self.scheduler.admit()
+        for req in admitted:
+            self.counters["admits"] += 1
+            m = _monitor
+            if m is not None:
+                m.on_serving_admit(
+                    (now - req.t_submit) * 1e3 if req.t_submit else 0.0)
+            self._prefill(req)
+        worked = bool(admitted)
+        if self.scheduler.has_running():
+            self._decode_round()
+            worked = True
+        return worked
+
+    def run(self) -> dict:
+        """Drain: step until no request is waiting or running, then
+        collect-and-RETIRE — returns ``{request_id: np.ndarray(generated
+        tokens)}`` for every request finished since the last collection,
+        after which the engine drops its reference (callers keep the
+        :class:`Request` handles :meth:`submit` returned). Drivers that
+        call :meth:`step` directly get the same contract from
+        :meth:`pop_finished`."""
+        while self.scheduler.has_work():
+            self.step()
+        return self.pop_finished()
+
+    def pop_finished(self) -> dict:
+        """Collect + retire finished requests (see :meth:`run`) —
+        the bound that keeps a continuously-fed engine's host memory
+        flat."""
+        out = {rid: np.asarray(r.output)
+               for rid, r in self._finished.items()}
+        self._finished.clear()
+        return out
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    # -- phases --------------------------------------------------------------
+
+    def _table_row(self, req) -> np.ndarray:
+        row = np.zeros((1, self.blocks_per_lane), np.int32)
+        row[0, :len(req.blocks)] = req.blocks
+        return row
+
+    def _prefill(self, req) -> None:
+        """Fill the lane's blocks chunk by chunk; on the final chunk,
+        greedy-sample the first token. A re-admitted (preempted) request
+        only rebuilds the pool — its pending token is already known, and
+        greedy recompute reproduces the continuation exactly as long as
+        the prefill and decode programs round K/V identically (proven
+        token-identical on the CPU tier in tests/test_serving.py; the
+        two programs fuse differently, so a TPU near-tie argmax flip is
+        possible — hardware recompute-parity A/B queued in ROADMAP)."""
+        toks = req.prefill_tokens
+        ctx = int(toks.size)
+        C = self.config.prefill_chunk
+        table = jnp.asarray(self._table_row(req))
+        nchunks = -(-ctx // C)
+        tok = None
+        for c in range(nchunks):
+            start = c * C
+            piece = toks[start:start + C]
+            chunk = np.zeros((1, C), np.int32)
+            chunk[0, :piece.size] = piece
+            last_idx = ctx - 1 - start if c == nchunks - 1 else 0
+            tok, self._kpool, self._vpool = self._prefill_exec(
+                self._params, self._kpool, self._vpool, table,
+                jnp.asarray(chunk), jnp.int32(start), jnp.int32(ctx),
+                jnp.int32(last_idx))
+        req.pool_len = ctx
+        self.counters["prefill_chunks"] += nchunks
+        m = _monitor
+        if m is not None:
+            m.on_serving_prefill(nchunks)
+        if req.output:
+            return  # recompute path: the pending token is output[-1]
+        self._emit(req, int(np.asarray(tok)[0]), time.perf_counter())
+
+    def _decode_round(self) -> None:
+        sched = self.scheduler
+        # growth walks FCFS order so older requests claim blocks first;
+        # a victim preempted mid-walk is skipped by the state check
+        for req in sched.running():
+            if req.state == RUNNING:
+                sched.ensure_capacity(req, on_preempt=self._note_preempt)
+        act = sched.running()
+        if not act:
+            return
+        L, M = self.config.max_lanes, self.blocks_per_lane
+        tables = np.zeros((L, M), np.int32)
+        cur = np.zeros((L,), np.int32)
+        last = np.zeros((L,), np.int32)
+        for req in act:
+            tables[req.lane, :len(req.blocks)] = req.blocks
+            cur[req.lane] = req.pool_len
+            last[req.lane] = req.output[-1]
+        t0 = time.perf_counter()
+        tok, self._kpool, self._vpool = self._decode_exec(
+            self._params, self._kpool, self._vpool, jnp.asarray(tables),
+            jnp.asarray(cur), jnp.asarray(last))
+        toks = np.asarray(tok)  # the round's ONE host sync
+        now = time.perf_counter()
+        c = self.counters
+        c["decode_wall_s"] += now - t0
+        c["decode_steps"] += 1
+        c["decoded_tokens"] += len(act)
+        # live-prefix KV slots a paged kernel would read this round —
+        # the roofline byte model's input (benchmarks/serving_bench.py)
+        c["kv_read_tokens"] += sum(r.pool_len + 1 for r in act)
+        m = _monitor
+        if m is not None:
+            m.on_serving_decode(len(act), sched.pool.free_count)
+        for req in act:
+            req.pool_len += 1
+            self._emit(req, int(toks[req.lane]), now)
+
+    def _emit(self, req, tok: int, now: float) -> None:
+        req.output.append(tok)
+        if req.t_first is None:
+            req.t_first = now
+        if (len(req.output) >= req.max_new_tokens
+                or (req.eos_token_id is not None
+                    and tok == req.eos_token_id)):
+            req.t_done = now
+            self.scheduler.finish(req)
+            self._finished[req.request_id] = \
+                self._requests.pop(req.request_id, req)
+            self.counters["finished"] += 1
+            m = _monitor
+            if m is not None:
+                m.on_serving_evict()
+
+    def _note_preempt(self, req) -> None:
+        self.counters["preemptions"] += 1
+        m = _monitor
+        if m is not None:
+            m.on_serving_preempt()
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Plain-int account of the engine's lifetime (always on)."""
+        out = dict(self.counters)
+        out.update(
+            lanes=self.config.max_lanes,
+            block_size=self.config.block_size,
+            num_blocks=self.scheduler.pool.num_blocks,
+            free_blocks=self.scheduler.pool.free_count,
+            blocks_per_lane=self.blocks_per_lane,
+            max_seq_len=self.max_seq_len,
+            prefill_chunk=self.config.prefill_chunk,
+            int8_weights=self.config.int8_weights,
+            lanes_occupied=self.scheduler.lanes_occupied,
+            waiting=len(self.scheduler.waiting),
+            requests=len(self._requests),
+            uncollected=len(self._finished),
+        )
+        return out
+
+
+_monitor_register(sys.modules[__name__])

@@ -1,0 +1,113 @@
+"""Block (paged) KV-cache bookkeeping for the serving runtime.
+
+The device side is two pool arrays ``[layers, num_blocks, block_size,
+kv_heads, head_dim]`` owned by the engine; this module owns the HOST
+side: which fixed-size blocks belong to which request, and the per-lane
+block tables the compiled step indexes through. Sequences of different
+lengths share ONE compiled decode program because length lives in the
+*data* (block-table rows + per-lane valid lengths), never in the shapes
+(the vLLM/PagedAttention memory model, applied to a gathered-read TPU
+step — docs/SERVING.md).
+
+Block 0 is the NULL block: never allocated, it absorbs the compiled
+step's masked writes (inactive decode lanes, prefill-chunk pad slots)
+so they can never corrupt a live lane's KV. Allocation hands out
+blocks 1..num_blocks-1.
+
+Safety contract: every block has at most one owner, ``free`` validates
+ownership (a double-free or cross-request free raises instead of
+silently aliasing two requests' KV — the bug class paged caches die of),
+and ``free_count + live == num_blocks - 1`` always holds
+(tests/test_serving.py asserts it across admission/preemption churn).
+"""
+from __future__ import annotations
+
+__all__ = ["BlockPool", "blocks_needed"]
+
+
+def blocks_needed(num_tokens: int, block_size: int) -> int:
+    """Blocks covering positions ``0..num_tokens-1`` (0 tokens -> 0)."""
+    return -(-int(num_tokens) // int(block_size))
+
+
+class BlockPool:
+    """Free-list allocator over the pooled KV blocks (host bookkeeping).
+
+    LIFO free list: a just-freed block is the next handed out, so under
+    admission/eviction churn the working set stays compact (warm for
+    any future locality-aware layout).
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is the reserved null "
+                f"block), got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # stack: pop() yields 1 first, then 2, ... — deterministic
+        # allocation order is part of the replayable-scheduler contract
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._owner: dict[int, object] = {}
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (the null block excluded)."""
+        return self.num_blocks - 1
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return len(self._owner)
+
+    def alloc(self, n: int, owner) -> list | None:
+        """Allocate ``n`` blocks for ``owner``; None when the pool cannot
+        satisfy the request (caller decides to wait or preempt —
+        allocation itself never evicts)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        for b in blocks:
+            self._owner[b] = owner
+        return blocks
+
+    def free(self, blocks, owner) -> None:
+        """Return ``blocks`` to the pool. Raises on a double-free, on a
+        block the pool never allocated, and on an owner mismatch — each
+        is a lost-KV/aliased-KV bug upstream, never recoverable here."""
+        for b in blocks:
+            have = self._owner.get(b)
+            if have is None:
+                raise ValueError(
+                    f"block {b} is not allocated (double-free, or never "
+                    f"allocated) — freeing it would let two requests "
+                    f"alias one KV block")
+            if have is not owner:
+                raise ValueError(
+                    f"block {b} is owned by {have!r}, not {owner!r}")
+        for b in blocks:
+            del self._owner[b]
+            self._free.append(b)
+
+    def owner_of(self, block: int):
+        return self._owner.get(block)
+
+    def check_invariant(self) -> None:
+        """free + used == capacity, disjointly — the accounting identity
+        the property tests drive through admission/preemption churn."""
+        if len(self._free) + len(self._owner) != self.capacity:
+            raise AssertionError(
+                f"block accounting broken: free {len(self._free)} + used "
+                f"{len(self._owner)} != capacity {self.capacity}")
+        overlap = set(self._free) & set(self._owner)
+        if overlap:
+            raise AssertionError(f"blocks both free and owned: {overlap}")
+        if 0 in self._owner or 0 in self._free:
+            raise AssertionError("null block 0 escaped reservation")

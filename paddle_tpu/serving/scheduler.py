@@ -1,0 +1,256 @@
+"""Continuous-batching scheduler: FCFS admission, finished-lane
+reclamation, recompute-on-preemption.
+
+Pure host logic over :class:`~paddle_tpu.serving.kv_cache.BlockPool` —
+no jax import, so the scheduling policy is property-testable at full
+speed (tests/test_serving.py replays seeded traces twice and compares
+the event logs byte-for-byte).
+
+Policy (the Orca/vLLM iteration-level discipline, recompute variant):
+
+- **Admission** is FCFS from the waiting deque: the head request is
+  admitted iff a lane is free AND the pool can cover its context plus
+  the first decode write. Admission never preempts — runners hold their
+  blocks until they finish or growth forces eviction.
+- **Growth**: each decode step may cross a block boundary;
+  :meth:`ensure_capacity` allocates the next block, and when the pool is
+  dry it preempts the MOST RECENTLY admitted runner (never an older one
+  — the oldest request always progresses, which is the no-starvation
+  argument). A preempted request keeps its generated tokens, frees its
+  blocks, and re-queues at the FRONT of the waiting deque in arrival
+  order; on re-admission the engine re-prefills prompt+output (greedy
+  decode is deterministic per program, so recompute continues exactly —
+  proven on the CPU tier; see ``engine._prefill`` for the TPU caveat).
+- **Reclamation**: a finished lane frees its blocks and its lane slot
+  the moment its last token is emitted; the next admit() fills it —
+  lanes never idle behind a static batch's stragglers.
+
+Every decision lands in ``self.events`` as ``(event, request_id,
+detail)`` — the deterministic-replay audit trail (a bounded ring:
+newest ``events_cap`` decisions, 65536 by default, so the trail never
+grows a long-running server's host memory).
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+
+import numpy as np
+
+from .kv_cache import BlockPool, blocks_needed
+
+__all__ = ["Request", "FCFSScheduler",
+           "WAITING", "RUNNING", "FINISHED"]
+
+WAITING = "waiting"
+RUNNING = "running"
+FINISHED = "finished"
+
+_auto_id = itertools.count()
+
+
+class Request:
+    """One generation request and its full lifecycle state.
+
+    ``output`` accumulates generated token ids (the LAST entry, while
+    running, is the *pending* token — sampled but not yet written to the
+    KV pool; the engine feeds it to the next decode step). ``blocks``
+    is the lane's block table in position order. Timestamps
+    (``t_submit``/``t_first``/``t_done``, engine clock seconds) carry
+    the TTFT / per-token-latency facts the serving bench reports.
+    """
+
+    __slots__ = ("request_id", "prompt", "max_new_tokens", "eos_token_id",
+                 "state", "output", "blocks", "lane", "pool_len",
+                 "t_submit", "t_first", "t_done", "preemptions",
+                 "_admit_seq")
+
+    def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
+                 request_id=None):
+        prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.request_id = (request_id if request_id is not None
+                           else next(_auto_id))
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = (None if eos_token_id is None
+                             else int(eos_token_id))
+        self.state = WAITING
+        self.output: list = []
+        self.blocks: list = []
+        self.lane = None
+        # tokens whose K/V sit in the pool (= prefilled context while
+        # running; the pending output token is NOT yet written)
+        self.pool_len = 0
+        self.t_submit = None
+        self.t_first = None
+        self.t_done = None
+        self.preemptions = 0
+        self._admit_seq = -1
+
+    @property
+    def prefill_tokens(self) -> np.ndarray:
+        """The context a (re-)prefill must write to the pool: the prompt
+        plus all generated tokens EXCEPT the pending last one."""
+        if not self.output:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.output[:-1], np.int32)])
+
+    @property
+    def finished(self) -> bool:
+        return self.state == FINISHED
+
+
+class FCFSScheduler:
+    """Lane + block assignment between steps; see module docstring."""
+
+    def __init__(self, pool: BlockPool, max_lanes: int,
+                 blocks_per_lane: int, max_seq_len: int,
+                 events_cap: int = 65536):
+        if max_lanes < 1:
+            raise ValueError(f"max_lanes must be >= 1, got {max_lanes}")
+        self.pool = pool
+        self.max_lanes = int(max_lanes)
+        self.blocks_per_lane = int(blocks_per_lane)
+        self.max_seq_len = int(max_seq_len)
+        self.waiting: collections.deque = collections.deque()
+        self.lanes: list = [None] * self.max_lanes
+        # audit trail as a bounded ring (the flight-recorder discipline):
+        # newest events_cap decisions kept, so a long-running server's
+        # host memory does not grow with its request history
+        self.events: collections.deque = collections.deque(
+            maxlen=events_cap)
+        self._admit_counter = itertools.count()
+
+    # -- intake --------------------------------------------------------------
+
+    def submit(self, req: Request) -> Request:
+        """Queue a request; validates it can EVER run (total length within
+        the lane's block table and the pool) so an impossible request
+        fails loudly at the door, not as a livelock mid-serve."""
+        total = int(req.prompt.size) + req.max_new_tokens
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"request {req.request_id}: prompt {req.prompt.size} + "
+                f"max_new_tokens {req.max_new_tokens} = {total} exceeds "
+                f"max_seq_len {self.max_seq_len}")
+        need = blocks_needed(total, self.pool.block_size)
+        if need > min(self.pool.capacity, self.blocks_per_lane):
+            raise ValueError(
+                f"request {req.request_id} needs {need} KV blocks but the "
+                f"pool holds {self.pool.capacity} "
+                f"({self.blocks_per_lane}/lane) — raise PT_SERVE_BLOCKS "
+                f"or shrink the request")
+        req.state = WAITING
+        self.waiting.append(req)
+        self.events.append(("submit", req.request_id, None))
+        return req
+
+    # -- admission -----------------------------------------------------------
+
+    def free_lane(self):
+        for i, r in enumerate(self.lanes):
+            if r is None:
+                return i
+        return None
+
+    def admit(self) -> list:
+        """FCFS: move waiting-head requests onto free lanes while blocks
+        cover each one's context + first decode write. Returns the newly
+        admitted requests (engine prefills them before the next decode
+        round)."""
+        admitted = []
+        while self.waiting:
+            lane = self.free_lane()
+            if lane is None:
+                break
+            req = self.waiting[0]
+            # context to prefill + the first decode write right after it
+            need = blocks_needed(
+                len(req.prefill_tokens) + 1, self.pool.block_size)
+            blocks = self.pool.alloc(need, req)
+            if blocks is None:
+                break  # runners will free blocks as they finish
+            self.waiting.popleft()
+            req.blocks = blocks
+            req.lane = lane
+            req.state = RUNNING
+            req.pool_len = 0  # set by the engine's prefill
+            req._admit_seq = next(self._admit_counter)
+            self.lanes[lane] = req
+            self.events.append(("admit", req.request_id, lane))
+            admitted.append(req)
+        return admitted
+
+    # -- growth / preemption -------------------------------------------------
+
+    def running(self) -> list:
+        """Active requests in admission (FCFS) order — the order
+        ensure_capacity must walk so older requests grab blocks first."""
+        return sorted((r for r in self.lanes if r is not None),
+                      key=lambda r: r._admit_seq)
+
+    def ensure_capacity(self, req: Request, on_preempt=None) -> bool:
+        """Grow ``req.blocks`` to cover its next decode write (position
+        ``pool_len``). When the pool is dry, preempt the newest runner —
+        possibly ``req`` itself when IT is the newest. Returns False iff
+        ``req`` was preempted (caller drops it from this round)."""
+        need = blocks_needed(req.pool_len + 1, self.pool.block_size)
+        while len(req.blocks) < need:
+            got = self.pool.alloc(need - len(req.blocks), req)
+            if got is not None:
+                req.blocks.extend(got)
+                return True
+            victims = [r for r in self.running() if r is not req]
+            if victims and victims[-1]._admit_seq > req._admit_seq:
+                self.preempt(victims[-1], on_preempt)
+            else:
+                self.preempt(req, on_preempt)
+                return False
+        return True
+
+    def preempt(self, req: Request, on_preempt=None) -> None:
+        """Evict a runner: free its blocks, requeue at the waiting FRONT
+        (it was admitted before everything behind it — FCFS is preserved
+        because victims are always the newest runners, and multiple
+        same-round victims re-enter newest-first, so appendleft restores
+        arrival order)."""
+        self.pool.free(req.blocks, req)
+        req.blocks = []
+        self.lanes[req.lane] = None
+        req.lane = None
+        req.pool_len = 0
+        req.state = WAITING
+        req.preemptions += 1
+        self.waiting.appendleft(req)
+        self.events.append(("preempt", req.request_id, None))
+        if on_preempt is not None:
+            on_preempt(req)
+
+    # -- reclamation ---------------------------------------------------------
+
+    def finish(self, req: Request) -> None:
+        """Reclaim a finished lane: KV blocks and the lane slot return to
+        the pool immediately (the eviction the admission loop feeds on)."""
+        self.pool.free(req.blocks, req)
+        req.blocks = []
+        self.lanes[req.lane] = None
+        req.lane = None
+        req.state = FINISHED
+        self.events.append(("finish", req.request_id, None))
+
+    # -- state ---------------------------------------------------------------
+
+    def has_running(self) -> bool:
+        return any(r is not None for r in self.lanes)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or self.has_running()
+
+    @property
+    def lanes_occupied(self) -> int:
+        return sum(1 for r in self.lanes if r is not None)

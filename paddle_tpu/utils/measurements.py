@@ -280,12 +280,17 @@ def last_good(metric: str,
     """Most recent real-hardware record for ``metric`` (None if none).
 
     ``match`` filters on extra fields — e.g. ``{"batch": 8, "seq": 1024}``
-    skips over sweep points at other configs instead of returning them."""
+    skips over sweep points at other configs instead of returning them.
+    A key ABSENT from a record's extra is a wildcard, not a mismatch:
+    records persisted before a config knob existed must stay eligible
+    baselines (same rule as ``tools/perf_guard.py:last_good``, this
+    function's stdlib twin — keep the two in lockstep)."""
     for rec in reversed(_load()["records"]):
         if rec.get("metric") != metric or not _is_hw(rec):
             continue
         ex = rec.get("extra") or {}
-        if match and any(ex.get(k) != v for k, v in match.items()):
+        if match and any(k in ex and ex[k] != v
+                         for k, v in match.items()):
             continue
         return rec
     return None

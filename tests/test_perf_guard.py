@@ -157,6 +157,47 @@ class TestEvaluate:
         assert v["ok"]
         assert not any(c["name"] == "compile_ms" for c in v["checks"])
 
+    def test_flags_ttft_p99_growth(self, guard):
+        # serving gate: p99 TTFT 40% over last-good fails past the 25%
+        # default; throughput rides the generic value check
+        base = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                "backend": "tpu", "extra": {"ttft_ms_p99": 100.0}}
+        fresh = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                 "unit": "tokens/s", "ttft_ms_p99": 140.0}
+        v = guard.evaluate(fresh, base, hardware=True)
+        assert not v["ok"]
+        assert any(c["name"] == "ttft_p99" and not c["ok"]
+                   for c in v["checks"])
+
+    def test_ttft_growth_within_threshold_passes(self, guard):
+        base = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                "backend": "tpu", "extra": {"ttft_ms_p99": 100.0}}
+        fresh = {"metric": "serving_tokens_per_sec", "value": 980.0,
+                 "unit": "tokens/s", "ttft_ms_p99": 118.0}
+        v = guard.evaluate(fresh, base, hardware=True)
+        assert v["ok"]
+        assert any(c["name"] == "ttft_p99" and c["ok"]
+                   for c in v["checks"])
+
+    def test_ttft_gate_skips_cpu_smoke_and_no_baseline(self, guard):
+        fresh = {"metric": "serving_tokens_per_sec", "value": 50.0,
+                 "unit": "tokens/s", "ttft_ms_p99": 9000.0,
+                 "note": "cpu smoke mode; not a TPU number"}
+        base = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                "backend": "tpu", "extra": {"ttft_ms_p99": 100.0}}
+        v = guard.evaluate(fresh, base)  # smoke inferred from the note
+        assert v["ok"]
+        assert not any(c["name"] == "ttft_p99" for c in v["checks"])
+        # hardware line judged against a baseline without the field:
+        # gate silently absent, everything else still applies
+        hw = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+              "unit": "tokens/s", "ttft_ms_p99": 9000.0}
+        v2 = guard.evaluate(
+            hw, {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                 "backend": "tpu", "extra": {}}, hardware=True)
+        assert v2["ok"]
+        assert not any(c["name"] == "ttft_p99" for c in v2["checks"])
+
     def test_flags_error_line(self, guard, store):
         fresh = {"metric": _METRIC, "value": 0.0, "unit": "tokens/s",
                  "error": "bench watchdog fired"}
@@ -254,6 +295,32 @@ class TestLoadHelpers:
         # without config keys in the line, no filter applies (legacy logs)
         assert guard.config_match({"metric": _METRIC}) == {}
         assert guard.last_good(p, _METRIC)["value"] == 48000.0
+
+    def test_last_good_treats_absent_config_key_as_wildcard(
+            self, guard, tmp_path):
+        """A record persisted BEFORE a config knob existed (its extra
+        lacks the key) must stay an eligible baseline — otherwise adding
+        a CONFIG_KEYS entry orphans every prior hardware record and
+        silently disables the gates it anchored (e.g. the pre-serving
+        decode records vs the new int8_weights key)."""
+        p = str(tmp_path / "s.json")
+        with open(p, "w") as f:
+            json.dump({"records": [
+                {"metric": "llama_decode_tokens_per_sec_per_chip",
+                 "value": 500.0, "unit": "tokens/s", "backend": "tpu",
+                 "device": "d",
+                 "extra": {"batch": 128}},  # predates int8_weights
+            ]}, f)
+        fresh = {"metric": "llama_decode_tokens_per_sec_per_chip",
+                 "value": 480.0, "unit": "tokens/s", "batch": 128,
+                 "int8_weights": False}
+        base = guard.last_good(p, fresh["metric"], fresh=fresh,
+                               match=guard.config_match(fresh))
+        assert base is not None and base["value"] == 500.0
+        # a PRESENT-but-different key still filters
+        fresh_b64 = dict(fresh, batch=64)
+        assert guard.last_good(p, fresh["metric"], fresh=fresh_b64,
+                               match=guard.config_match(fresh_b64)) is None
 
 
 class TestCLI:

@@ -45,6 +45,11 @@ BENCHES = [
     ("decode", [sys.executable, "benchmarks/decode_bench.py"], 1800, None),
     ("decode_int8", [sys.executable, "benchmarks/decode_bench.py"],
      1800, {"PT_DECODE_INT8": "1"}),
+    # continuous-batching serving runtime (docs/SERVING.md): smoke-sized
+    # Poisson trace, timeboxed — tokens/s + p50/p99 TTFT vs the decode
+    # HBM roofline; the guard's --ttft-growth gate judges the tail
+    ("serving", [sys.executable, "benchmarks/serving_bench.py"], 1800,
+     {"PT_SERVE_BENCH_REQUESTS": "32"}),
     ("bert", [sys.executable, "benchmarks/baseline_configs.py",
               "--bert-only"], 1800, None),
     ("ernie", [sys.executable, "benchmarks/ernie_bench.py"], 1800, None),
@@ -55,6 +60,11 @@ BENCHES = [
     ("flashtune", [sys.executable, "tools/flash_autotune.py"], 2400, None),
     ("profile", [sys.executable, "tools/profile_train_step.py"], 1800,
      None),
+    # queued PR-6 follow-up (ROADMAP item 5 remainder): cold-vs-warm
+    # compile_ms_total through the tunnel + proof the tunneled PJRT
+    # plugin supports serialize_executable (runs bench.py twice)
+    ("exec_cache_tunnel",
+     [sys.executable, "tools/exec_cache_tunnel_probe.py"], 5400, None),
 ]
 
 

@@ -181,6 +181,45 @@ def render_exec_cache(out, totals=None, hists=None, bench_tel=None,
                        f"({h['count']} file(s))")
 
 
+def render_serving(out, totals=None, hists=None, gauges=None, source=""):
+    """The continuous-batching engine's account (``serving/*`` counters
+    from ``paddle_tpu/serving/engine.py`` — docs/SERVING.md): lane
+    traffic (admits / finished-lane evictions / capacity preemptions),
+    prefill-vs-decode step mix, and the queue-wait histogram (TTFT's
+    scheduler-side component)."""
+    totals, hists, gauges = totals or {}, hists or {}, gauges or {}
+    if not any(k.startswith("serving/") for k in
+               (*totals, *hists, *gauges)):
+        return
+    out.append("")
+    out.append(f"-- serving (continuous batching){source} --")
+    admits = totals.get("serving/admits", 0)
+    evictions = totals.get("serving/evictions", 0)
+    preempts = totals.get("serving/preemptions", 0)
+    out.append(f"admits {admits}   evictions (finished) {evictions}   "
+               f"preemptions {preempts} "
+               f"(requeued {totals.get('serving/requeues', 0)})")
+    pre = totals.get("serving/prefill_steps", 0)
+    dec = totals.get("serving/decode_steps", 0)
+    line = f"prefill chunks {pre}   decode steps {dec}"
+    if dec:
+        line += f"   ({pre / dec:.2f} prefill/decode ratio)"
+    out.append(line)
+    lanes = gauges.get("serving/lanes_occupied")
+    blocks = gauges.get("serving/free_blocks")
+    if lanes is not None or blocks is not None:
+        parts = []
+        if lanes is not None:
+            parts.append(f"lanes occupied (last): {lanes:g}")
+        if blocks is not None:
+            parts.append(f"free KV blocks (last): {blocks:g}")
+        out.append("   ".join(parts))
+    w = hists.get("serving/queue_wait_ms")
+    if w:
+        out.append(f"queue wait ms: p50 {w['p50']}   p95 {w['p95']}   "
+                   f"max {w['max']} ({w['count']} admit(s))")
+
+
 def render_memory(mem, out, steps=(), source=""):
     """The memory observatory's account: run-level peaks (+ sentinel
     state) and the per-step live-census trajectory when step lines
@@ -480,6 +519,11 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
                       hists=(end or {}).get("totals", {})
                       .get("histograms", {}))
 
+    # -- serving runtime (serving/* from the continuous-batching engine) --
+    render_serving(out, totals=totals,
+                   hists=(end or {}).get("totals", {}).get("histograms", {}),
+                   gauges=(end or {}).get("totals", {}).get("gauges", {}))
+
     # -- device memory (observatory run_end sub-object and/or per-step
     #    censuses) --
     mem = (end or {}).get("memory")
@@ -517,6 +561,12 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
             tel_b = line.get("telemetry") or {}
             if tel_b.get("exec_cache") or "compile_ms_total" in tel_b:
                 render_exec_cache(out, bench_tel=tel_b, source=" (bench)")
+            if tel_b.get("serving"):
+                # serving_bench embeds the counters prefix-stripped
+                render_serving(
+                    out, totals={f"serving/{k}": v
+                                 for k, v in tel_b["serving"].items()},
+                    source=" (bench)")
             if line.get("guard"):
                 render_guard(line["guard"], out, source=" (bench)")
         elif read_ok:

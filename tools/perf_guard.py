@@ -25,6 +25,11 @@ and exits nonzero with a human-readable verdict when the run regressed:
   compile ms, so a cache that stops hitting (key churn, serialization
   break) fails the bench the same way a throughput drop does; the
   absolute slack keeps sub-second compile noise from tripping it
+- serving p99 time-to-first-token above last-good by more than
+  ``--ttft-growth`` (25%): ``ttft_ms_p99`` from a
+  ``benchmarks/serving_bench.py`` line vs the baseline record's
+  ``extra.ttft_ms_p99`` — the tail-latency gate; the aggregate tokens/s
+  drop is the same ``--throughput-drop`` check every metric gets
 - any post-warmup retrace (``telemetry.post_warmup_retraces`` > 0): a
   shape changed inside the timed loop, so the number includes an XLA
   compile and the next run won't reproduce it
@@ -63,6 +68,11 @@ DEFAULT_THRESHOLDS = {
     # small absolute values; a lost exec-cache warm start is neither)
     "compile_growth": 0.50,
     "compile_slack_ms": 2000.0,
+    # serving gate: fractional p99 time-to-first-token growth vs the
+    # last-good record before the check fails (serving_bench lines carry
+    # ttft_ms_p99; the aggregate tokens/s drop rides the generic
+    # throughput check — the metric's value IS tokens/s)
+    "ttft_growth": 0.25,
 }
 
 
@@ -114,8 +124,15 @@ def load_fresh(path: str) -> dict:
 
 # sweep knobs that change what the number measures: a baseline is only
 # comparable at the same config (CLAUDE.md PT_BENCH_BATCH / ce-chunk A/Bs
-# persist under the SAME metric name)
-CONFIG_KEYS = ("batch", "seq", "ce_chunk")
+# persist under the SAME metric name). The serving keys pin the bench's
+# offered load + engine geometry (int8_weights also rides decode_bench
+# lines): a 64-request trace legitimately queues deeper than a
+# 32-request one, so judging p99 TTFT across them would false-fail (or
+# mask) the gate. Keys a baseline record predates are wildcards — see
+# last_good.
+CONFIG_KEYS = ("batch", "seq", "ce_chunk",
+               "requests", "arrival_rate_per_s", "lanes", "block_size",
+               "int8_weights")
 
 
 def config_match(fresh: dict) -> dict:
@@ -150,7 +167,12 @@ def last_good(store_path: str, metric: str, fresh: dict | None = None,
                 and rec.get("backend") not in (None, "cpu", "unknown")):
             continue
         ex = rec.get("extra") or {}
-        if match and any(ex.get(k) != v for k, v in match.items()):
+        # a key ABSENT from a record's extra is a wildcard, not a
+        # mismatch: records persisted before a config knob existed
+        # (e.g. pre-serving decode lines without int8_weights) must
+        # stay eligible baselines for the gates they anchored
+        if match and any(k in ex and ex[k] != v
+                         for k, v in match.items()):
             continue
         if skipping_self and rec.get("value") == fresh.get("value"):
             continue
@@ -254,6 +276,18 @@ def evaluate(fresh: dict, baseline: dict | None, thresholds: dict | None
                   + (" — exec cache stopped saving compiles "
                      "(jit/exec_cache.py key churn or a dead disk tier?)"
                      if failed else ""))
+        ttft = fresh.get("ttft_ms_p99")
+        base_ttft = (baseline.get("extra") or {}).get("ttft_ms_p99")
+        if ttft and base_ttft:
+            tgrowth = ttft / base_ttft - 1.0
+            check("ttft_p99", tgrowth <= th["ttft_growth"],
+                  f"{ttft:.1f} ms vs last-good {base_ttft:.1f} ms "
+                  f"({'+' if tgrowth > 0 else '-'}"
+                  f"{abs(tgrowth) * 100:.1f}%, max growth "
+                  f"{th['ttft_growth'] * 100:.0f}%)"
+                  + (" — tail latency regressed (scheduler queueing or "
+                     "prefill got slower)" if tgrowth > th["ttft_growth"]
+                     else ""))
         hbm = peak_hbm_of(fresh)
         base_hbm = (baseline.get("extra") or {}).get("peak_hbm_gib")
         if hbm and base_hbm:
@@ -327,6 +361,10 @@ def main(argv=None) -> int:
                     default=DEFAULT_THRESHOLDS["compile_slack_ms"],
                     help="absolute compile-ms headroom before the growth "
                          "gate can fail (default 2000)")
+    ap.add_argument("--ttft-growth", type=float,
+                    default=DEFAULT_THRESHOLDS["ttft_growth"],
+                    help="max fractional p99 TTFT growth vs last-good "
+                         "for serving bench lines (default 0.25)")
     ap.add_argument("--require-baseline", action="store_true",
                     help="fail when the store has no last-good hardware "
                          "record for the metric")
@@ -354,7 +392,8 @@ def main(argv=None) -> int:
                     "max_starvation_rate": args.max_starvation_rate,
                     "hbm_growth": args.hbm_growth,
                     "compile_growth": args.compile_growth,
-                    "compile_slack_ms": args.compile_slack_ms},
+                    "compile_slack_ms": args.compile_slack_ms,
+                    "ttft_growth": args.ttft_growth},
         hardware=hardware)
     if args.require_baseline and baseline is None:
         verdict["ok"] = False
