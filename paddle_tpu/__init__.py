@@ -123,6 +123,7 @@ _LAZY = {
     "cost_model": ".cost_model",
     "monitor": ".monitor",
     "serving": ".serving",
+    "resilience": ".resilience",
 }
 
 

@@ -55,6 +55,29 @@ def _safe_name(key):
     return re.sub(r"[^0-9A-Za-z_.\-]", "_", key)
 
 
+def atomic_write_json(path, obj):
+    """Crash-safe JSON publish: tmp + fsync + rename + parent-dir fsync.
+    A crash mid-write can only leave the .tmp (never a truncated final
+    file), and the rename itself is durable once the directory entry is
+    synced. Shared by the index write here and the resilience layer's
+    MANIFEST.json (checkpoint_manager.py) — completeness markers must
+    all be torn-proof the same way."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:  # platform without directory fsync
+        pass
+
+
 def _spec_of(arr):
     s = getattr(arr, "sharding", None)
     spec = getattr(s, "spec", None)
@@ -191,11 +214,23 @@ def _barrier():
         multihost_utils.sync_global_devices("paddle_tpu_ckpt_save")
 
 
+def _seal_memmaps(path, open_memmaps):
+    """Flush chunk-streamed shard files and move them from .tmp to their
+    final names. ``open_memmap`` allocates the FULL file up front, so a
+    size check can never see a torn chunk write — the rename is the
+    write-complete marker ``is_complete`` relies on (a writer killed
+    mid-stream leaves only the .tmp; the final name is absent)."""
+    for fname, mm in open_memmaps.items():
+        mm.flush()
+        os.replace(os.path.join(path, fname + ".tmp"),
+                   os.path.join(path, fname))
+    open_memmaps.clear()
+
+
 def _write_item(path, item, open_memmaps):
     kind = item[0]
     if kind == "barrier":
-        for mm in open_memmaps.values():
-            mm.flush()
+        _seal_memmaps(path, open_memmaps)
         _barrier()
     elif kind == "npy":
         _, fname, arr = item
@@ -205,18 +240,19 @@ def _write_item(path, item, open_memmaps):
         mm = open_memmaps.get(fname)
         if mm is None:
             mm = np.lib.format.open_memmap(
-                os.path.join(path, fname), mode="w+",
+                os.path.join(path, fname + ".tmp"), mode="w+",
                 dtype=np.dtype(dtype), shape=tuple(shape))
             open_memmaps[fname] = mm
         mm[row0:row0 + arr.shape[0]] = arr
     elif kind == "index":
         _, meta = item
-        for mm in open_memmaps.values():
-            mm.flush()
-        open_memmaps.clear()
-        # index last: its presence marks the checkpoint complete
-        with open(os.path.join(path, _INDEX), "w") as f:
-            json.dump(meta, f, indent=1)
+        _seal_memmaps(path, open_memmaps)
+        # index last: its presence marks the checkpoint complete. Written
+        # atomically so a crash mid-write can only leave NO index (torn
+        # checkpoint, never selected for resume) — a truncated
+        # index.json would otherwise read as a checkpoint with fewer
+        # tensors, which is worse than none at all.
+        atomic_write_json(os.path.join(path, _INDEX), meta)
 
 
 def _emit_tensor(key, arr, entries, sink, snapshot=False,
@@ -245,11 +281,19 @@ def _emit_tensor(key, arr, entries, sink, snapshot=False,
         regions = {_region_tag([[0, d] for d in arr.shape]):
                    [[0, d] for d in arr.shape]}
         shards = None
+    itemsize = np.dtype(_dtype_str(arr)).itemsize
     entry = {
         "shape": list(arr.shape),
         "dtype": _dtype_str(arr),
         "spec": _spec_of(arr),
-        "shards": [{"file": f"{fbase}.{tag}.npy", "index": bounds}
+        # per-shard payload bytes: lets is_complete() detect a shard file
+        # truncated by a mid-save crash (a complete .npy is header + data,
+        # so its on-disk size is strictly greater than the data bytes)
+        "shards": [{"file": f"{fbase}.{tag}.npy", "index": bounds,
+                    "bytes": int(np.prod(
+                        [b[1] - b[0] for b in bounds],
+                        dtype=np.int64)) * itemsize if bounds
+                    else itemsize}
                    for tag, bounds in sorted(regions.items())],
     }
     entries[key] = entry
@@ -328,8 +372,7 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                 if item is None:
                     break
                 _write_item(path, item, open_memmaps)
-            for mm in open_memmaps.values():
-                mm.flush()
+            _seal_memmaps(path, open_memmaps)
         except BaseException as e:
             q.fail(e)  # unblock + fail the producer
             raise
@@ -417,6 +460,37 @@ def _load_index(path):
         raw = json.load(f)
     tensors = raw["tensors"]
     return {k: _meta_v1_to_v2(m) for k, m in tensors.items()}
+
+
+def is_complete(path):
+    """True iff ``path`` holds a complete, untorn checkpoint: the index
+    exists and parses, and every shard file it references mmaps with its
+    full header-declared payload on disk (``np.memmap`` refuses a file
+    shorter than header + data, so a shard truncated by a mid-save crash
+    fails here) AND matches the payload size the index recorded for its
+    region (``shards[].bytes``, absent on older checkpoints).
+    Chunk-streamed shards (tensors over the streaming threshold) are
+    covered by a different mechanism: they are written to ``.tmp`` and
+    renamed only once fully streamed (``_seal_memmaps``), because their
+    memmap is allocated at full size up front — a writer killed
+    mid-stream leaves no file at the final name. The resume selector
+    (``resilience/checkpoint_manager.py``) calls this so a checkpoint
+    killed mid-write is never resumed from."""
+    try:
+        index = _load_index(path)
+    except (OSError, ValueError, KeyError):
+        return False
+    for meta in index.values():
+        for sh in meta.get("shards", []):
+            fpath = os.path.join(path, sh["file"])
+            try:
+                data = np.load(fpath, mmap_mode="r")
+            except Exception:  # noqa: BLE001 — torn/missing/corrupt
+                return False
+            want = sh.get("bytes")
+            if want is not None and data.nbytes != want:
+                return False
+    return True
 
 
 def load_state_dict(state_dict, path, process_group=None,

@@ -32,6 +32,12 @@ class Callback:
         the error path (parity: the reference only ends clean runs)."""
         pass
 
+    def on_checkpoint(self, step, logs=None):
+        """Fired when a resilience checkpoint COMPLETES (manifest
+        published — not when the async save starts): ``step`` is what a
+        relaunch would now resume from."""
+        pass
+
     def on_eval_begin(self, logs=None):
         pass
 
@@ -239,6 +245,12 @@ class MonitorCallback(Callback):
             loss = None
         self._logger.log_step(loss=loss,
                               num_samples=params.get("batch_size"))
+
+    def on_checkpoint(self, step, logs=None):
+        # the run_end line (clean or crashed) then names the exact step a
+        # relaunch will resume from (StepLogger last_checkpoint_step)
+        if self._logger is not None:
+            self._logger.note_checkpoint(step)
 
     def on_train_end(self, logs=None):
         if self._logger is not None:

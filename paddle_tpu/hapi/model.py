@@ -26,12 +26,31 @@ from ..io.reader import DataLoader
 from ..jit.train_step import AsyncStepper, TrainStep
 from ..monitor import _register as _monitor_register
 from ..monitor import memory as _memory
+from ..monitor.numerics import NonFiniteError as _NonFiniteError
 
 # Telemetry slots (see paddle_tpu.monitor): None unless PT_MONITOR wired
 # them. `_spans` (monitor/spans.py) records fit/evaluate phase brackets
 # and the deliberate metric materializations as `sync` attribution spans.
 _monitor = None
 _spans = None
+
+
+def _fast_forward(src, n):
+    """Yield ``src``'s batches after discarding the first ``n`` —
+    host-side only (the resume fast-forward). Hand-rolled because the
+    DataLoader's iterator implements ``__next__`` without ``__iter__``,
+    which ``itertools.islice`` / ``yield from`` reject."""
+    it = iter(src)
+    for _ in range(n):
+        try:
+            next(it)
+        except StopIteration:
+            return
+    while True:
+        try:
+            yield next(it)
+        except StopIteration:
+            return
 
 
 def _to_tensor_list(batch):
@@ -227,7 +246,8 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, max_in_flight=2,
-            device_prefetch=0, nan_check=None):
+            device_prefetch=0, nan_check=None, resume_from=None,
+            checkpoint_dir=None, checkpoint_keep=None, nan_policy=None):
         """Parity: `paddle.Model.fit` — with an asynchronous device
         pipeline (docs/ASYNC_PIPELINE.md). Steps dispatch through an
         :class:`AsyncStepper` keeping up to ``max_in_flight`` compiled
@@ -246,11 +266,87 @@ class Model:
         the step and first bad leaf, after ``Callback.on_train_error``
         fired. ``None`` (default) follows the global ``PT_NANCHECK``
         state; ``False`` forces it off for this fit. The TrainStep's
-        own ``nan_check`` setting is restored when fit returns."""
+        own ``nan_check`` setting is restored when fit returns.
+
+        Resilience (docs/RESILIENCE.md): ``checkpoint_dir`` arms a
+        :class:`~paddle_tpu.resilience.CheckpointManager` — periodic
+        async sharded checkpoints on a cadence planned from the measured
+        save cost (``PT_CKPT_OVERHEAD_PCT``), each save quiescing the
+        AsyncStepper first, plus a final checkpoint at train end.
+        ``resume_from`` restores params / optimizer state / LR schedule /
+        PRNG / step counters and the data-iterator position from the
+        newest COMPLETE checkpoint under that directory (torn ones are
+        skipped) — resharding into the current mesh placements, so the
+        resumed (dp×mp) need not match the saved one. ``nan_policy=
+        "skip"`` forces the sentinel on and hands its failures to a
+        :class:`~paddle_tpu.resilience.NaNSkipPolicy`: the poisoned
+        batch is dropped (params/LR/step untouched — the step never
+        happened) and training continues, aborting only after
+        ``PT_NANSKIP_MAX`` consecutive failures."""
         assert self._train_step is not None, "call prepare() first"
+        policy = None
+        if nan_policy is not None:
+            if nan_policy != "skip":
+                raise ValueError(
+                    f"fit: nan_policy must be None or 'skip' "
+                    f"(got {nan_policy!r})")
+            from ..resilience.numerics_policy import NaNSkipPolicy
+
+            policy = NaNSkipPolicy()
+            nan_check = True  # the policy rides the sentinel's replay
+        start_epoch = 0
+        skip_batches = 0
+        global_step = 0
+        if resume_from is not None:
+            from ..resilience import resume as _resume
+
+            crash = int(os.environ.get("PADDLE_RESTART_COUNT", "0")
+                        or 0) > 0
+            scalars = _resume.restore_latest(
+                self.network, self._optimizer, resume_from,
+                train_step=self._train_step, crash_resume=crash)
+            if scalars is not None:
+                start_epoch = int(scalars.get("epoch", 0))
+                skip_batches = int(scalars.get("batch_in_epoch", 0))
+                global_step = int(scalars.get("step", 0))
+        mgr = None
+        if checkpoint_dir is not None:
+            from ..resilience.checkpoint_manager import CheckpointManager
+
+            mgr = CheckpointManager(checkpoint_dir, keep=checkpoint_keep)
+
+        def _ckpt_state(ep, batch_in_epoch, step):
+            from ..resilience import resume as _resume
+
+            return _resume.capture(
+                self.network, self._optimizer, epoch=ep,
+                batch_in_epoch=batch_in_epoch, step=step)
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                        drop_last=drop_last, num_workers=num_workers)
+        if skip_batches:
+            # the mid-epoch fast-forward replays the loader and discards
+            # the first `skip_batches` batches — that only reproduces the
+            # pre-crash data under a DETERMINISTIC order. Probe the
+            # actual loader (fit-built or user-supplied): an unseeded
+            # RandomSampler draws from global numpy state, which the
+            # checkpoint cannot capture.
+            from ..io.sampler import RandomSampler
+
+            sampler = getattr(getattr(loader, "batch_sampler", None),
+                              "sampler", None)
+            if isinstance(sampler, RandomSampler) and getattr(
+                    sampler, "generator", None) is None:
+                import warnings
+
+                warnings.warn(
+                    "fit(resume_from=...) is resuming mid-epoch over an "
+                    "unseeded shuffling loader: the resumed permutation "
+                    "differs from the pre-crash one, so the skipped "
+                    "batches are NOT the ones already trained (some "
+                    "samples repeat, others are missed this epoch). Use "
+                    "shuffle=False or a seeded sampler for exact "
+                    "resume.", stacklevel=2)
         try:
             steps = len(loader)
         except Exception:
@@ -270,27 +366,64 @@ class Model:
         prev_nan_check = self._train_step._nan_check
         if nan_check is not None:
             self._train_step._nan_check = bool(nan_check)
+        notified_ckpt = None
+        # loop position for the terminal checkpoint: (next epoch, next
+        # batch) a resume of this run would execute
+        pos = (start_epoch, skip_batches)
         try:
-            for epoch in range(epochs):
+            for epoch in range(start_epoch, epochs):
                 cbks.on_epoch_begin(epoch)
                 sp = _spans
                 t_epoch = time.perf_counter() if sp is not None else None
                 it = 0
                 logs = {}
-                epoch_iter = enumerate(loader)
+                skip_now = skip_batches if epoch == start_epoch else 0
+                data_src = loader
+                if skip_now:
+                    # resume fast-forward: the batches trained before
+                    # the checkpoint are consumed from the RAW loader,
+                    # host-side only (deterministic loaders replay the
+                    # same order) — never staged device-ward by the
+                    # prefetcher below, which would pay one useless H2D
+                    # transfer per discarded batch
+                    data_src = _fast_forward(loader, skip_now)
+                epoch_iter = enumerate(data_src, start=skip_now)
                 prefetch = None
                 if device_prefetch:
                     from ..io.prefetch import DevicePrefetchIterator
 
                     prefetch = DevicePrefetchIterator(
-                        loader, depth=device_prefetch)
-                    epoch_iter = enumerate(prefetch)
+                        data_src, depth=device_prefetch)
+                    epoch_iter = enumerate(prefetch, start=skip_now)
                 try:
                     for step, batch in epoch_iter:
                         cbks.on_train_batch_begin(step)
                         batch = batch if isinstance(batch, (list, tuple)) \
                             else [batch]
-                        loss = stepper(*_to_tensor_list(batch))
+                        try:
+                            loss = stepper(*_to_tensor_list(batch))
+                        except _NonFiniteError as e:
+                            if policy is None:
+                                raise
+                            # skip-and-continue: the sentinel raised
+                            # BEFORE the rebind, so params/opt/LR/step
+                            # are exactly pre-batch — drop it and move
+                            # on (record_failure raises past the budget).
+                            # on_train_batch_end is deliberately NOT
+                            # fired (end hooks carry training-progress
+                            # semantics — LRSchedulerCallback steps the
+                            # schedule there, and a skipped step must
+                            # not advance it), but the batch does count
+                            # toward num_iters so the loop stays bounded
+                            # on a poison-heavy stream
+                            policy.record_failure(e)
+                            it += 1
+                            if num_iters is not None and it >= num_iters:
+                                break
+                            continue
+                        if policy is not None:
+                            policy.record_success()
+                        global_step += 1
                         # lazy between windows; number-like (counted,
                         # sync-on-read) if a user callback touches it
                         logs = {"loss": _LazyLoss(loss)}
@@ -299,6 +432,19 @@ class Model:
                             # ProgBarLogger's print cadence
                             logs = _materialize_logs(logs)
                         cbks.on_train_batch_end(step, logs)
+                        pos = (epoch, step + 1)
+                        if mgr is not None:
+                            mgr.maybe_save(
+                                global_step,
+                                lambda ep=epoch, s=step, g=global_step:
+                                _ckpt_state(ep, s + 1, g),
+                                stepper=stepper)
+                            mgr.poll()
+                            if (mgr.last_complete_step is not None
+                                    and mgr.last_complete_step
+                                    != notified_ckpt):
+                                notified_ckpt = mgr.last_complete_step
+                                cbks.on_checkpoint(notified_ckpt)
                         it += 1
                         if num_iters is not None and it >= num_iters:
                             break
@@ -317,13 +463,42 @@ class Model:
                     sp.record("hapi/fit_epoch", "phase", t_epoch,
                               args={"epoch": epoch})
                 cbks.on_epoch_end(epoch, logs)
+                pos = (epoch + 1, 0)
                 if eval_data is not None and (epoch + 1) % eval_freq == 0:
                     self.evaluate(eval_data, batch_size=batch_size,
                                   verbose=verbose, callbacks=callbacks)
                     self.network.train()
                 if self.stop_training:
                     break
+            if mgr is not None:
+                # terminal checkpoint: the finished run's final state is
+                # durable, and resuming it is a no-op (epoch == epochs).
+                # Skipped when this step is already durably checkpointed
+                # (resume of a finished run) — rewriting a complete
+                # checkpoint in place buys nothing and risks tearing it
+                if (mgr.last_save_step != global_step
+                        and mgr.last_complete_step != global_step):
+                    mgr.save(global_step,
+                             _ckpt_state(pos[0], pos[1], global_step),
+                             stepper=stepper)
+                mgr.finalize()
+                if mgr.last_complete_step is not None \
+                        and mgr.last_complete_step != notified_ckpt:
+                    notified_ckpt = mgr.last_complete_step
+                    cbks.on_checkpoint(notified_ckpt)
         except BaseException as e:  # noqa: BLE001 — flush sinks, re-raise
+            if mgr is not None:
+                # publish any save whose writer ALREADY finished (poll,
+                # never join: a crashing run must not block on a stalled
+                # writer before its postmortem flushes) — the run_end
+                # record then names the true resume point
+                try:
+                    mgr.poll()
+                    if mgr.last_complete_step is not None \
+                            and mgr.last_complete_step != notified_ckpt:
+                        cbks.on_checkpoint(mgr.last_complete_step)
+                except Exception:  # noqa: BLE001 — original error wins
+                    pass
             cbks.on_train_error(f"{type(e).__name__}: {e}")
             raise
         finally:

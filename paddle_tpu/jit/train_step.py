@@ -482,7 +482,15 @@ class TrainStep:
                     sp.record("numerics/first_bad_step", "numerics",
                               t_check, args={"step": self._step_count,
                                              "leaf": leaf, "kind": kind})
-                raise _numerics.NonFiniteError(self._step_count, leaf, kind)
+                failed_step = self._step_count
+                # a failed step never happened: params/state were not
+                # rebound, so the counter must not advance either — a
+                # skip-and-continue policy (resilience/numerics_policy)
+                # retries the NEXT batch at the same step index, keeping
+                # LR schedules and bias correction aligned with the
+                # updates that actually landed
+                self._step_count -= 1
+                raise _numerics.NonFiniteError(failed_step, leaf, kind)
         t_rebind = time.perf_counter() if sp is not None else None
         for p, a in zip(self._params, new_params):
             p._data = a

@@ -59,6 +59,9 @@ INSTRUMENTED_MODULES = (
     "paddle_tpu.io.prefetch",
     "paddle_tpu.hapi.model",
     "paddle_tpu.serving.engine",
+    "paddle_tpu.resilience.checkpoint_manager",
+    "paddle_tpu.resilience.resume",
+    "paddle_tpu.resilience.numerics_policy",
 )
 
 _registry = Registry()
@@ -127,6 +130,15 @@ _c_serve_decode = _registry.counter("serving/decode_steps")
 _g_serve_lanes = _registry.gauge("serving/lanes_occupied")
 _g_serve_free_blocks = _registry.gauge("serving/free_blocks")
 _h_serve_queue_wait = _registry.histogram("serving/queue_wait_ms")
+# resilience runtime (paddle_tpu/resilience — docs/RESILIENCE.md):
+# checkpoint traffic + the NaN skip policy. `save_ms` is the BLOCKING
+# cost per save (quiesce + host snapshot; file I/O overlaps training) —
+# exactly the number the cadence planner budgets against
+_c_res_saves = _registry.counter("resilience/saves")
+_h_res_save_ms = _registry.histogram("resilience/save_ms")
+_c_res_restores = _registry.counter("resilience/restores")
+_c_res_crash_resumes = _registry.counter("resilience/crash_resumes")
+_c_res_skipped = _registry.counter("resilience/skipped_batches")
 
 
 # -- public metric access ----------------------------------------------------
@@ -458,6 +470,28 @@ def on_serving_decode(lanes_active: int, free_blocks: int) -> None:
     _c_serve_decode.inc()
     _g_serve_lanes.set(lanes_active)
     _g_serve_free_blocks.set(free_blocks)
+
+
+def on_ckpt_save(blocked_ms: float) -> None:
+    """The CheckpointManager started one checkpoint; ``blocked_ms`` is
+    the training loop's blocking cost (quiesce + host snapshot — the
+    async writer's file I/O is not in it)."""
+    _c_res_saves.inc()
+    _h_res_save_ms.observe(blocked_ms)
+
+
+def on_ckpt_restore(crash_resume: bool = False) -> None:
+    """Training state restored from a checkpoint; ``crash_resume`` marks
+    a relaunch-after-failure restore (``PADDLE_RESTART_COUNT`` > 0) as
+    opposed to an operator-requested warm start."""
+    _c_res_restores.inc()
+    if crash_resume:
+        _c_res_crash_resumes.inc()
+
+
+def on_nan_skip(n: int = 1) -> None:
+    """The NaN policy dropped a poisoned batch and continued."""
+    _c_res_skipped.inc(n)
 
 
 from . import memory  # noqa: E402  — device memory observatory

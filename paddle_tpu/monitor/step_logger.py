@@ -45,6 +45,7 @@ class StepLogger:
             os.makedirs(d, exist_ok=True)
         self._f = open(self.path, "a")
         self._step = 0
+        self._ckpt_step = None
         self._t0 = self._t_last = time.perf_counter()
         self._prev = _mon.snapshot()
         self._write({
@@ -97,6 +98,12 @@ class StepLogger:
         self._write(line)
         return line
 
+    def note_checkpoint(self, step) -> None:
+        """Record the last COMPLETE checkpoint's step: the ``run_end``
+        line (clean or crashed) then says exactly what a relaunch will
+        resume from — the postmortem's first question."""
+        self._ckpt_step = int(step)
+
     def close(self, error=None, **fields) -> None:
         """Write the ``run_end`` totals line and close the file
         (idempotent). ``error`` marks a run that died mid-loop — the
@@ -108,6 +115,8 @@ class StepLogger:
                 "steps": self._step,
                 "wall_s": round(time.perf_counter() - self._t0, 3),
                 "totals": self._mon.snapshot()}
+        if self._ckpt_step is not None:
+            line["last_checkpoint_step"] = self._ckpt_step
         led = self._memory._ledger
         if led is not None and "memory" not in fields:
             # run-level memory account: peak HBM + per-executable records

@@ -1,0 +1,30 @@
+"""Resilience runtime: survive what the observability stack detects.
+
+PRs 1–6 made every long-run killer *visible* — retraces, HBM growth,
+non-finite steps, host stalls. This subsystem makes runs *survive* them
+(docs/RESILIENCE.md):
+
+- :class:`CheckpointManager` — periodic async sharded checkpoints on a
+  cadence planned from the measured save cost, with retention/GC and a
+  completeness manifest so resume never selects a torn checkpoint;
+- :mod:`resume` — capture/restore of the full training state (params,
+  optimizer, LR schedule, PRNG, data-iterator position) with
+  reshard-on-load, so a run saved at one (dp×mp) resumes at another;
+- :class:`NaNSkipPolicy` — the numerics sentinel's replay handed to a
+  skip-batch-and-continue policy with a consecutive-failure abort.
+
+Wired into ``hapi.Model.fit(checkpoint_dir=, resume_from=, nan_policy=)``
+and capped by ``tools/soak.py`` (fault-injected long-run gate).
+"""
+from .checkpoint_manager import (  # noqa: F401
+    CheckpointManager, complete_checkpoints, latest_complete,
+    read_manifest, step_dir,
+)
+from .numerics_policy import NaNSkipPolicy, SkipBudgetExceeded  # noqa: F401
+from . import resume  # noqa: F401
+
+__all__ = [
+    "CheckpointManager", "complete_checkpoints", "latest_complete",
+    "read_manifest", "step_dir", "NaNSkipPolicy", "SkipBudgetExceeded",
+    "resume",
+]

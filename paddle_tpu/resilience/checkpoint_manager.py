@@ -1,0 +1,393 @@
+"""Planned async checkpointing: cadence from measured save cost,
+retention/GC, and a completeness manifest.
+
+The resilience layer's writer half (docs/RESILIENCE.md). Composes
+``distributed/checkpoint.py`` (async sharded save, reshard-on-load by
+construction) into a manager that decides WHEN to save and guarantees a
+resumer only ever sees COMPLETE checkpoints:
+
+- **Cadence planner**: the blocking cost of a save (quiesce + host
+  snapshot — file I/O overlaps training) is measured on the first save
+  and re-measured on every one after; the interval is planned so that
+  cost stays ≤ ``PT_CKPT_OVERHEAD_PCT`` (2%) of wall-clock:
+  ``interval = ceil(save_cost / (pct/100 × step_time))``, clamped to
+  [``PT_CKPT_MIN_INTERVAL``, ``PT_CKPT_MAX_INTERVAL``]. Step time is an
+  EMA over observed ``tick()`` gaps, so the plan tracks the run it is
+  actually protecting rather than a config guess.
+- **Quiesce**: a save first ``drain()``s the caller's AsyncStepper —
+  in-flight donated steps chain through the param buffers, and a
+  snapshot taken mid-chain would race the rebind. After the drain the
+  async save's host snapshots are produced synchronously (owned copies,
+  ``distributed/checkpoint.py:save_state_dict`` ``snapshot=True``), so
+  training may resume the moment ``save()`` returns.
+- **Completeness manifest**: ``MANIFEST.json`` (atomic tmp+fsync+rename)
+  is written only after the async writer has joined and the shard files
+  + index verify via ``checkpoint.is_complete`` — its presence is the
+  resume-eligibility marker. A checkpoint killed mid-write has no
+  manifest (or fails the size check) and is skipped by
+  :func:`latest_complete`, which falls back to the previous complete one.
+- **Retention**: the newest ``PT_CKPT_KEEP`` (3) complete checkpoints
+  survive; older ones and dead torn directories are GC'd after each
+  finalize.
+
+Telemetry (None-slot, zero-overhead off): ``resilience/saves``,
+``resilience/save_ms`` (blocking cost histogram), via the shared
+``monitor`` registry.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import shutil
+import sys
+import time
+
+from ..monitor import _register as _monitor_register
+
+# Telemetry slot (see paddle_tpu.monitor): None unless PT_MONITOR wired it.
+_monitor = None
+
+_MANIFEST = "MANIFEST.json"
+_STEP_DIR = re.compile(r"^step-(\d{8})$")
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def step_dir(directory, step):
+    return os.path.join(directory, f"step-{int(step):08d}")
+
+
+def _is_coordinator():
+    """Multi-host: only process 0 publishes manifests and GCs the shared
+    directory — every process writing the SAME MANIFEST.json.tmp would
+    race. Single-process (and pre-init) trivially True."""
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:  # noqa: BLE001 — no backend yet: single-process
+        return True
+
+
+def _write_manifest(path, manifest):
+    """Atomic completeness marker (tmp + fsync + rename + dir fsync, via
+    ``checkpoint.atomic_write_json``): a crash while writing it can only
+    leave a checkpoint WITHOUT a manifest (torn, skipped at resume) —
+    never one with a truncated manifest."""
+    from ..distributed.checkpoint import atomic_write_json
+
+    atomic_write_json(os.path.join(path, _MANIFEST), manifest)
+
+
+def read_manifest(path):
+    """The checkpoint's manifest dict, or None when absent/unparseable."""
+    try:
+        with open(os.path.join(path, _MANIFEST)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def complete_checkpoints(directory, verify=True):
+    """Ascending ``[(step, path)]`` of COMPLETE checkpoints under
+    ``directory``: manifest present + parseable AND (when ``verify``)
+    the sharded files check out (``checkpoint.is_complete`` — a
+    truncated shard disqualifies even a manifested checkpoint).
+    ``verify=False`` trusts the manifests — for retention bookkeeping,
+    where re-mmapping every shard of every retained checkpoint on each
+    publish would be pointless I/O; resume selection always verifies."""
+    from ..distributed import checkpoint as dckpt
+
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in sorted(names):
+        m = _STEP_DIR.match(name)
+        if not m:
+            continue
+        path = os.path.join(directory, name)
+        manifest = read_manifest(path)
+        if manifest is None or (verify and not dckpt.is_complete(path)):
+            continue
+        out.append((int(m.group(1)), path))
+    return out
+
+
+def latest_complete(directory):
+    """``(step, path, manifest)`` of the newest complete checkpoint under
+    ``directory`` (or of ``directory`` itself when it is a single
+    manifested checkpoint dir), else None. Torn checkpoints — no
+    manifest, missing/truncated shard files — are skipped, falling back
+    to the previous complete one."""
+    from ..distributed import checkpoint as dckpt
+
+    manifest = read_manifest(directory)
+    if manifest is not None and dckpt.is_complete(directory):
+        return int(manifest.get("step", 0)), directory, manifest
+    found = complete_checkpoints(directory)
+    if found:
+        step, path = found[-1]
+        manifest = read_manifest(path)
+        if manifest is not None:  # vanishing TOCTOU window only
+            return step, path, manifest
+    return None
+
+
+class CheckpointManager:
+    """Periodic async sharded checkpoints with a planned cadence.
+
+    Usage (``hapi.fit`` drives exactly this)::
+
+        mgr = CheckpointManager(ckpt_dir)
+        for step, batch in enumerate(loader):
+            loss = stepper(*batch)
+            mgr.maybe_save(step, lambda: (flat_state, scalars),
+                           stepper=stepper)
+        mgr.save(step, (flat_state, scalars), stepper=stepper)  # final
+        mgr.finalize()
+
+    ``state_provider`` returns ``(flat, scalars)``: ``flat`` a
+    ``{key: Tensor|ndarray}`` dict for the sharded checkpoint, ``scalars``
+    a JSON-able dict stored in the manifest (step counters, LR-schedule
+    state, RNG key, data-iterator position). At most one async save is in
+    flight; a due save first finalizes the previous one.
+    """
+
+    def __init__(self, directory, keep=None, overhead_pct=None,
+                 min_interval=None, max_interval=None, interval=None,
+                 async_save=True):
+        self.directory = directory
+        self.keep = keep if keep is not None else _env_int("PT_CKPT_KEEP", 3)
+        self.overhead_pct = (overhead_pct if overhead_pct is not None
+                             else _env_float("PT_CKPT_OVERHEAD_PCT", 2.0))
+        self.min_interval = (min_interval if min_interval is not None
+                             else _env_int("PT_CKPT_MIN_INTERVAL", 1))
+        self.max_interval = (max_interval if max_interval is not None
+                             else _env_int("PT_CKPT_MAX_INTERVAL", 2000))
+        # explicit interval pins the cadence (planner off) — tests and
+        # save-every-step fixtures
+        self._fixed_interval = interval
+        self._async = async_save
+        self._interval = interval
+        self._last_save_step = None
+        self._start_step = None
+        self._ema_step_s = None
+        self._last_tick = None
+        self._last_cost_s = None
+        self._last_publish_s = 0.0
+        # (writer_thread, step, path, manifest) — ≤ 1 outstanding
+        self._pending = None
+        self.last_complete_step = None
+        existing = latest_complete(directory) if os.path.isdir(directory) \
+            else None
+        if existing is not None:
+            self.last_complete_step = existing[0]
+        os.makedirs(directory, exist_ok=True)
+
+    # -- cadence ------------------------------------------------------------
+
+    def plan_interval(self, save_cost_s, step_s):
+        """Steps between saves so checkpointing costs ≤ ``overhead_pct``
+        of wall-clock: ``ceil(cost / (pct/100 × step))``, clamped."""
+        if self._fixed_interval is not None:
+            return self._fixed_interval
+        if step_s is None or step_s <= 0 or save_cost_s is None:
+            return self.min_interval
+        budget = max(self.overhead_pct, 1e-6) / 100.0
+        raw = math.ceil(save_cost_s / (budget * step_s))
+        return max(self.min_interval, min(self.max_interval, int(raw)))
+
+    def _tick(self, step):
+        now = time.perf_counter()
+        if self._last_tick is not None and step != self._last_tick[0]:
+            dt = (now - self._last_tick[1]) / max(1, step
+                                                  - self._last_tick[0])
+            self._ema_step_s = dt if self._ema_step_s is None else (
+                0.8 * self._ema_step_s + 0.2 * dt)
+        self._last_tick = (step, now)
+        if self._start_step is None:
+            self._start_step = step
+
+    def due(self, step):
+        anchor = self._last_save_step
+        if anchor is None:
+            # first save after min_interval steps: early enough to
+            # measure the cost the planner needs, late enough that a
+            # resumed run doesn't immediately re-save what it just read
+            return step - (self._start_step
+                           if self._start_step is not None
+                           else step) + 1 >= self.min_interval
+        return step - anchor >= (self._interval or self.min_interval)
+
+    def maybe_save(self, step, state_provider, stepper=None):
+        """Tick the step clock; save when the planned cadence says so.
+        Returns True when a save was started."""
+        self._tick(step)
+        if not self.due(step):
+            return False
+        state = state_provider() if callable(state_provider) \
+            else state_provider
+        self.save(step, state, stepper=stepper)
+        return True
+
+    # -- saving -------------------------------------------------------------
+
+    def save(self, step, state, stepper=None):
+        """Checkpoint ``state = (flat, scalars)`` at ``step``. Blocks for
+        quiesce + host snapshot only (async file I/O overlaps training);
+        the measured blocking cost feeds the cadence planner."""
+        from ..distributed import checkpoint as dckpt
+
+        flat, scalars = state
+        t0 = time.perf_counter()
+        if stepper is not None and hasattr(stepper, "drain"):
+            # quiesce: no in-flight (possibly donated) step may race the
+            # snapshot — after the drain every param/state buffer is the
+            # post-step value and stays bound until the next dispatch
+            stepper.drain()
+        folded_publish = self.finalize() is not None  # ≤ 1 outstanding
+        path = step_dir(self.directory, step)
+        os.makedirs(path, exist_ok=True)
+        # UNPUBLISH before rewriting: if this step dir already holds a
+        # manifested checkpoint (e.g. re-saving the terminal step), its
+        # files are about to be rewritten in place — the manifest must
+        # come down first or a crash mid-rewrite leaves a half-stale
+        # checkpoint that still reads as complete
+        try:
+            os.remove(os.path.join(path, _MANIFEST))
+        except OSError:
+            pass
+        manifest = {"format": 1, "step": int(step),
+                    "time": round(time.time(), 3),
+                    "scalars": scalars or {}}
+        writer = dckpt.save_state_dict(flat, path, async_save=self._async)
+        blocked = time.perf_counter() - t0
+        self._last_cost_s = blocked
+        self._last_save_step = step
+        # the planner budgets EVERYTHING a checkpoint costs the training
+        # thread: this save's quiesce+snapshot plus the verify/manifest/
+        # GC publish of the previous one. When that publish just ran
+        # inside finalize() above it is already in `blocked`; otherwise
+        # it was paid between batches via poll() and is added here
+        cost = blocked if folded_publish else (blocked
+                                               + self._last_publish_s)
+        self._interval = self.plan_interval(cost, self._ema_step_s)
+        m = _monitor
+        if m is not None:
+            m.on_ckpt_save(blocked * 1e3)
+        if writer is None:  # sync save: finalize inline
+            self._publish(step, path, manifest)
+        else:
+            self._pending = (writer, step, path, manifest)
+        return path
+
+    def _publish(self, step, path, manifest):
+        from ..distributed import checkpoint as dckpt
+
+        t0 = time.perf_counter()
+        if not dckpt.is_complete(path):
+            raise RuntimeError(
+                f"checkpoint at {path} failed its completeness check "
+                "after the writer finished (torn files?) — not publishing "
+                "a manifest for it")
+        # coordinator-only on multi-host: the writer's join already
+        # barriered all processes past the index write, so process 0's
+        # manifest is the one publish (no shared-tmp race) and the GC
+        # has one driver
+        if _is_coordinator():
+            _write_manifest(path, manifest)
+            self.gc()
+        self.last_complete_step = step
+        self._last_publish_s = time.perf_counter() - t0
+
+    def finalize(self):
+        """Join the outstanding async save (if any), verify it, and
+        publish its manifest. Raises if the writer failed — a failed
+        checkpoint must not pass for a written one. Returns the newly
+        completed step, or None."""
+        if self._pending is None:
+            return None
+        writer, step, path, manifest = self._pending
+        self._pending = None
+        writer.join()
+        # the module-global wait_all() registry would otherwise keep one
+        # dead (already-joined) thread per save for process life
+        from ..distributed import checkpoint as dckpt
+
+        try:
+            dckpt._pending.remove(writer)
+        except ValueError:
+            pass
+        self._publish(step, path, manifest)
+        return step
+
+    def poll(self):
+        """Non-blocking: publish the outstanding save iff its writer has
+        already finished. Returns the newly completed step, or None."""
+        if self._pending is None or self._pending[0].is_alive():
+            return None
+        return self.finalize()
+
+    @property
+    def interval(self):
+        return self._interval
+
+    @property
+    def last_save_step(self):
+        return self._last_save_step
+
+    @property
+    def last_save_cost_s(self):
+        return self._last_cost_s
+
+    # -- retention ----------------------------------------------------------
+
+    def gc(self):
+        """Keep the newest ``keep`` complete checkpoints; drop older
+        complete ones and torn directories older than the newest complete
+        (a torn dir NEWER than it may be a save in progress). Only called
+        from ``_publish``, which runs after the outstanding writer has
+        joined and before any new save dir exists — so an in-flight
+        save's directory is never a GC candidate by ordering."""
+        # manifest-presence only: each retained checkpoint was shard-
+        # verified once when its own manifest was published
+        complete = complete_checkpoints(self.directory, verify=False)
+        goners = complete[:-self.keep] if self.keep > 0 else []
+        goner_paths = {p for _, p in goners}
+        keep_paths = {p for _, p in complete[len(goners):]}
+        newest = complete[-1][0] if complete else None
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            m = _STEP_DIR.match(name)
+            if not m:
+                continue
+            path = os.path.join(self.directory, name)
+            if path in keep_paths:
+                continue
+            step = int(m.group(1))
+            torn = read_manifest(path) is None
+            if path in goner_paths or (
+                    torn and newest is not None and step < newest):
+                shutil.rmtree(path, ignore_errors=True)
+
+
+_monitor_register(sys.modules[__name__])

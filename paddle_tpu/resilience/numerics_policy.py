@@ -1,0 +1,93 @@
+"""NaN skip-and-continue: the sentinel's replay handed to a policy.
+
+The numerics sentinel (``monitor/numerics.py``, ``PT_NANCHECK=1`` /
+``fit(nan_check=True)``) turns a poisoned batch into a
+:class:`~paddle_tpu.monitor.numerics.NonFiniteError` raised BEFORE the
+param rebind — donation is suspended while armed, so the pre-step params
+are still live and the step effectively never happened (the step counter
+is rolled back on the raise path, ``jit/train_step.py``). That makes
+"skip the batch and continue" a safe policy rather than a prayer: this
+module decides whether to.
+
+Semantics (docs/RESILIENCE.md):
+
+- a skipped batch is as if it never arrived: params, optimizer state,
+  step counters and the LR schedule are all untouched; only the data
+  iterator advanced (and the PRNG stream consumed one key);
+- ``resilience/skipped_batches`` counts every skip (None-slot telemetry);
+- ``PT_NANSKIP_MAX`` (3) CONSECUTIVE failures abort the run with
+  :class:`SkipBudgetExceeded` chaining the last ``NonFiniteError`` —
+  one cosmic-ray batch is survivable, a diverged model is not, and
+  consecutive non-finite steps on fresh data mean the params themselves
+  are the problem. Any successful step resets the consecutive count.
+
+Armed via ``hapi.fit(nan_policy="skip")`` (which forces the sentinel on
+for that fit) or used directly around any ``TrainStep`` call.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+from ..monitor import _register as _monitor_register
+
+# Telemetry slot (see paddle_tpu.monitor): None unless PT_MONITOR wired it.
+_monitor = None
+
+
+class SkipBudgetExceeded(RuntimeError):
+    """Too many CONSECUTIVE non-finite steps: the model (not a batch) is
+    bad. Carries ``consecutive`` and chains the last ``NonFiniteError``
+    (``__cause__``) naming the final bad leaf."""
+
+    def __init__(self, consecutive, last):
+        self.consecutive = consecutive
+        self.last = last
+        super().__init__(
+            f"{consecutive} consecutive non-finite step(s) "
+            f"(PT_NANSKIP_MAX): skipping batches can no longer help — "
+            f"last failure: {last}")
+
+
+class NaNSkipPolicy:
+    """Count-and-decide for sentinel failures.
+
+    ``record_failure(err)`` either returns (the caller skips the batch
+    and continues) or raises :class:`SkipBudgetExceeded`;
+    ``record_success()`` resets the consecutive count after any healthy
+    step. ``skipped`` totals the batches dropped over the policy's life.
+    """
+
+    def __init__(self, max_consecutive=None):
+        if max_consecutive is None:
+            try:
+                max_consecutive = int(
+                    os.environ.get("PT_NANSKIP_MAX", "") or 3)
+            except ValueError:
+                max_consecutive = 3
+        if max_consecutive < 1:
+            raise ValueError(
+                f"NaNSkipPolicy: max_consecutive must be >= 1 "
+                f"(got {max_consecutive})")
+        self.max_consecutive = max_consecutive
+        self.skipped = 0
+        self.consecutive = 0
+
+    def record_failure(self, err):
+        """One sentinel failure on the current batch. Returns the running
+        consecutive count when the batch should be skipped; raises
+        :class:`SkipBudgetExceeded` when the budget is spent."""
+        self.consecutive += 1
+        self.skipped += 1
+        m = _monitor
+        if m is not None:
+            m.on_nan_skip()
+        if self.consecutive >= self.max_consecutive:
+            raise SkipBudgetExceeded(self.consecutive, err) from err
+        return self.consecutive
+
+    def record_success(self):
+        self.consecutive = 0
+
+
+_monitor_register(sys.modules[__name__])

@@ -1,0 +1,221 @@
+"""Resumable training state: capture/restore over the sharded checkpoint.
+
+The resilience layer's reader half (docs/RESILIENCE.md). A training
+checkpoint is two artifacts:
+
+- the **sharded tensor state** (``distributed/checkpoint.py``): every
+  model param/buffer under ``model.<name>`` and every optimizer
+  accumulator/master under ``opt.<name>.<slot>`` — saved per shard
+  region, loaded with the DESTINATION's sharding, so a run saved at one
+  (dp×mp) resumes at another by construction (the portable
+  redistribution contract, arXiv 2112.01075);
+- the **scalar manifest** (``CheckpointManager``'s MANIFEST.json):
+  optimizer step counters, LR-schedule state, the global PRNG key
+  (``jax.random.key_data`` words), and the data-iterator position
+  (epoch + batches consumed), so a resumed loop replays the exact
+  remaining batch sequence of a deterministic loader.
+
+Restore places every optimizer leaf with its owning param's CURRENT
+sharding before loading (reshard target), then writes the loaded arrays
+back into ``optimizer._accumulators`` — never materializing global
+values on the host for sharded leaves.
+
+Telemetry (None-slot, zero-overhead off): ``resilience/restores`` and
+``resilience/crash_resumes``.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from ..framework.core import Tensor
+from ..monitor import _register as _monitor_register
+
+# Telemetry slot (see paddle_tpu.monitor): None unless PT_MONITOR wired it.
+_monitor = None
+
+MODEL_PREFIX = "model."
+OPT_PREFIX = "opt."
+
+
+def _rng_key_words():
+    import jax
+
+    from ..framework import random as rng
+
+    return np.asarray(jax.random.key_data(rng.get_rng_state())) \
+        .astype(np.uint32).tolist()
+
+
+def _set_rng_key_words(words):
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework import random as rng
+
+    rng.set_rng_state(jax.random.wrap_key_data(
+        jnp.asarray(np.asarray(words, dtype=np.uint32))))
+
+
+def capture(network, optimizer, epoch=None, batch_in_epoch=None,
+            step=None, extra=None):
+    """``(flat, scalars)`` for a CheckpointManager save: ``flat`` the
+    sharded-checkpoint dict (live Tensor references — values are read at
+    snapshot time, after the quiesce), ``scalars`` the JSON manifest
+    payload (optimizer counters, LR schedule, PRNG key, data position).
+    """
+    flat = {}
+    for k, v in network.state_dict().items():
+        flat[MODEL_PREFIX + k] = v
+    opt_scalars = {}
+    if optimizer is not None:
+        for k, v in optimizer.state_dict().items():
+            if isinstance(v, Tensor):
+                flat[OPT_PREFIX + k] = v
+            else:  # global_step / per-param step_count ints, LR_Scheduler
+                opt_scalars[k] = v
+    scalars = {
+        "opt": opt_scalars,
+        "rng_key": _rng_key_words(),
+    }
+    if epoch is not None:
+        scalars["epoch"] = int(epoch)
+    if batch_in_epoch is not None:
+        scalars["batch_in_epoch"] = int(batch_in_epoch)
+    if step is not None:
+        scalars["step"] = int(step)
+    if extra:
+        scalars.update(extra)
+    return flat, scalars
+
+
+def _restore_model(network, index, path):
+    from ..distributed import checkpoint as dckpt
+
+    dest = {}
+    for k, t in network.state_dict().items():
+        key = MODEL_PREFIX + k
+        if key not in index:
+            raise KeyError(
+                f"checkpoint at {path} is missing model tensor {k!r} — "
+                "not a checkpoint of this model")
+        dest[key] = t  # live references: load reshards in place
+    dckpt.load_state_dict(dest, path)
+
+
+def _restore_optimizer(optimizer, index, path, opt_scalars):
+    """Reshard-on-load for the optimizer: init each accumulator leaf with
+    the owning param's CURRENT placement as the destination, load into
+    wrappers, write the loaded arrays back into ``_accumulators``."""
+    import jax
+
+    from ..distributed import checkpoint as dckpt
+    from ..optimizer.lr import LRScheduler
+
+    optimizer._global_step = int(opt_scalars.get("global_step", 0))
+    sched = opt_scalars.get("LR_Scheduler")
+    if sched and isinstance(optimizer._learning_rate, LRScheduler):
+        optimizer._learning_rate.set_state_dict(sched)
+    dest, writeback = {}, []
+    for i, p in enumerate(optimizer._parameter_list):
+        name = p.name or f"param_{i}"
+        st = optimizer._init_state(p._data)
+        placed = {}
+        sharding = getattr(p._data, "sharding", None)
+        missing = [k for k in st
+                   if f"{OPT_PREFIX}{name}.{k}" not in index]
+        if missing and not getattr(p, "stop_gradient", False) and (
+                len(missing) != len(st)
+                or int(opt_scalars.get("global_step", 0)) > 0):
+            # fail fast, like the model-side restore: restoring
+            # global_step=N next to freshly-zeroed moments would make
+            # bias correction treat zeros as converged statistics and
+            # silently walk off the loss curve
+            raise KeyError(
+                f"checkpoint at {path} is missing optimizer state "
+                f"{missing!r} for param {name!r} — saved under a "
+                f"different optimizer config?")
+        for k, v in st.items():
+            key = f"{OPT_PREFIX}{name}.{k}"
+            if key not in index:
+                continue
+            if sharding is not None and tuple(v.shape) == tuple(
+                    p._data.shape):
+                v = jax.device_put(v, sharding)
+            placed[k] = dest[key] = Tensor(v)
+        mkey = f"{OPT_PREFIX}{name}.master_weight"
+        master = None
+        if mkey in index:
+            import jax.numpy as jnp
+
+            mw = jnp.asarray(p._data, jnp.float32)
+            if sharding is not None:
+                mw = jax.device_put(mw, sharding)
+            master = dest[mkey] = Tensor(mw)
+        if placed or master is not None:
+            writeback.append((p, name, st, placed, master))
+    if dest:
+        dckpt.load_state_dict(dest, path)
+    for p, name, st, placed, master in writeback:
+        for k, t in placed.items():
+            st[k] = t._data
+        optimizer._accumulators[id(p)] = st
+        optimizer._step_counts[id(p)] = int(opt_scalars.get(
+            f"{name}.step_count", optimizer._global_step))
+        if master is not None:
+            optimizer._master_weights[id(p)] = master._data
+
+
+def restore(network, optimizer, path, manifest=None, train_step=None,
+            crash_resume=False):
+    """Restore params / optimizer state / LR schedule / PRNG / counters
+    from the complete checkpoint at ``path`` (its tensors reshard into
+    the destinations' current placements). Returns the manifest scalars
+    (epoch / batch_in_epoch / step for the caller's loop position).
+
+    ``train_step`` (a ``jit.TrainStep``): its functional state mirror is
+    reset so the next dispatch rebuilds from the restored accumulators
+    instead of stale pre-restore arrays.
+    """
+    from ..distributed import checkpoint as dckpt
+    from .checkpoint_manager import read_manifest
+
+    if manifest is None:
+        manifest = read_manifest(path) or {}
+    scalars = manifest.get("scalars", {})
+    index = dckpt._load_index(path)
+    _restore_model(network, index, path)
+    if optimizer is not None:
+        _restore_optimizer(optimizer, index, path,
+                           scalars.get("opt", {}))
+    if scalars.get("rng_key") is not None:
+        _set_rng_key_words(scalars["rng_key"])
+    if train_step is not None:
+        train_step._state = []
+        train_step._masters = []
+        train_step._step_count = (optimizer._global_step
+                                  if optimizer is not None else 0)
+    m = _monitor
+    if m is not None:
+        m.on_ckpt_restore(crash_resume=crash_resume)
+    return scalars
+
+
+def restore_latest(network, optimizer, directory, train_step=None,
+                   crash_resume=False):
+    """:func:`restore` from the newest COMPLETE checkpoint under
+    ``directory`` (torn ones skipped — ``latest_complete``). Returns the
+    manifest scalars, or None when no complete checkpoint exists (fresh
+    start)."""
+    from .checkpoint_manager import latest_complete
+
+    found = latest_complete(directory)
+    if found is None:
+        return None
+    step, path, manifest = found
+    return restore(network, optimizer, path, manifest=manifest,
+                   train_step=train_step, crash_resume=crash_resume)
+
+
+_monitor_register(sys.modules[__name__])

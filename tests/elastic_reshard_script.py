@@ -1,0 +1,90 @@
+"""Elastic kill/resume fixture WITH A MESH CHANGE: life 0 trains with
+params sharded over a 2-device "mp" axis and crashes mid-run; the
+launcher relaunch (PADDLE_RESTART_COUNT) rebuilds the model on a
+DIFFERENT mesh layout (RESHARD_MESH_R1, default 4 devices) and resumes
+from the resilience checkpoint — reshard-on-load by construction
+(distributed/checkpoint.py assembles each destination region from the
+overlapping saved shard files).
+
+Used by tests/test_elastic.py::test_kill_relaunch_resume_reshard: the
+stitched loss trajectory must stay on the SAME curve as an uninterrupted
+single-mesh run (loss-equivalence under resharding; bit-exactness is the
+same-topology test's job).
+"""
+import json
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, NamedSharding  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+from paddle_tpu import resilience  # noqa: E402
+from paddle_tpu.resilience import resume as rez  # noqa: E402
+
+WORKDIR = sys.argv[1]
+CRASH_AT = int(os.environ.get("ELASTIC_CRASH_AT", "-1"))
+TOTAL_STEPS = 6
+restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+mesh_n = int(os.environ.get(
+    "RESHARD_MESH_R1" if restart else "RESHARD_MESH", "2"))
+
+paddle.seed(0)
+model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+mesh = Mesh(np.array(jax.devices()[:mesh_n]), ("mp",))
+lin1, lin2 = model[0], model[2]
+# megatron-ish placement: column-parallel then row-parallel
+lin1.weight._data = jax.device_put(lin1.weight._data,
+                                   NamedSharding(mesh, P(None, "mp")))
+lin1.bias._data = jax.device_put(lin1.bias._data,
+                                 NamedSharding(mesh, P("mp")))
+lin2.weight._data = jax.device_put(lin2.weight._data,
+                                   NamedSharding(mesh, P("mp", None)))
+lin2.bias._data = jax.device_put(lin2.bias._data,
+                                 NamedSharding(mesh, P()))
+opt = paddle.optimizer.AdamW(learning_rate=5e-2,
+                             parameters=model.parameters())
+
+rng = np.random.default_rng(0)
+xs = rng.standard_normal((TOTAL_STEPS, 16, 8)).astype("float32")
+w_true = rng.standard_normal((8, 1)).astype("float32")
+repl = NamedSharding(mesh, P())
+
+ckpt_dir = os.path.join(WORKDIR, "ckpt")
+start_step = 0
+scal = rez.restore_latest(model, opt, ckpt_dir,
+                          crash_resume=restart > 0)
+if scal is not None:
+    start_step = int(scal.get("step", 0))
+
+# sync saves: this fixture proves RESHARD equivalence; torn-checkpoint
+# fallback has its own test (test_resilience.py)
+mgr = resilience.CheckpointManager(ckpt_dir, interval=1, keep=3,
+                                   async_save=False)
+losses = []
+for step in range(start_step, TOTAL_STEPS):
+    x = paddle.Tensor(jax.device_put(xs[step], repl))
+    y = paddle.Tensor(jax.device_put(xs[step] @ w_true, repl))
+    loss = F.mse_loss(model(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    losses.append(float(np.asarray(loss.numpy()).reshape(-1)[0]))
+    with open(os.path.join(WORKDIR, f"losses_r{restart}.json"), "w") as f:
+        json.dump({"start": start_step, "losses": losses,
+                   "mesh": mesh_n}, f)
+    mgr.save(step + 1, rez.capture(model, opt, step=step + 1))
+    if restart == 0 and step + 1 == CRASH_AT:
+        os._exit(17)  # simulated preemption mid-training

@@ -198,6 +198,36 @@ class TestEvaluate:
         assert v2["ok"]
         assert not any(c["name"] == "ttft_p99" for c in v2["checks"])
 
+    def test_flags_save_cost_growth(self, guard):
+        base = {"metric": "soak", "value": 900.0, "backend": "tpu",
+                "extra": {"ckpt_save_ms_p50": 300.0}}
+        fresh = {"metric": "soak", "value": 910.0, "unit": "samples/s",
+                 "ckpt_save_ms_p50": 700.0}
+        v = guard.evaluate(fresh, base, hardware=True)
+        assert not v["ok"]
+        assert any(c["name"] == "ckpt_save_ms" and not c["ok"]
+                   for c in v["checks"])
+
+    def test_save_cost_within_slack_passes(self, guard):
+        # +100% but under the 250 ms absolute slack: small-save noise
+        base = {"metric": "soak", "value": 900.0, "backend": "tpu",
+                "extra": {"ckpt_save_ms_p50": 40.0}}
+        fresh = {"metric": "soak", "value": 905.0, "unit": "samples/s",
+                 "ckpt_save_ms_p50": 80.0}
+        v = guard.evaluate(fresh, base, hardware=True)
+        assert v["ok"]
+        assert any(c["name"] == "ckpt_save_ms" and c["ok"]
+                   for c in v["checks"])
+
+    def test_save_cost_gate_absent_without_field(self, guard):
+        base = {"metric": "soak", "value": 900.0, "backend": "tpu",
+                "extra": {}}
+        fresh = {"metric": "soak", "value": 905.0, "unit": "samples/s",
+                 "ckpt_save_ms_p50": 9000.0}
+        v = guard.evaluate(fresh, base, hardware=True)
+        assert v["ok"]
+        assert not any(c["name"] == "ckpt_save_ms" for c in v["checks"])
+
     def test_flags_error_line(self, guard, store):
         fresh = {"metric": _METRIC, "value": 0.0, "unit": "tokens/s",
                  "error": "bench watchdog fired"}

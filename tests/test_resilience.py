@@ -1,0 +1,508 @@
+"""Resilience runtime tests (paddle_tpu/resilience — docs/RESILIENCE.md):
+planned checkpoints, torn-checkpoint fallback, crash-resume bit-exactness,
+reshard-on-resume, NaN skip-and-continue, and the soak smoke gate."""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import monitor, resilience
+from paddle_tpu.distributed import checkpoint as dckpt
+from paddle_tpu.resilience import resume as rez
+
+REPO = str(Path(__file__).parent.parent)
+
+
+def _build(seed=0, lr=5e-2):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = paddle.optimizer.AdamW(learning_rate=lr,
+                                 parameters=net.parameters())
+    model = paddle.Model(net)
+    model.prepare(opt, nn.MSELoss())
+    return model
+
+
+def _dataset(n=48, poison_batch=None, batch=8):
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((n, 8)).astype("float32")
+    ys = xs @ rng.standard_normal((8, 1)).astype("float32")
+    if poison_batch is not None:
+        xs[poison_batch * batch:(poison_batch + 1) * batch] = np.nan
+    return [(xs[i], ys[i]) for i in range(n)]
+
+
+class _Cap(paddle.callbacks.Callback):
+    """Per-batch loss capture + optional simulated crash (raise from the
+    batch-end hook — fit's error path must still finalize checkpoints)."""
+
+    def __init__(self, sink, crash_at=None):
+        self.sink = sink
+        self.crash_at = crash_at
+        self.n = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self.sink.append(float(logs["loss"]))
+        self.n += 1
+        if self.crash_at is not None and self.n == self.crash_at:
+            raise RuntimeError("simulated crash")
+
+
+# -- CheckpointManager -------------------------------------------------------
+
+def test_manager_save_gc_and_latest(tmp_path):
+    model = _build()
+    opt = model._optimizer
+    ck = str(tmp_path / "ck")
+    mgr = resilience.CheckpointManager(ck, keep=2, interval=1,
+                                       async_save=False)
+    for step in (1, 2, 3):
+        mgr.save(step, rez.capture(model.network, opt, epoch=0,
+                                   batch_in_epoch=step, step=step))
+    # retention: keep=2 -> steps 1 GC'd, 2+3 survive
+    assert [s for s, _ in resilience.complete_checkpoints(ck)] == [2, 3]
+    step, path, manifest = resilience.latest_complete(ck)
+    assert step == 3 and manifest["scalars"]["step"] == 3
+    assert dckpt.is_complete(path)
+
+
+def test_torn_checkpoint_never_selected(tmp_path):
+    """Satellite regression: a checkpoint with a truncated shard file (a
+    mid-save crash) must fail is_complete and be skipped by the resume
+    selector in favor of the previous complete one."""
+    model = _build()
+    ck = str(tmp_path / "ck")
+    mgr = resilience.CheckpointManager(ck, keep=3, interval=1,
+                                       async_save=False)
+    for step in (1, 2):
+        mgr.save(step, rez.capture(model.network, model._optimizer,
+                                   step=step))
+    newest = resilience.step_dir(ck, 2)
+    shard = next(p for p in sorted(os.listdir(newest))
+                 if p.endswith(".npy"))
+    fpath = os.path.join(newest, shard)
+    with open(fpath, "r+b") as f:
+        f.truncate(os.path.getsize(fpath) // 2)
+    assert not dckpt.is_complete(newest)
+    step, path, _ = resilience.latest_complete(ck)
+    assert step == 1, "torn checkpoint must not be the resume point"
+    # a manifest-less directory (killed before finalize) is torn too
+    os.remove(os.path.join(resilience.step_dir(ck, 1), "MANIFEST.json"))
+    assert resilience.latest_complete(ck) is None
+
+
+def test_index_written_atomically(tmp_path):
+    """index.json lands via tmp+rename: no .tmp residue, parseable, and
+    every shard entry carries its payload size for is_complete."""
+    path = str(tmp_path / "ck")
+    model = _build()
+    dckpt.save_state_dict(
+        {k: v for k, v in model.network.state_dict().items()}, path)
+    assert not os.path.exists(os.path.join(path, "index.json.tmp"))
+    with open(os.path.join(path, "index.json")) as f:
+        index = json.load(f)
+    for meta in index["tensors"].values():
+        for sh in meta["shards"]:
+            assert sh["bytes"] > 0
+            assert os.path.getsize(os.path.join(path, sh["file"])) \
+                > sh["bytes"]
+
+
+def test_chunk_streamed_shard_seals_atomically(tmp_path, monkeypatch):
+    """Review finding: chunk-streamed shards allocate their full memmap
+    up front, so size checks can't see a torn stream — the .tmp→final
+    rename is the completeness marker."""
+    monkeypatch.setattr(dckpt, "_CHUNK_BYTES", 256)
+    big = paddle.to_tensor(
+        np.arange(512, dtype=np.float32).reshape(64, 8))
+    path = str(tmp_path / "ck")
+    dckpt.save_state_dict({"big": big}, path)
+    assert not any(n.endswith(".tmp") for n in os.listdir(path))
+    assert dckpt.is_complete(path)
+    np.testing.assert_array_equal(dckpt.load_checkpoint(path)["big"],
+                                  np.asarray(big._data))
+    # a writer killed mid-stream leaves only the .tmp (no final name)
+    with open(os.path.join(path, "index.json")) as f:
+        index = json.load(f)
+    fname = index["tensors"]["big"]["shards"][0]["file"]
+    os.rename(os.path.join(path, fname),
+              os.path.join(path, fname + ".tmp"))
+    assert not dckpt.is_complete(path)
+
+
+def test_terminal_resave_never_tears_a_published_checkpoint(tmp_path):
+    """Review finding: re-saving into a step dir must unpublish its
+    manifest before rewriting files (manifest == complete invariant);
+    and a resumed FINISHED run must not re-save its terminal step at
+    all."""
+    model = _build()
+    ck = str(tmp_path / "ck")
+    mgr = resilience.CheckpointManager(ck, interval=1, async_save=False)
+    mgr.save(1, rez.capture(model.network, model._optimizer, step=1))
+    mtime0 = os.path.getmtime(
+        os.path.join(resilience.step_dir(ck, 1), "MANIFEST.json"))
+    # re-save same step: old manifest removed before rewrite, republished
+    mgr.save(1, rez.capture(model.network, model._optimizer, step=1))
+    assert resilience.latest_complete(ck)[0] == 1
+    assert os.path.getmtime(os.path.join(
+        resilience.step_dir(ck, 1), "MANIFEST.json")) >= mtime0
+
+
+def test_cadence_planner_math(tmp_path):
+    mgr = resilience.CheckpointManager(str(tmp_path / "ck"), keep=2,
+                                       overhead_pct=2.0, min_interval=1,
+                                       max_interval=1000)
+    # 1 s save, 100 ms steps, 2% budget -> every 500 steps
+    assert mgr.plan_interval(1.0, 0.1) == 500
+    # clamped at both ends
+    assert mgr.plan_interval(0.0001, 10.0) == 1
+    assert mgr.plan_interval(1000.0, 0.001) == 1000
+    # no step-time estimate yet -> conservative floor
+    assert mgr.plan_interval(1.0, None) == 1
+    fixed = resilience.CheckpointManager(str(tmp_path / "ck2"),
+                                         interval=7)
+    assert fixed.plan_interval(1.0, 0.1) == 7
+
+
+def test_async_save_quiesces_and_publishes(tmp_path):
+    """Async path: save() returns fast, finalize() publishes the
+    manifest, and the monitor counts the save under the None-slot
+    contract."""
+    model = _build()
+    ck = str(tmp_path / "ck")
+    monitor.enable()
+    try:
+        monitor.reset()
+        mgr = resilience.CheckpointManager(ck, interval=1)
+        mgr.save(1, rez.capture(model.network, model._optimizer, step=1))
+        assert mgr.finalize() == 1
+        assert mgr.last_complete_step == 1
+        snap = monitor.snapshot()["counters"]
+        assert snap.get("resilience/saves") == 1
+        h = monitor.snapshot()["histograms"]["resilience/save_ms"]
+        assert h["count"] == 1
+    finally:
+        monitor.disable()
+        monitor.reset()
+
+
+# -- fit integration ---------------------------------------------------------
+
+def test_fit_crash_resume_bitexact(tmp_path, monkeypatch):
+    """The acceptance core, in-process: a run killed mid-fit and resumed
+    from its checkpoint finishes with params BIT-IDENTICAL to an
+    uninterrupted run at the same topology."""
+    monkeypatch.setenv("PT_CKPT_MAX_INTERVAL", "1")
+    ds = _dataset()
+    ck = str(tmp_path / "ck")
+
+    clean = _build()
+    lc = []
+    clean.fit(ds, batch_size=8, epochs=2, shuffle=False, verbose=0,
+              log_freq=1, callbacks=[_Cap(lc)])
+
+    m1 = _build()
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        m1.fit(ds, batch_size=8, epochs=2, shuffle=False, verbose=0,
+               log_freq=1, checkpoint_dir=ck,
+               callbacks=[_Cap([], crash_at=8)])
+    # crash raised from batch 8's end-hook, before its checkpoint: the
+    # newest COMPLETE checkpoint is step 7 — or 6 when step 7's async
+    # writer hadn't finished at crash time (the crash path polls, never
+    # blocks on a possibly-stalled writer)
+    last = resilience.latest_complete(ck)[0]
+    assert last in (6, 7), last
+
+    m2 = _build()
+    l2 = []
+    m2.fit(ds, batch_size=8, epochs=2, shuffle=False, verbose=0,
+           log_freq=1, resume_from=ck, callbacks=[_Cap(l2)])
+    assert np.allclose(lc[last:], l2, atol=0), (lc[last:], l2)
+    for (k, a), (_, b) in zip(clean.network.state_dict().items(),
+                              m2.network.state_dict().items()):
+        assert np.array_equal(np.asarray(a._data), np.asarray(b._data)), k
+
+
+def test_fit_resume_of_finished_run_is_noop(tmp_path):
+    ds = _dataset()
+    ck = str(tmp_path / "ck")
+    m1 = _build()
+    m1.fit(ds, batch_size=8, epochs=1, shuffle=False, verbose=0,
+           checkpoint_dir=ck)
+    m2 = _build()
+    l2 = []
+    m2.fit(ds, batch_size=8, epochs=1, shuffle=False, verbose=0,
+           resume_from=ck, callbacks=[_Cap(l2)])
+    assert l2 == []  # terminal checkpoint says epoch==epochs: nothing left
+    for (k, a), (_, b) in zip(m1.network.state_dict().items(),
+                              m2.network.state_dict().items()):
+        assert np.array_equal(np.asarray(a._data), np.asarray(b._data)), k
+
+
+def test_restore_reshards_to_new_mesh(tmp_path):
+    """Save with params (and optimizer moments) sharded over a 2-device
+    mesh axis, restore into a 4-device layout: values identical, new
+    placement honored — reshard-on-load, end to end through the
+    resilience capture/restore path."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import paddle_tpu.nn.functional as F
+
+    def build(n_dev):
+        paddle.seed(3)
+        net = nn.Linear(8, 4)
+        mesh = Mesh(np.array(jax.devices()[:n_dev]), ("mp",))
+        net.weight._data = jax.device_put(
+            net.weight._data, NamedSharding(mesh, P(None, "mp")))
+        net.bias._data = jax.device_put(
+            net.bias._data, NamedSharding(mesh, P("mp")))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=net.parameters())
+        return net, opt
+
+    net2, opt2 = build(2)
+    mesh2 = net2.weight._data.sharding.mesh
+    x = paddle.Tensor(jax.device_put(
+        np.random.RandomState(0).randn(4, 8).astype("float32"),
+        NamedSharding(mesh2, P())))
+    y = paddle.Tensor(jax.device_put(np.zeros((4, 4), dtype="float32"),
+                                     NamedSharding(mesh2, P())))
+    loss = F.mse_loss(net2(x), y)
+    loss.backward()
+    opt2.step()  # accumulators now exist (sharded like their params)
+    ck = str(tmp_path / "ck")
+    mgr = resilience.CheckpointManager(ck, interval=1, async_save=False)
+    mgr.save(1, rez.capture(net2, opt2, step=1))
+
+    net4, opt4 = build(4)
+    scal = rez.restore_latest(net4, opt4, ck)
+    assert scal["step"] == 1
+    for (k, a), (_, b) in zip(net2.state_dict().items(),
+                              net4.state_dict().items()):
+        np.testing.assert_array_equal(np.asarray(a._data),
+                                      np.asarray(b._data), err_msg=k)
+    # destination placement (the 4-device mesh) was honored
+    assert len(net4.weight._data.sharding.mesh.devices.ravel()) == 4
+    # optimizer moments restored value-identical
+    for p2, p4 in zip(opt2._parameter_list, opt4._parameter_list):
+        st2 = opt2._accumulators[id(p2)]
+        st4 = opt4._accumulators[id(p4)]
+        assert sorted(st2) == sorted(st4)
+        for key in st2:
+            np.testing.assert_allclose(np.asarray(st2[key]),
+                                       np.asarray(st4[key]), atol=0)
+
+
+def test_fit_nan_skip_and_budget(monkeypatch):
+    """nan_policy='skip': the poisoned batch is dropped (finite losses,
+    one skip counted, step counters unaffected); an all-poison stream
+    aborts after PT_NANSKIP_MAX consecutive failures."""
+    ds = _dataset(poison_batch=2)
+    m = _build()
+    losses = []
+    monitor.enable()
+    try:
+        monitor.reset()
+        m.fit(ds, batch_size=8, epochs=1, shuffle=False, verbose=0,
+              log_freq=1, nan_policy="skip", callbacks=[_Cap(losses)])
+        snap = monitor.snapshot()["counters"]
+        assert len(losses) == 5 and np.isfinite(losses).all()
+        assert snap.get("resilience/skipped_batches") == 1
+        assert snap.get("numerics/failures") == 1
+    finally:
+        monitor.disable()
+        monitor.reset()
+    # a skipped step never happened: 5 updates -> global_step 5
+    assert m._optimizer._global_step == 5
+
+    monkeypatch.setenv("PT_NANSKIP_MAX", "2")
+    bad = [(np.full(8, np.nan, np.float32), np.zeros(1, np.float32))
+           for _ in range(24)]
+    m2 = _build()
+    with pytest.raises(resilience.SkipBudgetExceeded) as ei:
+        m2.fit(bad, batch_size=8, epochs=1, shuffle=False, verbose=0,
+               nan_policy="skip")
+    assert ei.value.consecutive == 2
+    from paddle_tpu.monitor.numerics import NonFiniteError
+
+    assert isinstance(ei.value.__cause__, NonFiniteError)
+
+
+def test_nan_skip_counts_toward_num_iters():
+    """A poison-heavy stream cannot run the loop past num_iters: skipped
+    batches count toward the iteration budget (review finding)."""
+    bad = _dataset(poison_batch=0)  # first batch poisoned
+    m = _build()
+    seen = []
+    m.fit(bad, batch_size=8, epochs=1, shuffle=False, verbose=0,
+          log_freq=1, nan_policy="skip", num_iters=2,
+          callbacks=[_Cap(seen)])
+    # budget 2 = 1 skip + 1 trained batch
+    assert len(seen) == 1
+    assert m._optimizer._global_step == 1
+
+
+def test_resume_mid_epoch_with_shuffle_warns(tmp_path, monkeypatch):
+    """Review finding: the mid-epoch fast-forward only replays the same
+    data under a deterministic order — resuming with the default
+    unseeded shuffle must say so."""
+    monkeypatch.setenv("PT_CKPT_MAX_INTERVAL", "1")
+    ck = str(tmp_path / "ck")
+    m1 = _build()
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        m1.fit(_dataset(), batch_size=8, epochs=1, verbose=0,
+               log_freq=1, shuffle=True, checkpoint_dir=ck,
+               callbacks=[_Cap([], crash_at=3)])
+    m2 = _build()
+    with pytest.warns(UserWarning, match="unseeded shuffling loader"):
+        m2.fit(_dataset(), batch_size=8, epochs=1, verbose=0,
+               shuffle=True, resume_from=ck)
+
+
+def test_restore_rejects_foreign_optimizer_state(tmp_path):
+    """Review finding: a checkpoint saved under a different optimizer
+    config must fail fast, not silently pair restored step counters with
+    freshly-zeroed moments."""
+    model = _build()
+    ck = str(tmp_path / "ck")
+    model.fit(_dataset(), batch_size=8, epochs=1, shuffle=False,
+              verbose=0, num_iters=2, checkpoint_dir=ck)
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    momentum = paddle.optimizer.Momentum(learning_rate=1e-2,
+                                         parameters=net.parameters())
+    with pytest.raises(KeyError, match="missing optimizer state"):
+        rez.restore_latest(net, momentum, ck)
+
+
+def test_nan_policy_rejects_unknown():
+    m = _build()
+    with pytest.raises(ValueError, match="nan_policy"):
+        m.fit(_dataset(), batch_size=8, verbose=0, nan_policy="retry")
+
+
+def test_trainstep_step_count_rolls_back_on_nonfinite():
+    from paddle_tpu.monitor.numerics import NonFiniteError
+
+    m = _build()
+    step = m._train_step
+    step._nan_check = True
+    good = [np.ones((8, 8), np.float32), np.ones((8, 1), np.float32)]
+    step(*good)
+    assert step._step_count == 1
+    bad = [np.full((8, 8), np.nan, np.float32),
+           np.ones((8, 1), np.float32)]
+    with pytest.raises(NonFiniteError) as ei:
+        step(*bad)
+    assert ei.value.step == 2  # the failed step's 1-based index...
+    assert step._step_count == 1  # ...but the counter did not advance
+    assert m._optimizer._global_step == 1
+    step(*good)
+    assert step._step_count == 2
+
+
+# -- StepLogger / postmortem -------------------------------------------------
+
+def test_run_end_names_last_checkpoint_step(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    log = monitor.StepLogger(path, meta={"source": "test"})
+    log.log_step(loss=1.0)
+    log.note_checkpoint(41)
+    log.close(error="RuntimeError: boom")
+    lines = [json.loads(ln) for ln in open(path)]
+    end = [ln for ln in lines if ln.get("event") == "run_end"][-1]
+    assert end["error"].startswith("RuntimeError")
+    assert end["last_checkpoint_step"] == 41
+
+
+def test_fit_crash_postmortem_carries_checkpoint_step(tmp_path,
+                                                      monkeypatch):
+    """Satellite: the crashed fit's run_end error record says what a
+    relaunch will resume from (MonitorCallback.on_checkpoint ->
+    StepLogger.note_checkpoint)."""
+    monkeypatch.setenv("PT_CKPT_MAX_INTERVAL", "1")
+    sink = str(tmp_path / "run.jsonl")
+    monitor.enable()
+    try:
+        monitor.reset()
+        from paddle_tpu.hapi.callbacks import MonitorCallback
+
+        m = _build()
+        cb = MonitorCallback(path=sink)
+        with pytest.raises(RuntimeError, match="simulated crash"):
+            m.fit(_dataset(), batch_size=8, epochs=1, shuffle=False,
+                  verbose=0, log_freq=1,
+                  checkpoint_dir=str(tmp_path / "ck"),
+                  callbacks=[cb, _Cap([], crash_at=4)])
+    finally:
+        monitor.disable()
+        monitor.reset()
+    lines = [json.loads(ln) for ln in open(sink)]
+    end = [ln for ln in lines if ln.get("event") == "run_end"][-1]
+    assert "error" in end
+    # the postmortem names EXACTLY what a relaunch will resume from —
+    # step 3, or 2 when step 3's async writer hadn't finished at crash
+    # time (the crash path never blocks on an in-flight writer)
+    resumable = resilience.latest_complete(str(tmp_path / "ck"))[0]
+    assert end["last_checkpoint_step"] == resumable
+    assert resumable in (2, 3), resumable
+
+
+def test_monitor_report_renders_resilience_section(tmp_path,
+                                                   monkeypatch):
+    monkeypatch.setenv("PT_CKPT_MAX_INTERVAL", "1")
+    sink = str(tmp_path / "run.jsonl")
+    monitor.enable()
+    try:
+        monitor.reset()
+        from paddle_tpu.hapi.callbacks import MonitorCallback
+
+        m = _build()
+        m.fit(_dataset(poison_batch=3), batch_size=8, epochs=1,
+              shuffle=False, verbose=0, log_freq=1, nan_policy="skip",
+              checkpoint_dir=str(tmp_path / "ck"),
+              callbacks=[MonitorCallback(path=sink)])
+    finally:
+        monitor.disable()
+        monitor.reset()
+    out = subprocess.run(
+        [sys.executable, "tools/monitor_report.py", sink],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "resilience (checkpoints + NaN policy)" in out.stdout
+    assert "NaN batches skipped: 1" in out.stdout
+    assert "last complete checkpoint" in out.stdout
+
+
+# -- soak smoke (the tier-1 acceptance gate) ---------------------------------
+
+def test_soak_smoke_survives_crash_and_poison(tmp_path):
+    """tools/soak.py --smoke with an injected crash AND an injected NaN
+    batch: exits 0, emits one parseable JSON verdict with every gate ok,
+    and the relaunched life resumed from a COMPLETE checkpoint."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PT_SOAK_CRASH_AT": "12", "PT_SOAK_POISON_AT": "24"})
+    env.pop("PT_MONITOR", None)
+    env.pop("PADDLE_RESTART_COUNT", None)
+    proc = subprocess.run(
+        [sys.executable, "tools/soak.py", "--smoke", "--steps", "36",
+         "--out", str(tmp_path / "soak")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = [json.loads(ln) for ln in proc.stdout.splitlines()
+            if ln.startswith("{")][-1]
+    assert line["metric"] == "soak" and line["ok"] is True
+    assert line["lives"] == 2
+    assert line["skipped_batches"] >= 1
+    by_name = {c["name"]: c["ok"] for c in line["checks"]}
+    for name in ("launcher", "finished", "crash_resume", "nan_skip",
+                 "loss_slope", "emitted"):
+        assert by_name.get(name) is True, (name, line["checks"])

@@ -50,6 +50,12 @@ BENCHES = [
     # HBM roofline; the guard's --ttft-growth gate judges the tail
     ("serving", [sys.executable, "benchmarks/serving_bench.py"], 1800,
      {"PT_SERVE_BENCH_REQUESTS": "32"}),
+    # resilience soak (docs/RESILIENCE.md): fault-injected (crash +
+    # poisoned batch) run through launcher relaunch + resume + NaN skip,
+    # gated on loss slope / memory growth / the save-cost guard; the
+    # persisted ckpt_save_ms_p50 anchors perf_guard --save-cost-growth
+    ("soak", [sys.executable, "tools/soak.py", "--steps", "600"], 2400,
+     None),
     ("bert", [sys.executable, "benchmarks/baseline_configs.py",
               "--bert-only"], 1800, None),
     ("ernie", [sys.executable, "benchmarks/ernie_bench.py"], 1800, None),

@@ -220,6 +220,39 @@ def render_serving(out, totals=None, hists=None, gauges=None, source=""):
                    f"max {w['max']} ({w['count']} admit(s))")
 
 
+def render_resilience(out, totals=None, hists=None, end=None, source=""):
+    """The resilience runtime's account (``resilience/*`` counters from
+    ``paddle_tpu/resilience`` — docs/RESILIENCE.md): checkpoint traffic
+    (saves + the blocking-cost histogram the cadence planner budgets
+    against), restores split by crash resumes, NaN batches skipped, and
+    the last COMPLETE checkpoint step the run_end line names (what a
+    relaunch will resume from)."""
+    totals, hists, end = totals or {}, hists or {}, end or {}
+    ckpt_step = end.get("last_checkpoint_step")
+    if not any(k.startswith("resilience/") for k in (*totals, *hists)) \
+            and ckpt_step is None:
+        return
+    out.append("")
+    out.append(f"-- resilience (checkpoints + NaN policy){source} --")
+    saves = totals.get("resilience/saves", 0)
+    restores = totals.get("resilience/restores", 0)
+    crash = totals.get("resilience/crash_resumes", 0)
+    out.append(f"saves {saves}   restores {restores} "
+               f"(crash resumes {crash})")
+    w = hists.get("resilience/save_ms")
+    if w:
+        out.append(f"  save blocking ms: p50 {w['p50']}   p95 {w['p95']}   "
+                   f"max {w['max']} ({w['count']} save(s))")
+    skipped = totals.get("resilience/skipped_batches", 0)
+    if skipped:
+        out.append(f"NaN batches skipped: {skipped} (params/LR/step "
+                   f"untouched per skip)")
+    if ckpt_step is not None:
+        out.append(f"last complete checkpoint: step {ckpt_step}"
+                   + (" — what a relaunch resumes from"
+                      if end.get("error") else ""))
+
+
 def render_memory(mem, out, steps=(), source=""):
     """The memory observatory's account: run-level peaks (+ sentinel
     state) and the per-step live-census trajectory when step lines
@@ -523,6 +556,12 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
     render_serving(out, totals=totals,
                    hists=(end or {}).get("totals", {}).get("histograms", {}),
                    gauges=(end or {}).get("totals", {}).get("gauges", {}))
+
+    # -- resilience runtime (resilience/* + run_end last_checkpoint_step) --
+    render_resilience(out, totals=totals,
+                      hists=(end or {}).get("totals", {})
+                      .get("histograms", {}),
+                      end=end)
 
     # -- device memory (observatory run_end sub-object and/or per-step
     #    censuses) --

@@ -73,6 +73,13 @@ DEFAULT_THRESHOLDS = {
     # ttft_ms_p99; the aggregate tokens/s drop rides the generic
     # throughput check — the metric's value IS tokens/s)
     "ttft_growth": 0.25,
+    # resilience gate: fractional growth of the blocking checkpoint-save
+    # cost (tools/soak.py lines carry ckpt_save_ms_p50 — the quiesce +
+    # host-snapshot time the cadence planner budgets against) vs the
+    # last-good record, past an absolute slack (small-model saves are
+    # noisy at single-digit ms)
+    "save_cost_growth": 0.50,
+    "save_cost_slack_ms": 250.0,
 }
 
 
@@ -288,6 +295,22 @@ def evaluate(fresh: dict, baseline: dict | None, thresholds: dict | None
                   + (" — tail latency regressed (scheduler queueing or "
                      "prefill got slower)" if tgrowth > th["ttft_growth"]
                      else ""))
+        sms = fresh.get("ckpt_save_ms_p50")
+        base_sms = (baseline.get("extra") or {}).get("ckpt_save_ms_p50")
+        if sms is not None and base_sms:
+            sgrowth = sms / base_sms - 1.0
+            sover = sms - base_sms
+            sfail = (sgrowth > th["save_cost_growth"]
+                     and sover > th["save_cost_slack_ms"])
+            check("ckpt_save_ms", not sfail,
+                  f"{sms:.1f} ms vs last-good {base_sms:.1f} ms "
+                  f"({'+' if sgrowth > 0 else '-'}"
+                  f"{abs(sgrowth) * 100:.1f}%, max growth "
+                  f"{th['save_cost_growth'] * 100:.0f}% past "
+                  f"{th['save_cost_slack_ms']:.0f} ms slack)"
+                  + (" — checkpointing got more expensive (the cadence "
+                     "planner will save less often for the same "
+                     "overhead budget)" if sfail else ""))
         hbm = peak_hbm_of(fresh)
         base_hbm = (baseline.get("extra") or {}).get("peak_hbm_gib")
         if hbm and base_hbm:
@@ -365,6 +388,15 @@ def main(argv=None) -> int:
                     default=DEFAULT_THRESHOLDS["ttft_growth"],
                     help="max fractional p99 TTFT growth vs last-good "
                          "for serving bench lines (default 0.25)")
+    ap.add_argument("--save-cost-growth", type=float,
+                    default=DEFAULT_THRESHOLDS["save_cost_growth"],
+                    help="max fractional checkpoint-save blocking-cost "
+                         "growth vs last-good for soak lines (default "
+                         "0.50; only fails past --save-cost-slack-ms)")
+    ap.add_argument("--save-cost-slack-ms", type=float,
+                    default=DEFAULT_THRESHOLDS["save_cost_slack_ms"],
+                    help="absolute save-cost headroom before the growth "
+                         "gate can fail (default 250)")
     ap.add_argument("--require-baseline", action="store_true",
                     help="fail when the store has no last-good hardware "
                          "record for the metric")
@@ -393,7 +425,9 @@ def main(argv=None) -> int:
                     "hbm_growth": args.hbm_growth,
                     "compile_growth": args.compile_growth,
                     "compile_slack_ms": args.compile_slack_ms,
-                    "ttft_growth": args.ttft_growth},
+                    "ttft_growth": args.ttft_growth,
+                    "save_cost_growth": args.save_cost_growth,
+                    "save_cost_slack_ms": args.save_cost_slack_ms},
         hardware=hardware)
     if args.require_baseline and baseline is None:
         verdict["ok"] = False
