@@ -176,8 +176,16 @@ def main():
     decode_bytes = (stats["decode_steps"] * param_bytes
                     + stats["kv_read_tokens"] * tok_kv_bytes
                     + stats["decoded_tokens"] * tok_kv_bytes)
+    # the dense gathered read's byte model (every table slot, live or
+    # not): with the paged kernel active the live-prefix model above is
+    # what the chip actually moves, and util_dense - util is the
+    # fraction of the pipe the paged read freed
+    dense_bytes = (stats["decode_steps"] * param_bytes
+                   + stats["kv_dense_read_tokens"] * tok_kv_bytes
+                   + stats["decoded_tokens"] * tok_kv_bytes)
     decode_wall = stats["decode_wall_s"] or 1e-9
     achieved_gbps = decode_bytes / decode_wall / 1e9
+    dense_gbps = dense_bytes / decode_wall / 1e9
     peak = db._peak_hbm_gbps(jax.devices()[0])
 
     rec = {"metric": "serving_tokens_per_sec",
@@ -206,7 +214,25 @@ def main():
                decode_bytes / max(stats["decode_steps"], 1)),
            "hbm_peak_gb_per_s": peak,
            "hbm_util": (round(achieved_gbps / peak, 4) if peak else None),
-           "int8_weights": serve_cfg.int8_weights}
+           "int8_weights": serve_cfg.int8_weights,
+           "paged_attention": bool(stats["paged_attention"])}
+    if stats["paged_attention"] and peak:
+        # the dense read this engine no longer performs, as utilization
+        # (docs/KERNELS.md: the paged kernel's measured-win readout)
+        rec["hbm_util_dense"] = round(dense_gbps / peak, 4)
+        rec["hbm_util_delta"] = round((dense_gbps - achieved_gbps)
+                                      / peak, 4)
+    try:
+        from paddle_tpu.ops.pallas import search as _ksearch
+
+        # {family: engaged} for the guard's engagement-regression gate;
+        # the serving engine's ACTUAL read path overrides the
+        # table-derived view (forced modes included)
+        kernels = _ksearch.engagement_report()
+        kernels["paged_attention"] = bool(stats["paged_attention"])
+        rec["kernels"] = kernels
+    except Exception:  # noqa: BLE001 — a readout must not break the line
+        pass
     # runtime telemetry rides along like bench.py's line: compile cost
     # actually paid + exec-cache traffic (the warm-server-start proof)
     try:

@@ -59,6 +59,7 @@ INSTRUMENTED_MODULES = (
     "paddle_tpu.io.prefetch",
     "paddle_tpu.hapi.model",
     "paddle_tpu.serving.engine",
+    "paddle_tpu.ops.pallas.search",
     "paddle_tpu.resilience.checkpoint_manager",
     "paddle_tpu.resilience.resume",
     "paddle_tpu.resilience.numerics_policy",
@@ -130,6 +131,15 @@ _c_serve_decode = _registry.counter("serving/decode_steps")
 _g_serve_lanes = _registry.gauge("serving/lanes_occupied")
 _g_serve_free_blocks = _registry.gauge("serving/free_blocks")
 _h_serve_queue_wait = _registry.histogram("serving/queue_wait_ms")
+# Pallas kernel engagement + the search harness (ops/pallas/search.py —
+# docs/KERNELS.md): every dispatch-time engagement decision is counted
+# (engaged vs composite fallback, with a per-family breakdown counter),
+# and a tuning run accounts its candidates (timed vs parity/compile
+# rejects) plus the winning kernel-vs-composite ratio per family
+_c_pallas_engaged = _registry.counter("pallas/engaged")
+_c_pallas_fallback = _registry.counter("pallas/fallback_composite")
+_c_search_timed = _registry.counter("search/candidates_timed")
+_c_search_rejects = _registry.counter("search/rejects")
 # resilience runtime (paddle_tpu/resilience — docs/RESILIENCE.md):
 # checkpoint traffic + the NaN skip policy. `save_ms` is the BLOCKING
 # cost per save (quiesce + host snapshot; file I/O overlaps training) —
@@ -470,6 +480,37 @@ def on_serving_decode(lanes_active: int, free_blocks: int) -> None:
     _c_serve_decode.inc()
     _g_serve_lanes.set(lanes_active)
     _g_serve_free_blocks.set(free_blocks)
+
+
+def on_pallas_engaged(family: str) -> None:
+    """A kernel dispatch decision chose the Pallas kernel (a measured
+    engagement row, or the flash crossover heuristic)."""
+    _c_pallas_engaged.inc()
+    _registry.counter(f"pallas/engaged/{family}").inc()
+
+
+def on_pallas_fallback(family: str) -> None:
+    """A kernel dispatch decision fell back to the XLA composite (no
+    measurement, a measured loss, or an ineligible shape/mask)."""
+    _c_pallas_fallback.inc()
+    _registry.counter(f"pallas/fallback/{family}").inc()
+
+
+def on_search_timed(family: str) -> None:
+    """The search harness timed one candidate configuration."""
+    _c_search_timed.inc()
+
+
+def on_search_reject(family: str) -> None:
+    """The search harness rejected a candidate (interpret-mode parity
+    failure or a compile/run error) before or during timing."""
+    _c_search_rejects.inc()
+
+
+def on_search_best_ratio(family: str, ratio: float) -> None:
+    """A search persisted a row; ``ratio`` is the winning candidate's
+    composite/kernel time ratio (>1 = the kernel is faster)."""
+    _registry.gauge(f"search/best_ratio/{family}").set(ratio)
 
 
 def on_ckpt_save(blocked_ms: float) -> None:

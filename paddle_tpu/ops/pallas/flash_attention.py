@@ -615,7 +615,10 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
         *head_rest, dkey = rest
         rest = tuple(head_rest)
 
+    from . import search as _search
+
     def fallback(dp):
+        _search.note_fallback("flash")
         arrs = (q, k, v) + rest + ((dkey,) if dkey is not None else ())
         if default_fn is not None:
             return default_fn(*arrs, causal=causal, dropout=dp,
@@ -659,6 +662,27 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
     # the composite wins below (0.37x at s=512 d=64).
     from . import autotune as _tune
 
+    scale = 1.0 / math.sqrt(d)
+    if not interpret:
+        # head-BATCHED variant (head_flash.py — no transpose pair):
+        # exact-key measured engagement only, from the search harness's
+        # flash_headbatch rows; the variant key markers keep dropout /
+        # mask calls disengaged until their own rows exist
+        from . import head_flash as _hb
+
+        hb_key = _hb.shape_key(b, sq, sk, h, h_kv, d, causal,
+                               dropout > 0.0, kadd is not None)
+        if _search.engaged("flash_headbatch", hb_key):
+            cfg = _search.best_config("flash_headbatch", hb_key) or {}
+            hb_seed = None
+            if dropout > 0.0:
+                hb_seed = jax.lax.bitcast_convert_type(
+                    jnp.asarray(dkey).reshape(2), jnp.int32)
+            _search.note_engaged("flash_headbatch")
+            return _hb.hb_flash(q, k, v, hb_seed, kadd, causal, scale,
+                                False, cfg.get("block_q"),
+                                cfg.get("block_k"), 0, dropout)
+
     bq_t = bk_t = None
     if not interpret:
         # dropout/mask variants have no dedicated tune rows yet: demand
@@ -683,7 +707,7 @@ def flash_attention_kernel(q, k, v, *rest, causal=False, dropout=0.0,
             # mask adds only VPU integer work.)
             return fallback(dropout)
         bq_t, bk_t = _tune.best_blocks(sq, sk, d, causal)
-    scale = 1.0 / math.sqrt(d)
+    _search.note_engaged("flash")
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)

@@ -83,10 +83,17 @@ class ServingConfig:
       ceiling; defaults to the model's max_position_embeddings.
     - ``int8_weights`` (``PT_DECODE_INT8``): weight-only int8 matmuls,
       same lever as ``generate()``.
+    - ``paged`` (``PT_SERVE_PAGED``): decode-attention read path —
+      ``"auto"`` (default) engages the Pallas paged-attention kernel
+      (``ops/pallas/paged_attention.py``) only on a measured-faster
+      tune-table row for this geometry (measurement-first; no row =
+      the dense gathered read), ``"1"``/True forces it on,
+      ``"0"``/False off.
     """
 
     def __init__(self, max_lanes=None, block_size=None, num_blocks=None,
-                 prefill_chunk=None, max_seq_len=None, int8_weights=None):
+                 prefill_chunk=None, max_seq_len=None, int8_weights=None,
+                 paged=None):
         self.max_lanes = max_lanes if max_lanes is not None \
             else _env_int("PT_SERVE_LANES", 8)
         self.block_size = block_size if block_size is not None \
@@ -100,6 +107,14 @@ class ServingConfig:
         if int8_weights is None:
             int8_weights = os.environ.get("PT_DECODE_INT8") == "1"
         self.int8_weights = bool(int8_weights)
+        if paged is None:
+            paged = os.environ.get("PT_SERVE_PAGED", "auto")
+        if paged in (True, 1, "1", "on"):
+            self.paged = "on"
+        elif paged in (False, 0, "0", "off"):
+            self.paged = "off"
+        else:
+            self.paged = "auto"
         for name in ("max_lanes", "block_size", "prefill_chunk"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1, "
@@ -134,7 +149,8 @@ def _attend_lanes(q, kc, vc, pos, nh, nkv, sliding_window=0):
     return out.reshape(b, s, nh, d).astype(q.dtype)
 
 
-def _pool_forward(params, kpool, vpool, tables, ids, pos, wlimit, cfg):
+def _pool_forward(params, kpool, vpool, tables, ids, pos, wlimit, cfg,
+                  paged=False, paged_dead="clamp"):
     """Forward ``ids`` [b, s] at absolute positions ``pos`` [b, s]
     against the block pool: per layer, write each token's K/V into its
     lane's block at ``pos`` (writes at positions >= ``wlimit[b]`` — pad
@@ -171,10 +187,23 @@ def _pool_forward(params, kpool, vpool, tables, ids, pos, wlimit, cfg):
         q, k = _rope_at(q, k, pos, cfg.rope_theta)
         kp = kp.at[li, blk, off].set(k)
         vp = vp.at[li, blk, off].set(v)
-        kc = kp[li][tables].reshape(b, M * B, nkv, d)
-        vc = vp[li][tables].reshape(b, M * B, nkv, d)
-        out = _attend_lanes(q, kc, vc, pos, nh, nkv,
-                            sliding_window=cfg.sliding_window)
+        if paged and s == 1:
+            # Pallas paged read: gather straight from the pool via the
+            # block table, touching only each lane's live prefix — the
+            # dense kp[li][tables] gather below reads every table slot
+            from ..ops.pallas.paged_attention import paged_attend
+
+            out = paged_attend(
+                q.reshape(b, nh, d), kp[li], vp[li], tables, pos[:, 0],
+                window=cfg.sliding_window, dead=paged_dead,
+                # axon = the tunneled TPU plugin (registry's alias)
+                interpret=jax.default_backend() not in
+                ("tpu", "axon"))[:, None]
+        else:
+            kc = kp[li][tables].reshape(b, M * B, nkv, d)
+            vc = vp[li][tables].reshape(b, M * B, nkv, d)
+            out = _attend_lanes(q, kc, vc, pos, nh, nkv,
+                                sliding_window=cfg.sliding_window)
         x = x + _mm(out.reshape(b, s, nh * d), layer_p["o"])
         h2 = _rms(x, layer_p["ln2"], cfg.rms_norm_eps)
         gu = _mm(h2, layer_p["gate_up"])
@@ -205,16 +234,19 @@ def _prefill_chunk(params, kpool, vpool, table, ids, start, ctx_len,
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), kpool, vpool
 
 
-def _decode_step(params, kpool, vpool, tables, cur_len, last_tok, *, cfg):
+def _decode_step(params, kpool, vpool, tables, cur_len, last_tok, *,
+                 cfg, paged=False, paged_dead="clamp"):
     """The shared decode step: every lane feeds its pending token at
     position ``cur_len`` (write-then-attend, so the token sees itself
     like ``generate()``'s step does) and greedy-samples the next. Idle
     lanes (cur_len 0, table row 0) write to the null block and their
-    outputs are ignored host-side. Returns (tok [L], kpool, vpool)."""
+    outputs are ignored host-side. ``paged`` (static) swaps the dense
+    gathered KV read for the Pallas paged-attention kernel. Returns
+    (tok [L], kpool, vpool)."""
     pos = cur_len[:, None]
     x, kpool, vpool = _pool_forward(
         params, kpool, vpool, tables, last_tok[:, None], pos,
-        cur_len + 1, cfg)
+        cur_len + 1, cfg, paged=paged, paged_dead=paged_dead)
     x = _rms(x, params["norm"], cfg.rms_norm_eps)
     logits = _mm(x[:, -1], params["lm_head"]).astype(jnp.float32)
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), kpool, vpool
@@ -265,13 +297,44 @@ class ServingEngine:
         self._finished: dict = {}
         self._prefill_exec = None
         self._decode_exec = None
+        self.paged_active = self._resolve_paged()
         # always-on plain-int accounting (the serving bench's source of
-        # truth; independent of the monitor like exec_cache._stats)
+        # truth; independent of the monitor like exec_cache._stats).
+        # kv_read_tokens counts the LIVE prefix (what the paged kernel
+        # reads); kv_dense_read_tokens the full-table slots the dense
+        # gather reads — the pair is the bench's hbm_util delta.
         self.counters = {
             "admits": 0, "finished": 0, "preemptions": 0,
             "prefill_chunks": 0, "decode_steps": 0, "decoded_tokens": 0,
-            "kv_read_tokens": 0, "decode_wall_s": 0.0,
+            "kv_read_tokens": 0, "kv_dense_read_tokens": 0,
+            "decode_wall_s": 0.0,
         }
+
+    def _resolve_paged(self) -> bool:
+        """Decode read-path selection (ServingConfig.paged): forced
+        on/off, or ``auto`` = engaged only on a measured-faster
+        ``paged_attention`` tune-table row for this geometry on this
+        device (the measurement-first convention — no row, no flip).
+        Also resolves ``self._paged_dead``: the row's WINNING
+        dead-iteration strategy — engaging the measured configuration,
+        not the default — falling back to ``"clamp"`` when forced on
+        with no row."""
+        from ..ops.pallas import paged_attention as _pa
+        from ..ops.pallas import search as _ksearch
+
+        nh = self._gcfg.num_attention_heads
+        nkv = self._gcfg.num_key_value_heads or nh
+        d = self._gcfg.hidden_size // nh
+        key = _pa.family_key(self.config.block_size, nkv, nh // nkv, d,
+                             window=self._gcfg.sliding_window)
+        cfg_row = _ksearch.best_config("paged_attention", key) or {}
+        self._paged_dead = cfg_row.get("dead", "clamp")
+        mode = self.config.paged
+        if mode == "on":
+            return True
+        if mode == "off":
+            return False
+        return _ksearch.decide("paged_attention", key)
 
     # -- intake --------------------------------------------------------------
 
@@ -329,14 +392,19 @@ class ServingEngine:
                     "donate": donate,
                     "mesh": exec_cache.mesh_spec(), **extra}
 
-        dec = jax.jit(_decode_step, **kw)
+        dkw = dict(kw)
+        dkw["static_argnames"] = ("cfg", "paged", "paged_dead")
+        dec = jax.jit(_decode_step, **dkw)
         self._decode_exec = exec_cache.get_or_compile(
-            key("serving_decode", lanes=L, m=M),
+            key("serving_decode", lanes=L, m=M,
+                paged=self.paged_active, paged_dead=self._paged_dead),
             lambda: dec.lower(
                 self._params, pspec, pspec,
                 jax.ShapeDtypeStruct((L, M), i32),
                 jax.ShapeDtypeStruct((L,), i32),
-                jax.ShapeDtypeStruct((L,), i32), cfg=self._gcfg),
+                jax.ShapeDtypeStruct((L,), i32), cfg=self._gcfg,
+                paged=self.paged_active,
+                paged_dead=self._paged_dead),
             label="serving/decode")
         pre = jax.jit(_prefill_chunk, **kw)
         scal = jax.ShapeDtypeStruct((), i32)
@@ -465,9 +533,11 @@ class ServingEngine:
         c["decode_wall_s"] += now - t0
         c["decode_steps"] += 1
         c["decoded_tokens"] += len(act)
-        # live-prefix KV slots a paged kernel would read this round —
-        # the roofline byte model's input (benchmarks/serving_bench.py)
+        # live-prefix KV slots the paged kernel reads this round vs the
+        # full-table slots the dense gather reads — the roofline byte
+        # model's inputs (benchmarks/serving_bench.py hbm_util delta)
         c["kv_read_tokens"] += sum(r.pool_len + 1 for r in act)
+        c["kv_dense_read_tokens"] += len(act) * M * self.config.block_size
         m = _monitor
         if m is not None:
             m.on_serving_decode(len(act), sched.pool.free_count)
@@ -511,6 +581,8 @@ class ServingEngine:
             max_seq_len=self.max_seq_len,
             prefill_chunk=self.config.prefill_chunk,
             int8_weights=self.config.int8_weights,
+            paged_attention=self.paged_active,
+            paged_dead=self._paged_dead,
             lanes_occupied=self.scheduler.lanes_occupied,
             waiting=len(self.scheduler.waiting),
             requests=len(self._requests),

@@ -1,0 +1,245 @@
+"""Head-batched flash kernel parity (interpret mode on CPU — the
+fake-device strategy of test_pallas_flash.py, on the native
+``[b, s, h, d]`` layout the kernel exists to keep)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas import head_flash, search
+from paddle_tpu.ops.pallas.flash_attention import (
+    _flash_bhsd, _flash_bhsd_drop,
+)
+from paddle_tpu.ops.pallas.head_flash import hb_flash
+
+
+@pytest.fixture(autouse=True)
+def _highest_precision():
+    old = jax.config.jax_default_matmul_precision
+    jax.config.update("jax_default_matmul_precision", "highest")
+    yield
+    jax.config.update("jax_default_matmul_precision", old or "highest")
+
+
+def _qkv(b=2, sq=64, sk=64, h=4, h_kv=None, d=32, seed=0):
+    h_kv = h if h_kv is None else h_kv
+    rng = np.random.RandomState(seed)
+    q = rng.randn(b, sq, h, d).astype(np.float32)
+    k = rng.randn(b, sk, h_kv, d).astype(np.float32)
+    v = rng.randn(b, sk, h_kv, d).astype(np.float32)
+    return q, k, v
+
+
+def _reference(q, k, v, causal=False, kmask=None, window=0):
+    """Native-layout fp32 composite with GQA (repeat) + bottom-right
+    causal — the same convention as `_sdpa_reference` / the bhsd
+    kernel."""
+    b, sq, h, d = q.shape
+    sk, h_kv = k.shape[1], k.shape[2]
+    g = h // h_kv
+    kr = np.repeat(np.asarray(k, np.float32), g, axis=2)
+    vr = np.repeat(np.asarray(v, np.float32), g, axis=2)
+    s = np.einsum("bqhd,bkhd->bhqk", np.asarray(q, np.float32),
+                  kr) / np.sqrt(d)
+    if causal:
+        mask = np.tril(np.ones((sq, sk), bool), k=sk - sq)
+        if window > 0:
+            mask &= ~np.tril(np.ones((sq, sk), bool),
+                             k=sk - sq - window)
+        s = np.where(mask[None, None], s, -1e30)
+    if kmask is not None:
+        s = s + np.asarray(kmask, np.float32)[:, None, :, :]  # [b,1,1,sk]
+    mx = s.max(-1, keepdims=True)
+    e = np.exp(s - mx)
+    p = e / e.sum(-1, keepdims=True)
+    out = np.einsum("bhqk,bkhd->bqhd", p, vr)
+    # rows with every key masked output exactly 0 (flash >= 2.1)
+    dead = (s <= -1e30 * 0.5).all(-1)
+    out[np.transpose(dead, (0, 2, 1))] = 0.0
+    return out
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_parity(causal):
+    q, k, v = _qkv()
+    out = hb_flash(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               _reference(q, k, v, causal=causal),
+                               atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grad_parity(causal):
+    q, k, v = _qkv(sq=64, sk=64)
+    w = np.random.RandomState(1).randn(*q.shape).astype(np.float32)
+
+    def kern(*a):
+        return (hb_flash(*a, causal=causal, interpret=True) * w).sum()
+
+    def comp(*a):
+        fam = search.FAMILIES["flash_headbatch"]
+        shape = (q.shape[0], q.shape[1], k.shape[1], q.shape[2],
+                 k.shape[2], q.shape[3], causal)
+        return (fam.build_composite(shape)(*a).astype(jnp.float32)
+                * w).sum()
+
+    g1 = jax.grad(kern, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(comp, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        scale = np.abs(np.asarray(b)).max() + 1e-9
+        np.testing.assert_allclose(np.asarray(a) / scale,
+                                   np.asarray(b) / scale, atol=1e-4)
+
+
+def test_gqa_parity_grouped_in_tile():
+    # h=6, h_kv=2: three query heads share each KV head with no repeat
+    # materialization; also exercises non-power-of-two head counts
+    q, k, v = _qkv(h=6, h_kv=2, d=32)
+    out = hb_flash(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               _reference(q, k, v, causal=True),
+                               atol=2e-5)
+    # GQA grads: dk/dv reduce over the grouped query heads in-tile
+    g1 = jax.grad(lambda *a: (hb_flash(
+        *a, causal=True, interpret=True) ** 2).sum(),
+        argnums=(1, 2))(q, k, v)
+    assert g1[0].shape == k.shape and g1[1].shape == v.shape
+    assert float(jnp.abs(g1[0]).max()) > 0
+
+
+@pytest.mark.parametrize("sq,sk", [(32, 64), (64, 32)])
+def test_cross_length_causal_bottom_right(sq, sk):
+    q, k, v = _qkv(sq=sq, sk=sk)
+    out = np.asarray(hb_flash(q, k, v, causal=True, interpret=True))
+    ref = _reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+    if sq > sk:
+        # bottom-right alignment: leading rows attend to NO key and
+        # output exactly 0 (flash-attn >= 2.1 semantics)
+        assert np.abs(out[:, :sq - sk]).max() == 0
+
+
+def test_key_padding_mask_parity_and_cotangent():
+    q, k, v = _qkv(sq=32, sk=64)
+    b, sk = q.shape[0], k.shape[1]
+    keep = np.arange(sk)[None, :] < np.array([40, 50])[:, None]
+    km = np.where(keep, 0.0, -1e30).astype(np.float32)[:, None, :]
+
+    out = hb_flash(q, k, v, kmask=jnp.asarray(km), causal=False,
+                   interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), _reference(q, k, v, kmask=km), atol=2e-5)
+
+    # the in-kernel mask cotangent (summed over heads AND rows) matches
+    # autodiff through the composite
+    w = np.random.RandomState(3).randn(*q.shape).astype(np.float32)
+
+    def kern(m):
+        return (hb_flash(q, k, v, kmask=m, causal=False,
+                         interpret=True) * w).sum()
+
+    def comp(m):
+        g = q.shape[2] // k.shape[2]
+        kr = jnp.repeat(jnp.asarray(k), g, axis=2)
+        vr = jnp.repeat(jnp.asarray(v), g, axis=2)
+        s = jnp.einsum("bqhd,bkhd->bhqk", jnp.asarray(q),
+                       kr) / np.sqrt(q.shape[3])
+        s = s + m[:, None, :, :]
+        p = jax.nn.softmax(s, axis=-1)
+        return (jnp.einsum("bhqk,bkhd->bqhd", p, vr) * w).sum()
+
+    g1 = jax.grad(kern)(jnp.asarray(km))
+    g2 = jax.grad(comp)(jnp.asarray(km))
+    live = np.asarray(keep)[:, None, :]
+    np.testing.assert_allclose(np.asarray(g1)[live],
+                               np.asarray(g2)[live], atol=1e-4)
+
+
+def test_dropout_bit_identical_mask_vs_bhsd_kernel():
+    """The head-batched kernel feeds `_keep_mask` the same flattened
+    b·h + i head index the bhsd kernel's grid row carries, so for one
+    seed the two kernels drop IDENTICAL elements — proven by comparing
+    outputs (a single flipped mask bit shifts a value by O(1/keep)).
+    Block shapes differ on purpose: the mask is a pure function of
+    global coordinates, not of the tiling."""
+    q, k, v = _qkv(b=2, sq=64, sk=64, h=4, d=32)
+    seed = jnp.asarray([7, 9], jnp.int32)
+    drop = 0.4
+    out_hb = hb_flash(q, k, v, seed, causal=True, interpret=True,
+                      block_q=32, block_k=32, dropout=drop)
+    b, sq, h, d = q.shape
+    qt = jnp.asarray(q).transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = jnp.asarray(k).transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    vt = jnp.asarray(v).transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    out_ref = _flash_bhsd_drop(
+        qt, kt, vt, seed, True, 1.0 / np.sqrt(d), True, 64, 64, 0,
+        drop).reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(out_hb), np.asarray(out_ref),
+                               atol=2e-5)
+    # and dropout actually drops
+    no_drop = hb_flash(q, k, v, causal=True, interpret=True)
+    assert float(jnp.abs(out_hb - no_drop).max()) > 1e-3
+
+
+def test_sliding_window_parity():
+    q, k, v = _qkv(sq=64, sk=64)
+    out = hb_flash(q, k, v, causal=True, interpret=True, window=16)
+    np.testing.assert_allclose(
+        np.asarray(out), _reference(q, k, v, causal=True, window=16),
+        atol=2e-5)
+
+
+def test_lse_layout_matches_outputs():
+    # the backward consumes lse [b, sq, h, _LANES]; its first lane must
+    # be the true per-row log-sum-exp (lane-broadcast)
+    from paddle_tpu.ops.pallas.head_flash import _hb_fwd
+
+    q, k, v = _qkv(sq=32, sk=32)
+    out, lse = _hb_fwd(q, k, v, False, 1.0 / np.sqrt(q.shape[3]), True)
+    assert lse.shape == (q.shape[0], q.shape[1], q.shape[2], 128)
+    np.testing.assert_allclose(np.asarray(lse[..., 0]),
+                               np.asarray(lse[..., 1]))
+
+
+def test_flash_attention_kernel_routes_to_headbatch_on_engaged_row(
+        monkeypatch):
+    """Dispatch wiring: with a measured-faster flash_headbatch row for
+    the exact shape key, `flash_attention_kernel` takes the head-batch
+    path (no transposes) with the row's tuned blocks; without a row it
+    never does."""
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    q, k, v = _qkv(b=1, sq=128, sk=128, h=2, d=128)
+    key = head_flash.shape_key(1, 128, 128, 2, 2, 128, True)
+    calls = []
+
+    def fake_hb(q_, k_, v_, seed, kmask, causal, scale, interpret,
+                bq, bk, window, dropout):
+        calls.append({"bq": bq, "bk": bk, "causal": causal,
+                      "dropout": dropout})
+        return jnp.zeros(q_.shape, q_.dtype)
+
+    monkeypatch.setattr(head_flash, "hb_flash", fake_hb)
+    monkeypatch.setattr(
+        search, "engaged",
+        lambda fam, k_: True if (fam, k_) == ("flash_headbatch", key)
+        else None)
+    monkeypatch.setattr(
+        search, "best_config",
+        lambda fam, k_: {"block_q": 64, "block_k": 128})
+    out = fa.flash_attention_kernel(q, k, v, causal=True)
+    assert calls == [{"bq": 64, "bk": 128, "causal": True,
+                      "dropout": 0.0}]
+    assert out.shape == q.shape
+    # variant calls (dropout/kmask) carry different keys -> no routing
+    calls.clear()
+    fa.flash_attention_kernel(q, k, v, causal=False)
+    assert calls == []
+
+
+def test_check_lowering_is_registered():
+    from paddle_tpu.ops import registry
+
+    assert "tpu" in registry._OPS["flash_attention_headbatch"].kernels
+    fn = registry._OPS["flash_attention_headbatch"].kernels["tpu"]
+    assert fn.check_lowering is head_flash.check_lowering

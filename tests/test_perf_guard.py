@@ -198,6 +198,60 @@ class TestEvaluate:
         assert v2["ok"]
         assert not any(c["name"] == "ttft_p99" for c in v2["checks"])
 
+    def test_flags_lost_kernel_engagement(self, guard):
+        # engaged in the last-good record, composite now -> regression
+        # (the tune-table row stopped matching)
+        base = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                "backend": "tpu",
+                "extra": {"kernels": {"paged_attention": True,
+                                      "flash": True}}}
+        fresh = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                 "unit": "tokens/s",
+                 "kernels": {"paged_attention": False, "flash": True}}
+        v = guard.evaluate(fresh, base, hardware=True)
+        assert not v["ok"]
+        bad = [c for c in v["checks"] if c["name"] == "kernel_engagement"]
+        assert bad and not bad[0]["ok"]
+        assert "paged_attention" in bad[0]["detail"]
+
+    def test_kernel_engagement_absent_family_is_wildcard(self, guard):
+        # a family the fresh line doesn't report wasn't exercised this
+        # run — not a regression; newly-engaged families never fail
+        base = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                "backend": "tpu",
+                "extra": {"kernels": {"flash": True,
+                                      "flash_headbatch": False}}}
+        fresh = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                 "unit": "tokens/s",
+                 "kernels": {"flash_headbatch": True}}
+        v = guard.evaluate(fresh, base, hardware=True)
+        assert v["ok"]
+        ok = [c for c in v["checks"] if c["name"] == "kernel_engagement"]
+        assert ok and ok[0]["ok"]
+
+    def test_kernel_engagement_skips_cpu_smoke_and_no_baseline(
+            self, guard):
+        fresh = {"metric": "serving_tokens_per_sec", "value": 50.0,
+                 "unit": "tokens/s",
+                 "kernels": {"paged_attention": False},
+                 "note": "cpu smoke mode; not a TPU number"}
+        base = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                "backend": "tpu",
+                "extra": {"kernels": {"paged_attention": True}}}
+        v = guard.evaluate(fresh, base)  # smoke inferred from the note
+        assert v["ok"]
+        assert not any(c["name"] == "kernel_engagement"
+                       for c in v["checks"])
+        # baseline without the kernels field: gate silently absent
+        hw = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+              "unit": "tokens/s", "kernels": {"paged_attention": False}}
+        v2 = guard.evaluate(
+            hw, {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                 "backend": "tpu", "extra": {}}, hardware=True)
+        assert v2["ok"]
+        assert not any(c["name"] == "kernel_engagement"
+                       for c in v2["checks"])
+
     def test_flags_save_cost_growth(self, guard):
         base = {"metric": "soak", "value": 900.0, "backend": "tpu",
                 "extra": {"ckpt_save_ms_p50": 300.0}}

@@ -64,6 +64,13 @@ BENCHES = [
     ("host_overhead", [sys.executable,
                        "benchmarks/host_overhead_bench.py"], 1200, None),
     ("flashtune", [sys.executable, "tools/flash_autotune.py"], 2400, None),
+    # kernel search harness (docs/KERNELS.md): enumerate + parity-filter
+    # + time the candidate spaces for every registered family (head-
+    # batched flash, paged attention, flash blocks) and persist the
+    # engagement rows the runtime flips on — the timeboxed stage that
+    # settles this PR's two disengaged-by-default kernels next chip-up
+    ("kernel_search", [sys.executable, "tools/kernel_search.py"], 2400,
+     None),
     ("profile", [sys.executable, "tools/profile_train_step.py"], 1800,
      None),
     # queued PR-6 follow-up (ROADMAP item 5 remainder): cold-vs-warm

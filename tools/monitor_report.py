@@ -11,7 +11,9 @@ pipeline (prefetch staging/starvation, AsyncStepper bound waits, hapi host
 syncs, host_blocked_ms_per_step), the AOT executable cache (hit rate,
 compile-ms saved/paid, tier + serialization latencies — from the
 `jit/exec_cache_*` metrics or a bench line's `telemetry.exec_cache`),
-device memory (peak HBM / live-census
+the Pallas kernel account (`pallas/*` engagement + `search/*` harness
+counters, and a bench line's `kernels` engagement map —
+docs/KERNELS.md), device memory (peak HBM / live-census
 peaks from the memory observatory, per-executable breakdown), the perf
 guard verdict (the `guard` sub-object bench.py embeds — rendered from the
 run_end line, or from a bench log via `--bench`), retrace timeline (which
@@ -218,6 +220,49 @@ def render_serving(out, totals=None, hists=None, gauges=None, source=""):
     if w:
         out.append(f"queue wait ms: p50 {w['p50']}   p95 {w['p95']}   "
                    f"max {w['max']} ({w['count']} admit(s))")
+
+
+def render_kernels(out, totals=None, gauges=None, bench_kernels=None,
+                   source=""):
+    """The Pallas kernel account (``pallas/*`` engagement counters and
+    ``search/*`` harness counters from ``ops/pallas/search.py`` —
+    docs/KERNELS.md): how often dispatch chose a kernel vs the XLA
+    composite (per family), and what the last search run did."""
+    totals, gauges = totals or {}, gauges or {}
+    have = any(k.startswith(("pallas/", "search/"))
+               for k in (*totals, *gauges))
+    if not have and not bench_kernels:
+        return
+    out.append("")
+    out.append(f"-- pallas kernels (engagement + search){source} --")
+    eng = totals.get("pallas/engaged", 0)
+    fb = totals.get("pallas/fallback_composite", 0)
+    if eng or fb:
+        line = f"engaged {eng}   composite fallbacks {fb}"
+        if eng or fb:
+            line += f"   (engage rate {eng / (eng + fb):.2f})"
+        out.append(line)
+        fams = sorted({k.rsplit("/", 1)[1] for k in totals
+                       if k.startswith(("pallas/engaged/",
+                                        "pallas/fallback/"))})
+        for fam in fams:
+            fe = totals.get(f"pallas/engaged/{fam}", 0)
+            ff = totals.get(f"pallas/fallback/{fam}", 0)
+            out.append(f"  {fam:<20} engaged {fe}   composite {ff}")
+    timed = totals.get("search/candidates_timed", 0)
+    rejects = totals.get("search/rejects", 0)
+    if timed or rejects:
+        out.append(f"search: candidates timed {timed}   rejects "
+                   f"{rejects} (parity/compile pre-filter)")
+    for name in sorted(gauges):
+        if name.startswith("search/best_ratio/"):
+            fam = name.split("search/best_ratio/", 1)[1]
+            out.append(f"  best ratio {fam}: {gauges[name]:g} "
+                       f"(>1 = kernel faster than composite)")
+    if bench_kernels:
+        line = ", ".join(f"{k}={'engaged' if v else 'composite'}"
+                         for k, v in sorted(bench_kernels.items()))
+        out.append(f"bench engagement: {line}")
 
 
 def render_resilience(out, totals=None, hists=None, end=None, source=""):
@@ -557,6 +602,10 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
                    hists=(end or {}).get("totals", {}).get("histograms", {}),
                    gauges=(end or {}).get("totals", {}).get("gauges", {}))
 
+    # -- pallas kernels (pallas/* + search/* from the search harness) --
+    render_kernels(out, totals=totals,
+                   gauges=(end or {}).get("totals", {}).get("gauges", {}))
+
     # -- resilience runtime (resilience/* + run_end last_checkpoint_step) --
     render_resilience(out, totals=totals,
                       hists=(end or {}).get("totals", {})
@@ -606,6 +655,9 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
                     out, totals={f"serving/{k}": v
                                  for k, v in tel_b["serving"].items()},
                     source=" (bench)")
+            if line.get("kernels"):
+                render_kernels(out, bench_kernels=line["kernels"],
+                               source=" (bench)")
             if line.get("guard"):
                 render_guard(line["guard"], out, source=" (bench)")
         elif read_ok:

@@ -30,6 +30,13 @@ and exits nonzero with a human-readable verdict when the run regressed:
   ``benchmarks/serving_bench.py`` line vs the baseline record's
   ``extra.ttft_ms_p99`` — the tail-latency gate; the aggregate tokens/s
   drop is the same ``--throughput-drop`` check every metric gets
+- a Pallas kernel family engaged in the last-good record but running on
+  the composite in the fresh line (``kernels`` sub-object — the
+  ``{family: engaged}`` map benches embed from
+  ``ops.pallas.search.engagement_report``): a lost engagement means the
+  tune table stopped matching (device change, key churn, a deleted
+  row) and the measured win silently evaporated. Families absent from
+  the fresh line are wildcards; CPU smokes skip the check
 - any post-warmup retrace (``telemetry.post_warmup_retraces`` > 0): a
   shape changed inside the timed loop, so the number includes an XLA
   compile and the next run won't reproduce it
@@ -311,6 +318,20 @@ def evaluate(fresh: dict, baseline: dict | None, thresholds: dict | None
                   + (" — checkpointing got more expensive (the cadence "
                      "planner will save less often for the same "
                      "overhead budget)" if sfail else ""))
+        kern = fresh.get("kernels")
+        base_kern = (baseline.get("extra") or {}).get("kernels")
+        if kern is not None and base_kern:
+            # engaged in the baseline but composite now -> regression;
+            # a family the fresh line doesn't report is a wildcard
+            # (that bench simply didn't exercise it this run)
+            lost = sorted(k for k, v in base_kern.items()
+                          if v and kern.get(k) is False)
+            check("kernel_engagement", not lost,
+                  ("all engaged kernel families still engaged"
+                   if not lost else
+                   f"engaged in last-good but composite now: "
+                   f"{', '.join(lost)} — tune-table row no longer "
+                   f"matches (device change or key churn?)"))
         hbm = peak_hbm_of(fresh)
         base_hbm = (baseline.get("extra") or {}).get("peak_hbm_gib")
         if hbm and base_hbm:
