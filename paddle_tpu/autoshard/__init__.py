@@ -1,0 +1,38 @@
+"""Automatic sharding planner (ROADMAP item 2 — docs/AUTOSHARD.md).
+
+Plan → launch → resume hybrid runs with zero hand-written
+PartitionSpecs: enumerate the legal (dp × mp, batch) candidates for a
+device count, AOT-lower each on a virtual mesh (exec-cache-warm, no
+execution), score with XLA's memory accounting (hard HBM fit) + the
+per-axis collective bytes parsed from the post-SPMD HLO + an
+analytical roofline seeded from `PERF_MEASUREMENTS.json`, and emit the
+winner as a deterministic, provenance-stamped ``shard_plan.json``.
+
+Driver: ``python tools/shard_plan.py plan|launch|resume|bench``.
+Consumers: ``hapi.Model.fit(shard_plan=)``, launch scripts via
+``apply_plan(load_plan(os.environ["PT_SHARD_PLAN"]), model)``.
+"""
+from .candidates import (  # noqa: F401
+    candidate_label, default_meshes, enumerate_candidates, parse_mesh,
+)
+from .cost import (  # noqa: F401
+    CostSeeds, default_seeds, rank_candidates, seed_from_measurements,
+)
+from .lowering import (  # noqa: F401
+    ProbeSpec, build_probe, collect_param_specs, lower_candidate,
+)
+from .plan import (  # noqa: F401
+    PLAN_VERSION, ShardPlan, apply_plan, derive_param_specs, load_plan,
+    shard_batch,
+)
+from .planner import make_plan, plan_sweep  # noqa: F401
+
+__all__ = [
+    "PLAN_VERSION", "ShardPlan", "ProbeSpec", "CostSeeds",
+    "enumerate_candidates", "default_meshes", "parse_mesh",
+    "candidate_label", "build_probe", "lower_candidate",
+    "collect_param_specs",
+    "derive_param_specs", "apply_plan", "load_plan", "shard_batch",
+    "make_plan", "plan_sweep", "rank_candidates",
+    "default_seeds", "seed_from_measurements",
+]

@@ -1,0 +1,74 @@
+"""Candidate enumeration for the automatic sharding planner.
+
+The legal search space is every (dp × mp) factorization of the device
+count crossed with the requested global batch sizes — exactly the space
+`tools/memory_planner.py` has always swept (its enumeration moved here
+so the OOM preflight and the planner share ONE code path). Pure stdlib:
+importable without jax, so CLI argument errors surface before any
+backend initializes.
+"""
+from __future__ import annotations
+
+__all__ = ["parse_mesh", "default_meshes", "enumerate_candidates",
+           "candidate_label"]
+
+
+def parse_mesh(token: str) -> dict:
+    """``dp4xmp2`` -> {"dp": 4, "mp": 2} (either axis optional)."""
+    out = {"dp": 1, "mp": 1}
+    for part in token.lower().split("x"):
+        part = part.strip()
+        if not part:
+            continue
+        for axis in ("dp", "mp"):
+            if part.startswith(axis):
+                out[axis] = int(part[len(axis):])
+                break
+        else:
+            raise ValueError(f"bad mesh token {part!r} "
+                             f"in {token!r} (expected dpN / mpN / dpNxmpM)")
+    return out
+
+
+def default_meshes(n_devices: int) -> list:
+    """(dp, mp) factorizations of the device count, dp-heavy first."""
+    out = []
+    mp = 1
+    while mp <= n_devices:
+        if n_devices % mp == 0:
+            out.append({"dp": n_devices // mp, "mp": mp})
+        mp *= 2
+    return out
+
+
+def candidate_label(cand: dict) -> str:
+    return f"dp{cand['dp']}·mp{cand['mp']} b{cand['batch']}"
+
+
+def enumerate_candidates(n_devices: int, configs=None, batches="8") -> list:
+    """The planner's candidate list: ``[{"dp", "mp", "batch"}, ...]``.
+
+    ``configs`` is a comma list of mesh tokens (or an iterable of them;
+    None = all power-of-2 factorizations of ``n_devices``); ``batches``
+    a comma list (or iterable) of global batch sizes. Ordering is
+    deterministic — the enumeration order is part of the plan's
+    byte-identity contract."""
+    if configs is None:
+        meshes = default_meshes(n_devices)
+    else:
+        tokens = (configs.split(",") if isinstance(configs, str)
+                  else list(configs))
+        meshes = [parse_mesh(t) for t in tokens]
+    if isinstance(batches, str):
+        batch_list = [int(b) for b in batches.split(",")]
+    else:
+        batch_list = [int(b) for b in batches]
+    out = []
+    for m in meshes:
+        if m["dp"] * m["mp"] != n_devices:
+            raise ValueError(
+                f"dp{m['dp']}xmp{m['mp']} does not "
+                f"factorize {n_devices} devices")
+        for b in batch_list:
+            out.append({**m, "batch": b})
+    return out

@@ -1,0 +1,217 @@
+"""Collective-traffic extraction from post-SPMD optimized HLO.
+
+The lowering the exec cache compiles carries only sharding annotations;
+the collectives that actually move bytes (gradient all-reduces, the
+Megatron f/g pair, reduce-scatters) are inserted by XLA's SPMD
+partitioner — so the honest per-candidate comms account reads the
+*compiled* executable's HLO (``compiled.as_text()``), not the StableHLO
+input (PAPERS.md: GSPMD 2105.04663 — the sharding choice determines the
+collective schedule, and both are visible post-partitioning).
+
+Attribution: every collective names its ``replica_groups``; given the
+mesh degrees (AXIS_ORDER ``dp,pp,sharding,sep,mp``, outer→inner, device
+id = row-major multi-index) the group structure identifies the mesh
+axis (or axis combination) the bytes crossed. ``mp`` groups are
+stride-1 id runs; ``dp`` groups stride by the product of the inner
+axes. Wire bytes follow the standard ring factors: all-reduce moves
+``2(n−1)/n`` of the payload, all-gather / reduce-scatter / all-to-all
+``(n−1)/n``, collective-permute the payload itself.
+
+Pure text parsing on stdlib + the mesh degrees — deterministic, so the
+byte totals can live inside a byte-identical ``shard_plan.json``.
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["parse_collectives", "classify_group_set",
+           "collective_bytes_by_axis", "AXIS_ORDER"]
+
+# canonical axis order, outermost (slowest) first — must match
+# distributed/env.py AXIS_ORDER (kept literal: this module is jax-free)
+AXIS_ORDER = ("dp", "pp", "sharding", "sep", "mp")
+
+_DTYPE_BYTES = {
+    "f64": 8, "s64": 8, "u64": 8, "c64": 8,
+    "f32": 4, "s32": 4, "u32": 4,
+    "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+    "f8e4m3fn": 1, "f8e5m2": 1, "s8": 1, "u8": 1, "pred": 1,
+}
+
+# `-start` carries the payload type; the matching `-done` would double
+# count, so only the base/start form is matched
+_COLL_RE = re.compile(
+    r"=\s*(?P<ty>[^=]*?)\s*"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|all-to-all|"
+    r"collective-permute)(?P<start>-start)?\(")
+_DONE_RE = re.compile(
+    r"(all-reduce|all-gather|reduce-scatter|all-to-all|"
+    r"collective-permute)-done\(")
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\]"
+    r"(?:T\(([0-9,]+)\))?")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+
+# ring wire factors: fraction of the payload each participant actually
+# puts on the interconnect
+_WIRE_FACTOR = {
+    "all-reduce": lambda n: 2.0 * (n - 1) / n,
+    "all-gather": lambda n: (n - 1) / n,
+    "reduce-scatter": lambda n: (n - 1) / n,
+    "all-to-all": lambda n: (n - 1) / n,
+    "collective-permute": lambda n: 1.0,
+}
+
+
+def _payload_bytes(type_text: str, start_op: bool = False) -> int:
+    """Bytes of an op's result type. Async ``-start`` ops are
+    tuple-typed ``(operands..., results...)`` — counting every element
+    would double the payload, so only the trailing (result) half is
+    summed for them; sync variadic tuples ARE all results and sum
+    whole."""
+    shapes = _SHAPE_RE.findall(type_text)
+    if start_op and len(shapes) > 1:
+        shapes = shapes[len(shapes) // 2:]
+    total = 0
+    for dt, dims in shapes:
+        n = 1
+        for d in dims.split(","):
+            d = d.strip()
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dt, 4)
+    return total
+
+
+def _parse_groups(line: str):
+    """The collective's replica groups as a list of id tuples (None when
+    the op carries none — e.g. a permute, handled via its pairs)."""
+    m = _GROUPS_RE.search(line)
+    if m:
+        return [tuple(int(x) for x in g.split(",") if x.strip())
+                for g in m.group(1)[1:-1].split("},{")]
+    m = _IOTA_RE.search(line)
+    if m:
+        # iota format: reshape(transpose(iota(prod(dims)), perm), [G, S])
+        g, s = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        n = 1
+        for d in dims:
+            n *= d
+        ids = list(range(n))
+        if m.group(4):
+            perm = [int(x) for x in m.group(4).split(",")]
+            # transpose the multi-dim iota: rebuild ids in permuted order
+            strides = [0] * len(dims)
+            acc = 1
+            for i in range(len(dims) - 1, -1, -1):
+                strides[i] = acc
+                acc *= dims[i]
+            pdims = [dims[p] for p in perm]
+            pstrides = [strides[p] for p in perm]
+            ids = []
+            idx = [0] * len(pdims)
+            for _ in range(n):
+                ids.append(sum(i * s for i, s in zip(idx, pstrides)))
+                for ax in range(len(pdims) - 1, -1, -1):
+                    idx[ax] += 1
+                    if idx[ax] < pdims[ax]:
+                        break
+                    idx[ax] = 0
+        return [tuple(ids[i * s:(i + 1) * s]) for i in range(g)]
+    return None
+
+
+def _coords(dev: int, degrees: dict) -> tuple:
+    """Device id -> mesh multi-index (row-major over AXIS_ORDER)."""
+    out = []
+    rem = dev
+    sizes = [degrees.get(a, 1) for a in AXIS_ORDER]
+    for i, a in enumerate(AXIS_ORDER):
+        inner = 1
+        for s in sizes[i + 1:]:
+            inner *= s
+        out.append(rem // inner)
+        rem %= inner
+    return tuple(out)
+
+
+def classify_group_set(groups, degrees: dict) -> str:
+    """Which mesh axes a replica-group partition communicates over.
+
+    Every group's members are decomposed into mesh coordinates; the
+    varying coordinate positions name the axes. One axis -> ``"mp"``;
+    a fused group over several -> ``"dp+mp"`` (AXIS_ORDER order);
+    nothing varying (degenerate 1-groups) -> ``"none"``."""
+    varying = set()
+    for g in groups:
+        coords = [_coords(d, degrees) for d in g]
+        for i, a in enumerate(AXIS_ORDER):
+            if len({c[i] for c in coords}) > 1:
+                varying.add(a)
+    if not varying:
+        return "none"
+    return "+".join(a for a in AXIS_ORDER if a in varying)
+
+
+def parse_collectives(hlo_text: str, degrees: dict) -> list:
+    """Every collective in an optimized-HLO module, as
+    ``{"op", "axis", "group_size", "payload_bytes", "wire_bytes"}``."""
+    out = []
+    for line in hlo_text.splitlines():
+        if _DONE_RE.search(line):
+            continue
+        m = _COLL_RE.search(line)
+        if m is None:
+            continue
+        op = m.group("op")
+        payload = _payload_bytes(m.group("ty"),
+                                 start_op=bool(m.group("start")))
+        groups = _parse_groups(line)
+        if groups is None and op == "collective-permute":
+            pm = _PAIRS_RE.search(line)
+            if pm:
+                pairs = [tuple(int(x) for x in p.split(","))
+                         for p in pm.group(1)[1:-1].split("},{")]
+                groups = [tuple(sorted({a for p in pairs for a in p}))]
+        if not groups:
+            continue
+        gsize = max(len(g) for g in groups)
+        if gsize <= 1:
+            continue
+        if op == "reduce-scatter":
+            # the HLO result is the already-scattered SHARD; the ring
+            # moves (n-1)/n of the pre-scatter input = result × n
+            payload *= gsize
+        axis = classify_group_set(groups, degrees)
+        out.append({
+            "op": op,
+            "axis": axis,
+            "group_size": gsize,
+            "payload_bytes": payload,
+            "wire_bytes": int(payload * _WIRE_FACTOR[op](gsize)),
+        })
+    return out
+
+
+def collective_bytes_by_axis(hlo_text: str, degrees: dict) -> dict:
+    """Aggregate per-axis comms account of one executable:
+    ``{"per_axis_wire_bytes": {...}, "per_axis_payload_bytes": {...},
+    "ops": {...}, "total_wire_bytes": N}`` — the cost-model input and
+    the shape persisted into ``shard_plan.json`` rows."""
+    per_wire: dict = {}
+    per_payload: dict = {}
+    ops: dict = {}
+    for c in parse_collectives(hlo_text, degrees):
+        a = c["axis"]
+        per_wire[a] = per_wire.get(a, 0) + c["wire_bytes"]
+        per_payload[a] = per_payload.get(a, 0) + c["payload_bytes"]
+        ops[c["op"]] = ops.get(c["op"], 0) + 1
+    return {
+        "per_axis_wire_bytes": dict(sorted(per_wire.items())),
+        "per_axis_payload_bytes": dict(sorted(per_payload.items())),
+        "ops": dict(sorted(ops.items())),
+        "total_wire_bytes": sum(per_wire.values()),
+    }

@@ -1,0 +1,179 @@
+"""Per-candidate AOT lowering: the planner's (and memory_planner's) one
+candidate-evaluation code path.
+
+For each (dp × mp, batch) candidate this builds the probe model under
+that mesh, AOT-compiles the full train step (fwd+bwd+optimizer —
+`jit/train_step.py`) and reads XLA's own executable memory accounting
+(`monitor/memory.py:executable_record`; per-device for SPMD
+executables). Nothing executes: host RAM materializes parameters for
+lowering, the device never runs. Absorbed from
+`tools/memory_planner.py:plan_one` (ISSUE 10 satellite — the OOM
+preflight now calls back into this module).
+
+With the exec cache armed (``PT_EXEC_CACHE``) every candidate compile
+routes through `jit/exec_cache.py`; a repeat sweep deserializes instead
+of recompiling, and the comms account (``collect_comms=True``) comes
+from the cache's meta sidecar instead of re-parsing HLO — a warm sweep
+pays ZERO fresh XLA compiles.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .candidates import candidate_label
+from .hlo_costs import collective_bytes_by_axis
+
+__all__ = ["ProbeSpec", "build_probe", "lower_candidate",
+           "collect_param_specs"]
+
+
+@dataclass(frozen=True)
+class ProbeSpec:
+    """Dimensions of the probe model the sweep lowers (defaults mirror
+    memory_planner's CLI defaults; ``intermediate=0`` -> 3*hidden)."""
+
+    vocab: int = 2048
+    hidden: int = 256
+    intermediate: int = 0
+    layers: int = 2
+    heads: int = 4
+    seq: int = 128
+
+    @classmethod
+    def from_args(cls, args) -> "ProbeSpec":
+        """From any object with vocab/hidden/intermediate/layers/heads/
+        seq attributes (e.g. an argparse namespace)."""
+        return cls(vocab=args.vocab, hidden=args.hidden,
+                   intermediate=args.intermediate, layers=args.layers,
+                   heads=args.heads, seq=args.seq)
+
+    def to_dict(self) -> dict:
+        return {"vocab": self.vocab, "hidden": self.hidden,
+                "intermediate": self.intermediate, "layers": self.layers,
+                "heads": self.heads, "seq": self.seq}
+
+
+def collect_param_specs(model) -> dict:
+    """Read back the PartitionSpec every parameter actually carries —
+    the propagated result of the model's seed annotations (parallel
+    layers / sharding constraints), in JSON-able form (tuples ->
+    lists, axis names / None as-is)."""
+    from ..distributed.shard import get_sharding
+
+    out = {}
+    for name, p in model.named_parameters():
+        spec = get_sharding(p)
+        if spec is None:
+            out[name] = []
+        else:
+            out[name] = [list(s) if isinstance(s, (tuple, list)) else s
+                         for s in tuple(spec)]
+    return out
+
+
+def build_probe(cand: dict, spec: ProbeSpec):
+    """Initialize the candidate's hybrid mesh and build the probe:
+    ``(train_step, ids, model)`` — model + AdamW + TrainStep + a
+    dp-SHARDED batch (`plan.shard_batch` — the planned run shards its
+    inputs over dp; building the probe any other way would cost dp
+    nothing and make its memory/comms account fiction). The ONE probe
+    constructor: the lowering sweep and the bench's measured run must
+    judge the SAME program. Caller owns the teardown
+    (``env_mod.reset_env()``)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    from .plan import shard_batch
+
+    dp, mp, batch = cand["dp"], cand["mp"], cand["batch"]
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": dp, "mp_degree": mp, "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    cfg = LlamaConfig(
+        vocab_size=spec.vocab, hidden_size=spec.hidden,
+        intermediate_size=spec.intermediate or spec.hidden * 3,
+        num_hidden_layers=spec.layers, num_attention_heads=spec.heads,
+        max_position_embeddings=spec.seq,
+        sequence_parallel=mp > 1,
+        use_parallel_cross_entropy=mp > 1)
+    pt.seed(0)
+    model = LlamaForCausalLM(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters())
+    step = TrainStep(model, opt, lambda m, i, l: m(i, l))
+    ids = shard_batch(pt.to_tensor(np.random.randint(
+        0, cfg.vocab_size, (batch, spec.seq))))
+    return step, ids, model
+
+
+def lower_candidate(cand: dict, spec: ProbeSpec, hbm_gb: float | None = None,
+                    collect_comms: bool = False,
+                    collect_specs: bool = False) -> dict:
+    """One candidate: mesh init -> probe model -> AOT compile ->
+    per-device memory record (-> comms account -> param specs) ->
+    verdict. Tears the mesh down before returning.
+
+    The returned row carries ``label``, the candidate axes/batch, the
+    memory fields from :func:`monitor.memory.analysis_to_dict`,
+    ``fits`` when ``hbm_gb`` is given, ``exec_cache: hit|miss`` when
+    the cache is armed, ``collectives`` when ``collect_comms``, and
+    ``param_specs`` when ``collect_specs``.
+    """
+    from paddle_tpu.distributed import env as env_mod
+    from paddle_tpu.jit import exec_cache
+    from paddle_tpu.monitor import memory as memobs
+
+    dp, mp = cand["dp"], cand["mp"]
+    label = candidate_label(cand)
+    try:
+        step, ids, model = build_probe(cand, spec)
+        hits_before = (exec_cache.stats()["mem_hits"]
+                       + exec_cache.stats()["disk_hits"])
+        rec = memobs.executable_record(step, ids, ids, name=label)
+        rec.update(cand)
+        rec["label"] = label
+        if hbm_gb is not None:
+            rec["fits"] = rec["peak_bytes"] <= hbm_gb * 2**30
+        if exec_cache.enabled():
+            st = exec_cache.stats()
+            rec["exec_cache"] = ("hit" if st["mem_hits"] + st["disk_hits"]
+                                 > hits_before else "miss")
+        if collect_comms:
+            rec["collectives"] = _comms_for(step, (ids, ids),
+                                            {"dp": dp, "mp": mp})
+        if collect_specs:
+            rec["param_specs"] = collect_param_specs(model)
+        return rec
+    finally:
+        env_mod.reset_env()
+
+
+def _comms_for(step, batch, degrees: dict) -> dict:
+    """Per-axis collective bytes of the candidate's compiled executable.
+
+    Served from the exec cache's meta sidecar when the key is warm
+    (``exec_cache.meta_get`` — no re-trace, no HLO re-parse); otherwise
+    parsed from the post-SPMD optimized HLO (``compiled.as_text()``)
+    and written back through ``meta_put`` under the SAME key as the
+    executable, so the facts and the artifact invalidate together."""
+    from paddle_tpu.jit import exec_cache
+
+    key = step.exec_cache_key(*batch)
+    meta = exec_cache.meta_get(key)
+    if meta is not None and "collectives" in meta:
+        return meta["collectives"]
+    entry, _arrays, _nan = step._get_compiled(batch)
+    try:
+        hlo = entry.compiled.as_text()
+    except Exception as e:  # noqa: BLE001 — a backend whose deserialized
+        # executables carry no HLO still plans; the cost model falls back
+        # to its analytical comms term
+        return {"error": f"hlo unavailable ({type(e).__name__})"}
+    comms = collective_bytes_by_axis(hlo, degrees)
+    exec_cache.meta_put(key, {"collectives": comms})
+    return comms

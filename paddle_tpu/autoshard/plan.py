@@ -1,0 +1,236 @@
+"""The shard plan: schema, GSPMD-style spec derivation, application.
+
+A :class:`ShardPlan` is what the planner emits and everything else
+consumes: mesh degrees + global batch + per-param PartitionSpecs +
+the scored candidate table + provenance. Serialization is
+deterministic by construction (sorted keys, rounded floats, no
+timestamps) — the acceptance contract is *same inputs → byte-identical
+``shard_plan.json``*, so reproducibility is checkable with ``cmp``.
+
+Spec derivation (`derive_param_specs`) is the "handful of seed rules,
+GSPMD-propagated" half of ISSUE 10: models built from the parallel
+layers already carry their specs (the layers ARE the seed
+annotations — `collect_param_specs` reads them back); a plain model
+gets the Megatron conjugate pairing propagated structurally — walk the
+parameters in declaration order, shard the first eligible 2-D weight's
+output dim on ``mp`` (column-parallel), flip the next one's input dim
+(row-parallel, XLA inserts the f/g collectives), carry column-parallel
+biases on ``mp``, replicate everything else. Embedding-shaped weights
+("embed" in the name) shard their vocab dim. No per-layer annotations
+anywhere — XLA's SPMD partitioner completes the propagation exactly as
+`distributed/shard.py` documents.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["PLAN_VERSION", "ShardPlan", "load_plan", "derive_param_specs",
+           "apply_plan", "shard_batch"]
+
+PLAN_VERSION = 1
+
+
+def _looks_like_embedding(name: str) -> bool:
+    tail = name.lower()
+    return "embed" in tail or "emb_" in tail
+
+
+def derive_param_specs(model, mp_degree: int = 2,
+                       mp_axis: str = "mp") -> dict:
+    """Rule-derived PartitionSpecs for a model with no annotations of
+    its own: ``{param_name: [spec entries]}`` (None = replicated dim).
+    Dims that ``mp_degree`` does not divide stay replicated (specs are
+    layout hints — correctness never depends on them)."""
+    specs = {}
+    stream_sharded = False
+    col_out = None
+    for name, p in model.named_parameters():
+        shape = tuple(int(d) for d in p.shape)
+        if len(shape) == 2:
+            if _looks_like_embedding(name):
+                specs[name] = [mp_axis, None] \
+                    if shape[0] % mp_degree == 0 else [None, None]
+                continue
+            if not stream_sharded:
+                if shape[1] % mp_degree == 0:
+                    specs[name] = [None, mp_axis]  # column-parallel
+                    stream_sharded, col_out = True, shape[1]
+                else:
+                    specs[name] = [None, None]
+            else:
+                specs[name] = ([mp_axis, None]      # row-parallel:
+                               if shape[0] % mp_degree == 0  # the conjugate
+                               else [None, None])
+                stream_sharded, col_out = False, None
+        elif len(shape) == 1:
+            specs[name] = ([mp_axis] if stream_sharded
+                           and shape[0] == col_out else [None])
+        else:
+            specs[name] = [None] * len(shape)
+    return specs
+
+
+class ShardPlan:
+    """One planned hybrid configuration, loadable everywhere a mesh is
+    needed (`fit(shard_plan=)`, the launcher env, the launch scripts)."""
+
+    def __init__(self, mesh: dict, batch: int, param_specs: dict,
+                 rows: list | None = None, winner: str | None = None,
+                 seeds: dict | None = None, provenance: dict | None = None):
+        self.mesh = {"dp": int(mesh.get("dp", 1)),
+                     "mp": int(mesh.get("mp", 1))}
+        self.batch = int(batch)
+        self.param_specs = dict(param_specs or {})
+        self.rows = list(rows or [])
+        self.winner = winner
+        self.seeds = dict(seeds or {})
+        self.provenance = dict(provenance or {})
+
+    @property
+    def devices(self) -> int:
+        return self.mesh["dp"] * self.mesh["mp"]
+
+    def to_dict(self) -> dict:
+        return {
+            "plan_version": PLAN_VERSION,
+            "mesh": self.mesh,
+            "batch": self.batch,
+            "winner": self.winner,
+            "param_specs": self.param_specs,
+            "rows": self.rows,
+            "cost_seeds": self.seeds,
+            "provenance": self.provenance,
+        }
+
+    def dumps(self) -> bytes:
+        """Canonical bytes — THE determinism boundary (sorted keys,
+        2-space indent, trailing newline; floats were rounded at row
+        construction)."""
+        return (json.dumps(self.to_dict(), sort_keys=True, indent=2)
+                + "\n").encode()
+
+    def digest(self) -> str:
+        import hashlib
+
+        return hashlib.sha256(self.dumps()).hexdigest()[:16]
+
+    def summary(self) -> dict:
+        """The compact form bench lines embed (``shard_plan`` sub-object
+        — what `tools/perf_guard.py --plan-drift` compares)."""
+        return {"dp": self.mesh["dp"], "mp": self.mesh["mp"],
+                "batch": self.batch, "devices": self.devices,
+                "digest": self.digest()}
+
+    def save(self, path: str) -> str:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(self.dumps())
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ShardPlan":
+        if d.get("plan_version") != PLAN_VERSION:
+            raise ValueError(
+                f"shard plan version {d.get('plan_version')!r} != "
+                f"{PLAN_VERSION} (replan with this tree)")
+        return cls(mesh=d["mesh"], batch=d["batch"],
+                   param_specs=d.get("param_specs", {}),
+                   rows=d.get("rows", []), winner=d.get("winner"),
+                   seeds=d.get("cost_seeds", {}),
+                   provenance=d.get("provenance", {}))
+
+
+def load_plan(path_or_plan) -> ShardPlan:
+    """A ShardPlan from a path / file-ish / already-a-plan."""
+    if isinstance(path_or_plan, ShardPlan):
+        return path_or_plan
+    with open(os.fspath(path_or_plan)) as f:
+        return ShardPlan.from_dict(json.load(f))
+
+
+def _spec_tuple(entries) -> tuple:
+    return tuple(tuple(e) if isinstance(e, list) else e for e in entries)
+
+
+def apply_plan(plan, model=None):
+    """Close the loop: initialize the global mesh at the plan's degrees
+    and place the model's parameters — plan-recorded specs by name
+    first, the rule-derived specs for everything else; parameters that
+    already carry a mesh-axis spec (parallel-layer models) keep it.
+    Returns the :class:`~paddle_tpu.distributed.env.ParallelEnv`.
+
+    This is the zero-hand-written-PartitionSpecs entry point: scripts
+    call ``apply_plan(load_plan(os.environ["PT_SHARD_PLAN"]), model)``
+    and never name an axis.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..distributed import env as env_mod
+    from ..distributed.shard import get_sharding
+
+    plan = load_plan(plan)
+    env = env_mod.init_mesh(dp=plan.mesh["dp"], mp=plan.mesh["mp"])
+    if model is None:
+        return env
+    derived = None
+    mesh_axes = set(env.mesh.axis_names)
+    for name, p in model.named_parameters():
+        cur = get_sharding(p)
+        if cur is not None and any(
+                a in mesh_axes for a in _flat_axes(cur)):
+            continue  # the model's own seed annotations win
+        entries = plan.param_specs.get(name)
+        if entries is None:
+            if derived is None:
+                derived = derive_param_specs(
+                    model, mp_degree=plan.mesh["mp"] or 1)
+            entries = derived.get(name, [])
+        spec = _clean_spec(_spec_tuple(entries), tuple(p.shape), env)
+        p._replace_(jax.device_put(
+            p._data, NamedSharding(env.mesh, PartitionSpec(*spec))))
+        p._sharding_spec = PartitionSpec(*spec)
+    return env
+
+
+def _flat_axes(spec) -> list:
+    out = []
+    for e in tuple(spec):
+        if isinstance(e, (tuple, list)):
+            out.extend(x for x in e if x is not None)
+        elif e is not None:
+            out.append(e)
+    return out
+
+
+def _clean_spec(spec: tuple, shape: tuple, env) -> tuple:
+    """Drop axis entries that do not divide their dim (same guard as
+    `shard.shard_tensor`) — a plan written for one model applied to a
+    near-relative degrades to replication instead of failing."""
+    sizes = dict(zip(env.mesh.axis_names, env.mesh.devices.shape))
+    out = []
+    for i, e in enumerate(spec):
+        names = e if isinstance(e, (tuple, list)) else (e,)
+        n = 1
+        for nm in names:
+            if nm is not None:
+                n *= sizes.get(nm, 1)
+        ok = i < len(shape) and n and shape[i] % n == 0
+        out.append(e if ok else None)
+    while out and out[-1] is None:
+        out.pop()
+    return tuple(out)
+
+
+def shard_batch(x, axis: str = "dp"):
+    """Shard a host/global batch over the data axis (dim 0), replicating
+    the rest — the one input-side placement a planned run needs.
+    Scalars (0-d) replicate: there is no batch dim to split, and a
+    1-entry spec on a rank-0 value is rejected by jax."""
+    from ..distributed.shard import shard_tensor
+
+    ndim = getattr(x, "ndim", None) or len(getattr(x, "shape", ()))
+    spec = (axis,) + (None,) * (ndim - 1) if ndim else ()
+    return shard_tensor(x, spec=spec)

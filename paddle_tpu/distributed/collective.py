@@ -41,10 +41,14 @@ _monitor = None
 _spans = None
 
 
-def _mon_collective(name, arr):
+def _mon_collective(name, arr, axes=()):
     m = _monitor
     if m is not None:
-        m.on_collective(name, int(getattr(arr, "nbytes", 0) or 0))
+        # axes = the group's mesh axes: the monitor splits the byte
+        # counter per axis (collective/bytes/<axis>) so the planner's
+        # per-axis cost model has a measured twin (docs/AUTOSHARD.md)
+        m.on_collective(name, int(getattr(arr, "nbytes", 0) or 0),
+                        axes=axes)
 
 
 def _traced_collective(fn):
@@ -269,7 +273,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     if g.nranks == 1:
         return tensor
     t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
-    _mon_collective("all_reduce", t._data)
+    _mon_collective("all_reduce", t._data, g.axes)
     if _axes_in_scope(g.axes):
         ax = g.axes if len(g.axes) > 1 else g.axes[0]
         red = {"sum": jax.lax.psum, "avg": jax.lax.pmean,
@@ -317,7 +321,7 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis=0):
         x = tensor_or_list
     t = x if isinstance(x, Tensor) else Tensor(x)
     if g.nranks > 1:
-        _mon_collective("all_gather", t._data)
+        _mon_collective("all_gather", t._data, g.axes)
     if g.nranks == 1:
         gathered = t
     elif _axes_in_scope(g.axes):
@@ -349,7 +353,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
     if g.nranks == 1 or _axes_in_scope(g.axes):
         return t
-    _mon_collective("broadcast", t._data)
+    _mon_collective("broadcast", t._data, g.axes)
     e = env_mod.ensure_env()
     spec = _current_spec(t._data)
     parts = [None if _mentions(p, g.axes) else p for p in spec]
@@ -376,7 +380,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
     if g.nranks == 1 or _axes_in_scope(g.axes):
         return t
-    _mon_collective("scatter", t._data)
+    _mon_collective("scatter", t._data, g.axes)
     e = env_mod.ensure_env()
     t._replace_(jax.device_put(
         _on_mesh(t._data), NamedSharding(e.mesh, _spec_on(t.ndim, g.axes, 0))))
@@ -403,7 +407,7 @@ def all_to_all(out_tensor_list, in_tensor_list=None, group=None, sync_op=True,
     t = x if isinstance(x, Tensor) else Tensor(x)
     if g.nranks == 1:
         return t
-    _mon_collective("all_to_all", t._data)
+    _mon_collective("all_to_all", t._data, g.axes)
     ax = g.axes if len(g.axes) > 1 else g.axes[0]
     if _axes_in_scope(g.axes):
         return apply(
@@ -451,7 +455,7 @@ def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True, axis=0):
     t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
     if g.nranks == 1:
         return t
-    _mon_collective("reduce_scatter", t._data)
+    _mon_collective("reduce_scatter", t._data, g.axes)
     ax = g.axes if len(g.axes) > 1 else g.axes[0]
     if _axes_in_scope(g.axes):
         return apply(
@@ -474,7 +478,7 @@ def ppermute(tensor, perm, group=None):
     g = get_group(group)
     ax = g.axes if len(g.axes) > 1 else g.axes[0]
     t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
-    _mon_collective("ppermute", t._data)
+    _mon_collective("ppermute", t._data, g.axes)
     return apply("ppermute", lambda a: jax.lax.ppermute(a, ax, perm), (t,))
 
 

@@ -50,9 +50,14 @@ class VocabParallelEmbedding(Layer):
 
     def forward(self, x):
         out = F.embedding(x, self.weight)
-        # token activations come out replicated (XLA: gather over the
-        # sharded vocab dim → one all-reduce, Megatron's masked-lookup+psum)
-        return shard.sharding_constraint(out, *(None,) * out.ndim)
+        # token activations come out replicated over 'mp' (XLA: gather
+        # over the sharded vocab dim → one all-reduce, Megatron's
+        # masked-lookup+psum); the batch dim KEEPS its dp split — naming
+        # only None dims would force XLA to gather the dp shards at
+        # every boundary now that traced constraints are honored
+        # (distributed/shard.py)
+        return shard.sharding_constraint(
+            out, "dp", *(None,) * (out.ndim - 1))
 
 
 class ColumnParallelLinear(Layer):
@@ -82,9 +87,12 @@ class ColumnParallelLinear(Layer):
     def forward(self, x):
         out = F.linear(x, self.weight, self.bias)
         nd = out.ndim
+        # batch dim keeps its dp split through both layouts (see
+        # VocabParallelEmbedding.forward)
         if self.gather_output:
-            return shard.sharding_constraint(out, *(None,) * nd)
-        return shard.sharding_constraint(out, *(None,) * (nd - 1), "mp")
+            return shard.sharding_constraint(out, "dp", *(None,) * (nd - 1))
+        return shard.sharding_constraint(
+            out, "dp", *(None,) * (nd - 2), "mp")
 
 
 class RowParallelLinear(Layer):
@@ -114,9 +122,11 @@ class RowParallelLinear(Layer):
     def forward(self, x):
         nd = x.ndim
         if self.input_is_parallel:
-            x = shard.sharding_constraint(x, *(None,) * (nd - 1), "mp")
+            x = shard.sharding_constraint(
+                x, "dp", *(None,) * (nd - 2), "mp")
         out = F.linear(x, self.weight, None)
-        out = shard.sharding_constraint(out, *(None,) * out.ndim)
+        out = shard.sharding_constraint(
+            out, "dp", *(None,) * (out.ndim - 1))
         if self.bias is not None:
             out = out + self.bias
         return out
@@ -138,7 +148,7 @@ class ParallelCrossEntropy(Layer):
 
     def forward(self, input, label):
         logits = shard.sharding_constraint(
-            input, *(None,) * (input.ndim - 1), "mp")
+            input, "dp", *(None,) * (input.ndim - 2), "mp")
         ignore = self.ignore_index
 
         def ce(lg, lb):

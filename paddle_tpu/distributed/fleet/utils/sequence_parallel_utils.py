@@ -28,9 +28,18 @@ from ...fleet.meta_parallel.parallel_layers.mp_layers import (
 
 
 def _seq_spec(ndim, axis="mp"):
+    # batch keeps its dp split: a constraint naming only the seq axis
+    # would force XLA to DROP the dp sharding at every SP boundary (a
+    # full remat copy per layer now that traced constraints are honored
+    # — distributed/shard.py)
     parts = [None] * ndim
+    parts[0] = "dp"
     parts[1] = axis
     return parts
+
+
+def _batch_spec(ndim):
+    return ["dp"] + [None] * (ndim - 1)
 
 
 class ScatterOp:
@@ -46,7 +55,7 @@ class GatherOp:
 
     @staticmethod
     def apply(x):
-        return shard.sharding_constraint(x, *(None,) * x.ndim)
+        return shard.sharding_constraint(x, *_batch_spec(x.ndim))
 
 
 class AllGatherOp:
@@ -54,7 +63,7 @@ class AllGatherOp:
 
     @staticmethod
     def apply(x):
-        return shard.sharding_constraint(x, *(None,) * x.ndim)
+        return shard.sharding_constraint(x, *_batch_spec(x.ndim))
 
 
 class ReduceScatterOp:

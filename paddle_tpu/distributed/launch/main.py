@@ -40,6 +40,11 @@ def _parse():
     p.add_argument("--job_id", type=str, default="default")
     p.add_argument("--devices", type=str, default=None,
                    help="visible device ids (sets JAX local device filter)")
+    p.add_argument("--shard_plan", type=str, default=None,
+                   help="shard_plan.json from tools/shard_plan.py: stamped "
+                        "into every worker as PT_SHARD_PLAN, so scripts "
+                        "(and hapi fit) apply the planned mesh + param "
+                        "placements with no hand-written PartitionSpecs")
     p.add_argument("--max_restart", type=int, default=3)
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -59,6 +64,8 @@ def _spawn(args, rank, nprocs, master, restarts=0):
         env["PADDLE_MASTER"] = master
     if args.devices is not None:
         env["TPU_VISIBLE_DEVICES"] = args.devices
+    if args.shard_plan is not None:
+        env["PT_SHARD_PLAN"] = os.path.abspath(args.shard_plan)
     os.makedirs(args.log_dir, exist_ok=True)
     log = open(os.path.join(args.log_dir,
                             f"workerlog.{rank}"), "ab", buffering=0)

@@ -91,10 +91,25 @@ def shard_tensor(x, mesh=None, placements=None, *, spec=None,
     parts = tuple(cleaned)
     sharding = NamedSharding(mesh, PartitionSpec(*parts))
 
-    # jax.device_put: eager -> physical reshard onto the mesh; traced ->
-    # equivalent to a sharding constraint. Differentiable in both (its
-    # transpose is a device_put back to the cotangent's prior sharding).
-    out = apply("shard_tensor", lambda a: jax.device_put(a, sharding), (t,))
+    # Eager -> physical reshard (device_put); traced -> an EXPLICIT
+    # with_sharding_constraint. On this jax (0.4.37) a device_put inside
+    # a trace is a jaxpr no-op — every model's dp/mp activation hint was
+    # silently dropped from compiled steps (dp lowered to fully
+    # replicated programs; caught by the autoshard planner's HLO comms
+    # extraction reading zero collectives). The branch is decided on the
+    # INPUT array, not inside the applied fn: the tape's eager jax.vjp
+    # traces the fn too, and with_sharding_constraint on an off-mesh
+    # concrete cotangent rejects the device-set change device_put
+    # handles. Differentiable in both (the transpose is the matching
+    # constraint/device_put on the cotangent).
+    if isinstance(t._data, jax.core.Tracer):
+        def _constrain(a):
+            return jax.lax.with_sharding_constraint(a, sharding)
+    else:
+        def _constrain(a):
+            return jax.device_put(a, sharding)
+
+    out = apply("shard_tensor", _constrain, (t,))
     out._sharding_spec = PartitionSpec(*parts)
     if stop_gradient is not None:
         out.stop_gradient = stop_gradient

@@ -247,7 +247,8 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, max_in_flight=2,
             device_prefetch=0, nan_check=None, resume_from=None,
-            checkpoint_dir=None, checkpoint_keep=None, nan_policy=None):
+            checkpoint_dir=None, checkpoint_keep=None, nan_policy=None,
+            shard_plan=None):
         """Parity: `paddle.Model.fit` — with an asynchronous device
         pipeline (docs/ASYNC_PIPELINE.md). Steps dispatch through an
         :class:`AsyncStepper` keeping up to ``max_in_flight`` compiled
@@ -282,8 +283,58 @@ class Model:
         :class:`~paddle_tpu.resilience.NaNSkipPolicy`: the poisoned
         batch is dropped (params/LR/step untouched — the step never
         happened) and training continues, aborting only after
-        ``PT_NANSKIP_MAX`` consecutive failures."""
+        ``PT_NANSKIP_MAX`` consecutive failures.
+
+        Automatic sharding (docs/AUTOSHARD.md): ``shard_plan`` — a
+        ``shard_plan.json`` path (or loaded
+        :class:`~paddle_tpu.autoshard.ShardPlan`) from
+        ``tools/shard_plan.py plan`` — initializes the global (dp×mp)
+        mesh at the plan's degrees and places every parameter by its
+        planned / rule-derived PartitionSpec before the first step: a
+        hybrid run with no hand-written specs. Defaults to the
+        ``PT_SHARD_PLAN`` env stamp the planner's launcher sets, so a
+        launched script needs no code either (``resume_from`` likewise
+        defaults from the ``PT_SHARD_RESUME`` stamp `shard_plan.py
+        resume` sets). Combines with ``resume_from``: the checkpoint
+        reshards into the NEW plan's placements on load, so the saved
+        (dp×mp) need not match."""
         assert self._train_step is not None, "call prepare() first"
+        if shard_plan is None:
+            shard_plan = os.environ.get("PT_SHARD_PLAN") or None
+        if resume_from is None:
+            # `shard_plan.py resume` stamps the checkpoint dir into the
+            # workers; an hapi script relaunched that way must resume,
+            # not silently retrain from step 0
+            resume_from = os.environ.get("PT_SHARD_RESUME") or None
+        shard_batch = None
+        if shard_plan is not None:
+            from ..autoshard import apply_plan, load_plan
+            from ..autoshard import shard_batch as _shard_batch
+
+            # mesh + param placement BEFORE resume/compile: the restore
+            # reshards into these placements, and the first step's
+            # lowering sees them
+            plan = load_plan(shard_plan)
+            apply_plan(plan, self.network)
+            if plan.batch and batch_size != plan.batch and not isinstance(
+                    train_data, DataLoader):
+                import warnings
+
+                # the plan's HBM-fit verdict and comms account were
+                # computed FOR plan.batch — a different executed batch
+                # voids both (a bigger one can OOM a "fits" plan)
+                warnings.warn(
+                    f"fit(shard_plan=): batch_size={batch_size} differs "
+                    f"from the planned global batch {plan.batch}; the "
+                    f"plan's HBM-fit and comms estimates assumed "
+                    f"{plan.batch}", stacklevel=2)
+            if plan.mesh.get("dp", 1) > 1:
+                # batches must join the dp split, or XLA lowers the step
+                # with the batch REPLICATED and data parallelism is
+                # compiled out (the plan's memory/comms account assumed
+                # dp-sharded inputs — autoshard/lowering.py lowers the
+                # candidates that way)
+                shard_batch = _shard_batch
         policy = None
         if nan_policy is not None:
             if nan_policy != "skip":
@@ -400,8 +451,11 @@ class Model:
                         cbks.on_train_batch_begin(step)
                         batch = batch if isinstance(batch, (list, tuple)) \
                             else [batch]
+                        tensors = _to_tensor_list(batch)
+                        if shard_batch is not None:
+                            tensors = [shard_batch(t) for t in tensors]
                         try:
-                            loss = stepper(*_to_tensor_list(batch))
+                            loss = stepper(*tensors)
                         except _NonFiniteError as e:
                             if policy is None:
                                 raise

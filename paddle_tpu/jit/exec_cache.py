@@ -49,6 +49,7 @@ import collections
 import contextlib
 import functools
 import hashlib
+import json
 import os
 import pickle
 import re
@@ -68,7 +69,7 @@ __all__ = [
     "get_or_compile", "ExecEntry", "enable", "disable", "enabled",
     "cache_dir", "clear", "stats", "key_hash", "array_spec",
     "array_digest", "freeze_attrs", "fingerprint_callable", "mesh_spec",
-    "FORMAT",
+    "meta_get", "meta_put", "FORMAT",
 ]
 
 # bump on any change to the artifact layout or key schema
@@ -147,6 +148,7 @@ def clear() -> None:
     """Drop the in-memory tier (the disk tier is left on disk) and zero
     the plain-int stats — test isolation hook."""
     _mem.clear()
+    _meta_mem.clear()
     for k in _stats:
         _stats[k] = 0.0 if k == "compile_ms_saved" else 0
 
@@ -474,6 +476,73 @@ class ExecEntry:
         return self.compiled.memory_analysis()
 
 
+# -- meta sidecar ------------------------------------------------------------
+
+# derived facts about a cached executable (the planner's per-axis
+# collective bytes parsed from its post-SPMD HLO), keyed by the SAME
+# key as the executable itself — the facts and the artifact invalidate
+# together (any source edit, jax bump, or topology change flips the key
+# hash for both). In-memory tier always works; the JSON disk tier rides
+# the cache dir so a warm planner sweep re-reads its comms account with
+# zero fresh traces. Bounded like the mem tier.
+_meta_mem: "collections.OrderedDict" = collections.OrderedDict()
+
+
+def _meta_path(sha: str) -> str:
+    return os.path.join(_dir, sha[:32] + ".meta.json")
+
+
+def meta_get(key) -> dict | None:
+    """Sidecar facts stored under ``key`` (None = no key / no facts)."""
+    if key is None:
+        return None
+    _rep, sha = key_hash(key)
+    hit = _meta_mem.get(sha)
+    if hit is not None:
+        return hit
+    if not enabled():
+        return None
+    try:
+        with open(_meta_path(sha)) as f:
+            blob = json.load(f)
+        if not (isinstance(blob, dict) and blob.get("format") == FORMAT
+                and blob.get("key_sha") == sha):
+            return None
+        meta = blob.get("meta")
+    except (OSError, ValueError):
+        return None
+    if isinstance(meta, dict):
+        _meta_mem[sha] = meta
+        while len(_meta_mem) > _MAX_MEM_ENTRIES:
+            with contextlib.suppress(KeyError):
+                _meta_mem.popitem(last=False)
+        return meta
+    return None
+
+
+def meta_put(key, meta: dict) -> None:
+    """Store sidecar facts under ``key`` (JSON-able dict); disk write is
+    atomic and best-effort — losing it only costs a re-derivation."""
+    if key is None or not isinstance(meta, dict):
+        return
+    _rep, sha = key_hash(key)
+    _meta_mem[sha] = meta
+    while len(_meta_mem) > _MAX_MEM_ENTRIES:
+        with contextlib.suppress(KeyError):
+            _meta_mem.popitem(last=False)
+    if not enabled():
+        return
+    try:
+        os.makedirs(_dir, exist_ok=True)
+        path = _meta_path(sha)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"format": FORMAT, "key_sha": sha, "meta": meta}, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # an unwritable dir must never break planning
+
+
 # -- the cache ---------------------------------------------------------------
 
 def _path_for(sha: str) -> str:
@@ -544,13 +613,14 @@ def _prune_disk() -> None:
     """Keep the newest ``PT_EXEC_CACHE_LIMIT`` (256) artifacts: source
     edits orphan every existing hash, and orphans are never re-read."""
     try:
-        paths = [os.path.join(_dir, f) for f in os.listdir(_dir)
-                 if f.endswith(".ptxc")]
-        if len(paths) <= _MAX_DISK_ENTRIES:
-            return
-        paths.sort(key=lambda p: os.stat(p).st_mtime)
-        for p in paths[:len(paths) - _MAX_DISK_ENTRIES]:
-            os.unlink(p)
+        for ext in (".ptxc", ".meta.json"):
+            paths = [os.path.join(_dir, f) for f in os.listdir(_dir)
+                     if f.endswith(ext)]
+            if len(paths) <= _MAX_DISK_ENTRIES:
+                continue
+            paths.sort(key=lambda p: os.stat(p).st_mtime)
+            for p in paths[:len(paths) - _MAX_DISK_ENTRIES]:
+                os.unlink(p)
     except OSError:
         pass  # a racing child pruned first, or the dir went away
 

@@ -271,13 +271,16 @@ class TrainStep:
 
     def _place(self, x):
         # host-side scalars/batches join the params' mesh (replicated;
-        # multihost-safe via env.put_replicated)
+        # multihost-safe via env.put_replicated). An input ALREADY on
+        # the mesh keeps its placement — a planned run's dp-sharded
+        # batch (autoshard.shard_batch) must not be re-replicated, or
+        # data parallelism would be compiled out of the step
         from ..distributed import env as env_mod
 
         e = env_mod.get_env()
         if e is None or e.mesh.size == 1:
             return x
-        return env_mod.put_replicated(x, e.mesh)
+        return env_mod.ensure_on_mesh(x, e.mesh)
 
     def _lowered_for(self, arrays, nan_check):
         """Trace + lower the step against the CURRENT params/state/batch
@@ -351,7 +354,13 @@ class TrainStep:
                     ec.freeze_attrs(opt, exclude=(
                         "_global_step", "_accumulators", "_step_counts",
                         "_master_weights", "_param_masks",
-                        "_parameter_list")),
+                        "_parameter_list",
+                        # per-param scratch _param_update writes DURING
+                        # tracing: keying them would make the key drift
+                        # across a compile (the planner's meta sidecar
+                        # re-keys after one) — their content is keyed
+                        # per-param in params_spec already
+                        "_current_param", "_current_reg")),
                     ec.freeze_attrs(getattr(opt, "_grad_clip", None))),
             "masks": mask_spec,
             "loss_fn": ec.fingerprint_callable(self._loss_fn),
@@ -515,6 +524,23 @@ class TrainStep:
     @property
     def compiled_count(self):
         return len(self._cache)
+
+    def exec_cache_key(self, *batch):
+        """The process-wide executable-cache key this batch signature
+        compiles under (None while the cache is disabled) — the handle
+        the sharding planner uses to file sidecar facts about the
+        executable (`exec_cache.meta_put`) under the SAME invalidation
+        lifetime as the executable itself."""
+        from . import exec_cache
+
+        if not exec_cache.enabled():
+            return None
+        self._ensure_state()
+        arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        return self._cache_key(
+            arrays, getattr(self._model, "training", True),
+            self._nan_active())
 
     def memory_analysis(self, *batch):
         """XLA memory accounting of the compiled step for these batch

@@ -99,9 +99,9 @@ class BertSelfAttention(Layer):
         q = q.reshape([b, s, self.num_heads, self.head_dim])
         k = k.reshape([b, s, self.num_heads, self.head_dim])
         v = v.reshape([b, s, self.num_heads, self.head_dim])
-        q = shard.sharding_constraint(q, None, None, "mp", None)
-        k = shard.sharding_constraint(k, None, None, "mp", None)
-        v = shard.sharding_constraint(v, None, None, "mp", None)
+        q = shard.sharding_constraint(q, "dp", None, "mp", None)
+        k = shard.sharding_constraint(k, "dp", None, "mp", None)
+        v = shard.sharding_constraint(v, "dp", None, "mp", None)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask, self.dropout_p, is_causal=False,
             training=self.training)

@@ -236,10 +236,18 @@ class LlamaAttention(Layer):
                 rep = self.num_heads // self.num_kv_heads
                 k = T.repeat_interleave(k, rep, axis=2)
                 v = T.repeat_interleave(v, rep, axis=2)
-        # heads stay mp-sharded through attention (dim 2)
-        q = shard.sharding_constraint(q, None, None, "mp", None)
-        k = shard.sharding_constraint(k, None, None, "mp", None)
-        v = shard.sharding_constraint(v, None, None, "mp", None)
+        if not cfg.context_parallel:
+            # heads stay mp-sharded through attention (dim 2); the batch
+            # dim keeps its dp split — a constraint that names only one
+            # axis forces XLA to drop the other (a full remat copy per
+            # layer now that traced constraints are honored, see
+            # distributed/shard.py). Under context parallelism the
+            # sequence dim is sep-sharded and the ring/ulysses paths own
+            # their layouts — constraining seq to None here would
+            # all-gather the full sequence CP exists to avoid
+            q = shard.sharding_constraint(q, "dp", None, "mp", None)
+            k = shard.sharding_constraint(k, "dp", None, "mp", None)
+            v = shard.sharding_constraint(v, "dp", None, "mp", None)
         if cfg.context_parallel:
             # exact attention with the sequence sharded across chips
             # (long-context path): KV-rotating ring by default, or
@@ -297,11 +305,12 @@ class LlamaDecoderLayer(Layer):
 
     def forward(self, x):
         sp = self.config.sequence_parallel
-        if sp:  # residual stream sequence-sharded over 'mp' (SP)
-            x = shard.sharding_constraint(x, None, "mp", None)
+        if sp:  # residual stream sequence-sharded over 'mp' (SP), batch
+            # still dp-split (hybrid: both axes in one constraint)
+            x = shard.sharding_constraint(x, "dp", "mp", None)
         h = x + self.self_attn(self.input_layernorm(x))
         if sp:
-            h = shard.sharding_constraint(h, None, "mp", None)
+            h = shard.sharding_constraint(h, "dp", "mp", None)
         out = h + self.mlp(self.post_attention_layernorm(h))
         return out
 

@@ -63,6 +63,7 @@ INSTRUMENTED_MODULES = (
     "paddle_tpu.resilience.checkpoint_manager",
     "paddle_tpu.resilience.resume",
     "paddle_tpu.resilience.numerics_policy",
+    "paddle_tpu.autoshard.planner",
 )
 
 _registry = Registry()
@@ -149,6 +150,22 @@ _h_res_save_ms = _registry.histogram("resilience/save_ms")
 _c_res_restores = _registry.counter("resilience/restores")
 _c_res_crash_resumes = _registry.counter("resilience/crash_resumes")
 _c_res_skipped = _registry.counter("resilience/skipped_batches")
+# automatic sharding planner (paddle_tpu/autoshard — docs/AUTOSHARD.md):
+# sweep accounting per candidate row + emitted plans; the winner gauge
+# is the roofline estimate the plan committed to
+_c_plan_candidates = _registry.counter("planner/candidates")
+_c_plan_infeasible = _registry.counter("planner/infeasible")
+_c_plan_errors = _registry.counter("planner/errors")
+_c_plan_plans = _registry.counter("planner/plans")
+_g_plan_winner_ms = _registry.gauge("planner/winner_est_step_ms")
+
+# per-axis collective-bytes attribution (ISSUE 10 satellite): eager
+# collectives know their group's mesh axes, so the aggregate
+# collective/bytes counter splits into collective/bytes/<axis> the
+# planner's cost model can be judged against. Multi-axis groups bill
+# the fused label ("dp+mp", canonical AXIS_ORDER order) so the per-axis
+# counters always sum to the aggregate.
+_COLL_AXIS_ORDER = ("dp", "pp", "sharding", "sep", "mp")
 
 
 # -- public metric access ----------------------------------------------------
@@ -361,10 +378,14 @@ def on_tunnel_sync(ms: float) -> None:
         _check_watchpoint("tunnel/syncs", _c_syncs.value)
 
 
-def on_collective(name: str, nbytes: int) -> None:
+def on_collective(name: str, nbytes: int, axes=None) -> None:
     _registry.counter(f"collective/{name}").inc()
     if nbytes:
         _c_coll_bytes.inc(nbytes)
+        if axes:
+            label = "+".join(a for a in _COLL_AXIS_ORDER if a in axes) \
+                or "+".join(sorted(axes))
+            _registry.counter(f"collective/bytes/{label}").inc(nbytes)
 
 
 def on_key_split() -> None:
@@ -533,6 +554,23 @@ def on_ckpt_restore(crash_resume: bool = False) -> None:
 def on_nan_skip(n: int = 1) -> None:
     """The NaN policy dropped a poisoned batch and continued."""
     _c_res_skipped.inc(n)
+
+
+def on_planner_candidate(fits: bool, error: bool = False) -> None:
+    """The planner judged one (dp×mp, batch) candidate row."""
+    _c_plan_candidates.inc()
+    if error:
+        _c_plan_errors.inc()
+    elif not fits:
+        _c_plan_infeasible.inc()
+
+
+def on_planner_plan(est_step_ms: float) -> None:
+    """A plan was emitted; the gauge holds its winner's roofline
+    step-time estimate (the number the hwbench ``shard_plan`` row
+    later judges against a measurement)."""
+    _c_plan_plans.inc()
+    _g_plan_winner_ms.set(est_step_ms)
 
 
 from . import memory  # noqa: E402  — device memory observatory

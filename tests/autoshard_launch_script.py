@@ -1,0 +1,80 @@
+"""Planner-driven launch/resume fixture — elastic_reshard_script.py's
+successor with ZERO hand-written PartitionSpecs (ISSUE 10 acceptance):
+every placement comes from the shard plan the launcher stamped into
+``PT_SHARD_PLAN`` (`autoshard.apply_plan` initializes the planned mesh
+and derives the Megatron conjugate pairing for the plain Sequential
+model; the batch is dp-sharded by `autoshard.shard_batch`).
+
+Life 0 trains under plan A and crashes mid-run (AUTOSHARD_CRASH_AT).
+The driver (tests/test_autoshard.py) then REPLANS for a different
+topology and relaunches with ``PT_SHARD_RESUME`` pointing at the
+checkpoint dir — reshard-on-load (distributed/checkpoint.py) rebuilds
+every param at the new placements. The stitched loss trajectory must
+stay on the SAME curve as an uninterrupted single-plan run.
+"""
+import json
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+from paddle_tpu import autoshard, resilience  # noqa: E402
+from paddle_tpu.resilience import resume as rez  # noqa: E402
+
+WORKDIR = sys.argv[1]
+CRASH_AT = int(os.environ.get("AUTOSHARD_CRASH_AT", "-1"))
+TOTAL_STEPS = 6
+resume_dir = os.environ.get("PT_SHARD_RESUME")
+life = 1 if resume_dir else 0
+
+plan = autoshard.load_plan(os.environ["PT_SHARD_PLAN"])
+
+paddle.seed(0)
+model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+# the whole point: mesh + every param placement from the plan — no
+# PartitionSpec appears anywhere in this file
+env = autoshard.apply_plan(plan, model)
+opt = paddle.optimizer.AdamW(learning_rate=5e-2,
+                             parameters=model.parameters())
+
+rng = np.random.default_rng(0)
+xs = rng.standard_normal((TOTAL_STEPS, 16, 8)).astype("float32")
+w_true = rng.standard_normal((8, 1)).astype("float32")
+
+ckpt_dir = os.path.join(WORKDIR, "ckpt")
+start_step = 0
+scal = rez.restore_latest(model, opt, ckpt_dir, crash_resume=life > 0)
+if scal is not None:
+    start_step = int(scal.get("step", 0))
+
+# sync saves: this fixture proves PLAN-driven reshard equivalence;
+# torn-checkpoint fallback has its own test (test_resilience.py)
+mgr = resilience.CheckpointManager(ckpt_dir, interval=1, keep=3,
+                                   async_save=False)
+losses = []
+for step in range(start_step, TOTAL_STEPS):
+    x = autoshard.shard_batch(paddle.to_tensor(xs[step]))
+    y = autoshard.shard_batch(paddle.to_tensor(xs[step] @ w_true))
+    loss = F.mse_loss(model(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    losses.append(float(np.asarray(loss.numpy()).reshape(-1)[0]))
+    with open(os.path.join(WORKDIR, f"losses_r{life}.json"), "w") as f:
+        json.dump({"start": start_step, "losses": losses,
+                   "mesh": dict(plan.mesh)}, f)
+    mgr.save(step + 1, rez.capture(model, opt, step=step + 1))
+    if life == 0 and step + 1 == CRASH_AT:
+        os._exit(17)  # simulated preemption mid-training

@@ -252,6 +252,74 @@ class TestEvaluate:
         assert not any(c["name"] == "kernel_engagement"
                        for c in v2["checks"])
 
+    def test_flags_plan_drift_same_topology(self, guard):
+        # the cost model flipped the planned sharding for the SAME
+        # device count — a silent production-sharding change
+        base = {"metric": "shard_plan_planned_vs_measured", "value": 900.0,
+                "backend": "tpu",
+                "extra": {"shard_plan": {"dp": 4, "mp": 2, "batch": 8,
+                                         "devices": 8}}}
+        fresh = {"metric": "shard_plan_planned_vs_measured", "value": 910.0,
+                 "unit": "tokens/s",
+                 "shard_plan": {"dp": 8, "mp": 1, "batch": 8,
+                                "devices": 8}}
+        v = guard.evaluate(fresh, base, hardware=True)
+        assert not v["ok"]
+        bad = [c for c in v["checks"] if c["name"] == "plan_drift"]
+        assert bad and not bad[0]["ok"]
+        assert "dp 4→8" in bad[0]["detail"]
+
+    def test_plan_drift_same_plan_passes(self, guard):
+        plan = {"dp": 4, "mp": 2, "batch": 8, "devices": 8}
+        base = {"metric": "shard_plan_planned_vs_measured", "value": 900.0,
+                "backend": "tpu", "extra": {"shard_plan": dict(plan)}}
+        fresh = {"metric": "shard_plan_planned_vs_measured", "value": 905.0,
+                 "unit": "tokens/s", "shard_plan": dict(plan)}
+        v = guard.evaluate(fresh, base, hardware=True)
+        assert v["ok"]
+        ok = [c for c in v["checks"] if c["name"] == "plan_drift"]
+        assert ok and ok[0]["ok"]
+
+    def test_plan_drift_skips_other_topology_smoke_and_missing(
+            self, guard):
+        base = {"metric": "shard_plan_planned_vs_measured", "value": 900.0,
+                "backend": "tpu",
+                "extra": {"shard_plan": {"dp": 4, "mp": 2, "batch": 8,
+                                         "devices": 8}}}
+        # different device count: not comparable, gate absent
+        fresh16 = {"metric": "shard_plan_planned_vs_measured",
+                   "value": 900.0, "unit": "tokens/s",
+                   "shard_plan": {"dp": 16, "mp": 1, "batch": 8,
+                                  "devices": 16}}
+        v = guard.evaluate(fresh16, base, hardware=True)
+        assert not any(c["name"] == "plan_drift" for c in v["checks"])
+        # cpu smoke: hardware comparisons skipped entirely
+        smoke = {"metric": "shard_plan_planned_vs_measured", "value": 10.0,
+                 "unit": "tokens/s",
+                 "shard_plan": {"dp": 8, "mp": 1, "batch": 8,
+                                "devices": 8},
+                 "note": "cpu smoke mode; not a TPU number"}
+        v2 = guard.evaluate(smoke, base)
+        assert v2["ok"]
+        assert not any(c["name"] == "plan_drift" for c in v2["checks"])
+        # baseline without the field: gate silently absent
+        hw = {"metric": "shard_plan_planned_vs_measured", "value": 900.0,
+              "unit": "tokens/s",
+              "shard_plan": {"dp": 8, "mp": 1, "batch": 8, "devices": 8}}
+        v3 = guard.evaluate(
+            hw, {"metric": "shard_plan_planned_vs_measured",
+                 "value": 900.0, "backend": "tpu", "extra": {}},
+            hardware=True)
+        assert not any(c["name"] == "plan_drift" for c in v3["checks"])
+        # the gate can be disabled explicitly (--no-plan-drift)
+        fresh = {"metric": "shard_plan_planned_vs_measured",
+                 "value": 910.0, "unit": "tokens/s",
+                 "shard_plan": {"dp": 8, "mp": 1, "batch": 8,
+                                "devices": 8}}
+        v4 = guard.evaluate(fresh, base, hardware=True,
+                            thresholds={"plan_drift": False})
+        assert not any(c["name"] == "plan_drift" for c in v4["checks"])
+
     def test_flags_save_cost_growth(self, guard):
         base = {"metric": "soak", "value": 900.0, "backend": "tpu",
                 "extra": {"ckpt_save_ms_p50": 300.0}}

@@ -213,7 +213,11 @@ class TestFitTraceExport:
     # host-overhead smoke) the bound gets one noisy-neighbor retry with
     # a fresh run before it may fail the tier.
     WALL_COVERAGE_MIN = 0.75
-    _RETRIES = 1
+    # two clean-slate retries: one was not enough on the shared box —
+    # the bound still tripped ~1-in-4 full-suite runs when this module
+    # follows compile-heavy ones (base tree and PRs alike; see the
+    # flaky-test note), and each retry is an independent ~2 s fit
+    _RETRIES = 2
 
     def test_fit_trace_lanes_wellformed_and_attribution(self, mon,
                                                         tmp_path):

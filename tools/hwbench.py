@@ -71,6 +71,13 @@ BENCHES = [
     # settles this PR's two disengaged-by-default kernels next chip-up
     ("kernel_search", [sys.executable, "tools/kernel_search.py"], 2400,
      None),
+    # automatic sharding planner (docs/AUTOSHARD.md): timeboxed candidate
+    # sweep + a short measured run of the winner and runner-up — persists
+    # the planned-vs-measured throughput delta (the cost-model
+    # calibration number) and the plan the guard's --plan-drift gate
+    # pins for this topology
+    ("shard_plan", [sys.executable, "tools/shard_plan.py", "bench"],
+     2400, None),
     ("profile", [sys.executable, "tools/profile_train_step.py"], 1800,
      None),
     # queued PR-6 follow-up (ROADMAP item 5 remainder): cold-vs-warm

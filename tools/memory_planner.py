@@ -35,102 +35,59 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _autoshard_mod(name):
+    """Load `paddle_tpu/autoshard/<name>.py` BY FILE PATH — these
+    modules are stdlib-pure, and a package import would pull the whole
+    jax-backed paddle_tpu __init__ into the parent process the
+    corrected-child re-exec exists to keep light (CLI/arg errors must
+    surface before any backend initializes)."""
+    import importlib.util
+
+    path = os.path.join(ROOT, "paddle_tpu", "autoshard", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(
+        f"_autoshard_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _candidates_mod():
+    """`paddle_tpu.autoshard.candidates` — the planner's enumeration is
+    the ONE code path (ISSUE 10 satellite: this tool's private copies
+    moved there)."""
+    return _autoshard_mod("candidates")
+
+
 def parse_mesh(token: str) -> dict:
     """``dp4xmp2`` -> {"dp": 4, "mp": 2} (either axis optional)."""
-    out = {"dp": 1, "mp": 1}
-    for part in token.lower().split("x"):
-        part = part.strip()
-        if not part:
-            continue
-        for axis in ("dp", "mp"):
-            if part.startswith(axis):
-                out[axis] = int(part[len(axis):])
-                break
-        else:
-            raise ValueError(f"memory_planner: bad mesh token {part!r} "
-                             f"in {token!r} (expected dpN / mpN / dpNxmpM)")
-    return out
+    return _candidates_mod().parse_mesh(token)
 
 
 def default_meshes(n_devices: int) -> list:
     """(dp, mp) factorizations of the device count, dp-heavy first."""
-    out = []
-    mp = 1
-    while mp <= n_devices:
-        if n_devices % mp == 0:
-            out.append({"dp": n_devices // mp, "mp": mp})
-        mp *= 2
-    return out
+    return _candidates_mod().default_meshes(n_devices)
 
 
 def candidates(args, n_devices: int) -> list:
-    meshes = ([parse_mesh(t) for t in args.configs.split(",")]
-              if args.configs else default_meshes(n_devices))
-    batches = [int(b) for b in str(args.batches).split(",")]
-    out = []
-    for m in meshes:
-        if m["dp"] * m["mp"] != n_devices:
-            raise ValueError(
-                f"memory_planner: dp{m['dp']}xmp{m['mp']} does not "
-                f"factorize {n_devices} devices")
-        for b in batches:
-            out.append({**m, "batch": b})
-    return out
+    return _candidates_mod().enumerate_candidates(
+        n_devices, args.configs, str(args.batches))
 
 
 def plan_one(cand: dict, args) -> dict:
     """One candidate: mesh init -> model -> AOT compile -> per-device
-    memory record -> verdict. Tears the mesh down before returning."""
-    import numpy as np
+    memory record -> verdict — via the sharding planner's shared
+    child-lowering API (`paddle_tpu/autoshard/lowering.py`, where this
+    function's body moved). Tears the mesh down before returning."""
+    sys.path.insert(0, ROOT)
+    from paddle_tpu.autoshard.lowering import ProbeSpec, lower_candidate
 
-    import paddle_tpu as pt
-    from paddle_tpu.distributed import env as env_mod, fleet
-    from paddle_tpu.jit.train_step import TrainStep
-    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-    from paddle_tpu.monitor import memory as memobs
-
-    dp, mp, batch = cand["dp"], cand["mp"], cand["batch"]
-    label = f"dp{dp}·mp{mp} b{batch}"
-    strategy = fleet.DistributedStrategy()
-    strategy.hybrid_configs = {
-        "dp_degree": dp, "mp_degree": mp, "pp_degree": 1}
-    fleet.init(is_collective=True, strategy=strategy)
-    try:
-        cfg = LlamaConfig(
-            vocab_size=args.vocab, hidden_size=args.hidden,
-            intermediate_size=args.intermediate or args.hidden * 3,
-            num_hidden_layers=args.layers, num_attention_heads=args.heads,
-            max_position_embeddings=args.seq,
-            sequence_parallel=mp > 1,
-            use_parallel_cross_entropy=mp > 1)
-        pt.seed(0)
-        model = LlamaForCausalLM(cfg)
-        opt = pt.optimizer.AdamW(learning_rate=1e-4,
-                                 parameters=model.parameters())
-        step = TrainStep(model, opt, lambda m, i, l: m(i, l))
-        ids = pt.to_tensor(np.random.randint(
-            0, cfg.vocab_size, (batch, args.seq)))
-        from paddle_tpu.jit import exec_cache
-
-        hits_before = (exec_cache.stats()["mem_hits"]
-                       + exec_cache.stats()["disk_hits"])
-        rec = memobs.executable_record(step, ids, ids, name=label)
-        rec.update(cand)
-        rec["label"] = label
-        rec["fits"] = rec["peak_bytes"] <= args.hbm_gb * 2**30
-        if exec_cache.enabled():
-            st = exec_cache.stats()
-            rec["exec_cache"] = ("hit" if st["mem_hits"] + st["disk_hits"]
-                                 > hits_before else "miss")
-        return rec
-    finally:
-        env_mod.reset_env()
+    return lower_candidate(cand, ProbeSpec.from_args(args),
+                           hbm_gb=args.hbm_gb)
 
 
 def render(rows: list, hbm_gb: float, n_devices: int) -> str:
@@ -166,8 +123,7 @@ def plan(args, n_devices: int) -> list:
             rows.append(plan_one(cand, args))
         except Exception as e:  # noqa: BLE001 — one broken candidate
             # must not hide the others' verdicts
-            rows.append({"label": f"dp{cand['dp']}·mp{cand['mp']} "
-                                  f"b{cand['batch']}",
+            rows.append({"label": _candidates_mod().candidate_label(cand),
                          **cand, "error": f"{type(e).__name__}: {e}"})
     return rows
 
@@ -188,13 +144,8 @@ def build_argparser() -> argparse.ArgumentParser:
                          "dp×mp factorizations of --devices)")
     ap.add_argument("--batches", default="8",
                     help="comma list of global batch sizes (default 8)")
-    ap.add_argument("--hidden", type=int, default=256)
-    ap.add_argument("--intermediate", type=int, default=0,
-                    help="FFN width (default 3*hidden)")
-    ap.add_argument("--layers", type=int, default=2)
-    ap.add_argument("--heads", type=int, default=4)
-    ap.add_argument("--seq", type=int, default=128)
-    ap.add_argument("--vocab", type=int, default=2048)
+    # probe dims shared with tools/shard_plan.py (one sweep, two tools)
+    _autoshard_mod("cli").add_probe_args(ap)
     ap.add_argument("--smoke", action="store_true",
                     help="tiny model + 3 mesh candidates (CI smoke)")
     ap.add_argument("--json", action="store_true",
@@ -209,46 +160,22 @@ def build_argparser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
+    _cli = _autoshard_mod("cli")
     if args.smoke:
-        args.hidden, args.layers, args.heads = 64, 2, 4
-        args.seq, args.vocab, args.batches = 32, 512, "8"
-        if not args.configs:
-            args.configs = "dp8,dp4xmp2,dp2xmp4"
+        _cli.apply_smoke(args)
 
     # the planner needs its virtual mesh BEFORE jax initializes a
     # backend; the host sitecustomize pins the tunneled TPU at
-    # interpreter start, so (like __graft_entry__.dryrun_multichip)
-    # re-exec in a corrected child environment
+    # interpreter start, so re-exec in a corrected child environment
+    # (shared dance: autoshard/cli.py — PT_EXEC_CACHE rides into the
+    # child so repeated sweeps pay XLA compilation once per candidate
+    # signature EVER, not once per invocation)
     if os.environ.get("_PT_PLANNER_CHILD") != "1":
-        env = dict(os.environ)
-        env["_PT_PLANNER_CHILD"] = "1"
-        env["JAX_PLATFORMS"] = "cpu"
-        # PT_EXEC_CACHE rides into the child (dict(os.environ) carries an
-        # inherited value; --exec-cache overrides) so the planner's normal
-        # usage — repeated sweeps — pays XLA compilation once per candidate
-        # signature EVER, not once per invocation
-        if args.exec_cache:
-            env["PT_EXEC_CACHE"] = os.path.abspath(args.exec_cache)
-        flags = [f for f in env.get("XLA_FLAGS", "").split()
-                 if "xla_force_host_platform_device_count" not in f]
-        flags.append(
-            f"--xla_force_host_platform_device_count={args.devices}")
-        env["XLA_FLAGS"] = " ".join(flags)
-        code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
-                "import sys; sys.path.insert(0, %r); "
-                "sys.path.insert(0, %r); "
-                "import importlib.util; "
-                "spec = importlib.util.spec_from_file_location("
-                "'memory_planner', %r); "
-                "mod = importlib.util.module_from_spec(spec); "
-                "spec.loader.exec_module(mod); "
-                "sys.exit(mod.main(%r))"
-                % (ROOT, os.path.join(ROOT, "tools"),
-                   os.path.abspath(__file__),
-                   argv if argv is not None else sys.argv[1:]))
-        proc = subprocess.run([sys.executable, "-c", code], env=env,
-                              cwd=ROOT, timeout=1800)
-        return proc.returncode
+        return _cli.reexec_virtual_child(
+            __file__, "memory_planner",
+            argv if argv is not None else sys.argv[1:],
+            args.devices, "_PT_PLANNER_CHILD",
+            exec_cache=args.exec_cache)
 
     import jax
 

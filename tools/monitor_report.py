@@ -265,6 +265,37 @@ def render_kernels(out, totals=None, gauges=None, bench_kernels=None,
         out.append(f"bench engagement: {line}")
 
 
+def render_planner(out, totals=None, gauges=None, source=""):
+    """The sharding planner's account (``planner/*`` counters from
+    ``paddle_tpu/autoshard/planner.py`` — docs/AUTOSHARD.md) plus the
+    per-axis collective-bytes split the cost model is judged against."""
+    totals = totals or {}
+    gauges = gauges or {}
+    axis_bytes = {k.rsplit("/", 1)[1]: v for k, v in totals.items()
+                  if k.startswith("collective/bytes/")}
+    if not (axis_bytes
+            or any(k.startswith("planner/") for k in totals)):
+        return
+    out.append("")
+    out.append(f"-- sharding planner{source} --")
+    cand = totals.get("planner/candidates", 0)
+    if cand:
+        out.append(f"candidates judged: {cand}   infeasible "
+                   f"{totals.get('planner/infeasible', 0)}   errors "
+                   f"{totals.get('planner/errors', 0)}   plans emitted "
+                   f"{totals.get('planner/plans', 0)}")
+        w = gauges.get("planner/winner_est_step_ms")
+        if w is not None:
+            out.append(f"winner roofline est: {w:g} ms/step")
+    if axis_bytes:
+        total = totals.get("collective/bytes", sum(axis_bytes.values()))
+        parts = "   ".join(f"{ax} {_fmt_bytes(v)}"
+                           for ax, v in sorted(axis_bytes.items()))
+        out.append(f"collective bytes by axis: {parts}"
+                   + (f"   (aggregate {_fmt_bytes(total)})"
+                      if total else ""))
+
+
 def render_resilience(out, totals=None, hists=None, end=None, source=""):
     """The resilience runtime's account (``resilience/*`` counters from
     ``paddle_tpu/resilience`` — docs/RESILIENCE.md): checkpoint traffic
@@ -604,6 +635,10 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
 
     # -- pallas kernels (pallas/* + search/* from the search harness) --
     render_kernels(out, totals=totals,
+                   gauges=(end or {}).get("totals", {}).get("gauges", {}))
+
+    # -- sharding planner (planner/* + collective/bytes/<axis>) --
+    render_planner(out, totals=totals,
                    gauges=(end or {}).get("totals", {}).get("gauges", {}))
 
     # -- resilience runtime (resilience/* + run_end last_checkpoint_step) --

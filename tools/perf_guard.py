@@ -30,6 +30,13 @@ and exits nonzero with a human-readable verdict when the run regressed:
   ``benchmarks/serving_bench.py`` line vs the baseline record's
   ``extra.ttft_ms_p99`` — the tail-latency gate; the aggregate tokens/s
   drop is the same ``--throughput-drop`` check every metric gets
+- a changed sharding plan (``--plan-drift``): a fresh hardware line
+  whose ``shard_plan`` sub-object (from ``tools/shard_plan.py``) names
+  a different (dp, mp, batch) than the last-good record's
+  ``extra.shard_plan`` for the SAME device count — a silently-changed
+  cost model must not flip production sharding without a human reading
+  this verdict. Missing baselines, missing plan fields, other
+  topologies, and CPU smokes skip the check
 - a Pallas kernel family engaged in the last-good record but running on
   the composite in the fresh line (``kernels`` sub-object — the
   ``{family: engaged}`` map benches embed from
@@ -87,6 +94,8 @@ DEFAULT_THRESHOLDS = {
     # noisy at single-digit ms)
     "save_cost_growth": 0.50,
     "save_cost_slack_ms": 250.0,
+    # sharding-plan drift gate: on by default; --no-plan-drift disables
+    "plan_drift": True,
 }
 
 
@@ -146,7 +155,7 @@ def load_fresh(path: str) -> dict:
 # last_good.
 CONFIG_KEYS = ("batch", "seq", "ce_chunk",
                "requests", "arrival_rate_per_s", "lanes", "block_size",
-               "int8_weights")
+               "int8_weights", "devices")
 
 
 def config_match(fresh: dict) -> dict:
@@ -318,6 +327,23 @@ def evaluate(fresh: dict, baseline: dict | None, thresholds: dict | None
                   + (" — checkpointing got more expensive (the cadence "
                      "planner will save less often for the same "
                      "overhead budget)" if sfail else ""))
+        plan = fresh.get("shard_plan")
+        base_plan = (baseline.get("extra") or {}).get("shard_plan")
+        if (th.get("plan_drift") and isinstance(plan, dict)
+                and isinstance(base_plan, dict)
+                and plan.get("devices") == base_plan.get("devices")):
+            drift = [k for k in ("dp", "mp", "batch")
+                     if plan.get(k) != base_plan.get(k)]
+            check("plan_drift", not drift,
+                  (f"planned dp{plan.get('dp')}×mp{plan.get('mp')} "
+                   f"b{plan.get('batch')} matches last-good"
+                   if not drift else
+                   f"plan changed for the same topology "
+                   f"({plan.get('devices')} devices): "
+                   + ", ".join(f"{k} {base_plan.get(k)}→{plan.get(k)}"
+                               for k in drift)
+                   + " — the cost model flipped production sharding; "
+                     "re-measure both configs before trusting it"))
         kern = fresh.get("kernels")
         base_kern = (baseline.get("extra") or {}).get("kernels")
         if kern is not None and base_kern:
@@ -418,6 +444,14 @@ def main(argv=None) -> int:
                     default=DEFAULT_THRESHOLDS["save_cost_slack_ms"],
                     help="absolute save-cost headroom before the growth "
                          "gate can fail (default 250)")
+    ap.add_argument("--plan-drift", dest="plan_drift",
+                    action="store_true", default=True,
+                    help="fail a hardware line whose shard_plan differs "
+                         "from the last-good record's for the same "
+                         "topology (default on)")
+    ap.add_argument("--no-plan-drift", dest="plan_drift",
+                    action="store_false",
+                    help="disable the sharding-plan drift gate")
     ap.add_argument("--require-baseline", action="store_true",
                     help="fail when the store has no last-good hardware "
                          "record for the metric")
@@ -448,7 +482,8 @@ def main(argv=None) -> int:
                     "compile_slack_ms": args.compile_slack_ms,
                     "ttft_growth": args.ttft_growth,
                     "save_cost_growth": args.save_cost_growth,
-                    "save_cost_slack_ms": args.save_cost_slack_ms},
+                    "save_cost_slack_ms": args.save_cost_slack_ms,
+                    "plan_drift": args.plan_drift},
         hardware=hardware)
     if args.require_baseline and baseline is None:
         verdict["ok"] = False
