@@ -330,6 +330,20 @@ def main():
         extra["note"] = tpu_note
         extra["see"] = "PERF.md records any TPU numbers measured earlier"
 
+    # compiled-program audit account (PT_PROGRAM_AUDIT=1 — every fresh
+    # compile above was judged at the exec-cache chokepoint): rides the
+    # line AND the persisted record, so tools/perf_guard.py --audit can
+    # fail a future line whose findings are new vs this baseline
+    program_audit = None
+    try:
+        from paddle_tpu.analysis import program_audit as _pa
+
+        if _pa.enabled():
+            program_audit = _pa.report()
+            extra["program_audit"] = program_audit
+    except Exception:  # noqa: BLE001 — the audit must not break the line
+        pass
+
     from paddle_tpu.utils import measurements as _meas
 
     # cold-vs-warm compile accounting: total XLA compile wall-time this
@@ -397,6 +411,8 @@ def main():
             rec_extra["exec_cache_enabled"] = _ec0.enabled()
         if mem_obj.get("peak_hbm_gib") is not None:
             rec_extra["peak_hbm_gib"] = mem_obj["peak_hbm_gib"]
+        if program_audit is not None:
+            rec_extra["program_audit"] = program_audit
         try:
             _meas.record(_METRIC, round(tokens_per_sec, 2), "tokens/s",
                          extra=rec_extra)

@@ -1,0 +1,27 @@
+"""Static analysis: the invariant auditor (docs/STATIC_ANALYSIS.md).
+
+Every production incident in this repo's history was a *statically
+detectable* invariant violation: PR 10's ``jax.device_put`` inside a
+trace silently lowered dp to fully replicated programs, the round-4
+timing rules exist because ``block_until_ready`` acks enqueue, and the
+monitor's zero-overhead-off contract was policed by one audit test.
+This package catches both the source patterns and their compiled-program
+symptoms before a chip ever runs them:
+
+- **Tier 1 — source lint** (:mod:`.lint`, ``tools/pt_lint.py`` /
+  ``pt-lint``): AST rules PTL001–PTL005, each named for the incident
+  that motivated it. Clean-tree is a tier-1 gate
+  (``tests/test_static_analysis.py``).
+- **Tier 2 — program audit** (:mod:`.program_audit`,
+  ``PT_PROGRAM_AUDIT=1``): inspects every freshly compiled executable at
+  the ``jit/exec_cache.get_or_compile`` chokepoint (None-slot,
+  zero-overhead off) for replicated-dp compute, dropped donation,
+  undeclared host round-trips, and retrace-budget blowouts — reusing
+  ``autoshard/hlo_costs.py``'s post-SPMD HLO parser (GSPMD, PAPERS.md
+  2105.04663: the compiled program alone carries the sharding truth).
+
+Both tiers are stdlib + existing parsers — zero hardware required.
+"""
+from __future__ import annotations
+
+__all__ = ["lint", "program_audit"]

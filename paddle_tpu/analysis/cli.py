@@ -1,0 +1,96 @@
+"""``pt-lint`` console entry (also ``python tools/pt_lint.py``).
+
+    pt-lint                      # lint ./paddle_tpu ./tools + root scripts
+    pt-lint paddle_tpu/models    # lint a subtree
+    pt-lint --json               # machine-readable findings
+    pt-lint --select PTL001      # one rule only
+
+Exit codes: 0 clean, 1 error-severity findings (warnings print but pass
+unless ``--strict``), 2 usage/setup error. The tier-1 clean-tree gate
+(``tests/test_static_analysis.py``) runs this over ``paddle_tpu/`` +
+``tools/`` and requires 0.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+try:
+    from .lint import RULES, lint_paths
+except ImportError:  # loaded standalone by tools/pt_lint.py (no package
+    from lint import RULES, lint_paths  # init => no jax import)
+
+
+def _default_paths() -> list:
+    """./paddle_tpu + ./tools + the root driver scripts when run from a
+    repo checkout; cwd otherwise."""
+    roots = [p for p in ("paddle_tpu", "tools", "benchmarks") if os.path.isdir(p)]
+    if not roots:
+        return ["."]
+    roots.extend(p for p in ("bench.py", "__graft_entry__.py")
+                 if os.path.isfile(p))
+    return roots
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pt-lint",
+        description="Invariant lint for the traps this repo keeps "
+                    "re-finding (rules PTL001-PTL005 — "
+                    "docs/STATIC_ANALYSIS.md).")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: paddle_tpu/, "
+                         "tools/, benchmarks/ + root scripts)")
+    ap.add_argument("--root", default=None,
+                    help="repo root for scope-relative paths "
+                         "(default: auto-detect)")
+    ap.add_argument("--select", action="append", default=None,
+                    metavar="PTLxxx", help="only these rule ids")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as one JSON object")
+    ap.add_argument("--strict", action="store_true",
+                    help="warnings also fail the run")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, summary in sorted(RULES.items()):
+            print(f"{rid}  {summary}")
+        return 0
+
+    paths = args.paths or _default_paths()
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"pt-lint: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    findings = lint_paths(paths, root=args.root)
+    if args.select:
+        sel = set(args.select)
+        findings = [f for f in findings if f.rule in sel]
+
+    errors = [f for f in findings if f.severity == "error"]
+    failed = bool(errors) or (args.strict and findings)
+    if args.json:
+        print(json.dumps({
+            "ok": not failed,
+            "errors": len(errors),
+            "warnings": len(findings) - len(errors),
+            "findings": [f.to_dict() for f in findings],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f)
+        n = len(findings)
+        print(f"pt-lint: {n} finding(s), {len(errors)} error(s)"
+              + ("" if n == 0 else
+                 " — escape hatch: '# ptlint: disable=<rule>' on the "
+                 "line, with a reason"))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,7 +27,22 @@ from ..ops.dispatch import apply
 __all__ = [
     "PartitionSpec", "shard_tensor", "sharding_constraint", "replicate",
     "get_sharding", "shard_parameter", "per_shard_bytes",
+    "constrain_or_put",
 ]
+
+
+def constrain_or_put(x, sharding):
+    """Trace-aware placement of a RAW jax array (the Tensor path is
+    :func:`shard_tensor`): traced -> ``with_sharding_constraint``, eager
+    -> ``device_put``. On jax 0.4.37 a ``device_put`` inside a trace is
+    a jaxpr NO-OP — the PR 10 incident compiled dp to fully replicated
+    programs because every in-model hint vanished this way. This is the
+    ONE blessed home of the branch; trace-reachable op/model code must
+    call it instead of ``jax.device_put`` (lint rule PTL001,
+    ``analysis/lint.py``)."""
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sharding)
+    return jax.device_put(x, sharding)
 
 
 def per_shard_bytes(x) -> int:

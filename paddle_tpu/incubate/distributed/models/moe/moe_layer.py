@@ -149,12 +149,16 @@ class MoELayer(Layer):
             dispatch = dispatch.astype(xa.dtype)
             # dispatch: [T,E,C] x [T,H] -> expert buffers [E,C,H]
             buf = jnp.einsum("tec,th->ech", dispatch, tokens)
-            # keep expert dim sharded: XLA emits the token all_to_all here
-            buf = jax.device_put(
+            # keep expert dim sharded: XLA emits the token all_to_all
+            # here. kernel runs under TrainStep traces, where device_put
+            # is a jaxpr no-op (PTL001) — the expert hint was silently
+            # dropped and EP compute replicated until this routed
+            # through the trace-aware placement
+            buf = shard.constrain_or_put(
                 buf, shard._named_sharding(axis, None, None))
             h = act(jnp.einsum("ech,ehf->ecf", buf, w_in.astype(xa.dtype)))
             out = jnp.einsum("ecf,efh->ech", h, w_out.astype(xa.dtype))
-            out = jax.device_put(
+            out = shard.constrain_or_put(
                 out, shard._named_sharding(axis, None, None))
             y = jnp.einsum("tec,ech->th", combine, out)
             return y.reshape(B, S, H), aux.astype(jnp.float32)

@@ -79,6 +79,12 @@ FORMAT = 1
 # PT_MONITOR wired it
 _monitor = None
 
+# compiled-program audit slot (analysis/program_audit.py): None unless
+# PT_PROGRAM_AUDIT armed it — same zero-overhead-off contract; every
+# fresh compile (and every cache hit, for sidecar re-reporting) at this
+# chokepoint is offered to the auditor when the slot is live
+_audit = None
+
 # -- state -------------------------------------------------------------------
 
 # on-disk tier directory; None = cache disabled (both tiers)
@@ -692,10 +698,13 @@ def get_or_compile(key, lower_fn, label: str | None = None) -> ExecEntry:
     inside it, so a hit skips tracing too on the mem tier and everything
     but deserialization on the disk tier).
     """
+    au = _audit
     if key is not None and enabled():
         rep, sha = key_hash(key)
         e = _mem_hit(sha)
         if e is not None:
+            if au is not None:
+                au.on_hit(e, key, label)
             return e
         # the lock serializes the whole miss path: the _fresh_compile
         # toggle is process-global (two threads interleaving it would
@@ -706,10 +715,14 @@ def get_or_compile(key, lower_fn, label: str | None = None) -> ExecEntry:
         with _compile_lock:
             e = _mem_hit(sha)  # a racing thread may have just compiled it
             if e is not None:
+                if au is not None:
+                    au.on_hit(e, key, label)
                 return e
             e = _disk_load(sha, rep)
             if e is not None:
                 _mem_put(sha, e)
+                if au is not None:
+                    au.on_hit(e, key, label)
                 return e
             _stats["misses"] += 1
             m = _monitor
@@ -725,6 +738,8 @@ def get_or_compile(key, lower_fn, label: str | None = None) -> ExecEntry:
             entry = ExecEntry(compiled, sha, "compile", ms)
             _mem_put(sha, entry)
             _disk_store(sha, rep, compiled, ms, label)
+            if au is not None:
+                au.on_compiled(entry, key, label)
             return entry
     t0 = time.perf_counter()
     compiled = lower_fn().compile()
@@ -732,7 +747,17 @@ def get_or_compile(key, lower_fn, label: str | None = None) -> ExecEntry:
     m = _monitor
     if m is not None:
         m.on_compile_ms(ms)
-    return ExecEntry(compiled, None, "compile", ms)
+    entry = ExecEntry(compiled, None, "compile", ms)
+    if au is not None:
+        au.on_compiled(entry, key, label)
+    return entry
 
 
 _monitor_register(sys.modules[__name__])
+
+# arm the program audit when requested: importing the auditor installs
+# it into the _audit slot above (analysis/program_audit.py). Kept after
+# _monitor_register so an armed process still satisfies the
+# zero-overhead audit's module-registration order.
+if os.environ.get("PT_PROGRAM_AUDIT", "0") not in ("", "0"):
+    from ..analysis import program_audit as _program_audit  # noqa: F401

@@ -64,6 +64,7 @@ INSTRUMENTED_MODULES = (
     "paddle_tpu.resilience.resume",
     "paddle_tpu.resilience.numerics_policy",
     "paddle_tpu.autoshard.planner",
+    "paddle_tpu.analysis.program_audit",
 )
 
 _registry = Registry()
@@ -158,6 +159,12 @@ _c_plan_infeasible = _registry.counter("planner/infeasible")
 _c_plan_errors = _registry.counter("planner/errors")
 _c_plan_plans = _registry.counter("planner/plans")
 _g_plan_winner_ms = _registry.gauge("planner/winner_est_step_ms")
+# compiled-program audit (analysis/program_audit.py, PT_PROGRAM_AUDIT=1
+# — docs/STATIC_ANALYSIS.md): executables judged at the exec-cache
+# chokepoint and invariant findings (per-rule breakdown under
+# analysis/findings/<rule>)
+_c_audit_programs = _registry.counter("analysis/audits")
+_c_audit_findings = _registry.counter("analysis/findings")
 
 # per-axis collective-bytes attribution (ISSUE 10 satellite): eager
 # collectives know their group's mesh axes, so the aggregate
@@ -563,6 +570,16 @@ def on_planner_candidate(fits: bool, error: bool = False) -> None:
         _c_plan_errors.inc()
     elif not fits:
         _c_plan_infeasible.inc()
+
+
+def on_program_audit(n_findings: int, rules=()) -> None:
+    """The program auditor judged one compiled executable (fresh compile
+    or sidecar re-report); ``rules`` are the finding rule ids."""
+    _c_audit_programs.inc()
+    if n_findings:
+        _c_audit_findings.inc(n_findings)
+    for r in rules:
+        _registry.counter(f"analysis/findings/{r}").inc()
 
 
 def on_planner_plan(est_step_ms: float) -> None:

@@ -334,7 +334,12 @@ def make_ring_attention(mesh, axis="sep", causal=True, use_flash=None):
         bwd_shard_flash, mesh=mesh, check_vma=False, **bwd_specs)
 
     def place(x):
-        return jax.device_put(x, NamedSharding(mesh, seq_spec))
+        # ring_attn runs under model traces: a traced input must get a
+        # with_sharding_constraint, not device_put (PTL001 — a traced
+        # device_put is a jaxpr no-op and the seq sharding would vanish)
+        from ..distributed.shard import constrain_or_put
+
+        return constrain_or_put(x, NamedSharding(mesh, seq_spec))
 
     @jax.custom_vjp
     def ring_attn(q, k, v):

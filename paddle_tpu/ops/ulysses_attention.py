@@ -85,7 +85,11 @@ def make_ulysses_attention(mesh, axis="sep", causal=True, use_flash=None):
         out_specs=seq_spec, check_vma=False)
 
     def place(x):
-        return jax.device_put(x, NamedSharding(mesh, seq_spec))
+        # same trap as ring_attention.place: under a trace, device_put
+        # would silently drop the seq sharding (PTL001)
+        from ..distributed.shard import constrain_or_put
+
+        return constrain_or_put(x, NamedSharding(mesh, seq_spec))
 
     def ulysses(q, k, v):
         if not (q.shape[2] == k.shape[2] == v.shape[2]):

@@ -377,9 +377,11 @@ def _load_host_bench():
 # structural bound — not a bare `<` that a scheduler hiccup on the
 # shared 2-core box can flip.
 ASYNC_VS_SYNC_MAX_RATIO = 0.8
-# known-flaky on 1-CPU boxes: one full retry (fresh median-of-3) before
-# the assertion is allowed to fail the tier
-_RETRIES = 1
+# known-flaky on 1-CPU boxes: full retries (fresh median-of-3 each)
+# before the assertion is allowed to fail the tier — measured on the
+# round-11 1-core box: fails ~1 in 3 single attempts under load on the
+# UNCHANGED seed tree, so one retry was not enough headroom
+_RETRIES = 3
 
 
 def test_host_overhead_smoke_async_beats_sync():

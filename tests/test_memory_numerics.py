@@ -466,3 +466,28 @@ def test_audit_list_covers_all_registered_sites():
         registered - set(monitor.INSTRUMENTED_MODULES))
     nan_sites = {m.__name__ for m in numerics._SITES}
     assert nan_sites <= set(monitor.INSTRUMENTED_MODULES), nan_sites
+
+
+def test_program_audit_in_audit_list_and_import_inert():
+    """The compiled-program auditor (ISSUE 12) is a slot-carrying module
+    like the rest: it must be in INSTRUMENTED_MODULES (so the
+    parametrized audit above covers its `_monitor` slot) AND leave the
+    exec-cache `_audit` hook slot None while PT_PROGRAM_AUDIT is unset —
+    arming telemetry must never arm the auditor."""
+    assert "paddle_tpu.analysis.program_audit" \
+        in monitor.INSTRUMENTED_MODULES
+    assert os.environ.get("PT_PROGRAM_AUDIT", "0") in ("", "0")
+    from paddle_tpu.analysis import program_audit
+    from paddle_tpu.jit import exec_cache
+
+    assert not program_audit.enabled()
+    assert exec_cache._audit is None
+    assert program_audit._monitor is None
+    # PT_MONITOR wires _monitor but must NOT arm the audit slot
+    monitor.enable()
+    try:
+        assert program_audit._monitor is monitor
+        assert exec_cache._audit is None
+    finally:
+        monitor.disable()
+    assert program_audit._monitor is None

@@ -1,0 +1,645 @@
+"""Invariant auditor (ISSUE 12): source lint + compiled-program audit.
+
+Per-rule violation fixtures (each rule fires on a known-bad snippet and
+stays silent on the repaired version), the tier-1 clean-tree gate, the
+program-audit HLO fixtures (replicated-dp, dropped-donation,
+host-callback — each producing exactly its expected finding), the
+exec-cache sidecar round-trip, and the perf-guard ``--audit`` gate.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.analysis import lint
+from paddle_tpu.analysis import program_audit as pa
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# -- tier 1: per-rule fixtures ----------------------------------------------
+
+class TestPTL001DevicePutInTrace:
+    BAD = (
+        "import jax\n"
+        "def make(mesh, spec):\n"
+        "    def place(x):\n"
+        "        return jax.device_put(x, spec)\n"
+        "    return place\n"
+    )
+    REPAIRED = (
+        "import jax\n"
+        "def make(mesh, spec):\n"
+        "    def place(x):\n"
+        "        if isinstance(x, jax.core.Tracer):\n"
+        "            return jax.lax.with_sharding_constraint(x, spec)\n"
+        "        return jax.device_put(x, spec)\n"
+        "    return place\n"
+    )
+
+    def test_fires_on_nested_device_put(self):
+        fs = lint.lint_text("paddle_tpu/ops/fake_op.py", self.BAD)
+        assert _rules(fs) == ["PTL001"]
+        assert fs[0].line == 4
+
+    def test_silent_on_tracer_branch_idiom(self):
+        assert lint.lint_text("paddle_tpu/ops/fake_op.py",
+                              self.REPAIRED) == []
+
+    def test_fires_inside_forward(self):
+        src = ("import jax\n"
+               "class L:\n"
+               "    def forward(self, x):\n"
+               "        return jax.device_put(x, self.s)\n")
+        assert _rules(lint.lint_text("paddle_tpu/nn/fake.py", src)) \
+            == ["PTL001"]
+
+    def test_silent_in_eager_method_and_out_of_scope(self):
+        src = ("import jax\n"
+               "class L:\n"
+               "    def to(self, dev):\n"
+               "        self._data = jax.device_put(self._data, dev)\n")
+        assert lint.lint_text("paddle_tpu/nn/fake.py", src) == []
+        # same nested pattern outside the trace-reachable roots is fine
+        assert lint.lint_text("paddle_tpu/io/fake.py", self.BAD) == []
+
+
+class TestPTL002BlockUntilReady:
+    BAD = (
+        "import time, jax\n"
+        "def bench(f, x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    jax.block_until_ready(f(x))\n"
+        "    return time.perf_counter() - t0\n"
+    )
+    REPAIRED = (
+        "import time\n"
+        "from paddle_tpu.utils.timing import device_sync\n"
+        "def bench(f, x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    device_sync(f(x))\n"
+        "    return time.perf_counter() - t0\n"
+    )
+
+    def test_error_under_a_timer(self):
+        fs = lint.lint_text("tools/fake_bench.py", self.BAD)
+        assert _rules(fs) == ["PTL002"]
+        assert fs[0].severity == "error"
+
+    def test_warning_without_a_timer(self):
+        src = "import jax\ndef warm(x):\n    jax.block_until_ready(x)\n"
+        fs = lint.lint_text("tools/fake_bench.py", src)
+        assert _rules(fs) == ["PTL002"]
+        assert fs[0].severity == "warning"
+
+    def test_silent_on_device_sync(self):
+        assert lint.lint_text("tools/fake_bench.py", self.REPAIRED) == []
+
+
+class TestPTL003MonitorSlots:
+    BAD = (
+        "from ..monitor import _register as _monitor_register\n"
+        "_monitor = None\n"
+        "def hot(x):\n"
+        "    _monitor.on_thing(x)\n"
+        "_monitor_register(None)\n"
+    )
+    REPAIRED = (
+        "from ..monitor import _register as _monitor_register\n"
+        "_monitor = None\n"
+        "def hot(x):\n"
+        "    m = _monitor\n"
+        "    if m is not None:\n"
+        "        m.on_thing(x)\n"
+        "_monitor_register(None)\n"
+    )
+
+    def test_unguarded_use_fires(self):
+        fs = lint.lint_text("paddle_tpu/fake/inst.py", self.BAD,
+                            instrumented=("paddle_tpu.fake.inst",))
+        assert _rules(fs) == ["PTL003"]
+        assert "not guarded" in fs[0].message
+
+    def test_guarded_alias_is_silent(self):
+        assert lint.lint_text("paddle_tpu/fake/inst.py", self.REPAIRED,
+                              instrumented=("paddle_tpu.fake.inst",)) == []
+
+    def test_early_return_guard_is_silent(self):
+        src = ("_spans = None\n"
+               "def wrap(fn):\n"
+               "    def w(*a):\n"
+               "        sp = _spans\n"
+               "        if sp is None:\n"
+               "            return fn(*a)\n"
+               "        sp.record('x')\n"
+               "    return w\n"
+               "_register(None)\n")
+        assert lint.lint_text("paddle_tpu/fake/inst.py", src,
+                              instrumented=("paddle_tpu.fake.inst",)) == []
+
+    def test_missing_from_audit_list_fires(self):
+        fs = lint.lint_text("paddle_tpu/fake/inst.py", self.REPAIRED,
+                            instrumented=("paddle_tpu.other",))
+        assert _rules(fs) == ["PTL003"]
+        assert "INSTRUMENTED_MODULES" in fs[0].message
+
+    def test_alias_in_sibling_function_is_not_a_slot(self):
+        # hapi regression: `m` is a metric in one function, a monitor
+        # alias in another — only the alias's own scope is slot-checked
+        src = ("_monitor = None\n"
+               "def a():\n"
+               "    m = _monitor\n"
+               "    if m is not None:\n"
+               "        m.on_x()\n"
+               "def b(metrics):\n"
+               "    for m in metrics:\n"
+               "        m.update()\n"
+               "_register(None)\n")
+        assert lint.lint_text("paddle_tpu/fake/inst.py", src,
+                              instrumented=("paddle_tpu.fake.inst",)) == []
+
+
+class TestPTL004PartialAxisConstraint:
+    def test_fires_without_dp(self):
+        src = ("from paddle_tpu.distributed import shard\n"
+               "def forward(x):\n"
+               "    return shard.sharding_constraint(x, None, 'mp', None)\n")
+        fs = lint.lint_text("paddle_tpu/models/fake.py", src)
+        assert _rules(fs) == ["PTL004"]
+
+    def test_silent_with_all_live_axes(self):
+        src = ("from paddle_tpu.distributed import shard\n"
+               "def forward(x):\n"
+               "    return shard.sharding_constraint(x, 'dp', 'mp', None)\n")
+        assert lint.lint_text("paddle_tpu/models/fake.py", src) == []
+
+    def test_dynamic_specs_are_not_judged(self):
+        src = ("from paddle_tpu.distributed import shard\n"
+               "def forward(x, spec):\n"
+               "    return shard.sharding_constraint(x, *spec)\n")
+        assert lint.lint_text("paddle_tpu/models/fake.py", src) == []
+
+
+class TestPTL005Nondeterminism:
+    BAD = (
+        "import time\n"
+        "import numpy as np\n"
+        "def sweep(cands):\n"
+        "    stamp = time.time()\n"
+        "    pick = np.random.randint(0, 4)\n"
+        "    order = list(set(cands))\n"
+        "    for c in set(cands):\n"
+        "        pass\n"
+        "    return stamp, pick, order\n"
+    )
+    REPAIRED = (
+        "import time\n"
+        "import numpy as np\n"
+        "def sweep(cands):\n"
+        "    stamp = time.perf_counter()\n"
+        "    pick = np.random.default_rng(0).integers(0, 4)\n"
+        "    order = sorted(set(cands))\n"
+        "    for c in sorted(set(cands)):\n"
+        "        pass\n"
+        "    return stamp, pick, order\n"
+    )
+
+    def test_fires_on_all_three_patterns(self):
+        fs = lint.lint_text("paddle_tpu/autoshard/fake.py", self.BAD)
+        assert sorted(set(_rules(fs))) == ["PTL005"]
+        assert len(fs) == 4  # time.time, np.random, list(set), for-set
+
+    def test_silent_on_repaired(self):
+        assert lint.lint_text("paddle_tpu/autoshard/fake.py",
+                              self.REPAIRED) == []
+
+    def test_out_of_scope_is_silent(self):
+        assert lint.lint_text("paddle_tpu/nn/fake.py", self.BAD) == []
+
+    def test_seeded_jax_random_is_silent(self):
+        src = ("import jax\n"
+               "def probe():\n"
+               "    return jax.random.normal(jax.random.PRNGKey(0), (4,))\n")
+        assert lint.lint_text("paddle_tpu/ops/pallas/fake.py", src) == []
+
+
+class TestEscapeHatch:
+    def test_line_disable(self):
+        src = ("import jax\n"
+               "def make(spec):\n"
+               "    def place(x):  # eager-only helper\n"
+               "        return jax.device_put(x, spec)"
+               "  # ptlint: disable=PTL001\n"
+               "    return place\n")
+        assert lint.lint_text("paddle_tpu/ops/fake.py", src) == []
+
+    def test_bare_disable_silences_all(self):
+        src = ("import jax\n"
+               "def make(spec):\n"
+               "    def place(x):\n"
+               "        return jax.device_put(x, spec)  # ptlint: disable\n"
+               "    return place\n")
+        assert lint.lint_text("paddle_tpu/ops/fake.py", src) == []
+
+    def test_skip_file(self):
+        src = "# ptlint: skip-file\n" + TestPTL001DevicePutInTrace.BAD
+        assert lint.lint_text("paddle_tpu/ops/fake.py", src) == []
+
+    def test_other_rule_disable_does_not_silence(self):
+        src = ("import jax\n"
+               "def make(spec):\n"
+               "    def place(x):\n"
+               "        return jax.device_put(x, spec)"
+               "  # ptlint: disable=PTL005\n"
+               "    return place\n")
+        assert _rules(lint.lint_text("paddle_tpu/ops/fake.py", src)) \
+            == ["PTL001"]
+
+
+# -- tier 1: the clean-tree gate ---------------------------------------------
+
+def test_clean_tree_gate():
+    """pt-lint over the whole tree reports zero errors — the standing
+    guarantee that the incident patterns stay out of the codebase."""
+    paths = [os.path.join(_ROOT, p)
+             for p in ("paddle_tpu", "tools", "benchmarks",
+                       "bench.py", "__graft_entry__.py")]
+    findings = lint.lint_paths(paths, root=_ROOT)
+    errors = [f for f in findings if f.severity == "error"]
+    assert not errors, "\n".join(str(f) for f in errors)
+
+
+def test_cli_exits_zero_on_clean_tree():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "pt_lint.py"),
+         "--json"], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    blob = json.loads(proc.stdout)
+    assert blob["ok"] and blob["errors"] == 0
+
+
+def test_cli_flags_a_violation(tmp_path):
+    bad = tmp_path / "paddle_tpu" / "ops" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(TestPTL001DevicePutInTrace.BAD)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "pt_lint.py"),
+         "--json", "--root", str(tmp_path), str(bad)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    blob = json.loads(proc.stdout)
+    assert blob["errors"] == 1
+    assert blob["findings"][0]["rule"] == "PTL001"
+
+
+def test_instrumented_modules_readable_statically():
+    from paddle_tpu import monitor
+
+    assert lint.load_instrumented_modules(_ROOT) \
+        == monitor.INSTRUMENTED_MODULES
+
+
+# -- tier 2: program-audit HLO fixtures --------------------------------------
+
+@pytest.fixture(scope="module")
+def dp_mesh():
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("dp", "mp"))
+
+
+class TestProgramAuditFixtures:
+    """The three violation fixtures each produce EXACTLY their expected
+    finding; the repaired programs are clean."""
+
+    def test_replicated_dp_fixture(self, dp_mesh):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        x = jax.device_put(jnp.ones((8, 8)),
+                           NamedSharding(dp_mesh, PartitionSpec("dp")))
+        degrees = {"dp": 4, "mp": 2}
+        # violation: dp-sharded input, elementwise program — zero
+        # cross-dp collectives (the PR 10 lowering)
+        bad = jax.jit(lambda a: a * 2).lower(x).compile()
+        fs = pa.audit_hlo(bad.as_text(), degrees=degrees, expect_dp=True)
+        assert [f["rule"] for f in fs] == ["PA001"]
+        assert fs[0]["name"] == "replicated_dp"
+        # repaired: a cross-dp reduction inserts the all-reduce
+        good = jax.jit(lambda a: jnp.sum(a)).lower(x).compile()
+        assert pa.audit_hlo(good.as_text(), degrees=degrees,
+                            expect_dp=True) == []
+
+    def test_dropped_donation_fixture(self):
+        # violation: donation requested but the module has no alias
+        # table (compiled without donate_argnums)
+        bad = jax.jit(lambda a: a + 1.0).lower(jnp.ones((8, 8))).compile()
+        fs = pa.audit_hlo(bad.as_text(), donate_expected=True)
+        assert [f["rule"] for f in fs] == ["PA002"]
+        assert fs[0]["name"] == "dropped_donation"
+        # repaired: donation honored -> input_output_alias present
+        good = jax.jit(lambda a: a + 1.0, donate_argnums=(0,)).lower(
+            jnp.ones((8, 8))).compile()
+        assert pa.audit_hlo(good.as_text(), donate_expected=True) == []
+
+    def test_host_callback_fixture(self):
+        def noisy(x):
+            jax.debug.print("s={s}", s=x.sum())
+            return x + 1
+
+        bad = jax.jit(noisy).lower(jnp.ones((4,))).compile()
+        fs = pa.audit_hlo(bad.as_text())
+        assert [f["rule"] for f in fs] == ["PA003"]
+        assert fs[0]["name"] == "host_callback"
+        good = jax.jit(lambda a: a + 1).lower(jnp.ones((4,))).compile()
+        assert pa.audit_hlo(good.as_text()) == []
+        # a declared allowance passes the same program
+        assert pa.audit_hlo(bad.as_text(), allowed_host_calls=1) == []
+
+
+def test_retrace_budget_fires_once(monkeypatch):
+    monkeypatch.setattr(pa, "RETRACE_BUDGET", 2)
+    pa.reset()
+    entry = types.SimpleNamespace(compiled=types.SimpleNamespace(
+        as_text=lambda: "HloModule stub"))
+    try:
+        for _ in range(5):
+            pa.on_compiled(entry, None, "train_step/Churny")
+        rep = pa.report()
+        pa004 = [f for f in rep["findings"] if f["rule"] == "PA004"]
+        assert len(pa004) == 1  # fires once, at the crossing
+        assert "3 distinct executables" in pa004[0]["detail"]
+        assert rep["audits"] == 5
+    finally:
+        pa.reset()
+
+
+def test_audit_entry_derives_context_from_key(dp_mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    x = jax.device_put(jnp.ones((8, 8)),
+                       NamedSharding(dp_mesh, PartitionSpec("dp")))
+    compiled = jax.jit(lambda a: a * 2).lower(x).compile()
+    entry = types.SimpleNamespace(compiled=compiled)
+    key = {"kind": "train_step", "donate": False,
+           "mesh": (("dp", "mp"), (4, 2))}
+    fs = pa.audit_entry(entry, key, "train_step/X")
+    assert [f["rule"] for f in fs] == ["PA001"]
+    # forward-only programs (any other kind) are not judged for dp
+    assert pa.audit_entry(entry, {"kind": "predictor",
+                                  "mesh": (("dp", "mp"), (4, 2))}) == []
+
+
+def test_audit_entry_keyless_uses_label_and_live_env(dp_mesh):
+    """PT_EXEC_CACHE unset => key=None at the chokepoint: train-step
+    identity comes from the compile-site label and degrees from the
+    live env, so PA001 stands without the cache."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from paddle_tpu.distributed import env as env_mod
+
+    x = jax.device_put(jnp.ones((8, 8)),
+                       NamedSharding(dp_mesh, PartitionSpec("dp")))
+    compiled = jax.jit(lambda a: a * 2).lower(x).compile()
+    entry = types.SimpleNamespace(compiled=compiled)
+    env_mod.init_mesh(dp=4, mp=2)
+    try:
+        fs = pa.audit_entry(entry, None, "train_step/X")
+        assert [f["rule"] for f in fs] == ["PA001"]
+        # non-train-step labels are not judged for dp
+        assert pa.audit_entry(entry, None, "serving/decode") == []
+    finally:
+        env_mod.reset_env()
+
+
+def test_pa004_not_persisted_to_sidecar(armed_cache, monkeypatch):
+    """PA004 is process-transient churn: it reaches the report and the
+    counters but never the sidecar — a later healthy warm start must
+    not replay it."""
+    exec_cache = armed_cache
+    monkeypatch.setattr(pa, "RETRACE_BUDGET", 0)
+    key = {"kind": "fixture", "case": "churn"}
+    exec_cache.get_or_compile(
+        key, lambda: jax.jit(lambda a: a + 3.0).lower(jnp.ones((2,))),
+        label="fixture/churn")
+    assert [f["rule"] for f in pa.report()["findings"]] == ["PA004"]
+    stored = exec_cache.meta_get(key)
+    assert stored["program_audit"]["findings"] == []
+    # warm start: the stored (clean) account is what gets re-reported
+    exec_cache.clear()
+    pa.reset()
+    exec_cache.get_or_compile(
+        key, lambda: jax.jit(lambda a: a + 3.0).lower(jnp.ones((2,))),
+        label="fixture/churn")
+    assert pa.report()["findings"] == []
+
+
+def test_lint_covers_the_audit_slot_itself():
+    """The _audit hook slot this PR adds to exec_cache is policed by the
+    same PTL003 contract as the monitor slots."""
+    src = ("_audit = None\n"
+           "def get(key):\n"
+           "    _audit.on_hit(key)\n"
+           "_register(None)\n")
+    fs = lint.lint_text("paddle_tpu/fake/cachey.py", src,
+                        instrumented=("paddle_tpu.fake.cachey",))
+    assert [f.rule for f in fs] == ["PTL003"]
+
+
+# -- exec-cache hook + sidecar round-trip ------------------------------------
+
+@pytest.fixture
+def armed_cache(tmp_path):
+    from paddle_tpu.jit import exec_cache
+
+    exec_cache.clear()
+    prev = exec_cache.cache_dir()
+    exec_cache.enable(str(tmp_path / "ptxc"))
+    pa.reset()
+    pa.enable()
+    try:
+        yield exec_cache
+    finally:
+        pa.disable()
+        pa.reset()
+        if prev:
+            exec_cache.enable(prev)
+        else:
+            exec_cache.disable()
+        exec_cache.clear()
+
+
+def test_sidecar_round_trip(armed_cache):
+    """A fresh compile files its findings in the meta sidecar under the
+    executable's key; a warm start re-reports them with NO re-parse."""
+    exec_cache = armed_cache
+    key = {"kind": "fixture", "donate": True, "case": "sidecar"}
+
+    def lower():
+        return jax.jit(lambda a: a + 1.0).lower(jnp.ones((4, 4)))
+
+    entry = exec_cache.get_or_compile(key, lower, label="fixture/sidecar")
+    assert entry.source == "compile"
+    rep = pa.report()
+    assert [f["rule"] for f in rep["findings"]] == ["PA002"]
+    stored = exec_cache.meta_get(key)
+    assert stored is not None
+    assert [f["rule"] for f in stored["program_audit"]["findings"]] \
+        == ["PA002"]
+
+    # warm start: drop the mem tier, re-report from the sidecar alone
+    exec_cache.clear()
+    pa.reset()
+    entry2 = exec_cache.get_or_compile(key, lower, label="fixture/sidecar")
+    assert entry2.source == "disk"
+    rep2 = pa.report()
+    assert rep2["audits"] == 1
+    assert [f["rule"] for f in rep2["findings"]] == ["PA002"]
+
+
+def test_sidecar_merges_with_collectives(armed_cache):
+    """The planner's comms sidecar entry and the audit entry share one
+    meta blob — neither write clobbers the other."""
+    exec_cache = armed_cache
+    key = {"kind": "fixture", "case": "merge"}
+    exec_cache.get_or_compile(
+        key, lambda: jax.jit(lambda a: a * 2).lower(jnp.ones((2,))),
+        label="fixture/merge")
+    merged = dict(exec_cache.meta_get(key) or {})
+    merged["collectives"] = {"total_wire_bytes": 0}
+    exec_cache.meta_put(key, merged)
+    meta = exec_cache.meta_get(key)
+    assert "program_audit" in meta and "collectives" in meta
+
+
+def test_audit_counters_ride_the_monitor(armed_cache):
+    from paddle_tpu import monitor
+
+    was = monitor.enabled()
+    monitor.enable()
+    try:
+        base = monitor.snapshot()["counters"].get("analysis/audits", 0)
+        armed_cache.get_or_compile(
+            {"kind": "fixture", "donate": True, "case": "counters"},
+            lambda: jax.jit(lambda a: a - 1.0).lower(jnp.ones((3,))),
+            label="fixture/counters")
+        c = monitor.snapshot()["counters"]
+        assert c.get("analysis/audits", 0) == base + 1
+        assert c.get("analysis/findings/PA002", 0) >= 1
+    finally:
+        if not was:
+            monitor.disable()
+
+
+def test_off_is_free():
+    """PT_PROGRAM_AUDIT unset (tier-1 default): the exec-cache slot is
+    None and the auditor reports disabled."""
+    from paddle_tpu.jit import exec_cache
+
+    assert exec_cache._audit is None
+    assert not pa.enabled()
+
+
+def test_audit_train_step_facts(dp_mesh):
+    """Full-context audit of a live TrainStep on a dp>1 mesh: clean, dp
+    moved real bytes (the dryrun_multichip proof leg's contract)."""
+    import paddle_tpu as pt
+    from paddle_tpu.distributed import env as env_mod
+    from paddle_tpu.jit.train_step import TrainStep
+
+    env_mod.init_mesh(dp=4, mp=2)
+    try:
+        from paddle_tpu.distributed import shard
+
+        net = pt.nn.Linear(8, 8)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+        step = TrainStep(net, opt,
+                         lambda m, x, y: ((m(x) - y) ** 2).mean())
+        # dp-shard the batch (what a planned run does): per-shard grads
+        # now differ, so the program must all-reduce over dp
+        x = shard.shard_tensor(
+            pt.to_tensor(np.ones((8, 8), np.float32)), spec=("dp", None))
+        y = shard.shard_tensor(
+            pt.to_tensor(np.zeros((8, 8), np.float32)), spec=("dp", None))
+        rep = pa.audit_train_step(step, x, y)
+        assert rep["findings"] == []
+        assert rep["facts"]["dp_collectives"] > 0
+        assert rep["facts"]["host_calls"] == 0
+
+        # and the tripwire side: a REPLICATED batch on the same mesh is
+        # exactly the PR 10 smell — every device computes the same step
+        step2 = TrainStep(net, opt,
+                          lambda m, x, y: ((m(x) - y) ** 2).mean())
+        rep2 = pa.audit_train_step(
+            step2, pt.to_tensor(np.ones((8, 8), np.float32)),
+            pt.to_tensor(np.zeros((8, 8), np.float32)))
+        assert [f["rule"] for f in rep2["findings"]] == ["PA001"]
+    finally:
+        env_mod.reset_env()
+
+
+# -- perf_guard --audit gate --------------------------------------------------
+
+@pytest.fixture(scope="module")
+def guard():
+    spec = importlib.util.spec_from_file_location(
+        "perf_guard_sa", os.path.join(_ROOT, "tools", "perf_guard.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _line(findings):
+    return {"metric": "m", "value": 100.0, "unit": "u",
+            "program_audit": {"audits": 3, "findings": findings}}
+
+
+def _baseline(findings):
+    return {"metric": "m", "value": 100.0, "backend": "tpu",
+            "extra": {"program_audit": {"audits": 3,
+                                        "findings": findings}}}
+
+
+_F = {"rule": "PA001", "name": "replicated_dp", "severity": "error",
+      "detail": "d", "label": "train_step/X"}
+
+
+def test_guard_fails_on_new_finding(guard):
+    v = guard.evaluate(_line([_F]), _baseline([]), hardware=True)
+    chk = {c["name"]: c for c in v["checks"]}
+    assert not chk["program_audit"]["ok"]
+    assert "PA001" in chk["program_audit"]["detail"]
+    assert not v["ok"]
+
+
+def test_guard_passes_on_baseline_known_finding(guard):
+    v = guard.evaluate(_line([_F]), _baseline([_F]), hardware=True)
+    chk = {c["name"]: c for c in v["checks"]}
+    assert chk["program_audit"]["ok"]
+
+
+def test_guard_skips_without_subobject_or_on_cpu(guard):
+    # baseline predates the audit -> no check emitted
+    base = {"metric": "m", "value": 100.0, "backend": "tpu", "extra": {}}
+    v = guard.evaluate(_line([_F]), base, hardware=True)
+    assert "program_audit" not in {c["name"] for c in v["checks"]}
+    # cpu smoke skips with the rest of the hardware comparisons
+    v = guard.evaluate(_line([_F]), _baseline([]), hardware=False)
+    assert "program_audit" not in {c["name"] for c in v["checks"]}
+
+
+def test_guard_no_audit_flag_disables(guard):
+    v = guard.evaluate(_line([_F]), _baseline([]),
+                       thresholds={"audit": False}, hardware=True)
+    assert "program_audit" not in {c["name"] for c in v["checks"]}

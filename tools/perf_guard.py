@@ -37,6 +37,14 @@ and exits nonzero with a human-readable verdict when the run regressed:
   cost model must not flip production sharding without a human reading
   this verdict. Missing baselines, missing plan fields, other
   topologies, and CPU smokes skip the check
+- a new compiled-program audit finding (``--audit``): a fresh hardware
+  line whose ``program_audit`` sub-object (``analysis/program_audit.py``,
+  armed by ``PT_PROGRAM_AUDIT=1``) reports a (rule, label) finding
+  absent from the last-good record's ``extra.program_audit`` —
+  replicated-dp compute, dropped donation, host callbacks, or retrace
+  churn appeared since the baseline. Lines or baselines without the
+  sub-object skip the check; CPU smokes skip with the rest of the
+  hardware comparisons
 - a Pallas kernel family engaged in the last-good record but running on
   the composite in the fresh line (``kernels`` sub-object — the
   ``{family: engaged}`` map benches embed from
@@ -96,6 +104,14 @@ DEFAULT_THRESHOLDS = {
     "save_cost_slack_ms": 250.0,
     # sharding-plan drift gate: on by default; --no-plan-drift disables
     "plan_drift": True,
+    # program-audit gate (--audit / --no-audit): a fresh hardware line
+    # whose program_audit sub-object (analysis/program_audit.py,
+    # PT_PROGRAM_AUDIT=1) reports findings ABSENT from the last-good
+    # record fails — a compiled-invariant break (replicated dp, dropped
+    # donation, host callbacks, retrace churn) must not land silently.
+    # CPU smokes and baselines without the sub-object skip, matching the
+    # --ttft-growth convention
+    "audit": True,
 }
 
 
@@ -344,6 +360,29 @@ def evaluate(fresh: dict, baseline: dict | None, thresholds: dict | None
                                for k in drift)
                    + " — the cost model flipped production sharding; "
                      "re-measure both configs before trusting it"))
+        pa = fresh.get("program_audit")
+        base_pa = (baseline.get("extra") or {}).get("program_audit")
+        if (th.get("audit") and isinstance(pa, dict)
+                and isinstance(base_pa, dict)):
+            # a finding is "new" when its (rule, label) pair is absent
+            # from the last-good record — known/accepted findings ride
+            # the baseline forward, fresh invariant breaks fail
+            known = {(f.get("rule"), f.get("label"))
+                     for f in base_pa.get("findings", [])
+                     if isinstance(f, dict)}
+            new = [f for f in pa.get("findings", [])
+                   if isinstance(f, dict)
+                   and (f.get("rule"), f.get("label")) not in known]
+            check("program_audit", not new,
+                  ("no new compiled-program findings "
+                   f"({len(pa.get('findings', []))} total, all in "
+                   "baseline)" if not new else
+                   "new compiled-program finding(s) vs last-good: "
+                   + "; ".join(
+                       f"{f.get('rule')} {f.get('name')} "
+                       f"[{f.get('label')}]" for f in new)
+                   + " — a program invariant broke since the baseline "
+                     "(see analysis/program_audit.py)"))
         kern = fresh.get("kernels")
         base_kern = (baseline.get("extra") or {}).get("kernels")
         if kern is not None and base_kern:
@@ -452,6 +491,14 @@ def main(argv=None) -> int:
     ap.add_argument("--no-plan-drift", dest="plan_drift",
                     action="store_false",
                     help="disable the sharding-plan drift gate")
+    ap.add_argument("--audit", dest="audit", action="store_true",
+                    default=True,
+                    help="fail a hardware line whose program_audit "
+                         "sub-object reports findings absent from the "
+                         "last-good record (default on; skips when "
+                         "either side lacks the sub-object)")
+    ap.add_argument("--no-audit", dest="audit", action="store_false",
+                    help="disable the program-audit gate")
     ap.add_argument("--require-baseline", action="store_true",
                     help="fail when the store has no last-good hardware "
                          "record for the metric")
@@ -483,7 +530,8 @@ def main(argv=None) -> int:
                     "ttft_growth": args.ttft_growth,
                     "save_cost_growth": args.save_cost_growth,
                     "save_cost_slack_ms": args.save_cost_slack_ms,
-                    "plan_drift": args.plan_drift},
+                    "plan_drift": args.plan_drift,
+                    "audit": args.audit},
         hardware=hardware)
     if args.require_baseline and baseline is None:
         verdict["ok"] = False

@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Invariant lint over the tree (rules PTL001–PTL005).
+
+    python tools/pt_lint.py [paths...] [--json] [--select PTL001]
+
+Thin launcher for ``paddle_tpu.analysis.cli`` (also installed as the
+``pt-lint`` console entry) that works from any cwd — and, like
+``tools/perf_guard.py``, without importing the package (so no jax):
+the analysis modules are loaded straight off the source tree. Rule
+catalog + incident history: ``docs/STATIC_ANALYSIS.md``. The tier-1
+clean-tree gate lives in ``tests/test_static_analysis.py``.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ANALYSIS = os.path.join(_REPO, "paddle_tpu", "analysis")
+if _ANALYSIS not in sys.path:
+    sys.path.insert(0, _ANALYSIS)
+
+import cli  # noqa: E402  — paddle_tpu/analysis/cli.py, package-free
+
+if __name__ == "__main__":
+    # default scope: the repo this script lives in, not the cwd
+    os.chdir(_REPO)
+    sys.exit(cli.main())
