@@ -9,13 +9,24 @@ per-token decode latency in the standard one-JSON-line format.
 
 Run: python benchmarks/serving_bench.py [--smoke]
 Prints one JSON line: {"metric": "serving_tokens_per_sec", ...} with
-``tokens_per_sec`` / ``ttft_ms_p50`` / ``ttft_ms_p99`` / ``tpot_ms_*``.
+``tokens_per_sec`` / ``ttft_ms_p50`` / ``ttft_ms_p99`` / ``tpot_ms_*``
+plus the prefix-cache readout: ``prefix_hit_rate`` (cached fraction of
+all (re-)prefilled context tokens) and the cached-vs-cold TTFT A/B
+(``ttft_ms_p50_cached`` / ``ttft_ms_p50_cold`` — requests whose
+admission hit the prefix cache vs requests that prefilled everything).
 
-Knobs (seeded defaults; smoke mode shrinks everything):
-  PT_SERVE_BENCH_REQUESTS (64)   trace length
-  PT_SERVE_BENCH_RATE     (4.0)  Poisson arrival rate, requests/s
+Knobs (seeded defaults; --smoke pins the small trace explicitly):
+  PT_SERVE_BENCH_REQUESTS (64; smoke 8)    trace length
+  PT_SERVE_BENCH_RATE     (4.0; smoke 50)  Poisson arrival rate, req/s
   PT_SERVE_BENCH_SEED     (0)    trace seed
+  PT_SERVE_BENCH_SHARED   (0)    shared-system-prompt trace mode: every
+                                 prompt opens with the SAME seeded
+                                 N-token prefix (hwbench's
+                                 ``serving_prefix`` row sets 64), so
+                                 the prefix cache turns all but the
+                                 first prefill of it into hits
   PT_SERVE_*                     engine geometry (docs/SERVING.md)
+  PT_SERVE_PREFIX_CACHE=0        share-nothing pool A/B
   PT_DECODE_INT8=1               weight-only int8 decode A/B
 """
 from __future__ import annotations
@@ -43,18 +54,26 @@ def _load_decode_bench():
     return mod
 
 
-def build_trace(n, rate, vocab, prompt_rng, new_rng, seed=0):
+def build_trace(n, rate, vocab, prompt_rng, new_rng, seed=0,
+                shared_prefix=0):
     """Seeded Poisson trace: ``[(arrival_s, prompt_ids, max_new)]``,
     arrival-sorted by construction. Deterministic for a (seed, n, rate,
-    length-range) tuple — the replayable-input contract the scheduler
-    property tests lean on."""
+    length-range, shared-prefix) tuple — the replayable-input contract
+    the scheduler property tests lean on. ``shared_prefix`` > 0 is the
+    shared-system-prompt mode: one seeded prefix of that many tokens
+    opens EVERY prompt (per-request lengths still draw from
+    ``prompt_rng`` for the unique suffix)."""
     rng = np.random.RandomState(seed)
+    prefix = rng.randint(0, vocab, size=(int(shared_prefix),)) \
+        .astype(np.int32)
     arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n))
     trace = []
     for i in range(n):
         plen = int(rng.randint(prompt_rng[0], prompt_rng[1] + 1))
         new = int(rng.randint(new_rng[0], new_rng[1] + 1))
         prompt = rng.randint(0, vocab, size=(plen,)).astype(np.int32)
+        if shared_prefix:
+            prompt = np.concatenate([prefix, prompt])
         trace.append((float(arrivals[i]), prompt, new))
     return trace
 
@@ -87,10 +106,16 @@ def main():
         _mon.enable()
 
     pt.seed(0)
+    # documented defaults (module docstring): 64 requests at 4.0/s;
+    # --smoke pins its small trace explicitly (8 at 50/s), env overrides
+    # either way
+    n_req_env = os.environ.get("PT_SERVE_BENCH_REQUESTS")
+    rate_env = os.environ.get("PT_SERVE_BENCH_RATE")
+    shared = int(os.environ.get("PT_SERVE_BENCH_SHARED", "0") or 0)
     if smoke:
         cfg = LlamaConfig.tiny()
-        n_req = int(os.environ.get("PT_SERVE_BENCH_REQUESTS", "8"))
-        rate = float(os.environ.get("PT_SERVE_BENCH_RATE", "50"))
+        n_req = int(n_req_env) if n_req_env else 8
+        rate = float(rate_env) if rate_env else 50.0
         prompt_rng, new_rng = (3, 12), (4, 12)
         serve_cfg = ServingConfig(
             max_lanes=int(os.environ.get("PT_SERVE_LANES", "4")),
@@ -105,12 +130,20 @@ def main():
             num_hidden_layers=12, num_attention_heads=12,
             max_position_embeddings=2048, dtype="bfloat16",
             use_parallel_cross_entropy=False)
-        n_req = int(os.environ.get("PT_SERVE_BENCH_REQUESTS", "64"))
-        rate = float(os.environ.get("PT_SERVE_BENCH_RATE", "4"))
+        n_req = int(n_req_env) if n_req_env else 64
+        rate = float(rate_env) if rate_env else 4.0
         prompt_rng, new_rng = (64, 192), (64, 256)
         serve_cfg = ServingConfig(max_seq_len=int(
             os.environ.get("PT_SERVE_MAX_LEN", "512")))
     seed = int(os.environ.get("PT_SERVE_BENCH_SEED", "0"))
+    if shared and (serve_cfg.max_seq_len is None or
+                   shared + prompt_rng[1] + new_rng[1]
+                   > serve_cfg.max_seq_len):
+        raise SystemExit(
+            f"PT_SERVE_BENCH_SHARED={shared} would exceed max_seq_len "
+            f"{serve_cfg.max_seq_len} with prompts up to "
+            f"{prompt_rng[1]} + {new_rng[1]} new tokens — raise "
+            f"PT_SERVE_MAX_LEN or shrink the shared prefix")
 
     model = LlamaForCausalLM(cfg)
     if cfg.dtype == "bfloat16":
@@ -120,7 +153,7 @@ def main():
 
     engine = ServingEngine(model, serve_cfg)
     trace = build_trace(n_req, rate, cfg.vocab_size, prompt_rng, new_rng,
-                        seed=seed)
+                        seed=seed, shared_prefix=shared)
     engine.warmup()  # compiles (or exec-cache-loads) outside the clock
 
     # replay: submit each request when its arrival time passes, step the
@@ -150,6 +183,16 @@ def main():
             if r.t_first is not None]
     tpot = [(r.t_done - r.t_first) * 1e3 / (len(r.output) - 1)
             for r in reqs if r.t_done is not None and len(r.output) > 1]
+    # prefix-cache readout: hit rate over every (re-)prefilled context
+    # token, and the cached-vs-cold TTFT A/B — grouped by the FIRST
+    # admission's cache credit (the prefill that set t_first; a later
+    # recompute hit must not relabel a cold-TTFT request as cached)
+    hit, miss = stats["prefix_hit_tokens"], stats["prefix_miss_tokens"]
+    hit_rate = hit / (hit + miss) if (hit + miss) else 0.0
+    ttft_cached = [(r.t_first - r.t_submit) * 1e3 for r in reqs
+                   if r.t_first is not None and r.ttft_cached_tokens]
+    ttft_cold = [(r.t_first - r.t_submit) * 1e3 for r in reqs
+                 if r.t_first is not None and not r.ttft_cached_tokens]
 
     # HBM roofline (decode_bench's byte model on the decode phase): per
     # step the chip reads every matmul weight once (lanes share the
@@ -209,6 +252,15 @@ def main():
            "preemptions": stats["preemptions"],
            "decode_steps": stats["decode_steps"],
            "prefill_chunks": stats["prefill_chunks"],
+           "prefix_cache": bool(stats["prefix_cache"]),
+           "shared_prefix_tokens": shared,
+           "prefix_hit_rate": round(hit_rate, 4),
+           "prefix_hit_tokens": hit,
+           "prefix_miss_tokens": miss,
+           "ttft_ms_p50_cached": (round(percentile(ttft_cached, 50), 2)
+                                  if ttft_cached else None),
+           "ttft_ms_p50_cold": (round(percentile(ttft_cold, 50), 2)
+                                if ttft_cold else None),
            "hbm_gb_per_s": round(achieved_gbps, 1),
            "hbm_model_bytes_per_step": int(
                decode_bytes / max(stats["decode_steps"], 1)),

@@ -133,6 +133,14 @@ _c_serve_decode = _registry.counter("serving/decode_steps")
 _g_serve_lanes = _registry.gauge("serving/lanes_occupied")
 _g_serve_free_blocks = _registry.gauge("serving/free_blocks")
 _h_serve_queue_wait = _registry.histogram("serving/queue_wait_ms")
+# prefix-cache KV sharing (serving/kv_cache.py prefix index): per
+# (re-)prefill token split — hit = context served by acquired shared
+# blocks, miss = tokens actually prefilled — plus the pool's live
+# shared / cold-LRU block census after the prefill
+_c_serve_prefix_hit = _registry.counter("serving/prefix_hit_tokens")
+_c_serve_prefix_miss = _registry.counter("serving/prefix_miss_tokens")
+_g_serve_shared_blocks = _registry.gauge("serving/shared_blocks")
+_g_serve_cold_blocks = _registry.gauge("serving/cold_blocks")
 # Pallas kernel engagement + the search harness (ops/pallas/search.py —
 # docs/KERNELS.md): every dispatch-time engagement decision is counted
 # (engaged vs composite fallback, with a per-family breakdown counter),
@@ -508,6 +516,20 @@ def on_serving_decode(lanes_active: int, free_blocks: int) -> None:
     _c_serve_decode.inc()
     _g_serve_lanes.set(lanes_active)
     _g_serve_free_blocks.set(free_blocks)
+
+
+def on_serving_prefix(hit_tokens: int, miss_tokens: int,
+                      shared_blocks: int, cold_blocks: int) -> None:
+    """One lane's (re-)prefill consulted the prefix cache:
+    ``hit_tokens`` of its context rode acquired shared blocks,
+    ``miss_tokens`` went through the prefill program; the gauges are
+    the pool's shared/cold block census afterwards."""
+    if hit_tokens:
+        _c_serve_prefix_hit.inc(hit_tokens)
+    if miss_tokens:
+        _c_serve_prefix_miss.inc(miss_tokens)
+    _g_serve_shared_blocks.set(shared_blocks)
+    _g_serve_cold_blocks.set(cold_blocks)
 
 
 def on_pallas_engaged(family: str) -> None:

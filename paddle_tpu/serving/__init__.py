@@ -16,12 +16,13 @@ Benchmark: ``python benchmarks/serving_bench.py [--smoke]`` replays a
 seeded Poisson arrival trace and reports tokens/s + p50/p99 TTFT.
 """
 from .engine import ServingConfig, ServingEngine  # noqa: F401
-from .kv_cache import BlockPool, blocks_needed  # noqa: F401
+from .kv_cache import BlockPool, blocks_needed, prefix_keys  # noqa: F401
 from .scheduler import (  # noqa: F401
     FINISHED, RUNNING, WAITING, FCFSScheduler, Request,
 )
 
 __all__ = [
     "ServingConfig", "ServingEngine", "BlockPool", "blocks_needed",
-    "FCFSScheduler", "Request", "WAITING", "RUNNING", "FINISHED",
+    "prefix_keys", "FCFSScheduler", "Request", "WAITING", "RUNNING",
+    "FINISHED",
 ]

@@ -8,7 +8,11 @@ growth never retrace. Two compiled programs serve the whole lifetime:
 - **prefill chunk** ``[1, C]``: one lane's context enters the pool C
   tokens at a time (padded tail chunks write only below the context
   length — pads are redirected to the null block), and the final chunk
-  samples the first generated token from the last real position.
+  samples the first generated token from the last real position. With
+  the prefix cache on (``PT_SERVE_PREFIX_CACHE``, default) prefill
+  starts at the first token not covered by acquired shared blocks —
+  a fully cached system prompt costs zero prefill chunks beyond its
+  private tail.
 - **decode step** ``[L, 1]``: every occupied lane advances one token —
   write the pending token's K/V at ``pool_len``, attend over the lane's
   gathered blocks masked to ``slot <= pos``, greedy-sample the next.
@@ -89,11 +93,16 @@ class ServingConfig:
       tune-table row for this geometry (measurement-first; no row =
       the dense gathered read), ``"1"``/True forces it on,
       ``"0"``/False off.
+    - ``prefix_cache`` (``PT_SERVE_PREFIX_CACHE``, on): ref-counted
+      prefix sharing in the block pool — requests whose context starts
+      with already-cached full blocks (shared system prompts, few-shot
+      headers, recompute re-admissions) skip prefilling them
+      (docs/SERVING.md). ``0`` restores the share-nothing pool.
     """
 
     def __init__(self, max_lanes=None, block_size=None, num_blocks=None,
                  prefill_chunk=None, max_seq_len=None, int8_weights=None,
-                 paged=None):
+                 paged=None, prefix_cache=None):
         self.max_lanes = max_lanes if max_lanes is not None \
             else _env_int("PT_SERVE_LANES", 8)
         self.block_size = block_size if block_size is not None \
@@ -115,6 +124,10 @@ class ServingConfig:
             self.paged = "off"
         else:
             self.paged = "auto"
+        if prefix_cache is None:
+            prefix_cache = os.environ.get(
+                "PT_SERVE_PREFIX_CACHE", "1") not in ("0", "off")
+        self.prefix_cache = bool(prefix_cache)
         for name in ("max_lanes", "block_size", "prefill_chunk"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1, "
@@ -289,7 +302,8 @@ class ServingEngine:
         self._vpool = jnp.zeros_like(self._kpool)
         self.scheduler = FCFSScheduler(
             BlockPool(num_blocks, cfg.block_size), cfg.max_lanes,
-            self.blocks_per_lane, self.max_seq_len)
+            self.blocks_per_lane, self.max_seq_len,
+            prefix_cache=cfg.prefix_cache)
         # live (waiting/running) requests only; finished ones move to
         # _finished until collected — a long-running server must not
         # grow with its request history
@@ -303,9 +317,14 @@ class ServingEngine:
         # kv_read_tokens counts the LIVE prefix (what the paged kernel
         # reads); kv_dense_read_tokens the full-table slots the dense
         # gather reads — the pair is the bench's hbm_util delta.
+        # prefix_{hit,miss}_tokens split every (re-)prefilled context:
+        # hit = tokens served by acquired shared blocks (no compute),
+        # miss = tokens actually pushed through the prefill program —
+        # the bench's prefix_hit_rate numerator/denominator.
         self.counters = {
             "admits": 0, "finished": 0, "preemptions": 0,
             "prefill_chunks": 0, "decode_steps": 0, "decoded_tokens": 0,
+            "prefix_hit_tokens": 0, "prefix_miss_tokens": 0,
             "kv_read_tokens": 0, "kv_dense_read_tokens": 0,
             "decode_wall_s": 0.0,
         }
@@ -423,18 +442,24 @@ class ServingEngine:
         """One scheduling round: admit + prefill newly admitted lanes
         (they join this same round's decode — continuous batching), run
         the shared decode step, emit/reclaim. Returns whether any work
-        was done."""
+        was done. Admission is one lane at a time with the prefill (and
+        its prefix publish) in between, so burst arrivals sharing a
+        prompt hit the cache from the second lane on."""
         self._ensure_compiled()
-        now = time.perf_counter()
-        admitted = self.scheduler.admit()
-        for req in admitted:
+        worked = False
+        while True:
+            admitted = self.scheduler.admit(limit=1)
+            if not admitted:
+                break
+            req = admitted[0]
+            worked = True
             self.counters["admits"] += 1
             m = _monitor
             if m is not None:
+                now = time.perf_counter()
                 m.on_serving_admit(
                     (now - req.t_submit) * 1e3 if req.t_submit else 0.0)
             self._prefill(req)
-        worked = bool(admitted)
         if self.scheduler.has_running():
             self._decode_round()
             worked = True
@@ -472,35 +497,48 @@ class ServingEngine:
         return row
 
     def _prefill(self, req) -> None:
-        """Fill the lane's blocks chunk by chunk; on the final chunk,
-        greedy-sample the first token. A re-admitted (preempted) request
-        only rebuilds the pool — its pending token is already known, and
-        greedy recompute reproduces the continuation exactly as long as
-        the prefill and decode programs round K/V identically (proven
-        token-identical on the CPU tier in tests/test_serving.py; the
-        two programs fuse differently, so a TPU near-tie argmax flip is
-        possible — hardware recompute-parity A/B queued in ROADMAP)."""
+        """Fill the lane's blocks chunk by chunk — starting at
+        ``cached_len``, the span already covered by acquired prefix-
+        cache blocks (block-aligned, capped at ctx-1, so at least one
+        chunk always runs and every write lands in a private block) —
+        and greedy-sample the first token on the final chunk. Once the
+        context is in the pool its full blocks are published to the
+        prefix index (they are frozen now: decode writes only positions
+        >= ctx). A re-admitted (preempted) request only rebuilds the
+        pool — its pending token is already known, and greedy recompute
+        reproduces the continuation exactly as long as the prefill and
+        decode programs round K/V identically (proven token-identical
+        on the CPU tier in tests/test_serving.py; the two programs fuse
+        differently, so a TPU near-tie argmax flip is possible —
+        hardware recompute-parity A/B queued in ROADMAP)."""
         toks = req.prefill_tokens
         ctx = int(toks.size)
+        cached = int(req.cached_len)
         C = self.config.prefill_chunk
         table = jnp.asarray(self._table_row(req))
-        nchunks = -(-ctx // C)
+        nchunks = 0
         tok = None
-        for c in range(nchunks):
-            start = c * C
+        for start in range(cached, ctx, C):
             piece = toks[start:start + C]
             chunk = np.zeros((1, C), np.int32)
             chunk[0, :piece.size] = piece
-            last_idx = ctx - 1 - start if c == nchunks - 1 else 0
+            last_idx = ctx - 1 - start if start + C >= ctx else 0
             tok, self._kpool, self._vpool = self._prefill_exec(
                 self._params, self._kpool, self._vpool, table,
                 jnp.asarray(chunk), jnp.int32(start), jnp.int32(ctx),
                 jnp.int32(last_idx))
+            nchunks += 1
         req.pool_len = ctx
+        self.scheduler.publish_prefix(req)
         self.counters["prefill_chunks"] += nchunks
+        self.counters["prefix_hit_tokens"] += cached
+        self.counters["prefix_miss_tokens"] += ctx - cached
         m = _monitor
         if m is not None:
             m.on_serving_prefill(nchunks)
+            pool = self.scheduler.pool
+            m.on_serving_prefix(cached, ctx - cached,
+                                pool.shared_count, pool.cold_count)
         if req.output:
             return  # recompute path: the pending token is output[-1]
         self._emit(req, int(np.asarray(tok)[0]), time.perf_counter())
@@ -540,7 +578,10 @@ class ServingEngine:
         c["kv_dense_read_tokens"] += len(act) * M * self.config.block_size
         m = _monitor
         if m is not None:
-            m.on_serving_decode(len(act), sched.pool.free_count)
+            # allocatable = free list + revivable cold LRU — the
+            # pre-sharing meaning of "free" (cold blocks are spare
+            # capacity, not occupancy)
+            m.on_serving_decode(len(act), sched.pool.allocatable)
         for req in act:
             req.pool_len += 1
             self._emit(req, int(toks[req.lane]), now)
@@ -577,12 +618,17 @@ class ServingEngine:
             block_size=self.config.block_size,
             num_blocks=self.scheduler.pool.num_blocks,
             free_blocks=self.scheduler.pool.free_count,
+            allocatable_blocks=self.scheduler.pool.allocatable,
             blocks_per_lane=self.blocks_per_lane,
             max_seq_len=self.max_seq_len,
             prefill_chunk=self.config.prefill_chunk,
             int8_weights=self.config.int8_weights,
             paged_attention=self.paged_active,
             paged_dead=self._paged_dead,
+            prefix_cache=self.config.prefix_cache,
+            shared_blocks=self.scheduler.pool.shared_count,
+            cold_blocks=self.scheduler.pool.cold_count,
+            indexed_blocks=self.scheduler.pool.indexed_count,
             lanes_occupied=self.scheduler.lanes_occupied,
             waiting=len(self.scheduler.waiting),
             requests=len(self._requests),

@@ -10,7 +10,10 @@ Policy (the Orca/vLLM iteration-level discipline, recompute variant):
 
 - **Admission** is FCFS from the waiting deque: the head request is
   admitted iff a lane is free AND the pool can cover its context plus
-  the first decode write. Admission never preempts — runners hold their
+  the first decode write — with the prefix cache on, the longest
+  block-aligned indexed prefix is acquired (shared, ref-counted)
+  instead of allocated, and the engine prefills only from
+  ``cached_len`` on. Admission never preempts — runners hold their
   blocks until they finish or growth forces eviction.
 - **Growth**: each decode step may cross a block boundary;
   :meth:`ensure_capacity` allocates the next block, and when the pool is
@@ -37,7 +40,7 @@ import itertools
 
 import numpy as np
 
-from .kv_cache import BlockPool, blocks_needed
+from .kv_cache import BlockPool, blocks_needed, prefix_keys
 
 __all__ = ["Request", "FCFSScheduler",
            "WAITING", "RUNNING", "FINISHED"]
@@ -62,6 +65,8 @@ class Request:
 
     __slots__ = ("request_id", "prompt", "max_new_tokens", "eos_token_id",
                  "state", "output", "blocks", "lane", "pool_len",
+                 "cached_len", "prefix_cached_tokens",
+                 "ttft_cached_tokens", "_pkeys",
                  "t_submit", "t_first", "t_done", "preemptions",
                  "_admit_seq")
 
@@ -85,6 +90,22 @@ class Request:
         # tokens whose K/V sit in the pool (= prefilled context while
         # running; the pending output token is NOT yet written)
         self.pool_len = 0
+        # leading tokens covered by acquired prefix-cache blocks at the
+        # CURRENT admission (prefill starts here; reset on preemption)
+        self.cached_len = 0
+        # lifetime cache credit across (re-)admissions (stats), and the
+        # FIRST admission's credit alone — the admission whose prefill
+        # sets t_first, so the serving bench's cached-vs-cold TTFT A/B
+        # groups by it (a later recompute hit must not relabel a
+        # cold-TTFT request as cached)
+        self.prefix_cached_tokens = 0
+        self.ttft_cached_tokens = None
+        # chain-key cache for the current prefill context (ctx, keys):
+        # a blocked admission retries every engine step, and rehashing
+        # a long context per retry is pure repeated work. ctx alone
+        # keys the cache — prefill_tokens only ever grows (recompute
+        # appends kept output), so equal length implies equal content.
+        self._pkeys = None
         self.t_submit = None
         self.t_first = None
         self.t_done = None
@@ -110,10 +131,14 @@ class FCFSScheduler:
 
     def __init__(self, pool: BlockPool, max_lanes: int,
                  blocks_per_lane: int, max_seq_len: int,
-                 events_cap: int = 65536):
+                 events_cap: int = 65536, prefix_cache: bool = True):
         if max_lanes < 1:
             raise ValueError(f"max_lanes must be >= 1, got {max_lanes}")
         self.pool = pool
+        # prefix-cache policy switch (PT_SERVE_PREFIX_CACHE via
+        # ServingConfig): off = the pre-sharing admission path, byte for
+        # byte — no lookups, no publishes, cold LRU stays empty
+        self.prefix_cache = bool(prefix_cache)
         self.max_lanes = int(max_lanes)
         self.blocks_per_lane = int(blocks_per_lane)
         self.max_seq_len = int(max_seq_len)
@@ -158,33 +183,82 @@ class FCFSScheduler:
                 return i
         return None
 
-    def admit(self) -> list:
+    def admit(self, limit: int | None = None) -> list:
         """FCFS: move waiting-head requests onto free lanes while blocks
-        cover each one's context + first decode write. Returns the newly
+        cover each one's context + first decode write. With the prefix
+        cache on, the head request's longest block-aligned indexed
+        prefix is acquired (ref-counted, possibly reviving cold blocks)
+        and only the remainder is privately allocated — the engine's
+        prefill then starts at ``cached_len``. Returns the newly
         admitted requests (engine prefills them before the next decode
-        round)."""
+        round). The engine passes ``limit=1`` and prefills+publishes
+        between admissions, so a BURST of same-prompt arrivals shares
+        from the second request on — admitting a whole wave first would
+        privately allocate every lane's copy before any prefix was
+        published."""
         admitted = []
-        while self.waiting:
+        while self.waiting and (limit is None or len(admitted) < limit):
             lane = self.free_lane()
             if lane is None:
                 break
             req = self.waiting[0]
+            ctx = len(req.prefill_tokens)
+            hits = []
+            if self.prefix_cache:
+                # cap at ctx-1: at least one token always prefills, so
+                # the final-chunk sampling position and its K/V write
+                # stay in a lane-private block (no shared-block writes)
+                hits = self.pool.lookup(self._chain_keys(req)[
+                    :(ctx - 1) // self.pool.block_size])
             # context to prefill + the first decode write right after it
-            need = blocks_needed(
-                len(req.prefill_tokens) + 1, self.pool.block_size)
-            blocks = self.pool.alloc(need, req)
+            need = blocks_needed(ctx + 1, self.pool.block_size)
+            # acquire the hits FIRST: a cold hit revived here can no
+            # longer be reclaimed by the private alloc below
+            self.pool.acquire(hits, req)
+            blocks = self.pool.alloc(need - len(hits), req)
             if blocks is None:
+                self.pool.free(hits, req)  # back to the cold LRU
                 break  # runners will free blocks as they finish
             self.waiting.popleft()
-            req.blocks = blocks
+            req.blocks = hits + blocks
             req.lane = lane
             req.state = RUNNING
             req.pool_len = 0  # set by the engine's prefill
+            req.cached_len = len(hits) * self.pool.block_size
+            req.prefix_cached_tokens += req.cached_len
+            if req.ttft_cached_tokens is None:  # first admission
+                req.ttft_cached_tokens = req.cached_len
             req._admit_seq = next(self._admit_counter)
             self.lanes[lane] = req
             self.events.append(("admit", req.request_id, lane))
+            if hits:
+                self.events.append(
+                    ("prefix_hit", req.request_id, req.cached_len))
             admitted.append(req)
         return admitted
+
+    def _chain_keys(self, req: Request) -> list:
+        """``prefix_keys`` over the request's CURRENT prefill context,
+        memoized on the request (see ``Request._pkeys``): a blocked
+        admission retrying every step, and the post-prefill publish,
+        reuse one hash pass instead of rehashing per call."""
+        ctx = len(req.prefill_tokens)
+        if req._pkeys is None or req._pkeys[0] != ctx:
+            req._pkeys = (ctx, prefix_keys(req.prefill_tokens,
+                                           self.pool.block_size))
+        return req._pkeys[1]
+
+    def publish_prefix(self, req: Request) -> None:
+        """Index ``req``'s full, frozen context blocks (engine calls
+        this AFTER the lane's prefill wrote their K/V — publishing
+        earlier would let a same-round admission read unwritten
+        blocks). Blocks that arrived via the prefix cache re-publish as
+        no-ops (same chain key, same block); on a key another lane
+        published first, this lane's copy just stays private."""
+        if not self.prefix_cache:
+            return
+        for i, key in enumerate(self._chain_keys(req)):
+            self.pool.publish(key, req.blocks[i], req)
 
     # -- growth / preemption -------------------------------------------------
 
@@ -224,6 +298,7 @@ class FCFSScheduler:
         self.lanes[req.lane] = None
         req.lane = None
         req.pool_len = 0
+        req.cached_len = 0
         req.state = WAITING
         req.preemptions += 1
         self.waiting.appendleft(req)

@@ -179,6 +179,72 @@ class TestEvaluate:
         assert any(c["name"] == "ttft_p99" and c["ok"]
                    for c in v["checks"])
 
+    def test_shared_prefix_absence_means_default_not_wildcard(
+            self, guard, tmp_path):
+        # a pre-prefix-cache serving record (no shared_prefix_tokens in
+        # extra) was a shared=0 trace: it must stay the baseline for a
+        # fresh PLAIN line but never for a shared-prefix line — the
+        # 64-token-longer-prompt workload would cross-judge TTFT
+        path = str(tmp_path / "store.json")
+        with open(path, "w") as f:
+            json.dump({"records": [
+                {"metric": "serving_tokens_per_sec", "value": 900.0,
+                 "unit": "tokens/s", "backend": "tpu",
+                 "extra": {"requests": 32}}]}, f)
+        plain = {"metric": "serving_tokens_per_sec", "value": 880.0,
+                 "requests": 32, "shared_prefix_tokens": 0,
+                 "prefix_cache": True}
+        shared = dict(plain, shared_prefix_tokens=64)
+        assert guard.last_good(
+            path, "serving_tokens_per_sec",
+            match=guard.config_match(plain)) is not None
+        assert guard.last_good(
+            path, "serving_tokens_per_sec",
+            match=guard.config_match(shared)) is None
+
+    def test_flags_prefix_hit_rate_collapse(self, guard):
+        # prefix-cache gate (ISSUE 13): the shared-prompt trace's hit
+        # rate dropped 50% vs last-good — sharing silently stopped
+        base = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                "backend": "tpu", "extra": {"prefix_hit_rate": 0.8}}
+        fresh = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                 "unit": "tokens/s", "prefix_hit_rate": 0.4}
+        v = guard.evaluate(fresh, base, hardware=True)
+        assert not v["ok"]
+        assert any(c["name"] == "prefix_hit" and not c["ok"]
+                   for c in v["checks"])
+        # a drop within the 25% default passes
+        ok = dict(fresh, prefix_hit_rate=0.7)
+        v2 = guard.evaluate(ok, base, hardware=True)
+        assert v2["ok"]
+        assert any(c["name"] == "prefix_hit" and c["ok"]
+                   for c in v2["checks"])
+
+    def test_prefix_hit_gate_skips_smoke_zero_and_missing(self, guard):
+        base = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                "backend": "tpu", "extra": {"prefix_hit_rate": 0.8}}
+        # cpu smoke: skipped with the other hardware comparisons
+        smoke = {"metric": "serving_tokens_per_sec", "value": 50.0,
+                 "unit": "tokens/s", "prefix_hit_rate": 0.0,
+                 "note": "cpu smoke mode; not a TPU number"}
+        v = guard.evaluate(smoke, base)
+        assert v["ok"]
+        assert not any(c["name"] == "prefix_hit" for c in v["checks"])
+        # a 0-rate baseline (plain trace, no shared prefix) pins nothing
+        zero_base = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                     "backend": "tpu", "extra": {"prefix_hit_rate": 0.0}}
+        hw = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+              "unit": "tokens/s", "prefix_hit_rate": 0.0}
+        v2 = guard.evaluate(hw, zero_base, hardware=True)
+        assert v2["ok"]
+        assert not any(c["name"] == "prefix_hit" for c in v2["checks"])
+        # baseline predating the field: gate silently absent
+        v3 = guard.evaluate(
+            hw, {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                 "backend": "tpu", "extra": {}}, hardware=True)
+        assert v3["ok"]
+        assert not any(c["name"] == "prefix_hit" for c in v3["checks"])
+
     def test_ttft_gate_skips_cpu_smoke_and_no_baseline(self, guard):
         fresh = {"metric": "serving_tokens_per_sec", "value": 50.0,
                  "unit": "tokens/s", "ttft_ms_p99": 9000.0,

@@ -50,6 +50,13 @@ BENCHES = [
     # HBM roofline; the guard's --ttft-growth gate judges the tail
     ("serving", [sys.executable, "benchmarks/serving_bench.py"], 1800,
      {"PT_SERVE_BENCH_REQUESTS": "32"}),
+    # prefix-cache KV sharing (docs/SERVING.md): the same Poisson trace
+    # with every prompt opening on one 64-token shared system prompt —
+    # persists prefix_hit_rate + the cached-vs-cold TTFT A/B next to
+    # the plain serving row; perf_guard --prefix-hit-drop pins the rate
+    ("serving_prefix", [sys.executable, "benchmarks/serving_bench.py"],
+     1800, {"PT_SERVE_BENCH_REQUESTS": "32",
+            "PT_SERVE_BENCH_SHARED": "64"}),
     # resilience soak (docs/RESILIENCE.md): fault-injected (crash +
     # poisoned batch) run through launcher relaunch + resume + NaN skip,
     # gated on loss slope / memory growth / the save-cost guard; the

@@ -207,14 +207,25 @@ def render_serving(out, totals=None, hists=None, gauges=None, source=""):
     if dec:
         line += f"   ({pre / dec:.2f} prefill/decode ratio)"
     out.append(line)
+    hit = totals.get("serving/prefix_hit_tokens", 0)
+    miss = totals.get("serving/prefix_miss_tokens", 0)
+    if hit or miss:
+        out.append(f"prefix cache: {hit} cached + {miss} prefilled "
+                   f"context tokens ({hit / (hit + miss):.0%} hit rate)")
     lanes = gauges.get("serving/lanes_occupied")
     blocks = gauges.get("serving/free_blocks")
-    if lanes is not None or blocks is not None:
+    shared = gauges.get("serving/shared_blocks")
+    cold = gauges.get("serving/cold_blocks")
+    if any(v is not None for v in (lanes, blocks, shared, cold)):
         parts = []
         if lanes is not None:
             parts.append(f"lanes occupied (last): {lanes:g}")
         if blocks is not None:
             parts.append(f"free KV blocks (last): {blocks:g}")
+        if shared is not None:
+            parts.append(f"shared (last): {shared:g}")
+        if cold is not None:
+            parts.append(f"cold-cached (last): {cold:g}")
         out.append("   ".join(parts))
     w = hists.get("serving/queue_wait_ms")
     if w:

@@ -30,6 +30,12 @@ and exits nonzero with a human-readable verdict when the run regressed:
   ``benchmarks/serving_bench.py`` line vs the baseline record's
   ``extra.ttft_ms_p99`` — the tail-latency gate; the aggregate tokens/s
   drop is the same ``--throughput-drop`` check every metric gets
+- serving ``prefix_hit_rate`` below last-good by more than
+  ``--prefix-hit-drop`` (25%): the shared-prompt trace stopped sharing
+  KV blocks (chain-key churn or a publish regression in
+  ``serving/kv_cache.py``'s prefix index) — the cached-TTFT win
+  evaporated even when this run's tail happens to pass. Skipped when
+  either side lacks the field or the baseline rate is 0
 - a changed sharding plan (``--plan-drift``): a fresh hardware line
   whose ``shard_plan`` sub-object (from ``tools/shard_plan.py``) names
   a different (dp, mp, batch) than the last-good record's
@@ -95,6 +101,14 @@ DEFAULT_THRESHOLDS = {
     # ttft_ms_p99; the aggregate tokens/s drop rides the generic
     # throughput check — the metric's value IS tokens/s)
     "ttft_growth": 0.25,
+    # prefix-cache gate: fractional drop of serving_bench's
+    # prefix_hit_rate vs the last-good record before the check fails —
+    # a collapsed hit rate means the shared-prompt workload stopped
+    # sharing (chain-key churn, publish regression, or cold-LRU
+    # thrash) and the TTFT win silently evaporated. Skips when either
+    # side lacks the field or the baseline rate is 0 (a trace with no
+    # shared prefix pins nothing), and on CPU smokes with the rest
+    "prefix_hit_drop": 0.25,
     # resilience gate: fractional growth of the blocking checkpoint-save
     # cost (tools/soak.py lines carry ckpt_save_ms_p50 — the quiesce +
     # host-snapshot time the cadence planner budgets against) vs the
@@ -171,7 +185,15 @@ def load_fresh(path: str) -> dict:
 # last_good.
 CONFIG_KEYS = ("batch", "seq", "ce_chunk",
                "requests", "arrival_rate_per_s", "lanes", "block_size",
-               "int8_weights", "devices")
+               "int8_weights", "devices",
+               "shared_prefix_tokens", "prefix_cache")
+
+# keys whose ABSENCE from an old record means the knob's default, not a
+# wildcard: records persisted before the prefix cache existed WERE
+# shared=0 / cache-on runs, so a fresh shared-prefix line must not
+# judge itself against them (a 64-token-longer-prompt workload), while
+# a fresh plain line keeps its pre-PR baselines
+CONFIG_KEY_DEFAULTS = {"shared_prefix_tokens": 0, "prefix_cache": True}
 
 
 def config_match(fresh: dict) -> dict:
@@ -209,9 +231,12 @@ def last_good(store_path: str, metric: str, fresh: dict | None = None,
         # a key ABSENT from a record's extra is a wildcard, not a
         # mismatch: records persisted before a config knob existed
         # (e.g. pre-serving decode lines without int8_weights) must
-        # stay eligible baselines for the gates they anchored
-        if match and any(k in ex and ex[k] != v
-                         for k, v in match.items()):
+        # stay eligible baselines for the gates they anchored —
+        # except CONFIG_KEY_DEFAULTS keys, where absence means the
+        # knob's default value (pre-knob behavior)
+        if match and any(
+                (ex[k] if k in ex else CONFIG_KEY_DEFAULTS.get(k, v))
+                != v for k, v in match.items()):
             continue
         if skipping_self and rec.get("value") == fresh.get("value"):
             continue
@@ -327,6 +352,17 @@ def evaluate(fresh: dict, baseline: dict | None, thresholds: dict | None
                   + (" — tail latency regressed (scheduler queueing or "
                      "prefill got slower)" if tgrowth > th["ttft_growth"]
                      else ""))
+        phr = fresh.get("prefix_hit_rate")
+        base_phr = (baseline.get("extra") or {}).get("prefix_hit_rate")
+        if phr is not None and base_phr:
+            pdrop = 1.0 - phr / base_phr
+            check("prefix_hit", pdrop <= th["prefix_hit_drop"],
+                  f"hit rate {phr:.3f} vs last-good {base_phr:.3f} "
+                  f"({'-' if pdrop > 0 else '+'}{abs(pdrop) * 100:.1f}%,"
+                  f" max drop {th['prefix_hit_drop'] * 100:.0f}%)"
+                  + (" — prefix sharing collapsed (chain-key churn, a "
+                     "publish regression, or cold-LRU thrash?)"
+                     if pdrop > th["prefix_hit_drop"] else ""))
         sms = fresh.get("ckpt_save_ms_p50")
         base_sms = (baseline.get("extra") or {}).get("ckpt_save_ms_p50")
         if sms is not None and base_sms:
@@ -474,6 +510,11 @@ def main(argv=None) -> int:
                     default=DEFAULT_THRESHOLDS["ttft_growth"],
                     help="max fractional p99 TTFT growth vs last-good "
                          "for serving bench lines (default 0.25)")
+    ap.add_argument("--prefix-hit-drop", type=float,
+                    default=DEFAULT_THRESHOLDS["prefix_hit_drop"],
+                    help="max fractional prefix_hit_rate drop vs "
+                         "last-good for serving bench lines (default "
+                         "0.25; skipped when the baseline rate is 0)")
     ap.add_argument("--save-cost-growth", type=float,
                     default=DEFAULT_THRESHOLDS["save_cost_growth"],
                     help="max fractional checkpoint-save blocking-cost "
@@ -528,6 +569,7 @@ def main(argv=None) -> int:
                     "compile_growth": args.compile_growth,
                     "compile_slack_ms": args.compile_slack_ms,
                     "ttft_growth": args.ttft_growth,
+                    "prefix_hit_drop": args.prefix_hit_drop,
                     "save_cost_growth": args.save_cost_growth,
                     "save_cost_slack_ms": args.save_cost_slack_ms,
                     "plan_drift": args.plan_drift,
