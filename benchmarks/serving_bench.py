@@ -25,8 +25,25 @@ Knobs (seeded defaults; --smoke pins the small trace explicitly):
                                  ``serving_prefix`` row sets 64), so
                                  the prefix cache turns all but the
                                  first prefill of it into hits
+  PT_SERVE_BENCH_SPEC_K   (0)    speculative-decoding trace mode
+                                 (hwbench's ``serving_spec`` row sets
+                                 4): the engine runs with spec_k=N and
+                                 every prompt becomes a seeded tiled
+                                 motif (repetition-friendly — the
+                                 prompt-lookup drafter's win
+                                 condition), so ``accept_rate`` /
+                                 ``tokens_per_decode_step`` measure a
+                                 workload speculation can actually
+                                 serve
+  PT_SERVE_BENCH_SPEC_AB  (0)    =1 replays the same trace once more
+                                 with speculation off on a fresh
+                                 engine and embeds the A/B
+                                 (``spec_off`` sub-object: decode
+                                 rounds + tokens/s the plain decode
+                                 path needed)
   PT_SERVE_*                     engine geometry (docs/SERVING.md)
   PT_SERVE_PREFIX_CACHE=0        share-nothing pool A/B
+  PT_SERVE_SPEC=0                speculation off (plain decode) A/B
   PT_DECODE_INT8=1               weight-only int8 decode A/B
 """
 from __future__ import annotations
@@ -55,14 +72,18 @@ def _load_decode_bench():
 
 
 def build_trace(n, rate, vocab, prompt_rng, new_rng, seed=0,
-                shared_prefix=0):
+                shared_prefix=0, motif=0):
     """Seeded Poisson trace: ``[(arrival_s, prompt_ids, max_new)]``,
     arrival-sorted by construction. Deterministic for a (seed, n, rate,
-    length-range, shared-prefix) tuple — the replayable-input contract
-    the scheduler property tests lean on. ``shared_prefix`` > 0 is the
-    shared-system-prompt mode: one seeded prefix of that many tokens
-    opens EVERY prompt (per-request lengths still draw from
-    ``prompt_rng`` for the unique suffix)."""
+    length-range, shared-prefix, motif) tuple — the replayable-input
+    contract the scheduler property tests lean on. ``shared_prefix`` > 0
+    is the shared-system-prompt mode: one seeded prefix of that many
+    tokens opens EVERY prompt (per-request lengths still draw from
+    ``prompt_rng`` for the unique suffix). ``motif`` > 0 is the
+    repetition-friendly mode (PT_SERVE_BENCH_SPEC_K): each prompt is a
+    per-request seeded ``motif``-token pattern tiled to its drawn
+    length — the structure (code, quoted context, lists) prompt-lookup
+    speculation exists for."""
     rng = np.random.RandomState(seed)
     prefix = rng.randint(0, vocab, size=(int(shared_prefix),)) \
         .astype(np.int32)
@@ -71,7 +92,12 @@ def build_trace(n, rate, vocab, prompt_rng, new_rng, seed=0,
     for i in range(n):
         plen = int(rng.randint(prompt_rng[0], prompt_rng[1] + 1))
         new = int(rng.randint(new_rng[0], new_rng[1] + 1))
-        prompt = rng.randint(0, vocab, size=(plen,)).astype(np.int32)
+        if motif:
+            pat = rng.randint(0, vocab, size=(int(motif),))
+            prompt = np.tile(pat, -(-plen // int(motif)))[:plen] \
+                .astype(np.int32)
+        else:
+            prompt = rng.randint(0, vocab, size=(plen,)).astype(np.int32)
         if shared_prefix:
             prompt = np.concatenate([prefix, prompt])
         trace.append((float(arrivals[i]), prompt, new))
@@ -112,17 +138,29 @@ def main():
     n_req_env = os.environ.get("PT_SERVE_BENCH_REQUESTS")
     rate_env = os.environ.get("PT_SERVE_BENCH_RATE")
     shared = int(os.environ.get("PT_SERVE_BENCH_SHARED", "0") or 0)
+    # speculative trace mode (docs/SERVING.md): PT_SERVE_BENCH_SPEC_K=N
+    # pins the engine's draft depth AND makes the prompts repetitive
+    # (tiled seeded motifs) so prompt-lookup acceptance is measurable
+    spec_k_env = int(os.environ.get("PT_SERVE_BENCH_SPEC_K", "0") or 0)
+    spec_kw = {"spec": True, "spec_k": spec_k_env} if spec_k_env else {}
+    motif = 4 if spec_k_env else 0
     if smoke:
         cfg = LlamaConfig.tiny()
         n_req = int(n_req_env) if n_req_env else 8
         rate = float(rate_env) if rate_env else 50.0
         prompt_rng, new_rng = (3, 12), (4, 12)
-        serve_cfg = ServingConfig(
+        if spec_k_env:  # longer outputs give speculation room to help
+            new_rng = (12, 24)
+        make_cfg = lambda **kw: ServingConfig(  # noqa: E731
             max_lanes=int(os.environ.get("PT_SERVE_LANES", "4")),
             block_size=int(os.environ.get("PT_SERVE_BLOCK", "4")),
             prefill_chunk=int(
                 os.environ.get("PT_SERVE_PREFILL_CHUNK", "8")),
-            max_seq_len=int(os.environ.get("PT_SERVE_MAX_LEN", "32")))
+            max_seq_len=int(os.environ.get("PT_SERVE_MAX_LEN",
+                                           "48" if spec_k_env
+                                           else "32")),
+            **{**spec_kw, **kw})
+        serve_cfg = make_cfg()
     else:
         # the headline-bench decode model (~0.44B, one v5e chip)
         cfg = LlamaConfig(
@@ -133,8 +171,10 @@ def main():
         n_req = int(n_req_env) if n_req_env else 64
         rate = float(rate_env) if rate_env else 4.0
         prompt_rng, new_rng = (64, 192), (64, 256)
-        serve_cfg = ServingConfig(max_seq_len=int(
-            os.environ.get("PT_SERVE_MAX_LEN", "512")))
+        make_cfg = lambda **kw: ServingConfig(  # noqa: E731
+            max_seq_len=int(os.environ.get("PT_SERVE_MAX_LEN", "512")),
+            **{**spec_kw, **kw})
+        serve_cfg = make_cfg()
     seed = int(os.environ.get("PT_SERVE_BENCH_SEED", "0"))
     if shared and (serve_cfg.max_seq_len is None or
                    shared + prompt_rng[1] + new_rng[1]
@@ -151,30 +191,48 @@ def main():
             p._data = p._data.astype("bfloat16")
     model.eval()
 
-    engine = ServingEngine(model, serve_cfg)
     trace = build_trace(n_req, rate, cfg.vocab_size, prompt_rng, new_rng,
-                        seed=seed, shared_prefix=shared)
-    engine.warmup()  # compiles (or exec-cache-loads) outside the clock
+                        seed=seed, shared_prefix=shared, motif=motif)
 
-    # replay: submit each request when its arrival time passes, step the
-    # engine whenever it has work. Request timestamps (TTFT, per-token)
-    # come from the engine's own perf_counter clock; a host transfer per
-    # decode round makes the timing honest through the tunnel (the
-    # emitted token IS fetched — CLAUDE.md timing rules).
-    reqs = []
-    t0 = time.perf_counter()
-    i = 0
-    while i < len(trace) or engine.has_work():
-        now = time.perf_counter() - t0
-        while i < len(trace) and trace[i][0] <= now:
-            _, prompt, new = trace[i]
-            reqs.append(engine.submit(prompt, max_new_tokens=new))
-            i += 1
-        if engine.has_work():
-            engine.step()
-        elif i < len(trace):
-            time.sleep(min(trace[i][0] - now, 0.02))
-    wall = time.perf_counter() - t0
+    def replay(engine):
+        """Submit each request when its arrival time passes, step the
+        engine whenever it has work. Request timestamps (TTFT,
+        per-token) come from the engine's own perf_counter clock; a
+        host transfer per decode round makes the timing honest through
+        the tunnel (the emitted token IS fetched — CLAUDE.md timing
+        rules)."""
+        reqs = []
+        t0 = time.perf_counter()
+        i = 0
+        while i < len(trace) or engine.has_work():
+            now = time.perf_counter() - t0
+            while i < len(trace) and trace[i][0] <= now:
+                _, prompt, new = trace[i]
+                reqs.append(engine.submit(prompt, max_new_tokens=new))
+                i += 1
+            if engine.has_work():
+                engine.step()
+            elif i < len(trace):
+                time.sleep(min(trace[i][0] - now, 0.02))
+        return reqs, time.perf_counter() - t0
+
+    engine = ServingEngine(model, serve_cfg)
+    engine.warmup()  # compiles (or exec-cache-loads) outside the clock
+    reqs, wall = replay(engine)
+    # snapshot the monitor AND the exec-cache account NOW: the optional
+    # spec-off A/B engine below must not leak its counters or cache
+    # traffic into the main run's telemetry
+    try:
+        mon_snap = _mon.snapshot()
+    except Exception:  # noqa: BLE001 — telemetry must not break the run
+        mon_snap = None
+    try:
+        from paddle_tpu.jit import exec_cache as _ec_snap_mod
+
+        ec_snap = (_ec_snap_mod.stats()
+                   if _ec_snap_mod.enabled() else None)
+    except Exception:  # noqa: BLE001
+        ec_snap = None
 
     stats = engine.stats()
     tokens = sum(len(r.output) for r in reqs)
@@ -206,7 +264,11 @@ def main():
     # (~GBs held live in a bench whose point is HBM headroom)
     params = engine._params
     embed_nbytes = params["embed"].nbytes
-    lane_rows = (stats["decoded_tokens"] / max(stats["decode_steps"], 1))
+    # decode_rounds = plain decode steps + speculative verify steps:
+    # every round reads the matmul weights exactly once either way —
+    # fewer rounds for the same tokens IS speculation's byte saving
+    rounds = stats["decode_rounds"]
+    lane_rows = (stats["decoded_tokens"] / max(rounds, 1))
     embed_row_bytes = lane_rows * cfg.hidden_size \
         * params["embed"].dtype.itemsize
     param_bytes = sum(
@@ -216,14 +278,14 @@ def main():
     nkv = cfg.num_key_value_heads or cfg.num_attention_heads
     head_dim = cfg.hidden_size // cfg.num_attention_heads
     tok_kv_bytes = 2 * cfg.num_hidden_layers * nkv * head_dim * kv_el_bytes
-    decode_bytes = (stats["decode_steps"] * param_bytes
+    decode_bytes = (rounds * param_bytes
                     + stats["kv_read_tokens"] * tok_kv_bytes
                     + stats["decoded_tokens"] * tok_kv_bytes)
     # the dense gathered read's byte model (every table slot, live or
     # not): with the paged kernel active the live-prefix model above is
     # what the chip actually moves, and util_dense - util is the
     # fraction of the pipe the paged read freed
-    dense_bytes = (stats["decode_steps"] * param_bytes
+    dense_bytes = (rounds * param_bytes
                    + stats["kv_dense_read_tokens"] * tok_kv_bytes
                    + stats["decoded_tokens"] * tok_kv_bytes)
     decode_wall = stats["decode_wall_s"] or 1e-9
@@ -251,7 +313,18 @@ def main():
            "prefill_chunk": stats["prefill_chunk"],
            "preemptions": stats["preemptions"],
            "decode_steps": stats["decode_steps"],
+           "verify_steps": stats["verify_steps"],
+           "decode_rounds": rounds,
            "prefill_chunks": stats["prefill_chunks"],
+           # speculative decoding readout (docs/SERVING.md): accept_rate
+           # = accepted/proposed draft tokens (post-trim), and the
+           # tokens-per-round multiplier speculation bought; spec-off
+           # lines omit accept_rate so perf_guard's --accept-drop gate
+           # skips them
+           "spec": bool(stats["spec"]),
+           "spec_k": stats["spec_k"],
+           "tokens_per_decode_step": round(
+               stats["decoded_tokens"] / rounds, 3) if rounds else None,
            "prefix_cache": bool(stats["prefix_cache"]),
            "shared_prefix_tokens": shared,
            "prefix_hit_rate": round(hit_rate, 4),
@@ -263,11 +336,37 @@ def main():
                                 if ttft_cold else None),
            "hbm_gb_per_s": round(achieved_gbps, 1),
            "hbm_model_bytes_per_step": int(
-               decode_bytes / max(stats["decode_steps"], 1)),
+               decode_bytes / max(rounds, 1)),
            "hbm_peak_gb_per_s": peak,
            "hbm_util": (round(achieved_gbps / peak, 4) if peak else None),
            "int8_weights": serve_cfg.int8_weights,
            "paged_attention": bool(stats["paged_attention"])}
+    if stats["spec"]:
+        prop = stats["spec_proposed_tokens"]
+        rec["accept_rate"] = round(
+            stats["spec_accepted_tokens"] / prop, 4) if prop else 0.0
+        rec["spec_proposed_tokens"] = prop
+        rec["spec_accepted_tokens"] = stats["spec_accepted_tokens"]
+        rec["spec_bonus_tokens"] = stats["spec_bonus_tokens"]
+    if stats["spec"] and os.environ.get(
+            "PT_SERVE_BENCH_SPEC_AB", "0") == "1":
+        # spec-on vs spec-off A/B: the SAME trace through a fresh
+        # plain-decode engine — the decode-rounds delta is the claim
+        # ("one verify round advances several tokens"), the tokens/s
+        # delta is what it was worth end to end on this box
+        eng_off = ServingEngine(model, make_cfg(spec=False))
+        eng_off.warmup()
+        reqs_off, wall_off = replay(eng_off)
+        st_off = eng_off.stats()
+        toks_off = sum(len(r.output) for r in reqs_off)
+        rec["spec_off"] = {
+            "tokens_per_sec": round(toks_off / wall_off, 1)
+            if wall_off > 0 else 0.0,
+            "decode_rounds": st_off["decode_rounds"],
+            "decode_tokens_per_sec": round(
+                st_off["decoded_tokens"]
+                / (st_off["decode_wall_s"] or 1e-9), 1),
+        }
     if stats["paged_attention"] and peak:
         # the dense read this engine no longer performs, as utilization
         # (docs/KERNELS.md: the paged kernel's measured-win readout)
@@ -292,7 +391,7 @@ def main():
         from paddle_tpu.jit import exec_cache as _ec
 
         tel = {}
-        snap = _mon.snapshot()
+        snap = mon_snap if mon_snap is not None else _mon.snapshot()
         _ch = snap["histograms"].get("jit/compile_ms")
         tel["compile_ms_total"] = round(_ch["sum"], 1) if _ch else 0.0
         # top-level too (→ the persisted record's extra): perf_guard's
@@ -307,7 +406,8 @@ def main():
         if serv:
             tel["serving"] = serv
         if _ec.enabled():
-            tel["exec_cache"] = _ec.stats()
+            tel["exec_cache"] = ec_snap if ec_snap is not None \
+                else _ec.stats()
         rec["telemetry"] = tel
     except Exception:  # noqa: BLE001 — telemetry must not break the line
         pass

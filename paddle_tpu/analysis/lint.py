@@ -76,10 +76,13 @@ TRACE_SCOPE = (
 )
 
 # code whose outputs carry a byte-identity contract (deterministic
-# shard_plan.json, one locked tune table) — the PTL005 scope
+# shard_plan.json, one locked tune table, replayable scheduler event
+# logs — a nondeterministic drafter would break seeded serving-trace
+# replays) — the PTL005 scope
 DETERMINISM_SCOPE = (
     "paddle_tpu/autoshard/",
     "paddle_tpu/ops/pallas/",
+    "paddle_tpu/serving/speculative",
     "tools/shard_plan.py",
     "tools/kernel_search.py",
     "tools/flash_autotune.py",

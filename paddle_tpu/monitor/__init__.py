@@ -59,6 +59,7 @@ INSTRUMENTED_MODULES = (
     "paddle_tpu.io.prefetch",
     "paddle_tpu.hapi.model",
     "paddle_tpu.serving.engine",
+    "paddle_tpu.serving.speculative",
     "paddle_tpu.ops.pallas.search",
     "paddle_tpu.resilience.checkpoint_manager",
     "paddle_tpu.resilience.resume",
@@ -141,6 +142,19 @@ _c_serve_prefix_hit = _registry.counter("serving/prefix_hit_tokens")
 _c_serve_prefix_miss = _registry.counter("serving/prefix_miss_tokens")
 _g_serve_shared_blocks = _registry.gauge("serving/shared_blocks")
 _g_serve_cold_blocks = _registry.gauge("serving/cold_blocks")
+# speculative decoding (serving/engine.py verify rounds + the
+# serving/speculative.py drafter — docs/SERVING.md): decoded_tokens
+# accumulates across plain decode AND verify rounds so
+# tokens-per-decode-step = decoded / (decode_steps + verify_steps);
+# proposed/accepted are post-trim (accepted/proposed IS the accept
+# rate) and the per-round rate lands in the histogram
+_c_serve_verify = _registry.counter("serving/verify_steps")
+_c_serve_decoded = _registry.counter("serving/decoded_tokens")
+_c_spec_proposed = _registry.counter("serving/spec_proposed_tokens")
+_c_spec_accepted = _registry.counter("serving/spec_accepted_tokens")
+_c_spec_bonus = _registry.counter("serving/spec_bonus_tokens")
+_c_spec_draft_calls = _registry.counter("serving/spec_draft_calls")
+_h_spec_accept = _registry.histogram("serving/spec_accept_rate")
 # Pallas kernel engagement + the search harness (ops/pallas/search.py —
 # docs/KERNELS.md): every dispatch-time engagement decision is counted
 # (engaged vs composite fallback, with a per-family breakdown counter),
@@ -514,8 +528,39 @@ def on_serving_prefill(chunks: int) -> None:
 def on_serving_decode(lanes_active: int, free_blocks: int) -> None:
     """One shared decode step advanced ``lanes_active`` lanes."""
     _c_serve_decode.inc()
+    _c_serve_decoded.inc(lanes_active)
     _g_serve_lanes.set(lanes_active)
     _g_serve_free_blocks.set(free_blocks)
+
+
+def on_serving_verify(lanes_active: int, free_blocks: int,
+                      emitted_tokens: int) -> None:
+    """One speculative verify step scored ``lanes_active`` lanes and
+    emitted ``emitted_tokens`` (accepted prefixes + bonus tokens —
+    ``>= lanes_active`` unless finishes truncated a prefix)."""
+    _c_serve_verify.inc()
+    _c_serve_decoded.inc(emitted_tokens)
+    _g_serve_lanes.set(lanes_active)
+    _g_serve_free_blocks.set(free_blocks)
+
+
+def on_serving_spec(proposed: int, accepted: int, bonus: int) -> None:
+    """One verify round's speculation account (post-trim draft tokens
+    scored / accepted, bonus tokens emitted); the per-round accept rate
+    feeds the ``serving/spec_accept_rate`` histogram."""
+    if proposed:
+        _c_spec_proposed.inc(proposed)
+        _h_spec_accept.observe(accepted / proposed)
+    if accepted:
+        _c_spec_accepted.inc(accepted)
+    if bonus:
+        _c_spec_bonus.inc(bonus)
+
+
+def on_spec_draft_call() -> None:
+    """The drafter ran one propose() pass for a lane
+    (serving/speculative.py)."""
+    _c_spec_draft_calls.inc()
 
 
 def on_serving_prefix(hit_tokens: int, miss_tokens: int,

@@ -20,9 +20,10 @@ from .kv_cache import BlockPool, blocks_needed, prefix_keys  # noqa: F401
 from .scheduler import (  # noqa: F401
     FINISHED, RUNNING, WAITING, FCFSScheduler, Request,
 )
+from .speculative import Drafter, NgramDrafter  # noqa: F401
 
 __all__ = [
     "ServingConfig", "ServingEngine", "BlockPool", "blocks_needed",
     "prefix_keys", "FCFSScheduler", "Request", "WAITING", "RUNNING",
-    "FINISHED",
+    "FINISHED", "Drafter", "NgramDrafter",
 ]

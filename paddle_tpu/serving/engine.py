@@ -3,7 +3,7 @@
 The millions-of-users path (ROADMAP item 1): requests of unequal prompt
 and output lengths share ONE compiled decode step — per-lane block
 tables and valid lengths are runtime *data*, so admission, eviction, and
-growth never retrace. Two compiled programs serve the whole lifetime:
+growth never retrace. Three compiled programs serve the whole lifetime:
 
 - **prefill chunk** ``[1, C]``: one lane's context enters the pool C
   tokens at a time (padded tail chunks write only below the context
@@ -16,8 +16,21 @@ growth never retrace. Two compiled programs serve the whole lifetime:
 - **decode step** ``[L, 1]``: every occupied lane advances one token —
   write the pending token's K/V at ``pool_len``, attend over the lane's
   gathered blocks masked to ``slot <= pos``, greedy-sample the next.
+- **verify step** ``[L, k+1]`` (speculative decoding, ``PT_SERVE_SPEC``
+  — docs/SERVING.md): when the host-side drafter
+  (:mod:`.speculative`) proposed tokens for any lane, every lane's
+  pending token plus its (possibly empty) draft is scored in one pass;
+  the host accepts each lane's longest prefix matching the program's
+  own greedy argmaxes, plus one bonus token. Draft length is DATA:
+  short/empty drafts pad up to ``k`` with writes redirected to the
+  null block (``wlimit``), so a no-draft lane verifies exactly one
+  token and churn in draft lengths never retraces. Rejected positions
+  roll back by rewinding ``pool_len`` only — the tail blocks are
+  lane-private (shared prefix blocks are full + frozen), so
+  over-written K/V was never shared and the next accepted write simply
+  overwrites it.
 
-Both compile through :func:`paddle_tpu.jit.exec_cache.get_or_compile`
+All three compile through :func:`paddle_tpu.jit.exec_cache.get_or_compile`
 (keyed on generation config, param avals, pool geometry, lane count and
 mesh), so a warm ``PT_EXEC_CACHE`` server start pays zero fresh XLA
 compiles. The attention/RoPE/MLP math reuses
@@ -58,6 +71,9 @@ from ..models.generation import (
 from ..monitor import _register as _monitor_register
 from .kv_cache import BlockPool, blocks_needed
 from .scheduler import RUNNING, FCFSScheduler, Request
+from .speculative import NgramDrafter
+
+_EMPTY_DRAFT = np.zeros((0,), np.int32)
 
 __all__ = ["ServingConfig", "ServingEngine"]
 
@@ -98,11 +114,17 @@ class ServingConfig:
       with already-cached full blocks (shared system prompts, few-shot
       headers, recompute re-admissions) skip prefilling them
       (docs/SERVING.md). ``0`` restores the share-nothing pool.
+    - ``spec`` (``PT_SERVE_SPEC``, auto): speculative decoding —
+      ``"auto"`` engages it for the greedy path (which is all the
+      engine decodes today), ``0``/``off`` disables. ``spec_k``
+      (``PT_SERVE_SPEC_K``, 4) caps tokens proposed per lane per
+      round; ``spec_k=0`` degenerates to plain decode (no verify
+      program is compiled). docs/SERVING.md.
     """
 
     def __init__(self, max_lanes=None, block_size=None, num_blocks=None,
                  prefill_chunk=None, max_seq_len=None, int8_weights=None,
-                 paged=None, prefix_cache=None):
+                 paged=None, prefix_cache=None, spec=None, spec_k=None):
         self.max_lanes = max_lanes if max_lanes is not None \
             else _env_int("PT_SERVE_LANES", 8)
         self.block_size = block_size if block_size is not None \
@@ -128,6 +150,17 @@ class ServingConfig:
             prefix_cache = os.environ.get(
                 "PT_SERVE_PREFIX_CACHE", "1") not in ("0", "off")
         self.prefix_cache = bool(prefix_cache)
+        if spec is None:
+            spec = os.environ.get("PT_SERVE_SPEC", "auto")
+        # "auto" == on: the engine is greedy-only, and greedy is exactly
+        # where verification preserves token identity for free
+        self.spec = spec not in (False, 0, "0", "off")
+        self.spec_k = spec_k if spec_k is not None \
+            else _env_int("PT_SERVE_SPEC_K", 4)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {self.spec_k}")
+        if self.spec_k == 0:
+            self.spec = False  # k=0 IS plain decode; skip the program
         for name in ("max_lanes", "block_size", "prefill_chunk"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1, "
@@ -265,6 +298,28 @@ def _decode_step(params, kpool, vpool, tables, cur_len, last_tok, *,
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), kpool, vpool
 
 
+def _verify_step(params, kpool, vpool, tables, cur_len, toks, wlimit, *,
+                 cfg):
+    """The speculative verify step: ``toks`` [L, k+1] holds each lane's
+    pending token (column 0) followed by its draft, at absolute
+    positions ``cur_len + j``. Writes at positions >= ``wlimit[b]`` (=
+    ``cur_len + 1 + draft_len``: the pad tail of a short/empty draft,
+    idle lanes) go to the null block, exactly like a prefill chunk's pad
+    tail — draft length is data, never shape. Write-then-attend per
+    layer means draft token ``j`` attends over slots ``<= cur_len + j``,
+    the same causal view plain decode would give it, so the returned
+    greedy argmaxes [L, k+1] are the tokens the decode step WOULD emit
+    after each draft prefix — the host's acceptance rule compares
+    drafts against them directly."""
+    S = toks.shape[1]
+    pos = cur_len[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    x, kpool, vpool = _pool_forward(
+        params, kpool, vpool, tables, toks, pos, wlimit, cfg)
+    x = _rms(x, params["norm"], cfg.rms_norm_eps)
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), kpool, vpool
+
+
 # -- the engine ---------------------------------------------------------------
 
 class ServingEngine:
@@ -273,7 +328,8 @@ class ServingEngine:
     module docstring for the execution model and docs/SERVING.md for
     the operational guide."""
 
-    def __init__(self, model, config: ServingConfig | None = None):
+    def __init__(self, model, config: ServingConfig | None = None,
+                 drafter=None):
         if getattr(model.config, "moe_num_experts", 0) > 1:
             from ..framework.errors import UnimplementedError
 
@@ -311,6 +367,13 @@ class ServingEngine:
         self._finished: dict = {}
         self._prefill_exec = None
         self._decode_exec = None
+        self._verify_exec = None
+        # speculative decoding (docs/SERVING.md): active iff configured
+        # on AND k > 0; the drafter slot is pluggable (a draft model
+        # would implement Drafter.propose) — default prompt-lookup
+        self.spec_active = bool(cfg.spec and cfg.spec_k > 0)
+        self.drafter = drafter if drafter is not None \
+            else (NgramDrafter() if self.spec_active else None)
         self.paged_active = self._resolve_paged()
         # always-on plain-int accounting (the serving bench's source of
         # truth; independent of the monitor like exec_cache._stats).
@@ -321,9 +384,16 @@ class ServingEngine:
         # hit = tokens served by acquired shared blocks (no compute),
         # miss = tokens actually pushed through the prefill program —
         # the bench's prefix_hit_rate numerator/denominator.
+        # spec_{proposed,accepted}_tokens are post-trim (what the verify
+        # step actually speculated) so accepted/proposed IS the accept
+        # rate; bonus counts the +1 token a drafted lane's verification
+        # emitted on top of its accepted prefix.
         self.counters = {
             "admits": 0, "finished": 0, "preemptions": 0,
-            "prefill_chunks": 0, "decode_steps": 0, "decoded_tokens": 0,
+            "prefill_chunks": 0, "decode_steps": 0, "verify_steps": 0,
+            "decoded_tokens": 0,
+            "spec_proposed_tokens": 0, "spec_accepted_tokens": 0,
+            "spec_bonus_tokens": 0,
             "prefix_hit_tokens": 0, "prefix_miss_tokens": 0,
             "kv_read_tokens": 0, "kv_dense_read_tokens": 0,
             "decode_wall_s": 0.0,
@@ -435,6 +505,18 @@ class ServingEngine:
                 jax.ShapeDtypeStruct((1, C), i32),
                 scal, scal, scal, cfg=self._gcfg),
             label="serving/prefill")
+        if self.spec_active:
+            S = self.config.spec_k + 1
+            ver = jax.jit(_verify_step, **kw)
+            self._verify_exec = exec_cache.get_or_compile(
+                key("serving_verify", lanes=L, m=M, k=self.config.spec_k),
+                lambda: ver.lower(
+                    self._params, pspec, pspec,
+                    jax.ShapeDtypeStruct((L, M), i32),
+                    jax.ShapeDtypeStruct((L,), i32),
+                    jax.ShapeDtypeStruct((L, S), i32),
+                    jax.ShapeDtypeStruct((L,), i32), cfg=self._gcfg),
+                label="serving/verify")
 
     # -- the step loop -------------------------------------------------------
 
@@ -553,6 +635,123 @@ class ServingEngine:
         act = sched.running()
         if not act:
             return
+        drafts = self._draft(act) if self.spec_active else {}
+        if any(d.size for d in drafts.values()):
+            self._verify_round(act, drafts)
+        else:
+            # no lane proposed anything: today's [L, 1] decode program
+            # (and the k=0 / spec-off path, byte for byte)
+            self._plain_decode_round(act)
+
+    def _draft(self, act) -> dict:
+        """Per-lane draft proposals for this round, keyed by ``id(req)``
+        — trimmed to the request's remaining-token budget (drafting the
+        final token is pointless: its verification could emit past
+        ``max_new_tokens``) and to the blocks the pool can back WITHOUT
+        preempting anyone (`scheduler.grow_for_draft`): speculation is
+        opportunistic, it never evicts a runner."""
+        k = self.config.spec_k
+        drafts = {}
+        for req in act:
+            cap = min(k, req.max_new_tokens - len(req.output) - 1)
+            d = _EMPTY_DRAFT
+            if cap > 0:
+                ctx = np.concatenate(
+                    [req.prompt, np.asarray(req.output, np.int32)])
+                d = np.asarray(self.drafter.propose(ctx, cap),
+                               np.int32).reshape(-1)[:cap]
+                if d.size:
+                    d = d[:self.scheduler.grow_for_draft(
+                        req, int(d.size))]
+            drafts[id(req)] = d
+        return drafts
+
+    def _verify_round(self, act, drafts) -> None:
+        """One [L, k+1] verify step for every occupied lane: score the
+        pending token + draft, accept each lane's longest prefix that
+        matches the program's own greedy picks plus one bonus token.
+        Rejected positions roll back by REWINDING ``pool_len`` only:
+        their K/V sits above the lane's valid length in lane-private
+        blocks (masked out of every later attend) until the next
+        accepted write overwrites it."""
+        L, M = self.config.max_lanes, self.blocks_per_lane
+        K = self.config.spec_k
+        tables = np.zeros((L, M), np.int32)
+        cur = np.zeros((L,), np.int32)
+        toks = np.zeros((L, K + 1), np.int32)
+        wlim = np.zeros((L,), np.int32)
+        for req in act:
+            d = drafts.get(id(req), _EMPTY_DRAFT)
+            tables[req.lane, :len(req.blocks)] = req.blocks
+            cur[req.lane] = req.pool_len
+            toks[req.lane, 0] = req.output[-1]
+            if d.size:
+                toks[req.lane, 1:1 + d.size] = d
+            wlim[req.lane] = req.pool_len + 1 + d.size
+        t0 = time.perf_counter()
+        pred, self._kpool, self._vpool = self._verify_exec(
+            self._params, self._kpool, self._vpool, jnp.asarray(tables),
+            jnp.asarray(cur), jnp.asarray(toks), jnp.asarray(wlim))
+        preds = np.asarray(pred)  # the round's ONE host sync
+        now = time.perf_counter()
+        c = self.counters
+        c["decode_wall_s"] += now - t0
+        c["verify_steps"] += 1
+        proposed = accepted = bonus = emitted = 0
+        for req in act:
+            d = drafts.get(id(req), _EMPTY_DRAFT)
+            n = int(d.size)
+            row = preds[req.lane]
+            a = 0
+            while a < n and row[a] == d[a]:
+                a += 1
+            proposed += n
+            accepted += a
+            if n:  # optional feedback hook (Drafter.observe)
+                observe = getattr(self.drafter, "observe", None)
+                if observe is not None:
+                    observe(d, a)
+            # emit the a accepted drafts (== row[:a]) + the bonus token
+            # row[a]; stop early when max_new_tokens/eos finishes the
+            # request mid-prefix (the cap in _draft makes overshoot
+            # impossible — a+1 <= remaining)
+            got = 0
+            for j in range(a + 1):
+                req.pool_len += 1
+                got += 1
+                self._emit(req, int(row[j]), now)
+                if req.finished:
+                    break
+            if n and got == a + 1:
+                bonus += 1
+            emitted += got
+            # rejected-draft blocks go straight back to the pool
+            # (no-op for finished lanes, whose blocks are already
+            # freed): a failed speculation must leave no allocation
+            # pressure behind to preempt someone later
+            if req.state == RUNNING:
+                self.scheduler.release_draft_blocks(req)
+        c["decoded_tokens"] += emitted
+        c["spec_proposed_tokens"] += proposed
+        c["spec_accepted_tokens"] += accepted
+        c["spec_bonus_tokens"] += bonus
+        # byte-model inputs (see _plain_decode_round): a verify round
+        # performs the DENSE gather regardless of the paged engagement
+        # (s > 1 — no paged verify kernel exists), so both byte models
+        # bill the full table here; the paged-vs-dense delta the bench
+        # reports comes from plain decode rounds alone, which keeps the
+        # "what the chip actually moves" readout honest for spec-on
+        # paged engines
+        dense_slots = len(act) * M * self.config.block_size
+        c["kv_read_tokens"] += dense_slots
+        c["kv_dense_read_tokens"] += dense_slots
+        m = _monitor
+        if m is not None:
+            m.on_serving_verify(len(act), self.scheduler.pool.allocatable,
+                                emitted)
+            m.on_serving_spec(proposed, accepted, bonus)
+
+    def _plain_decode_round(self, act) -> None:
         L, M = self.config.max_lanes, self.blocks_per_lane
         tables = np.zeros((L, M), np.int32)
         cur = np.zeros((L,), np.int32)
@@ -581,7 +780,7 @@ class ServingEngine:
             # allocatable = free list + revivable cold LRU — the
             # pre-sharing meaning of "free" (cold blocks are spare
             # capacity, not occupancy)
-            m.on_serving_decode(len(act), sched.pool.allocatable)
+            m.on_serving_decode(len(act), self.scheduler.pool.allocatable)
         for req in act:
             req.pool_len += 1
             self._emit(req, int(toks[req.lane]), now)
@@ -614,6 +813,10 @@ class ServingEngine:
         """Plain-int account of the engine's lifetime (always on)."""
         out = dict(self.counters)
         out.update(
+            decode_rounds=(self.counters["decode_steps"]
+                           + self.counters["verify_steps"]),
+            spec=self.spec_active,
+            spec_k=self.config.spec_k if self.spec_active else 0,
             lanes=self.config.max_lanes,
             block_size=self.config.block_size,
             num_blocks=self.scheduler.pool.num_blocks,

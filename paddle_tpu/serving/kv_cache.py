@@ -36,6 +36,13 @@ of), reclaim never touches a block with refs > 0, and
 (tests/test_serving.py asserts it across admission/preemption/sharing
 churn; without publishing, cold is empty and the identity reduces to
 the original ``free + used == capacity``).
+
+The tail-block privacy rule (only FULL blocks are ever published) is
+also what makes speculative decoding's rollback free: the verify step
+(``engine._verify_step``) writes draft K/V at positions past
+``pool_len`` — always in the lane's private tail blocks — so rejecting
+a draft is a ``pool_len`` rewind with no copy and no shared-state
+repair (docs/SERVING.md speculative section).
 """
 from __future__ import annotations
 
@@ -144,16 +151,20 @@ class BlockPool:
     def refcount(self, block: int) -> int:
         return len(self._holders.get(block, ()))
 
-    def alloc(self, n: int, owner) -> list | None:
+    def alloc(self, n: int, owner, reclaim_cold: bool = True) -> \
+            list | None:
         """Allocate ``n`` PRIVATE blocks for ``owner``; None when the
         pool cannot satisfy the request (caller decides to wait or
         preempt — allocation itself never evicts a lane). The free list
         serves first; when it runs dry, cold blocks are reclaimed
         oldest-release-first, their index entries evicted. Blocks with
-        refs > 0 are never touched."""
+        refs > 0 are never touched. ``reclaim_cold=False`` draws from
+        the free list ONLY — speculative draft growth must never evict
+        a cached prefix to back a guess
+        (``scheduler.grow_for_draft``)."""
         if n < 0:
             raise ValueError(f"alloc({n})")
-        if n > self.allocatable:
+        if n > (self.allocatable if reclaim_cold else len(self._free)):
             return None
         blocks = []
         for _ in range(n):

@@ -19,7 +19,12 @@ Policy (the Orca/vLLM iteration-level discipline, recompute variant):
   :meth:`ensure_capacity` allocates the next block, and when the pool is
   dry it preempts the MOST RECENTLY admitted runner (never an older one
   — the oldest request always progresses, which is the no-starvation
-  argument). A preempted request keeps its generated tokens, frees its
+  argument). Speculative draft positions grow through
+  :meth:`grow_for_draft` instead, which NEVER preempts: a dry pool
+  trims the draft, and :meth:`release_draft_blocks` returns the unused
+  tail after every verify round — so speculation can only add
+  throughput, never evict a runner or squat on capacity (the
+  no-starvation argument is untouched). A preempted request keeps its generated tokens, frees its
   blocks, and re-queues at the FRONT of the waiting deque in arrival
   order; on re-admission the engine re-prefills prompt+output (greedy
   decode is deterministic per program, so recompute continues exactly —
@@ -286,6 +291,62 @@ class FCFSScheduler:
                 self.preempt(req, on_preempt)
                 return False
         return True
+
+    def grow_for_draft(self, req: Request, n: int) -> int:
+        """Best-effort block growth for ``n`` speculative draft
+        positions beyond the next decode write (which
+        :meth:`ensure_capacity` already covered). Returns how many
+        draft positions are actually backed (0..n) after clamping to
+        the lane's table / ``max_seq_len`` ceiling and to what the
+        FREE LIST can hand out RIGHT NOW: speculation is opportunistic,
+        so unlike ensure_capacity this never preempts a runner (a dry
+        pool just trims the draft) and never reclaims a cold cached
+        prefix (``reclaim_cold=False`` — evicting an index entry to
+        back a guess would trade real prefill savings for speculative
+        ones). The engine returns the unused tail via
+        :meth:`release_draft_blocks` after every verify round. Engine
+        calls walk requests in FCFS order, so older lanes claim draft
+        headroom first — deterministic, like every other allocation
+        decision."""
+        if n <= 0:
+            return 0
+        bs = self.pool.block_size
+        ceiling = min(self.blocks_per_lane * bs, self.max_seq_len)
+        n = min(n, ceiling - (req.pool_len + 1))
+        if n <= 0:
+            return 0
+        need = blocks_needed(req.pool_len + 1 + n, bs)
+        grown = 0
+        while len(req.blocks) < need:
+            # free list only: a draft must never reclaim a COLD cached
+            # prefix (evicting its index entry forever) to back a guess
+            got = self.pool.alloc(1, req, reclaim_cold=False)
+            if got is None:
+                break
+            req.blocks.extend(got)
+            grown += 1
+        if grown:
+            self.events.append(("draft_grow", req.request_id, grown))
+        return max(0, min(n, len(req.blocks) * bs - req.pool_len - 1))
+
+    def release_draft_blocks(self, req: Request) -> int:
+        """Return a lane's unused speculative tail blocks — anything
+        past the next decode write — to the pool. The engine calls this
+        after a verify round rewound ``pool_len`` past rejected drafts,
+        which is what makes :meth:`grow_for_draft`'s no-harm contract
+        real: a rejected draft leaves NO allocation pressure behind, so
+        speculation can never cause a preemption plain decode wouldn't
+        have. Tail blocks past the context are always lane-private
+        (publish covers only full context blocks), so the free is a
+        plain refcount-1 release. Returns the blocks freed."""
+        need = blocks_needed(req.pool_len + 1, self.pool.block_size)
+        extra = req.blocks[need:]
+        if extra:
+            self.pool.free(extra, req)
+            del req.blocks[need:]
+            self.events.append(
+                ("draft_release", req.request_id, len(extra)))
+        return len(extra)
 
     def preempt(self, req: Request, on_preempt=None) -> None:
         """Evict a runner: free its blocks, requeue at the waiting FRONT

@@ -245,6 +245,71 @@ class TestEvaluate:
         assert v3["ok"]
         assert not any(c["name"] == "prefix_hit" for c in v3["checks"])
 
+    def test_flags_accept_rate_collapse(self, guard):
+        # speculative gate (ISSUE 14): the repetitive trace's accept
+        # rate dropped 50% vs last-good — the drafter stopped matching
+        base = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                "backend": "tpu", "extra": {"accept_rate": 0.6}}
+        fresh = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                 "unit": "tokens/s", "accept_rate": 0.3}
+        v = guard.evaluate(fresh, base, hardware=True)
+        assert not v["ok"]
+        assert any(c["name"] == "accept_rate" and not c["ok"]
+                   for c in v["checks"])
+        # a drop within the 25% default passes
+        ok = dict(fresh, accept_rate=0.5)
+        v2 = guard.evaluate(ok, base, hardware=True)
+        assert v2["ok"]
+        assert any(c["name"] == "accept_rate" and c["ok"]
+                   for c in v2["checks"])
+
+    def test_accept_gate_skips_smoke_zero_and_missing(self, guard):
+        base = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                "backend": "tpu", "extra": {"accept_rate": 0.6}}
+        # cpu smoke: skipped with the other hardware comparisons
+        smoke = {"metric": "serving_tokens_per_sec", "value": 50.0,
+                 "unit": "tokens/s", "accept_rate": 0.0,
+                 "note": "cpu smoke mode; not a TPU number"}
+        v = guard.evaluate(smoke, base)
+        assert v["ok"]
+        assert not any(c["name"] == "accept_rate" for c in v["checks"])
+        # a 0-rate baseline pins nothing
+        zero_base = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                     "backend": "tpu", "extra": {"accept_rate": 0.0}}
+        hw = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+              "unit": "tokens/s", "accept_rate": 0.0}
+        v2 = guard.evaluate(hw, zero_base, hardware=True)
+        assert v2["ok"]
+        assert not any(c["name"] == "accept_rate" for c in v2["checks"])
+        # spec-off fresh lines never carry the field: gate absent
+        off = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+               "unit": "tokens/s"}
+        v3 = guard.evaluate(off, base, hardware=True)
+        assert v3["ok"]
+        assert not any(c["name"] == "accept_rate" for c in v3["checks"])
+
+    def test_spec_config_keys_absence_means_plain_decode(
+            self, guard, tmp_path):
+        # a pre-speculation serving record (no spec/spec_k in extra) WAS
+        # a plain-decode run: it must stay the baseline for a fresh
+        # spec-off line but never for a spec-on line (a different
+        # execution schedule must not cross-judge tokens/s or TTFT)
+        path = str(tmp_path / "store.json")
+        with open(path, "w") as f:
+            json.dump({"records": [
+                {"metric": "serving_tokens_per_sec", "value": 900.0,
+                 "unit": "tokens/s", "backend": "tpu",
+                 "extra": {"requests": 32}}]}, f)
+        off = {"metric": "serving_tokens_per_sec", "value": 880.0,
+               "requests": 32, "spec": False, "spec_k": 0}
+        on = dict(off, spec=True, spec_k=4)
+        assert guard.last_good(
+            path, "serving_tokens_per_sec",
+            match=guard.config_match(off)) is not None
+        assert guard.last_good(
+            path, "serving_tokens_per_sec",
+            match=guard.config_match(on)) is None
+
     def test_ttft_gate_skips_cpu_smoke_and_no_baseline(self, guard):
         fresh = {"metric": "serving_tokens_per_sec", "value": 50.0,
                  "unit": "tokens/s", "ttft_ms_p99": 9000.0,

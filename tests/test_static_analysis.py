@@ -233,6 +233,19 @@ class TestPTL005Nondeterminism:
                "    return jax.random.normal(jax.random.PRNGKey(0), (4,))\n")
         assert lint.lint_text("paddle_tpu/ops/pallas/fake.py", src) == []
 
+    def test_speculative_drafter_is_in_scope(self):
+        # ISSUE 14: a nondeterministic drafter would break seeded
+        # serving-trace replay byte-identity — the speculative module
+        # lives under the same PTL005 contract as the planner/tuner
+        src = ("import numpy as np\n"
+               "def propose(tokens, k):\n"
+               "    return np.random.randint(0, 100, (k,))\n")
+        fs = lint.lint_text("paddle_tpu/serving/speculative.py", src)
+        assert _rules(fs) == ["PTL005"]
+        # the rest of serving/ (engine scheduling uses perf_counter
+        # timestamps legitimately) stays out of the determinism scope
+        assert lint.lint_text("paddle_tpu/serving/engine.py", src) == []
+
 
 class TestEscapeHatch:
     def test_line_disable(self):

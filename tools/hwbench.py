@@ -47,16 +47,26 @@ BENCHES = [
      1800, {"PT_DECODE_INT8": "1"}),
     # continuous-batching serving runtime (docs/SERVING.md): smoke-sized
     # Poisson trace, timeboxed — tokens/s + p50/p99 TTFT vs the decode
-    # HBM roofline; the guard's --ttft-growth gate judges the tail
+    # HBM roofline; the guard's --ttft-growth gate judges the tail.
+    # Spec pinned off: these two rows keep judging against their
+    # pre-speculation baselines (spec/spec_k are guard config keys)
     ("serving", [sys.executable, "benchmarks/serving_bench.py"], 1800,
-     {"PT_SERVE_BENCH_REQUESTS": "32"}),
+     {"PT_SERVE_BENCH_REQUESTS": "32", "PT_SERVE_SPEC": "0"}),
     # prefix-cache KV sharing (docs/SERVING.md): the same Poisson trace
     # with every prompt opening on one 64-token shared system prompt —
     # persists prefix_hit_rate + the cached-vs-cold TTFT A/B next to
     # the plain serving row; perf_guard --prefix-hit-drop pins the rate
     ("serving_prefix", [sys.executable, "benchmarks/serving_bench.py"],
      1800, {"PT_SERVE_BENCH_REQUESTS": "32",
-            "PT_SERVE_BENCH_SHARED": "64"}),
+            "PT_SERVE_BENCH_SHARED": "64", "PT_SERVE_SPEC": "0"}),
+    # speculative decoding (docs/SERVING.md): the repetition-friendly
+    # trace (tiled-motif prompts, spec_k=4) with the embedded spec-off
+    # replay — persists accept_rate + tokens_per_decode_step and the
+    # decode-rounds A/B; perf_guard --accept-drop pins the accept rate
+    ("serving_spec", [sys.executable, "benchmarks/serving_bench.py"],
+     1800, {"PT_SERVE_BENCH_REQUESTS": "32",
+            "PT_SERVE_BENCH_SPEC_K": "4",
+            "PT_SERVE_BENCH_SPEC_AB": "1"}),
     # resilience soak (docs/RESILIENCE.md): fault-injected (crash +
     # poisoned batch) run through launcher relaunch + resume + NaN skip,
     # gated on loss slope / memory growth / the save-cost guard; the

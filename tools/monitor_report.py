@@ -203,15 +203,40 @@ def render_serving(out, totals=None, hists=None, gauges=None, source=""):
                f"(requeued {totals.get('serving/requeues', 0)})")
     pre = totals.get("serving/prefill_steps", 0)
     dec = totals.get("serving/decode_steps", 0)
+    ver = totals.get("serving/verify_steps", 0)
     line = f"prefill chunks {pre}   decode steps {dec}"
-    if dec:
-        line += f"   ({pre / dec:.2f} prefill/decode ratio)"
+    if ver:
+        line += f"   verify steps {ver}"
+    if dec or ver:
+        line += f"   ({pre / (dec + ver):.2f} prefill/decode ratio)"
     out.append(line)
     hit = totals.get("serving/prefix_hit_tokens", 0)
     miss = totals.get("serving/prefix_miss_tokens", 0)
     if hit or miss:
         out.append(f"prefix cache: {hit} cached + {miss} prefilled "
                    f"context tokens ({hit / (hit + miss):.0%} hit rate)")
+    # speculative decoding (serving/speculative.py — docs/SERVING.md):
+    # accept rate over proposed draft tokens + the tokens-per-round
+    # multiplier the verify step bought
+    prop = totals.get("serving/spec_proposed_tokens", 0)
+    acc = totals.get("serving/spec_accepted_tokens", 0)
+    bon = totals.get("serving/spec_bonus_tokens", 0)
+    if prop or ver:
+        line = f"speculative: {prop} proposed"
+        if prop:
+            line += f"   {acc} accepted ({acc / prop:.0%} accept rate)"
+        line += f"   {bon} bonus"
+        out.append(line)
+        decoded = totals.get("serving/decoded_tokens", 0)
+        if decoded and (dec + ver):
+            out.append(f"tokens per decode step: "
+                       f"{decoded / (dec + ver):.2f} "
+                       f"({decoded} tokens / {dec + ver} rounds)")
+        h = (hists or {}).get("serving/spec_accept_rate")
+        if h:
+            out.append(f"  accept rate per round: p50 {h['p50']}   "
+                       f"p95 {h['p95']}   max {h['max']} "
+                       f"({h['count']} round(s))")
     lanes = gauges.get("serving/lanes_occupied")
     blocks = gauges.get("serving/free_blocks")
     shared = gauges.get("serving/shared_blocks")

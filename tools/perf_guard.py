@@ -36,6 +36,12 @@ and exits nonzero with a human-readable verdict when the run regressed:
   ``serving/kv_cache.py``'s prefix index) — the cached-TTFT win
   evaporated even when this run's tail happens to pass. Skipped when
   either side lacks the field or the baseline rate is 0
+- serving speculative ``accept_rate`` below last-good by more than
+  ``--accept-drop`` (25%): the drafter stopped matching the workload
+  (``serving/speculative.py`` regression or a verify-step acceptance
+  bug) — the tokens-per-decode-step multiplier evaporated. Spec-off
+  lines never carry the field, so they skip; ``spec``/``spec_k`` are
+  sweep-config keys, so spec and plain serving rows never cross-judge
 - a changed sharding plan (``--plan-drift``): a fresh hardware line
   whose ``shard_plan`` sub-object (from ``tools/shard_plan.py``) names
   a different (dp, mp, batch) than the last-good record's
@@ -109,6 +115,15 @@ DEFAULT_THRESHOLDS = {
     # side lacks the field or the baseline rate is 0 (a trace with no
     # shared prefix pins nothing), and on CPU smokes with the rest
     "prefix_hit_drop": 0.25,
+    # speculative-decoding gate: fractional drop of serving_bench's
+    # accept_rate (accepted/proposed draft tokens) vs the last-good
+    # record before the check fails — a collapsed accept rate means the
+    # drafter stopped matching the workload (drafter regression, trace
+    # change, or a verify-step acceptance bug) and the
+    # tokens-per-decode-step win silently evaporated. Skips when either
+    # side lacks the field (spec-off lines never carry it) or the
+    # baseline rate is 0, and on CPU smokes with the rest
+    "accept_drop": 0.25,
     # resilience gate: fractional growth of the blocking checkpoint-save
     # cost (tools/soak.py lines carry ckpt_save_ms_p50 — the quiesce +
     # host-snapshot time the cadence planner budgets against) vs the
@@ -186,14 +201,18 @@ def load_fresh(path: str) -> dict:
 CONFIG_KEYS = ("batch", "seq", "ce_chunk",
                "requests", "arrival_rate_per_s", "lanes", "block_size",
                "int8_weights", "devices",
-               "shared_prefix_tokens", "prefix_cache")
+               "shared_prefix_tokens", "prefix_cache", "spec", "spec_k")
 
 # keys whose ABSENCE from an old record means the knob's default, not a
 # wildcard: records persisted before the prefix cache existed WERE
 # shared=0 / cache-on runs, so a fresh shared-prefix line must not
 # judge itself against them (a 64-token-longer-prompt workload), while
-# a fresh plain line keeps its pre-PR baselines
-CONFIG_KEY_DEFAULTS = {"shared_prefix_tokens": 0, "prefix_cache": True}
+# a fresh plain line keeps its pre-PR baselines. Likewise records from
+# before speculative decoding were plain-decode (spec-off) runs: a
+# fresh spec-on line gets no pre-spec baseline, a fresh spec-off line
+# keeps its history
+CONFIG_KEY_DEFAULTS = {"shared_prefix_tokens": 0, "prefix_cache": True,
+                       "spec": False, "spec_k": 0}
 
 
 def config_match(fresh: dict) -> dict:
@@ -363,6 +382,18 @@ def evaluate(fresh: dict, baseline: dict | None, thresholds: dict | None
                   + (" — prefix sharing collapsed (chain-key churn, a "
                      "publish regression, or cold-LRU thrash?)"
                      if pdrop > th["prefix_hit_drop"] else ""))
+        ar = fresh.get("accept_rate")
+        base_ar = (baseline.get("extra") or {}).get("accept_rate")
+        if ar is not None and base_ar:
+            adrop = 1.0 - ar / base_ar
+            check("accept_rate", adrop <= th["accept_drop"],
+                  f"accept rate {ar:.3f} vs last-good {base_ar:.3f} "
+                  f"({'-' if adrop > 0 else '+'}{abs(adrop) * 100:.1f}%,"
+                  f" max drop {th['accept_drop'] * 100:.0f}%)"
+                  + (" — speculation stopped accepting (drafter "
+                     "regression, workload change, or a verify-step "
+                     "acceptance bug?)"
+                     if adrop > th["accept_drop"] else ""))
         sms = fresh.get("ckpt_save_ms_p50")
         base_sms = (baseline.get("extra") or {}).get("ckpt_save_ms_p50")
         if sms is not None and base_sms:
@@ -515,6 +546,12 @@ def main(argv=None) -> int:
                     help="max fractional prefix_hit_rate drop vs "
                          "last-good for serving bench lines (default "
                          "0.25; skipped when the baseline rate is 0)")
+    ap.add_argument("--accept-drop", type=float,
+                    default=DEFAULT_THRESHOLDS["accept_drop"],
+                    help="max fractional speculative accept_rate drop "
+                         "vs last-good for serving bench lines (default "
+                         "0.25; skipped when either side lacks the "
+                         "field or the baseline rate is 0)")
     ap.add_argument("--save-cost-growth", type=float,
                     default=DEFAULT_THRESHOLDS["save_cost_growth"],
                     help="max fractional checkpoint-save blocking-cost "
@@ -570,6 +607,7 @@ def main(argv=None) -> int:
                     "compile_slack_ms": args.compile_slack_ms,
                     "ttft_growth": args.ttft_growth,
                     "prefix_hit_drop": args.prefix_hit_drop,
+                    "accept_drop": args.accept_drop,
                     "save_cost_growth": args.save_cost_growth,
                     "save_cost_slack_ms": args.save_cost_slack_ms,
                     "plan_drift": args.plan_drift,
