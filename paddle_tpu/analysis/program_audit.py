@@ -21,6 +21,12 @@ actually shipped are auditable at the one compile chokepoint
 - **PA004 retrace_budget** — one compile site (label) accumulating more
   than ``PT_AUDIT_RETRACE_BUDGET`` (8) distinct executables: signature
   churn is paying an XLA compile per step somewhere.
+- **PA005 missing_pp_handoff** — a train-step program on a pp>1 mesh
+  with ZERO collective-permutes crossing the pp axis: the planned
+  pipeline's stage handoff was silently dropped and every "stage"
+  computes the whole model (the PA001 sibling for the pipeline axis —
+  ISSUE 15; the ZeRO-style head/tail all-gathers over pp do not count,
+  only the ppermute ring does).
 
 Enablement: ``PT_PROGRAM_AUDIT=1`` (or :func:`enable`) installs this
 module into ``exec_cache._audit`` — the same None-slot pattern as the
@@ -61,6 +67,7 @@ RULES = {
     "PA002": "dropped_donation",
     "PA003": "host_callback",
     "PA004": "retrace_budget",
+    "PA005": "missing_pp_handoff",
 }
 
 # distinct executables one compile site (label) may accumulate before
@@ -137,20 +144,24 @@ def _finding(rule: str, detail: str, label=None) -> dict:
 # -- the pure HLO checks (unit-testable on captured fixtures) ----------------
 
 def audit_hlo(hlo_text: str, *, degrees: dict | None = None,
-              expect_dp: bool = False, donate_expected: bool = False,
+              expect_dp: bool = False, expect_pp: bool = False,
+              donate_expected: bool = False,
               allowed_host_calls: int = 0, label: str | None = None) -> list:
     """Findings for ONE compiled module's optimized-HLO text.
 
     ``degrees``: mesh axis degrees (``{"dp": 4, "mp": 2}``) for
     collective attribution; ``expect_dp``: the program SHOULD move bytes
-    across dp (a train step on a dp>1 mesh); ``donate_expected``: the
-    compile was requested with donated args; ``allowed_host_calls``:
-    declared host round-trips (0 — the NaN sentinel is an in-program
-    reduction, not a callback)."""
+    across dp (a train step on a dp>1 mesh); ``expect_pp``: the program
+    SHOULD hand microbatches stage-to-stage (a train step on a pp>1
+    mesh — zero cross-pp collective-permutes means the pipeline was
+    compiled out); ``donate_expected``: the compile was requested with
+    donated args; ``allowed_host_calls``: declared host round-trips
+    (0 — the NaN sentinel is an in-program reduction, not a callback)."""
     out = []
     degrees = degrees or {}
+    colls = (_parse_collectives(hlo_text, degrees)
+             if (expect_dp or expect_pp) else [])
     if expect_dp:
-        colls = _parse_collectives(hlo_text, degrees)
         dp_colls = [c for c in colls
                     if "dp" in c["axis"].split("+")]
         if not dp_colls:
@@ -161,6 +172,19 @@ def audit_hlo(hlo_text: str, *, degrees: dict | None = None,
                 "parallelism compiled to replicated compute (the PR 10 "
                 "bug class: check sharding constraints survived the "
                 "trace)", label))
+    if expect_pp:
+        pp_perms = [c for c in colls
+                    if c["op"] == "collective-permute"
+                    and "pp" in c["axis"].split("+")]
+        if not pp_perms:
+            out.append(_finding(
+                "PA005",
+                f"pp={degrees.get('pp')} mesh but the step program has "
+                f"zero cross-pp collective-permutes ({len(colls)} "
+                "collectives total) — the stage handoff was silently "
+                "dropped; every stage is computing the whole model "
+                "(stage the model through PipelineLayer / "
+                "autoshard.stage_model)", label))
     if donate_expected and not _ALIAS_RE.search(hlo_text):
         out.append(_finding(
             "PA002",
@@ -223,9 +247,12 @@ def audit_entry(entry, key=None, label: str | None = None) -> list:
         kind = "train_step"
     expect_dp = (kind == "train_step"
                  and int(degrees.get("dp", 1) or 1) > 1)
+    expect_pp = (kind == "train_step"
+                 and int(degrees.get("pp", 1) or 1) > 1)
     donate_expected = (isinstance(key, dict) and bool(key.get("donate"))
                        and not key.get("nan_check"))
     return audit_hlo(hlo, degrees=degrees, expect_dp=expect_dp,
+                     expect_pp=expect_pp,
                      donate_expected=donate_expected, label=label)
 
 
@@ -315,7 +342,9 @@ def audit_train_step(step, *batch) -> dict:
     donate_expected = bool(getattr(step, "_donate", False)) and not nan_check
     hlo = entry.compiled.as_text()
     expect_dp = int(degrees.get("dp", 1) or 1) > 1
+    expect_pp = int(degrees.get("pp", 1) or 1) > 1
     findings = audit_hlo(hlo, degrees=degrees, expect_dp=expect_dp,
+                         expect_pp=expect_pp,
                          donate_expected=donate_expected,
                          label=f"train_step/{type(step._model).__name__}")
     colls = _parse_collectives(hlo, degrees)
@@ -324,6 +353,11 @@ def audit_train_step(step, *batch) -> dict:
         "collectives": len(colls),
         "dp_collectives": sum(1 for c in colls
                               if "dp" in c["axis"].split("+")),
+        "pp_collectives": sum(1 for c in colls
+                              if "pp" in c["axis"].split("+")),
+        "pp_handoffs": sum(1 for c in colls
+                           if c["op"] == "collective-permute"
+                           and "pp" in c["axis"].split("+")),
         "donation_expected": donate_expected,
         "donation_honored": bool(_ALIAS_RE.search(hlo)),
         "host_calls": len(_CALLBACK_RE.findall(hlo)),

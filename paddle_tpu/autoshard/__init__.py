@@ -1,12 +1,16 @@
 """Automatic sharding planner (ROADMAP item 2 — docs/AUTOSHARD.md).
 
 Plan → launch → resume hybrid runs with zero hand-written
-PartitionSpecs: enumerate the legal (dp × mp, batch) candidates for a
-device count, AOT-lower each on a virtual mesh (exec-cache-warm, no
-execution), score with XLA's memory accounting (hard HBM fit) + the
-per-axis collective bytes parsed from the post-SPMD HLO + an
-analytical roofline seeded from `PERF_MEASUREMENTS.json`, and emit the
-winner as a deterministic, provenance-stamped ``shard_plan.json``.
+PartitionSpecs: enumerate the legal (dp × mp × pp, batch) candidates
+for a device count (pp capped by the probe's stage-able depth),
+AOT-lower each on a virtual mesh (exec-cache-warm, no execution; pp>1
+probes compile the GPipe-in-XLA PipelineLayer schedule), score with
+XLA's memory accounting (hard HBM fit) + the per-axis collective bytes
+parsed from the post-SPMD HLO (incl. the ppermute stage handoff) + an
+analytical roofline seeded from `PERF_MEASUREMENTS.json` (pipeline
+candidates pay the ``(pp−1)/n_micro`` bubble), and emit the winner as
+a deterministic, provenance-stamped ``shard_plan.json`` carrying
+``pp``/``n_micro``/the layer→stage assignment.
 
 Driver: ``python tools/shard_plan.py plan|launch|resume|bench``.
 Consumers: ``hapi.Model.fit(shard_plan=)``, launch scripts via
@@ -14,6 +18,7 @@ Consumers: ``hapi.Model.fit(shard_plan=)``, launch scripts via
 """
 from .candidates import (  # noqa: F401
     candidate_label, default_meshes, enumerate_candidates, parse_mesh,
+    plan_microbatches, pp_cap,
 )
 from .cost import (  # noqa: F401
     CostSeeds, default_seeds, rank_candidates, seed_from_measurements,
@@ -23,7 +28,7 @@ from .lowering import (  # noqa: F401
 )
 from .plan import (  # noqa: F401
     PLAN_VERSION, ShardPlan, apply_plan, derive_param_specs, load_plan,
-    shard_batch,
+    shard_batch, stage_model,
 )
 from .planner import make_plan, plan_sweep  # noqa: F401
 
@@ -33,6 +38,7 @@ __all__ = [
     "candidate_label", "build_probe", "lower_candidate",
     "collect_param_specs",
     "derive_param_specs", "apply_plan", "load_plan", "shard_batch",
+    "stage_model", "plan_microbatches", "pp_cap",
     "make_plan", "plan_sweep", "rank_candidates",
     "default_seeds", "seed_from_measurements",
 ]

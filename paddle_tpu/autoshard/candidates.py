@@ -1,60 +1,116 @@
 """Candidate enumeration for the automatic sharding planner.
 
-The legal search space is every (dp × mp) factorization of the device
-count crossed with the requested global batch sizes — exactly the space
-`tools/memory_planner.py` has always swept (its enumeration moved here
-so the OOM preflight and the planner share ONE code path). Pure stdlib:
-importable without jax, so CLI argument errors surface before any
-backend initializes.
+The legal search space is every (dp × mp × pp) factorization of the
+device count crossed with the requested global batch sizes — exactly
+the space `tools/memory_planner.py` has always swept (its enumeration
+moved here so the OOM preflight and the planner share ONE code path).
+The pp axis (ISSUE 15) is capped by the probe's stage-able depth: a
+pipeline candidate only exists when the repeated block count divides
+over its stages, so callers pass ``stage_depth`` (the probe's layer
+count) and the env knob ``PT_AUTOSHARD_PP_MAX`` bounds the sweep.
+Pure stdlib: importable without jax, so CLI argument errors surface
+before any backend initializes.
 """
 from __future__ import annotations
 
+import os
+
 __all__ = ["parse_mesh", "default_meshes", "enumerate_candidates",
-           "candidate_label"]
+           "candidate_label", "plan_microbatches", "pp_cap"]
+
+_AXES = ("dp", "mp", "pp")
 
 
 def parse_mesh(token: str) -> dict:
-    """``dp4xmp2`` -> {"dp": 4, "mp": 2} (either axis optional)."""
-    out = {"dp": 1, "mp": 1}
+    """``dp4xmp2`` / ``dp2xpp2`` -> degree dict (every axis optional)."""
+    out = {"dp": 1, "mp": 1, "pp": 1}
     for part in token.lower().split("x"):
         part = part.strip()
         if not part:
             continue
-        for axis in ("dp", "mp"):
+        for axis in _AXES:
             if part.startswith(axis):
                 out[axis] = int(part[len(axis):])
                 break
         else:
             raise ValueError(f"bad mesh token {part!r} "
-                             f"in {token!r} (expected dpN / mpN / dpNxmpM)")
+                             f"in {token!r} (expected dpN / mpN / ppN, "
+                             f"e.g. dpNxmpM or dpNxppK)")
     return out
 
 
-def default_meshes(n_devices: int) -> list:
-    """(dp, mp) factorizations of the device count, dp-heavy first."""
+def pp_cap(stage_depth=None) -> int:
+    """The pp sweep bound: ``PT_AUTOSHARD_PP_MAX`` (default 8) clamped
+    to the probe's stage-able depth (its repeated-block count — a
+    pipeline deeper than its blocks cannot be staged)."""
+    cap = int(os.environ.get("PT_AUTOSHARD_PP_MAX", "8") or 8)
+    if stage_depth:
+        cap = min(cap, int(stage_depth))
+    return max(cap, 1)
+
+
+def default_meshes(n_devices: int, pp_max: int = 1,
+                   stage_depth=None) -> list:
+    """(dp, mp, pp) factorizations of the device count, pp=1 rows first
+    in the historical dp-heavy order (byte-identity of pre-PP plans),
+    then deeper pipelines. pp values that the stage depth does not
+    divide over are skipped — such a candidate could never build."""
     out = []
-    mp = 1
-    while mp <= n_devices:
-        if n_devices % mp == 0:
-            out.append({"dp": n_devices // mp, "mp": mp})
-        mp *= 2
+    pp = 1
+    while pp <= min(n_devices, pp_max):
+        if n_devices % pp == 0 and (
+                not stage_depth or int(stage_depth) % pp == 0):
+            rest = n_devices // pp
+            mp = 1
+            while mp <= rest:
+                if rest % mp == 0:
+                    out.append({"dp": rest // mp, "mp": mp, "pp": pp})
+                mp *= 2
+        pp *= 2
     return out
+
+
+def plan_microbatches(pp: int, batch: int, dp: int = 1) -> int:
+    """The planned microbatch count for a pipeline candidate: the
+    largest divisor of the global batch ≤ 2·pp whose microbatch still
+    dp-shards — 2·pp microbatches halve the fill/drain bubble
+    ``(pp−1)/n_micro`` vs one-per-stage while keeping per-tick work
+    meaningful. Deterministic (part of the plan's byte-identity);
+    pp=1 pipelines nothing (n_micro=1)."""
+    if pp <= 1 or batch <= 0:
+        return 1
+    best = 1
+    for n in range(1, batch + 1):
+        if n > 2 * pp:
+            break
+        if batch % n or (batch // n) % max(dp, 1):
+            continue
+        best = n
+    return best
 
 
 def candidate_label(cand: dict) -> str:
-    return f"dp{cand['dp']}·mp{cand['mp']} b{cand['batch']}"
+    pp = cand.get("pp", 1)
+    tail = f"·pp{pp}" if pp > 1 else ""
+    return f"dp{cand['dp']}·mp{cand['mp']}{tail} b{cand['batch']}"
 
 
-def enumerate_candidates(n_devices: int, configs=None, batches="8") -> list:
-    """The planner's candidate list: ``[{"dp", "mp", "batch"}, ...]``.
+def enumerate_candidates(n_devices: int, configs=None, batches="8",
+                         pp_max: int = 1, stage_depth=None) -> list:
+    """The planner's candidate list:
+    ``[{"dp", "mp", "pp", "batch", "n_micro"}, ...]``.
 
     ``configs`` is a comma list of mesh tokens (or an iterable of them;
-    None = all power-of-2 factorizations of ``n_devices``); ``batches``
-    a comma list (or iterable) of global batch sizes. Ordering is
-    deterministic — the enumeration order is part of the plan's
-    byte-identity contract."""
+    None = all power-of-2 factorizations of ``n_devices``, pp bounded
+    by ``pp_max``/``stage_depth``); ``batches`` a comma list (or
+    iterable) of global batch sizes. Ordering is deterministic — the
+    enumeration order is part of the plan's byte-identity contract.
+    ``n_micro`` is stamped per candidate (`plan_microbatches`) so the
+    lowering, the cost model, and the emitted plan all agree on the
+    schedule they judged."""
     if configs is None:
-        meshes = default_meshes(n_devices)
+        meshes = default_meshes(n_devices, pp_max=pp_max,
+                                stage_depth=stage_depth)
     else:
         tokens = (configs.split(",") if isinstance(configs, str)
                   else list(configs))
@@ -65,10 +121,12 @@ def enumerate_candidates(n_devices: int, configs=None, batches="8") -> list:
         batch_list = [int(b) for b in batches]
     out = []
     for m in meshes:
-        if m["dp"] * m["mp"] != n_devices:
+        m.setdefault("pp", 1)
+        if m["dp"] * m["mp"] * m["pp"] != n_devices:
             raise ValueError(
-                f"dp{m['dp']}xmp{m['mp']} does not "
+                f"dp{m['dp']}xmp{m['mp']}xpp{m['pp']} does not "
                 f"factorize {n_devices} devices")
         for b in batch_list:
-            out.append({**m, "batch": b})
+            out.append({**m, "batch": b,
+                        "n_micro": plan_microbatches(m["pp"], b, m["dp"])})
     return out

@@ -16,8 +16,10 @@ import sys
 __all__ = ["add_probe_args", "apply_smoke", "reexec_virtual_child",
            "SMOKE_CONFIGS"]
 
-# the tier-1 smoke sweep: tiny probe, three mesh candidates
-SMOKE_CONFIGS = "dp8,dp4xmp2,dp2xmp4"
+# the tier-1 smoke sweep: tiny probe, four mesh candidates — one per
+# parallelism family incl. a pp>1 pipeline (the smoke probe's 2 layers
+# stage over pp=2)
+SMOKE_CONFIGS = "dp8,dp4xmp2,dp2xmp4,dp4xpp2"
 
 
 def add_probe_args(ap) -> None:
@@ -29,6 +31,10 @@ def add_probe_args(ap) -> None:
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--vocab", type=int, default=2048)
+    ap.add_argument("--moe-experts", type=int, default=0,
+                    help="experts per MLP (0 = dense probe; >0 builds an "
+                         "MoE probe so the sweep costs the expert "
+                         "all-to-all)")
 
 
 def apply_smoke(args) -> None:

@@ -95,14 +95,24 @@ def seed_from_measurements(store_path: str | None = None) -> CostSeeds:
     return seeds
 
 
-def probe_param_count(spec) -> int:
+MOE_TOP_K = 2  # gshard top-2 routing (models/llama.py moe_top_k default)
+
+
+def probe_param_count(spec, active_experts=None) -> int:
     """Analytical parameter count of the Llama-shaped probe
-    (embedding + per-layer attention/MLP/norms + final norm + lm_head)."""
+    (embedding + per-layer attention/MLP/norms + final norm + lm_head).
+    An MoE probe multiplies the MLP stack by its expert count;
+    ``active_experts`` caps that factor — the FLOPs term must count
+    only the top-k experts routing activates per token, while memory/
+    grad-traffic terms count them all."""
     h = spec.hidden
     inter = spec.intermediate or h * 3
-    per_layer = (4 * h * h            # q/k/v/o projections
-                 + 3 * h * inter      # gate/up/down
-                 + 2 * h)             # the two RMSNorm scales
+    experts = max(int(getattr(spec, "moe_experts", 0) or 0), 1)
+    if active_experts is not None:
+        experts = min(experts, max(int(active_experts), 1))
+    per_layer = (4 * h * h                  # q/k/v/o projections
+                 + 3 * h * inter * experts  # gate/up/down (per expert)
+                 + 2 * h)                   # the two RMSNorm scales
     return (spec.vocab * h            # embedding
             + spec.layers * per_layer
             + h                       # final norm
@@ -111,13 +121,25 @@ def probe_param_count(spec) -> int:
 
 def score_candidate(cand: dict, row: dict, spec, seeds: CostSeeds) -> dict:
     """Roofline estimate for one FITTING candidate; returns the cost
-    sub-dict merged into its plan row."""
+    sub-dict merged into its plan row. Pipeline candidates (pp>1) pay
+    the GPipe fill/drain bubble ``(pp−1)/n_micro`` on the compute term
+    (the planned ``n_micro`` is stamped on the candidate) plus the
+    per-tick ppermute handoff on the wire term."""
     dp, mp, batch = cand["dp"], cand["mp"], cand["batch"]
-    devices = dp * mp
+    pp = int(cand.get("pp", 1) or 1)
+    n_micro = max(int(cand.get("n_micro", 1) or 1), 1)
+    devices = dp * mp * pp
     tokens = batch * spec.seq
-    flops = 6.0 * probe_param_count(spec) * tokens
+    # flops over the ACTIVATED params: gshard routes each token through
+    # top-k experts, not the whole expert stack (grad/memory terms below
+    # still count every expert)
+    flops = 6.0 * probe_param_count(spec, active_experts=MOE_TOP_K) * tokens
     eff_flops = seeds["peak_tflops"] * 1e12 * seeds["mfu"] * devices
     compute_ms = flops / eff_flops * 1e3
+    if pp > 1:
+        # fill/drain bubble: (pp-1) of the n_micro+pp-1 schedule ticks
+        # run partially empty stages — compute stretches by the ratio
+        compute_ms *= 1.0 + (pp - 1) / n_micro
     comms = row.get("collectives") or {}
     per_axis = comms.get("per_axis_wire_bytes") or {}
     comms_ms = sum(per_axis.values()) / (seeds["ici_gbps"] * 1e9) * 1e3
@@ -126,16 +148,33 @@ def score_candidate(cand: dict, row: dict, spec, seeds: CostSeeds) -> dict:
         # collect_comms=False): the analytical terms stand in — ring
         # all-reduce of the dp-replicated grads + the Megatron f/g pair
         # per layer (two mp all-reduces of the [batch, seq, hidden]
-        # activation each way). BOTH terms must exist, and the fallback
-        # must fire whenever the parsed account is absent — scoring
-        # zero comms would hand mp-heavy candidates a free win
+        # activation each way) + the pipeline's per-tick ppermute of
+        # the stage-state array + the MoE dispatch/combine all-to-all.
+        # ALL terms must exist, and the fallback must fire whenever the
+        # parsed account is absent — scoring zero comms would hand
+        # comms-heavy candidates a free win
         wire = 0.0
         if dp > 1:
-            grad_bytes = 4.0 * probe_param_count(spec) / mp
+            grad_bytes = 4.0 * probe_param_count(spec) / (mp * pp)
             wire += 2.0 * (dp - 1) / dp * grad_bytes
         if mp > 1:
             act_bytes = 4.0 * batch * spec.seq * spec.hidden
             wire += (spec.layers * 2 * 2.0 * (mp - 1) / mp * act_bytes)
+        if pp > 1:
+            # one collective-permute of the stage state per schedule
+            # tick, forward + backward replay (the vjp runs the ring in
+            # reverse). PER-DEVICE bytes like every sibling term: the
+            # state is [pp, mb, ...] with dim0 pp-sharded and the
+            # microbatch dim dp-sharded, so each device ships its own
+            # [mb/dp, seq, hidden] slice per tick
+            mb_bytes = 4.0 * (batch // n_micro) * spec.seq * spec.hidden
+            ticks = n_micro + pp - 1
+            wire += 2.0 * ticks * mb_bytes / dp
+        if getattr(spec, "moe_experts", 0) and dp > 1:
+            # GShard dispatch + combine all-to-all per MoE layer
+            # (EP rides the dp axis), forward + backward
+            act_bytes = 4.0 * batch * spec.seq * spec.hidden
+            wire += spec.layers * 4.0 * (dp - 1) / dp * act_bytes
         comms_ms = wire / (seeds["ici_gbps"] * 1e9) * 1e3
     est_ms = compute_ms + comms_ms
     return {
@@ -149,11 +188,12 @@ def score_candidate(cand: dict, row: dict, spec, seeds: CostSeeds) -> dict:
 
 def rank_candidates(rows: list) -> list:
     """Fitting rows best-first. The ordering key is the determinism
-    contract: (rounded est_step_ms, fewer model-parallel splits, larger
-    batch, label) — so equal-cost candidates prefer the simpler mesh
-    and the bigger batch, stably."""
+    contract: (rounded est_step_ms, fewer model-parallel splits, fewer
+    pipeline stages, larger batch, label) — so equal-cost candidates
+    prefer the simpler mesh and the bigger batch, stably."""
     fits = [r for r in rows if r.get("fits") and "error" not in r]
     return sorted(fits, key=lambda r: (r.get("est_step_ms", float("inf")),
                                        r.get("mp", 1),
+                                       r.get("pp", 1),
                                        -r.get("batch", 0),
                                        r.get("label", "")))

@@ -175,7 +175,14 @@ def parse_collectives(hlo_text: str, degrees: dict) -> list:
             if pm:
                 pairs = [tuple(int(x) for x in p.split(","))
                          for p in pm.group(1)[1:-1].split("},{")]
-                groups = [tuple(sorted({a for p in pairs for a in p}))]
+                # each {src,tgt} pair is an independent point-to-point
+                # hop: classifying pairs (not their union) attributes a
+                # pp-ring shift to "pp" instead of smearing it over
+                # every axis the pair set happens to span. Self-pairs
+                # (identity entries XLA keeps for uninvolved devices)
+                # drop out via the gsize<=1 guard below.
+                groups = [tuple(sorted({a, b})) for a, b in pairs
+                          if a != b]
         if not groups:
             continue
         gsize = max(len(g) for g in groups)

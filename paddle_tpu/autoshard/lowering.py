@@ -1,8 +1,10 @@
 """Per-candidate AOT lowering: the planner's (and memory_planner's) one
 candidate-evaluation code path.
 
-For each (dp × mp, batch) candidate this builds the probe model under
-that mesh, AOT-compiles the full train step (fwd+bwd+optimizer —
+For each (dp × mp × pp, batch) candidate this builds the probe model
+under that mesh (pp>1: the pipeline-staged probe through
+`LlamaForCausalLMPipe` at the candidate's planned microbatch count),
+AOT-compiles the full train step (fwd+bwd+optimizer —
 `jit/train_step.py`) and reads XLA's own executable memory accounting
 (`monitor/memory.py:executable_record`; per-device for SPMD
 executables). Nothing executes: host RAM materializes parameters for
@@ -30,7 +32,11 @@ __all__ = ["ProbeSpec", "build_probe", "lower_candidate",
 @dataclass(frozen=True)
 class ProbeSpec:
     """Dimensions of the probe model the sweep lowers (defaults mirror
-    memory_planner's CLI defaults; ``intermediate=0`` -> 3*hidden)."""
+    memory_planner's CLI defaults; ``intermediate=0`` -> 3*hidden).
+    ``layers`` is also the stage-able depth: pp candidates exist only
+    where it divides over the stages. ``moe_experts > 0`` builds an
+    MoE probe so the sweep's HLO account (and the analytical fallback)
+    carries the expert all-to-all."""
 
     vocab: int = 2048
     hidden: int = 256
@@ -38,6 +44,7 @@ class ProbeSpec:
     layers: int = 2
     heads: int = 4
     seq: int = 128
+    moe_experts: int = 0
 
     @classmethod
     def from_args(cls, args) -> "ProbeSpec":
@@ -45,12 +52,14 @@ class ProbeSpec:
         seq attributes (e.g. an argparse namespace)."""
         return cls(vocab=args.vocab, hidden=args.hidden,
                    intermediate=args.intermediate, layers=args.layers,
-                   heads=args.heads, seq=args.seq)
+                   heads=args.heads, seq=args.seq,
+                   moe_experts=getattr(args, "moe_experts", 0) or 0)
 
     def to_dict(self) -> dict:
         return {"vocab": self.vocab, "hidden": self.hidden,
                 "intermediate": self.intermediate, "layers": self.layers,
-                "heads": self.heads, "seq": self.seq}
+                "heads": self.heads, "seq": self.seq,
+                "moe_experts": self.moe_experts}
 
 
 def collect_param_specs(model) -> dict:
@@ -85,14 +94,22 @@ def build_probe(cand: dict, spec: ProbeSpec):
     import paddle_tpu as pt
     from paddle_tpu.distributed import fleet
     from paddle_tpu.jit.train_step import TrainStep
-    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   LlamaForCausalLMPipe)
 
+    from .candidates import plan_microbatches
     from .plan import shard_batch
 
     dp, mp, batch = cand["dp"], cand["mp"], cand["batch"]
+    pp = int(cand.get("pp", 1) or 1)
+    n_micro = int(cand.get("n_micro") or plan_microbatches(pp, batch, dp))
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {
-        "dp_degree": dp, "mp_degree": mp, "pp_degree": 1}
+        "dp_degree": dp, "mp_degree": mp, "pp_degree": pp}
+    if pp > 1:
+        # the plan's schedule IS the probed schedule: the PipelineLayer
+        # reads accumulate_steps for its default microbatch count
+        strategy.pipeline_configs = {"accumulate_steps": n_micro}
     fleet.init(is_collective=True, strategy=strategy)
     cfg = LlamaConfig(
         vocab_size=spec.vocab, hidden_size=spec.hidden,
@@ -100,12 +117,19 @@ def build_probe(cand: dict, spec: ProbeSpec):
         num_hidden_layers=spec.layers, num_attention_heads=spec.heads,
         max_position_embeddings=spec.seq,
         sequence_parallel=mp > 1,
-        use_parallel_cross_entropy=mp > 1)
+        use_parallel_cross_entropy=mp > 1,
+        **({"moe_num_experts": spec.moe_experts}
+           if getattr(spec, "moe_experts", 0) else {}))
     pt.seed(0)
-    model = LlamaForCausalLM(cfg)
+    # pp>1: the staged probe — decoder blocks stacked over the 'pp'
+    # axis, the GPipe-in-XLA schedule compiled into the ONE train step
+    # (fleet/meta_parallel pp_layers — the same program fit() trains)
+    model = LlamaForCausalLMPipe(cfg) if pp > 1 else LlamaForCausalLM(cfg)
     opt = pt.optimizer.AdamW(learning_rate=1e-4,
                              parameters=model.parameters())
-    step = TrainStep(model, opt, lambda m, i, l: m(i, l))
+    step = TrainStep(model, opt,
+                     (lambda m, i, l: m.loss_fn(m(i), l)) if pp > 1
+                     else (lambda m, i, l: m(i, l)))
     # seeded: probe token VALUES never matter (nothing executes) but the
     # batch digest can reach exec-cache keys — global-RNG draws here
     # would churn the warm sweep (PTL005)
@@ -132,7 +156,7 @@ def lower_candidate(cand: dict, spec: ProbeSpec, hbm_gb: float | None = None,
     from paddle_tpu.jit import exec_cache
     from paddle_tpu.monitor import memory as memobs
 
-    dp, mp = cand["dp"], cand["mp"]
+    dp, mp, pp = cand["dp"], cand["mp"], int(cand.get("pp", 1) or 1)
     label = candidate_label(cand)
     try:
         step, ids, model = build_probe(cand, spec)
@@ -149,7 +173,7 @@ def lower_candidate(cand: dict, spec: ProbeSpec, hbm_gb: float | None = None,
                                  > hits_before else "miss")
         if collect_comms:
             rec["collectives"] = _comms_for(step, (ids, ids),
-                                            {"dp": dp, "mp": mp})
+                                            {"dp": dp, "mp": mp, "pp": pp})
         if collect_specs:
             rec["param_specs"] = collect_param_specs(model)
         return rec

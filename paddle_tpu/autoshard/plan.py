@@ -26,7 +26,7 @@ import json
 import os
 
 __all__ = ["PLAN_VERSION", "ShardPlan", "load_plan", "derive_param_specs",
-           "apply_plan", "shard_batch"]
+           "apply_plan", "shard_batch", "stage_model"]
 
 PLAN_VERSION = 1
 
@@ -77,25 +77,34 @@ class ShardPlan:
 
     def __init__(self, mesh: dict, batch: int, param_specs: dict,
                  rows: list | None = None, winner: str | None = None,
-                 seeds: dict | None = None, provenance: dict | None = None):
+                 seeds: dict | None = None, provenance: dict | None = None,
+                 n_micro: int = 1, stage_assignment=None):
         self.mesh = {"dp": int(mesh.get("dp", 1)),
-                     "mp": int(mesh.get("mp", 1))}
+                     "mp": int(mesh.get("mp", 1)),
+                     "pp": int(mesh.get("pp", 1))}
         self.batch = int(batch)
         self.param_specs = dict(param_specs or {})
         self.rows = list(rows or [])
         self.winner = winner
         self.seeds = dict(seeds or {})
         self.provenance = dict(provenance or {})
+        # pipeline schedule the plan committed to: microbatch count per
+        # step and the deterministic layer→stage map (None when pp=1)
+        self.n_micro = max(int(n_micro or 1), 1)
+        self.stage_assignment = (list(stage_assignment)
+                                 if stage_assignment else None)
 
     @property
     def devices(self) -> int:
-        return self.mesh["dp"] * self.mesh["mp"]
+        return self.mesh["dp"] * self.mesh["mp"] * self.mesh["pp"]
 
     def to_dict(self) -> dict:
         return {
             "plan_version": PLAN_VERSION,
             "mesh": self.mesh,
             "batch": self.batch,
+            "n_micro": self.n_micro,
+            "stage_assignment": self.stage_assignment,
             "winner": self.winner,
             "param_specs": self.param_specs,
             "rows": self.rows,
@@ -119,8 +128,8 @@ class ShardPlan:
         """The compact form bench lines embed (``shard_plan`` sub-object
         — what `tools/perf_guard.py --plan-drift` compares)."""
         return {"dp": self.mesh["dp"], "mp": self.mesh["mp"],
-                "batch": self.batch, "devices": self.devices,
-                "digest": self.digest()}
+                "pp": self.mesh["pp"], "batch": self.batch,
+                "devices": self.devices, "digest": self.digest()}
 
     def save(self, path: str) -> str:
         tmp = f"{path}.tmp.{os.getpid()}"
@@ -139,7 +148,9 @@ class ShardPlan:
                    param_specs=d.get("param_specs", {}),
                    rows=d.get("rows", []), winner=d.get("winner"),
                    seeds=d.get("cost_seeds", {}),
-                   provenance=d.get("provenance", {}))
+                   provenance=d.get("provenance", {}),
+                   n_micro=d.get("n_micro", 1),
+                   stage_assignment=d.get("stage_assignment"))
 
 
 def load_plan(path_or_plan) -> ShardPlan:
@@ -161,18 +172,30 @@ def apply_plan(plan, model=None):
     already carry a mesh-axis spec (parallel-layer models) keep it.
     Returns the :class:`~paddle_tpu.distributed.env.ParallelEnv`.
 
-    This is the zero-hand-written-PartitionSpecs entry point: scripts
-    call ``apply_plan(load_plan(os.environ["PT_SHARD_PLAN"]), model)``
+    A pp>1 plan initializes the full hybrid strategy (fleet.init) so
+    the pipeline container reads the planned microbatch count
+    (``accumulate_steps = plan.n_micro``) — wrap the model's block run
+    afterwards with :func:`stage_model`. This is the
+    zero-hand-written-PartitionSpecs entry point: scripts call
+    ``apply_plan(load_plan(os.environ["PT_SHARD_PLAN"]), model)``
     and never name an axis.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
     from ..distributed import env as env_mod
+    from ..distributed import fleet as _fleet
     from ..distributed.shard import get_sharding
 
     plan = load_plan(plan)
-    env = env_mod.init_mesh(dp=plan.mesh["dp"], mp=plan.mesh["mp"])
+    strategy = _fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": plan.mesh["dp"], "mp_degree": plan.mesh["mp"],
+        "pp_degree": plan.mesh["pp"]}
+    if plan.mesh["pp"] > 1:
+        strategy.pipeline_configs = {"accumulate_steps": plan.n_micro}
+    _fleet.init(is_collective=True, strategy=strategy)
+    env = env_mod.get_env()
     if model is None:
         return env
     derived = None
@@ -222,6 +245,51 @@ def _clean_spec(spec: tuple, shape: tuple, env) -> tuple:
     while out and out[-1] is None:
         out.pop()
     return tuple(out)
+
+
+def stage_model(model, plan):
+    """Wrap ``model``'s repeated block run into the staged pipeline
+    container when the plan pipelines (pp>1); identity otherwise.
+
+    Call AFTER :func:`apply_plan` (the container reads the live 'pp'
+    mesh degree and the planned ``accumulate_steps``) and build the
+    optimizer from the RETURNED model's parameters — the wrapped
+    blocks' parameters are re-stored stacked over the 'pp' axis
+    (values unchanged, so a pp>1 run stays on the pp=1 loss curve).
+    Models that are already a pipelined ``PipelineLayer`` (the *Pipe
+    model classes) pass through; a model whose direct children carry
+    no stage-able repeated run raises with a conversion hint.
+    """
+    from ..distributed.fleet.meta_parallel.parallel_layers.pp_layers \
+        import PipelineLayer
+
+    plan = load_plan(plan)
+    pp = plan.mesh.get("pp", 1)
+    if pp <= 1:
+        return model
+    kwargs = {}
+    if isinstance(model, PipelineLayer):
+        if getattr(model, "_pipelined", False):
+            return model
+        # re-staging a (pp=1-built) pipeline container: carry its
+        # schedule/remat knobs over — dropping recompute_interval here
+        # would train a program the plan's HBM-fit never judged
+        subs = list(model._run_order)
+        kwargs = {"recompute_interval": model._recompute,
+                  "num_virtual_pipeline_stages": model._virtual,
+                  "remat_ticks": model._remat_ticks}
+    else:
+        subs = [sub for _, sub in model.named_children()]
+    try:
+        return PipelineLayer(subs, loss_fn=getattr(model, "loss_fn", None),
+                             **kwargs)
+    except ValueError as e:
+        raise ValueError(
+            f"stage_model: cannot stage {type(model).__name__} over "
+            f"pp={pp} ({e}) — express the model as repeated blocks "
+            "(nn.Sequential of identical block layers) or use a "
+            "pipeline-native class (LlamaForCausalLMPipe / "
+            "ErnieForPretrainingPipe)") from e
 
 
 def shard_batch(x, axis: str = "dp"):

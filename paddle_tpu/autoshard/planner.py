@@ -1,12 +1,16 @@
 """The planner loop: enumerate → lower → score → emit the plan.
 
 Closes ROADMAP item 2's loop from candidate enumeration to a launched
-run: `candidates.enumerate_candidates` names the legal (dp × mp, batch)
-space, `lowering.lower_candidate` AOT-lowers each on the virtual mesh
-(exec-cache-warm — a repeat sweep pays zero fresh XLA compiles),
+run: `candidates.enumerate_candidates` names the legal
+(dp × mp × pp, batch) space (pp capped by the probe's stage-able
+depth — ISSUE 15), `lowering.lower_candidate` AOT-lowers each on the
+virtual mesh (exec-cache-warm — a repeat sweep pays zero fresh XLA
+compiles; pp>1 candidates compile the staged pipeline schedule),
 `cost.score_candidate` applies the HBM-fit hard constraint + the
-compute/comms roofline, and the winner becomes a provenance-stamped
-:class:`~paddle_tpu.autoshard.plan.ShardPlan`.
+compute/comms roofline (incl. the pipeline bubble), and the winner
+becomes a provenance-stamped
+:class:`~paddle_tpu.autoshard.plan.ShardPlan` carrying its planned
+``n_micro`` and layer→stage assignment.
 
 Telemetry (``planner/*`` counters, zero-overhead off — this module is
 in ``monitor.INSTRUMENTED_MODULES``): ``planner/candidates`` /
@@ -19,7 +23,7 @@ from __future__ import annotations
 import sys
 
 from . import cost as _cost
-from .candidates import candidate_label, enumerate_candidates
+from .candidates import candidate_label, enumerate_candidates, pp_cap
 from .lowering import ProbeSpec, lower_candidate
 from .plan import PLAN_VERSION, ShardPlan
 from ..monitor import _register as _monitor_register
@@ -42,7 +46,9 @@ def plan_sweep(n_devices: int, hbm_gb: float, spec: ProbeSpec | None = None,
     spec = spec or ProbeSpec()
     seeds = seeds if seeds is not None else _cost.seed_from_measurements()
     rows = []
-    for cand in enumerate_candidates(n_devices, configs, batches):
+    for cand in enumerate_candidates(n_devices, configs, batches,
+                                     pp_max=pp_cap(spec.layers),
+                                     stage_depth=spec.layers):
         m = _monitor
         try:
             row = lower_candidate(cand, spec, hbm_gb=hbm_gb,
@@ -86,8 +92,9 @@ def make_plan(n_devices: int, hbm_gb: float, spec: ProbeSpec | None = None,
         r.pop("param_specs", None)
         r.pop("exec_cache", None)
         plan_rows.append(r)
+    winner_pp = int(winner.get("pp", 1) or 1)
     plan = ShardPlan(
-        mesh={"dp": winner["dp"], "mp": winner["mp"]},
+        mesh={"dp": winner["dp"], "mp": winner["mp"], "pp": winner_pp},
         batch=winner["batch"],
         param_specs=param_specs,
         rows=plan_rows,
@@ -95,11 +102,24 @@ def make_plan(n_devices: int, hbm_gb: float, spec: ProbeSpec | None = None,
         seeds=seeds,
         provenance=_provenance(n_devices, hbm_gb, spec, configs, batches,
                                jax),
+        n_micro=int(winner.get("n_micro", 1) or 1),
+        stage_assignment=_stage_assignment(spec, winner_pp),
     )
     m = _monitor
     if m is not None:
         m.on_planner_plan(winner.get("est_step_ms", 0.0))
     return plan, rows
+
+
+def _stage_assignment(spec, pp: int):
+    """Deterministic layer→stage map for the winner (GPipe contiguous
+    partition, v=1): block i runs on stage ``i // (layers/pp)``. None
+    for unpipelined winners — the plan stays byte-compatible with its
+    pre-PP shape there."""
+    if pp <= 1 or spec.layers % pp:
+        return None
+    bps = spec.layers // pp
+    return [i // bps for i in range(spec.layers)]
 
 
 def _provenance(n_devices, hbm_gb, spec, configs, batches, jax) -> dict:

@@ -37,18 +37,30 @@ independent of the microbatch count):
 """
 from __future__ import annotations
 
+import collections
 import re
+import sys
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from .... import env as env_mod
 from .....autograd.tape import no_grad
 from .....framework import random as rng
 from .....framework.core import EagerParamBase, Tensor
+from .....monitor import _register as _monitor_register
 from .....nn.layer.layers import Layer
 from .....ops.dispatch import apply
+
+# Telemetry slot (paddle_tpu.monitor None-slot contract): None unless
+# PT_MONITOR wired it. The compiled ppermute handoff is invisible to
+# the eager collective counters (it lives inside the one XLA program),
+# so the pipeline forward reports its schedule analytically here —
+# ticks, microbatches, and the per-tick stage-state bytes that ride
+# `collective/bytes/pp`.
+_monitor = None
 
 
 class LayerDesc:
@@ -135,11 +147,17 @@ class PipelineLayer(Layer):
 
         pp = _pp_degree()
         if pp <= 1:
-            # degenerate: plain sequential container
+            # degenerate: plain sequential container. The repeated-run
+            # bounds are still recorded: they define the CANONICAL
+            # per-block checkpoint keys ("<flat index>.<param>") that a
+            # pipelined relaunch of the same model assembles its stacks
+            # from (stage-move reshard-on-load, docs/RESILIENCE.md)
             self._pipelined = False
             for i, sub in enumerate(built):
                 self.add_sublayer(str(i), sub)
             self._run_order = built
+            start, length = self._repeated_run(descs, built)
+            self._flat_start, self._n_blocks = start, length
             return
 
         start, length = self._repeated_run(descs, built)
@@ -154,6 +172,7 @@ class PipelineLayer(Layer):
         self._blocks_per_stage = n_blocks // pp
         self._blocks_per_chunk = n_blocks // (pp * v)
         self._n_blocks = n_blocks
+        self._flat_start = start
 
         self._head = built[:start]
         blocks = built[start:start + length]
@@ -255,6 +274,109 @@ class PipelineLayer(Layer):
                 continue
             yield name, p
 
+    # -- canonical (stage-layout-free) checkpoint surface ------------------
+    #
+    # Checkpoints must survive stage moves (pp1 ↔ pp2 ↔ pp4, any v):
+    # state_dict always speaks CANONICAL per-block keys — the flat
+    # "<index>.<param>" names the pp=1 sequential container produces —
+    # regardless of how the parameters are stored. In pipelined mode the
+    # stacked tensors are exposed as per-block slices on save and
+    # reassembled (with the stacked sharding) on load, so a checkpoint
+    # written at any topology restores at any other by construction
+    # (resilience/resume.py rides this for the model AND the optimizer
+    # moments). docs/RESILIENCE.md "stage-move reshard".
+
+    def _canonical_prefix_items(self):
+        """Head/tail sublayers with their canonical flat-index prefix."""
+        items = [(str(i), sub) for i, sub in enumerate(self._head)]
+        base = self._flat_start + self._n_blocks
+        items += [(str(base + i), sub) for i, sub in enumerate(self._tail)]
+        return items
+
+    def _stacked_layout(self):
+        """``[(stacked_param, template_key, canonical_keys)]`` — the
+        canonical per-block key list is in STORAGE order (slice j of the
+        stack is flat block ``_block_order[j]``, so interleaved virtual
+        stages canonicalize too)."""
+        out = []
+        for (name, _p), sp in zip(self._template.named_parameters(),
+                                  self._stacked):
+            keys = [f"{self._flat_start + bi}.{name}"
+                    for bi in self._block_order]
+            out.append((sp, name, keys))
+        return out
+
+    def _template_buffers(self):
+        """The template block's persistable buffers (relative key →
+        live Tensor). Staging SHARES one buffer across every block
+        (blocks[1:]'s copies are discarded at construction — the
+        container cannot represent per-block buffer state), so the
+        canonical surface writes the shared value under every block's
+        key and reads it back from whichever loads last."""
+        param_keys = {k for k, _ in self._template.named_parameters()}
+        return {k: v for k, v in self._template.state_dict().items()
+                if k not in param_keys}
+
+    def state_dict(self, destination=None, include_sublayers=True,
+                   use_hook=True):
+        if not getattr(self, "_pipelined", False):
+            return super().state_dict(destination, include_sublayers,
+                                      use_hook)
+        dest = destination if destination is not None \
+            else collections.OrderedDict()
+        for prefix, sub in self._canonical_prefix_items():
+            for k, v in sub.state_dict().items():
+                dest[f"{prefix}.{k}"] = v
+        for sp, _name, keys in self._stacked_layout():
+            for j, key in enumerate(keys):
+                dest[key] = Tensor(sp._data[j], stop_gradient=True)
+        for bname, buf in self._template_buffers().items():
+            for bi in range(self._n_blocks):
+                dest[f"{self._flat_start + bi}.{bname}"] = buf
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        if not getattr(self, "_pipelined", False):
+            return super().set_state_dict(state_dict, use_structured_name)
+        missing, own = [], set()
+        for prefix, sub in self._canonical_prefix_items():
+            pre = prefix + "."
+            sub_sd = {k[len(pre):]: v for k, v in state_dict.items()
+                      if k.startswith(pre)}
+            m, _ = sub.set_state_dict(sub_sd)
+            missing += [pre + k for k in m]
+            own.update(pre + k for k in sub.state_dict())
+        for bname, buf in self._template_buffers().items():
+            bkeys = [f"{self._flat_start + bi}.{bname}"
+                     for bi in range(self._n_blocks)]
+            own.update(bkeys)
+            present = [k for k in bkeys if k in state_dict]
+            if not present:
+                missing += bkeys
+            else:
+                v = state_dict[present[-1]]
+                buf._data = jax.device_put(np.asarray(
+                    v.numpy() if isinstance(v, Tensor) else v))
+        for sp, _name, keys in self._stacked_layout():
+            own.update(keys)
+            if any(k not in state_dict for k in keys):
+                missing += [k for k in keys if k not in state_dict]
+                continue
+            vals = [np.asarray(state_dict[k].numpy()
+                               if isinstance(state_dict[k], Tensor)
+                               else state_dict[k]) for k in keys]
+            arr = np.stack(vals)
+            if tuple(arr.shape) != tuple(sp.shape):
+                raise ValueError(
+                    f"shape mismatch for stacked {_name}: loaded "
+                    f"{arr.shape} vs expected {tuple(sp.shape)}")
+            sp._data = jax.device_put(
+                jnp.asarray(arr, dtype=sp._data.dtype), sp._data.sharding)
+        unexpected = [k for k in state_dict if k not in own]
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
     def get_num_stages(self):
         return self._num_stages
 
@@ -346,6 +468,20 @@ class PipelineLayer(Layer):
         stage_sharding = NamedSharding(e.mesh, PartitionSpec("pp"))
 
         chunks, enters, exits = self._make_schedule(n_micro, pp, v)
+        m = _monitor
+        if m is not None:
+            # the compiled ppermute handoff never reaches the eager
+            # collective counters — account it analytically from the
+            # schedule: one permute of the [pp, mb, ...] state per tick
+            mb = x.shape[0] // n_micro if n_micro else int(x.shape[0])
+            elems = pp * mb
+            for d in x.shape[1:]:
+                elems *= int(d)
+            itemsize = np.dtype(x._data.dtype).itemsize
+            m.on_pipeline_forward(
+                pp=pp, n_micro=n_micro, ticks=len(chunks),
+                p2p_bytes=len(chunks) * elems * itemsize,
+                bubble=(len(chunks) - v * n_micro) / max(len(chunks), 1))
         sched = (jnp.asarray(chunks, jnp.int32),
                  jnp.asarray(enters, jnp.int32),
                  jnp.asarray(exits, jnp.int32),
@@ -439,3 +575,6 @@ class PipelineLayer(Layer):
         if s is not None and s.pipeline_configs.get("accumulate_steps"):
             return int(s.pipeline_configs["accumulate_steps"])
         return _pp_degree()
+
+
+_monitor_register(sys.modules[__name__])

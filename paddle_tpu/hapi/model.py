@@ -288,10 +288,15 @@ class Model:
         Automatic sharding (docs/AUTOSHARD.md): ``shard_plan`` — a
         ``shard_plan.json`` path (or loaded
         :class:`~paddle_tpu.autoshard.ShardPlan`) from
-        ``tools/shard_plan.py plan`` — initializes the global (dp×mp)
-        mesh at the plan's degrees and places every parameter by its
-        planned / rule-derived PartitionSpec before the first step: a
-        hybrid run with no hand-written specs. Defaults to the
+        ``tools/shard_plan.py plan`` — initializes the global
+        (dp×mp×pp) mesh at the plan's degrees and places every
+        parameter by its planned / rule-derived PartitionSpec before
+        the first step: a hybrid run with no hand-written specs. A
+        pp>1 plan additionally wraps the network's repeated block run
+        into the staged pipeline container (``autoshard.stage_model``
+        — the planned ``n_micro`` microbatches must divide the batch)
+        and re-points the optimizer at the stacked parameters; losses
+        stay on the pp=1 curve. Defaults to the
         ``PT_SHARD_PLAN`` env stamp the planner's launcher sets, so a
         launched script needs no code either (``resume_from`` likewise
         defaults from the ``PT_SHARD_RESUME`` stamp `shard_plan.py
@@ -308,7 +313,7 @@ class Model:
             resume_from = os.environ.get("PT_SHARD_RESUME") or None
         shard_batch = None
         if shard_plan is not None:
-            from ..autoshard import apply_plan, load_plan
+            from ..autoshard import apply_plan, load_plan, stage_model
             from ..autoshard import shard_batch as _shard_batch
 
             # mesh + param placement BEFORE resume/compile: the restore
@@ -316,6 +321,23 @@ class Model:
             # lowering sees them
             plan = load_plan(shard_plan)
             apply_plan(plan, self.network)
+            if plan.mesh.get("pp", 1) > 1:
+                # a pipelined plan: wrap the block run into the staged
+                # shard_map container (param values unchanged — the
+                # pp>1 run stays on the pp=1 loss curve), re-point the
+                # optimizer at the stacked parameters, and rebuild the
+                # compiled step around the staged network. The restore
+                # below then reshards INTO the stacked placements
+                # (canonical per-block checkpoint keys —
+                # docs/RESILIENCE.md stage-move reshard)
+                staged = stage_model(self.network, plan)
+                if staged is not self.network:
+                    self.network = staged
+                    if self._optimizer is not None:
+                        self._optimizer._parameter_list = list(
+                            staged.parameters())
+                    self._train_step = TrainStep(
+                        self.network, self._optimizer, self._loss_fn)
             if plan.batch and batch_size != plan.batch and not isinstance(
                     train_data, DataLoader):
                 import warnings

@@ -66,6 +66,7 @@ INSTRUMENTED_MODULES = (
     "paddle_tpu.resilience.numerics_policy",
     "paddle_tpu.autoshard.planner",
     "paddle_tpu.analysis.program_audit",
+    "paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.pp_layers",
 )
 
 _registry = Registry()
@@ -187,6 +188,18 @@ _g_plan_winner_ms = _registry.gauge("planner/winner_est_step_ms")
 # analysis/findings/<rule>)
 _c_audit_programs = _registry.counter("analysis/audits")
 _c_audit_findings = _registry.counter("analysis/findings")
+# pipeline parallelism (fleet/meta_parallel pp_layers — ISSUE 15): the
+# GPipe-in-XLA schedule's account per forward. The ppermute stage
+# handoff is compiled into the one program, invisible to the eager
+# collective counters, so the container reports it analytically —
+# p2p_bytes also rides collective/bytes/pp so the planner's per-axis
+# prediction has a measured twin; the gauge is the last schedule's
+# fill/drain bubble fraction
+_c_pipe_fwd = _registry.counter("pipeline/forwards")
+_c_pipe_micro = _registry.counter("pipeline/microbatches")
+_c_pipe_ticks = _registry.counter("pipeline/ticks")
+_c_pipe_p2p = _registry.counter("pipeline/p2p_bytes")
+_g_pipe_bubble = _registry.gauge("pipeline/bubble_frac")
 
 # per-axis collective-bytes attribution (ISSUE 10 satellite): eager
 # collectives know their group's mesh axes, so the aggregate
@@ -647,6 +660,25 @@ def on_program_audit(n_findings: int, rules=()) -> None:
         _c_audit_findings.inc(n_findings)
     for r in rules:
         _registry.counter(f"analysis/findings/{r}").inc()
+
+
+def on_pipeline_forward(pp: int, n_micro: int, ticks: int,
+                        p2p_bytes: int, bubble: float = 0.0) -> None:
+    """One pipelined forward dispatched its compiled GPipe schedule:
+    ``ticks`` scan iterations over ``n_micro`` microbatches, moving
+    ``p2p_bytes`` of stage state over the 'pp' axis (one
+    collective-permute of the [pp, mb, ...] state array per tick).
+    Same convention as every in-trace collective counter: under a
+    compiled TrainStep this fires once per TRACE (the schedule shape
+    per signature), not once per executed step — eager forwards count
+    per call."""
+    _c_pipe_fwd.inc()
+    _c_pipe_micro.inc(n_micro)
+    _c_pipe_ticks.inc(ticks)
+    _g_pipe_bubble.set(bubble)
+    if p2p_bytes:
+        _c_pipe_p2p.inc(p2p_bytes)
+        on_collective("ppermute", p2p_bytes, axes=("pp",))
 
 
 def on_planner_plan(est_step_ms: float) -> None:

@@ -20,6 +20,18 @@ sharding before loading (reshard target), then writes the loaded arrays
 back into ``optimizer._accumulators`` — never materializing global
 values on the host for sharded leaves.
 
+**Stage-move reshard (ISSUE 15):** checkpoints are written in the
+CANONICAL per-block layout — pipeline containers
+(`fleet/meta_parallel/.../pp_layers.py`) expose their stage-stacked
+parameters as flat "<block index>.<param>" slices in ``state_dict``,
+and the optimizer state here is keyed by the param's MODEL state-dict
+name (topology-stable) instead of its auto-assigned ``p.name``. A run
+saved at pp=1 therefore resumes at pp>1 (and vice versa, and across
+interleave orders): restoring INTO a stacked parameter assembles its
+blocks from the per-block checkpoint tensors via
+``jax.make_array_from_callback`` with the stacked sharding — the
+global stack is never materialized on the host.
+
 Telemetry (None-slot, zero-overhead off): ``resilience/restores`` and
 ``resilience/crash_resumes``.
 """
@@ -37,6 +49,88 @@ _monitor = None
 
 MODEL_PREFIX = "model."
 OPT_PREFIX = "opt."
+
+
+def _stacked_pipes(network):
+    """The pipelined PipelineLayer when ``network`` IS one (the only
+    configuration whose checkpoints are canonical: the per-block key
+    scheme lives in the container's own ``state_dict`` override, which
+    a WRAPPER model's generic ``Layer.state_dict`` never calls — a
+    nested pipe therefore checkpoints its raw stacked tensors and
+    reshards like any other sharded param, without stage-move support,
+    instead of crashing the restore on keys that were never written)."""
+    try:
+        from ..distributed.fleet.meta_parallel.parallel_layers.pp_layers \
+            import PipelineLayer
+    except Exception:  # noqa: BLE001 — no fleet stack, no pipes
+        return []
+    if isinstance(network, PipelineLayer) \
+            and getattr(network, "_pipelined", False):
+        return [("", network)]
+    return []
+
+
+def _stacked_param_keys(network):
+    """``{id(stacked_param): (param, [canonical model keys])}`` — the
+    per-block checkpoint keys (storage order) of every stage-stacked
+    parameter of a top-level pipeline container."""
+    out = {}
+    if network is None:
+        return out
+    for prefix, pipe in _stacked_pipes(network):
+        pre = prefix + "." if prefix else ""
+        for sp, _name, keys in pipe._stacked_layout():
+            out[id(sp)] = (sp, [pre + k for k in keys])
+    return out
+
+
+def _param_name_map(network):
+    """``{id(param): model state-dict key}`` — the topology-stable
+    canonical name optimizer state is checkpointed under (auto
+    ``p.name``s differ between a flat and a staged build of the same
+    model; state-dict keys do not)."""
+    out = {}
+    if network is None:
+        return out
+    for k, v in network.state_dict().items():
+        if isinstance(v, Tensor) and id(v) not in out:
+            out[id(v)] = k
+    return out
+
+
+def _assemble_stacked(shape, dtype, sharding, keys, index, path,
+                      what="model tensor"):
+    """Load a stage-stacked array of ``shape`` from its per-block
+    checkpoint tensors, placed with ``sharding``. Region reads only —
+    the global stack never materializes on the host."""
+    import jax
+
+    from ..distributed import checkpoint as dckpt
+
+    missing = [k for k in keys if k not in index]
+    if missing:
+        raise KeyError(
+            f"checkpoint at {path} is missing {what} {missing[0]!r} "
+            f"(+{len(missing) - 1} more) — not a checkpoint of this "
+            "model's block run")
+    metas = [index[k] for k in keys]
+    shape = tuple(int(d) for d in shape)
+    for k, meta in zip(keys, metas):
+        if tuple(meta["shape"]) != shape[1:]:
+            raise ValueError(
+                f"{k}: checkpoint block shape {tuple(meta['shape'])} != "
+                f"stacked slice {shape[1:]} (shape-changing conversion "
+                "is not a stage move)")
+
+    def cb(idx):
+        bounds = dckpt._norm_index(idx, shape)
+        j0, j1 = bounds[0]
+        inner = bounds[1:]
+        return np.stack([
+            dckpt._read_region(path, metas[j], inner)
+            for j in range(j0, j1)]).astype(dtype)
+
+    return jax.make_array_from_callback(shape, sharding, cb)
 
 
 def _rng_key_words():
@@ -70,11 +164,41 @@ def capture(network, optimizer, epoch=None, batch_in_epoch=None,
         flat[MODEL_PREFIX + k] = v
     opt_scalars = {}
     if optimizer is not None:
-        for k, v in optimizer.state_dict().items():
-            if isinstance(v, Tensor):
-                flat[OPT_PREFIX + k] = v
-            else:  # global_step / per-param step_count ints, LR_Scheduler
-                opt_scalars[k] = v
+        from ..optimizer.lr import LRScheduler
+
+        stacked = _stacked_param_keys(network)
+        names = _param_name_map(network)
+        for i, p in enumerate(optimizer._parameter_list):
+            st = optimizer._accumulators.get(id(p)) or {}
+            mw = optimizer._master_weights.get(id(p))
+            sc = optimizer._step_counts.get(id(p))
+            if id(p) in stacked:
+                # stage-stacked param: split each accumulator the same
+                # canonical way the model tensor is split, so a flat
+                # relaunch finds its per-block moments (and vice versa)
+                _sp, keys = stacked[id(p)]
+                for slot, arr in st.items():
+                    for j, key in enumerate(keys):
+                        flat[f"{OPT_PREFIX}{key}.{slot}"] = Tensor(arr[j])
+                if mw is not None:
+                    for j, key in enumerate(keys):
+                        flat[f"{OPT_PREFIX}{key}.master_weight"] = \
+                            Tensor(mw[j])
+                if sc is not None:
+                    for key in keys:
+                        opt_scalars[f"{key}.step_count"] = sc
+                continue
+            name = names.get(id(p)) or p.name or f"param_{i}"
+            for slot, arr in st.items():
+                flat[f"{OPT_PREFIX}{name}.{slot}"] = Tensor(arr)
+            if mw is not None:
+                flat[f"{OPT_PREFIX}{name}.master_weight"] = Tensor(mw)
+            if sc is not None:
+                opt_scalars[f"{name}.step_count"] = sc
+        opt_scalars["global_step"] = optimizer._global_step
+        if isinstance(optimizer._learning_rate, LRScheduler):
+            opt_scalars["LR_Scheduler"] = \
+                optimizer._learning_rate.state_dict()
     scalars = {
         "opt": opt_scalars,
         "rng_key": _rng_key_words(),
@@ -93,21 +217,38 @@ def capture(network, optimizer, epoch=None, batch_in_epoch=None,
 def _restore_model(network, index, path):
     from ..distributed import checkpoint as dckpt
 
+    # stage-stacked params restore by ASSEMBLY: their canonical
+    # state_dict entries are computed slices (writing into them would be
+    # lost), so each stack is rebuilt from its per-block checkpoint
+    # tensors with the stacked sharding instead
+    stacked = _stacked_param_keys(network)
+    stacked_keys = {MODEL_PREFIX + k
+                    for _sp, keys in stacked.values() for k in keys}
     dest = {}
     for k, t in network.state_dict().items():
         key = MODEL_PREFIX + k
+        if key in stacked_keys:
+            continue
         if key not in index:
             raise KeyError(
                 f"checkpoint at {path} is missing model tensor {k!r} — "
                 "not a checkpoint of this model")
         dest[key] = t  # live references: load reshards in place
     dckpt.load_state_dict(dest, path)
+    for sp, keys in stacked.values():
+        sp._data = _assemble_stacked(
+            sp._data.shape, sp._data.dtype, sp._data.sharding,
+            [MODEL_PREFIX + k for k in keys], index, path)
 
 
-def _restore_optimizer(optimizer, index, path, opt_scalars):
+def _restore_optimizer(optimizer, index, path, opt_scalars,
+                       network=None):
     """Reshard-on-load for the optimizer: init each accumulator leaf with
     the owning param's CURRENT placement as the destination, load into
-    wrappers, write the loaded arrays back into ``_accumulators``."""
+    wrappers, write the loaded arrays back into ``_accumulators``.
+    Keys are the params' canonical model state-dict names (see module
+    docstring); a stage-stacked param assembles each accumulator from
+    the per-block entries the source topology saved."""
     import jax
 
     from ..distributed import checkpoint as dckpt
@@ -117,10 +258,27 @@ def _restore_optimizer(optimizer, index, path, opt_scalars):
     sched = opt_scalars.get("LR_Scheduler")
     if sched and isinstance(optimizer._learning_rate, LRScheduler):
         optimizer._learning_rate.set_state_dict(sched)
+    stacked = _stacked_param_keys(network)
+    names = _param_name_map(network)
     dest, writeback = {}, []
     for i, p in enumerate(optimizer._parameter_list):
-        name = p.name or f"param_{i}"
+        if id(p) in stacked:
+            _restore_stacked_opt(optimizer, p, stacked[id(p)][1], index,
+                                 path, opt_scalars)
+            continue
+        name = names.get(id(p)) or p.name or f"param_{i}"
         st = optimizer._init_state(p._data)
+        if st and all(f"{OPT_PREFIX}{name}.{k}" not in index for k in st):
+            # legacy-key fallback: checkpoints written before the
+            # canonical (model state-dict) key scheme used p.name /
+            # param_<i> — a crash-restart across that code change must
+            # still resume, so probe the old names when the canonical
+            # ones are entirely absent
+            for legacy in (p.name, f"param_{i}"):
+                if legacy and legacy != name and any(
+                        f"{OPT_PREFIX}{legacy}.{k}" in index for k in st):
+                    name = legacy
+                    break
         placed = {}
         sharding = getattr(p._data, "sharding", None)
         missing = [k for k in st
@@ -167,6 +325,49 @@ def _restore_optimizer(optimizer, index, path, opt_scalars):
             optimizer._master_weights[id(p)] = master._data
 
 
+def _restore_stacked_opt(optimizer, p, keys, index, path, opt_scalars):
+    """Optimizer state for one stage-stacked param: every accumulator
+    (and master weight) is assembled from the per-block entries of the
+    SOURCE topology's checkpoint — the stage-move twin of the model-side
+    assembly, so AdamW moments stay on the loss curve across pp moves."""
+    st = optimizer._init_state(p._data)
+    restored = {}
+    missing = [k for k in st
+               if any(f"{OPT_PREFIX}{key}.{k}" not in index
+                      for key in keys)]
+    if missing and not getattr(p, "stop_gradient", False) and (
+            len(missing) != len(st)
+            or int(opt_scalars.get("global_step", 0)) > 0):
+        raise KeyError(
+            f"checkpoint at {path} is missing optimizer state "
+            f"{missing!r} for stacked param {p.name!r} — saved under a "
+            f"different optimizer config?")
+    sharding = getattr(p._data, "sharding", None)
+    for k in st:
+        full = [f"{OPT_PREFIX}{key}.{k}" for key in keys]
+        if any(f not in index for f in full):
+            continue
+        restored[k] = _assemble_stacked(
+            st[k].shape, st[k].dtype, sharding, full, index, path,
+            what="optimizer state")
+    mfull = [f"{OPT_PREFIX}{key}.master_weight" for key in keys]
+    master = None
+    if all(f in index for f in mfull):
+        import jax.numpy as jnp
+
+        master = _assemble_stacked(
+            p._data.shape, jnp.float32, sharding, mfull, index, path,
+            what="optimizer master weight")
+    if restored or master is not None:
+        for k, v in restored.items():
+            st[k] = v
+        optimizer._accumulators[id(p)] = st
+        optimizer._step_counts[id(p)] = int(opt_scalars.get(
+            f"{keys[0]}.step_count", optimizer._global_step))
+        if master is not None:
+            optimizer._master_weights[id(p)] = master
+
+
 def restore(network, optimizer, path, manifest=None, train_step=None,
             crash_resume=False):
     """Restore params / optimizer state / LR schedule / PRNG / counters
@@ -188,7 +389,7 @@ def restore(network, optimizer, path, manifest=None, train_step=None,
     _restore_model(network, index, path)
     if optimizer is not None:
         _restore_optimizer(optimizer, index, path,
-                           scalars.get("opt", {}))
+                           scalars.get("opt", {}), network=network)
     if scalars.get("rng_key") is not None:
         _set_rng_key_words(scalars["rng_key"])
     if train_step is not None:

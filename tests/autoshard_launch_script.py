@@ -3,14 +3,18 @@ successor with ZERO hand-written PartitionSpecs (ISSUE 10 acceptance):
 every placement comes from the shard plan the launcher stamped into
 ``PT_SHARD_PLAN`` (`autoshard.apply_plan` initializes the planned mesh
 and derives the Megatron conjugate pairing for the plain Sequential
-model; the batch is dp-sharded by `autoshard.shard_batch`).
+model; the batch is dp-sharded by `autoshard.shard_batch`;
+`autoshard.stage_model` wraps the repeated Block run into the staged
+pipeline container whenever the plan says pp>1 — ISSUE 15).
 
 Life 0 trains under plan A and crashes mid-run (AUTOSHARD_CRASH_AT).
 The driver (tests/test_autoshard.py) then REPLANS for a different
 topology and relaunches with ``PT_SHARD_RESUME`` pointing at the
-checkpoint dir — reshard-on-load (distributed/checkpoint.py) rebuilds
-every param at the new placements. The stitched loss trajectory must
-stay on the SAME curve as an uninterrupted single-plan run.
+checkpoint dir — reshard-on-load (distributed/checkpoint.py + the
+canonical per-block keys of resilience/resume.py) rebuilds every param
+at the new placements, including across stage moves. The stitched loss
+trajectory must stay on the SAME curve as an uninterrupted single-plan
+run.
 """
 import json
 import os
@@ -41,11 +45,26 @@ life = 1 if resume_dir else 0
 
 plan = autoshard.load_plan(os.environ["PT_SHARD_PLAN"])
 
+
+class Block(nn.Layer):
+    """The repeated (stage-able) unit: a pp>1 plan stacks these."""
+
+    def __init__(self, h):
+        super().__init__()
+        self.fc = nn.Linear(h, h)
+
+    def forward(self, x):
+        return paddle.tanh(self.fc(x))
+
+
 paddle.seed(0)
-model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+model = nn.Sequential(nn.Linear(8, 16), Block(16), Block(16),
+                      nn.Linear(16, 1))
 # the whole point: mesh + every param placement from the plan — no
-# PartitionSpec appears anywhere in this file
+# PartitionSpec appears anywhere in this file, and the pipeline
+# staging (when planned) is the plan's decision too
 env = autoshard.apply_plan(plan, model)
+model = autoshard.stage_model(model, plan)
 opt = paddle.optimizer.AdamW(learning_rate=5e-2,
                              parameters=model.parameters())
 

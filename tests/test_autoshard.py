@@ -1,20 +1,26 @@
-"""Automatic sharding planner (paddle_tpu/autoshard — ISSUE 10).
+"""Automatic sharding planner (paddle_tpu/autoshard — ISSUE 10 + the
+pp axis of ISSUE 15).
 
 Tier-1 coverage:
-- candidate enumeration + the HLO collective parser/axis classifier
-  (pure units)
+- candidate enumeration (incl. the dp×mp×pp sweep, the stage-depth pp
+  cap, and the planned microbatch count) + the HLO collective
+  parser/axis classifier (pure units)
 - GSPMD-style spec derivation (Megatron conjugate pairing from seed
   rules — zero hand-written PartitionSpecs)
 - planner determinism: same inputs → byte-identical ``shard_plan.json``
+  (pp rows included)
 - HBM-infeasible candidates rejected (no plan, exit-code-3 path)
 - per-axis ``collective/bytes/<axis>`` monitor counters
-- ``fit(shard_plan=)`` + ``apply_plan`` placement
-- the ``tools/shard_plan.py plan --smoke`` CLI pipeline proof with the
-  exec-cache-warm zero-fresh-compiles acceptance check
+- ``fit(shard_plan=)`` + ``apply_plan`` placement; a planned pp2 fit
+  training on the pp=1 loss curve (the 1F1B-in-XLA correctness proof)
+- stage-move reshard: a pp1 checkpoint resumed at pp2 (and back) stays
+  on the same loss curve — canonical per-block checkpoint keys
+- the ``tools/shard_plan.py plan --smoke`` CLI pipeline proof with a
+  pp>1 candidate and the exec-cache-warm zero-fresh-compiles check
 
-Slow tier: the 2-process launcher proof — plan at dp2×mp1, launch,
-kill, REPLAN at dp1×mp2, resume through reshard-on-load, losses on the
-same curve (extends the elastic_reshard_script fixture lineage).
+Slow tier: the 2-process launcher proofs — plan/launch/kill/replan/
+resume across a dp→mp reshard AND across a pipelined dp2×pp2 →
+dp1×mp2×pp2 stage-boundary move, losses on the same curve.
 """
 import json
 import os
@@ -52,7 +58,38 @@ class TestCandidates:
 
     def test_bad_token_refused(self):
         with pytest.raises(ValueError, match="bad mesh token"):
-            autoshard.parse_mesh("pp2")
+            autoshard.parse_mesh("xx2")
+
+    def test_pp_tokens_parse(self):
+        assert autoshard.parse_mesh("dp2xmp2xpp2") == {
+            "dp": 2, "mp": 2, "pp": 2}
+        assert autoshard.parse_mesh("dp4xpp2")["pp"] == 2
+
+    def test_pp_enumeration_caps_at_stage_depth(self):
+        # pp=1 rows first in the historical order, then the pipelines;
+        # pp=4 absent: the 2-layer probe cannot stage over 4
+        cands = autoshard.enumerate_candidates(8, None, "8", pp_max=8,
+                                               stage_depth=2)
+        labels = [autoshard.candidate_label(c) for c in cands]
+        assert labels[:4] == ["dp8·mp1 b8", "dp4·mp2 b8", "dp2·mp4 b8",
+                              "dp1·mp8 b8"]
+        assert "dp4·mp1·pp2 b8" in labels
+        assert not any("pp4" in l for l in labels)
+
+    def test_pp_defaults_off_without_cap(self):
+        # callers that predate the pp axis (pp_max default 1) see the
+        # historical dp×mp space unchanged
+        cands = autoshard.enumerate_candidates(8, None, "8")
+        assert all(c["pp"] == 1 for c in cands)
+
+    def test_plan_microbatches_deterministic_rules(self):
+        # pp=1 pipelines nothing; pp>1 takes the largest batch divisor
+        # ≤ 2·pp whose microbatch still dp-shards
+        assert autoshard.plan_microbatches(1, 64) == 1
+        assert autoshard.plan_microbatches(2, 8, dp=4) == 2
+        assert autoshard.plan_microbatches(2, 8, dp=2) == 4
+        assert autoshard.plan_microbatches(2, 16, dp=2) == 4
+        assert autoshard.plan_microbatches(4, 64, dp=1) == 8
 
     def test_axis_order_copies_agree(self):
         # three deliberate literals (env.py is jax-heavy, hlo_costs and
@@ -136,6 +173,26 @@ class TestHloCosts:
         assert c["payload_bytes"] == 512 * 4
         assert c["wire_bytes"] == int(512 * 4 * 7 / 8)
 
+    def test_permute_pairs_classified_per_pair(self):
+        # dp2×pp2×mp2 (AXIS_ORDER dp,pp,sharding,sep,mp): pp stride 2.
+        # The roll of a pp-sharded stage state permutes (0↔2),(1↔3),...
+        # — each {src,tgt} pair is its own hop, so the op classifies as
+        # "pp", not smeared over the union of every pair's axes
+        deg = {"dp": 2, "pp": 2, "sharding": 1, "sep": 1, "mp": 2}
+        hlo = ("  %cp = f32[2,16]{1,0} collective-permute(f32[2,16]{1,0} "
+               "%x), channel_id=5, source_target_pairs="
+               "{{0,2},{2,0},{1,3},{3,1},{4,6},{6,4},{5,7},{7,5}}")
+        (c,) = hlo_costs.parse_collectives(hlo, deg)
+        assert c["op"] == "collective-permute"
+        assert c["axis"] == "pp"
+        assert c["wire_bytes"] == 2 * 16 * 4  # permute moves the payload
+
+    def test_permute_self_pairs_ignored(self):
+        deg = {"dp": 2, "pp": 1, "sharding": 1, "sep": 1, "mp": 1}
+        hlo = ("  %cp = f32[4]{0} collective-permute(f32[4]{0} %x), "
+               "source_target_pairs={{0,0},{1,1}}")
+        assert hlo_costs.parse_collectives(hlo, deg) == []
+
     def test_async_start_tuple_counts_results_only(self):
         # TPU HLO: async start ops are (operands, results) tuples — the
         # operand alias must not double the payload (never visible on
@@ -210,8 +267,31 @@ class TestPlanSchema:
         q = autoshard.load_plan(path)
         assert q.dumps() == p.dumps()
         assert q.digest() == p.digest()
-        assert q.summary() == {"dp": 2, "mp": 1, "batch": 16,
+        assert q.summary() == {"dp": 2, "mp": 1, "pp": 1, "batch": 16,
                                "devices": 2, "digest": p.digest()}
+
+    def test_pp_fields_round_trip(self, tmp_path):
+        p = autoshard.ShardPlan(
+            mesh={"dp": 2, "mp": 1, "pp": 2}, batch=8, param_specs={},
+            n_micro=4, stage_assignment=[0, 0, 1, 1])
+        q = autoshard.load_plan(p.save(str(tmp_path / "pp.json")))
+        assert q.mesh == {"dp": 2, "mp": 1, "pp": 2}
+        assert q.devices == 4
+        assert q.n_micro == 4
+        assert q.stage_assignment == [0, 0, 1, 1]
+
+    def test_pre_pp_plan_files_still_load(self, tmp_path):
+        # a plan written before the pp axis existed (no pp/n_micro/
+        # stage_assignment keys) loads with pipeline defaults
+        d = self._plan().to_dict()
+        d["mesh"] = {"dp": 2, "mp": 1}
+        for k in ("n_micro", "stage_assignment"):
+            d.pop(k, None)
+        path = tmp_path / "old.json"
+        path.write_text(json.dumps(d))
+        q = autoshard.load_plan(str(path))
+        assert q.mesh["pp"] == 1 and q.n_micro == 1
+        assert q.stage_assignment is None
 
     def test_version_skew_refused(self, tmp_path):
         d = self._plan().to_dict()
@@ -285,6 +365,120 @@ class TestPlanner:
         assert any("mp" in str(v) for v in plan.param_specs.values())
 
 
+# -- cost-model fallback terms (pure) ----------------------------------------
+
+class TestCostFallbackTerms:
+    """The analytical comms fallback (no parsed HLO account) must carry
+    the pipeline bubble/handoff and the MoE all-to-all terms — scoring
+    zero comms would hand those candidates a free win."""
+
+    # deliberately slow "hardware": the scored row rounds to 4 decimal
+    # places, so the asserted quantities must land well above 1e-4 ms
+    SEEDS = autoshard.CostSeeds(peak_tflops=1e-3, ici_gbps=0.01,
+                                mfu=0.5, source="test")
+
+    def _score(self, cand, spec):
+        from paddle_tpu.autoshard import cost
+
+        return cost.score_candidate(cand, {}, spec, self.SEEDS)
+
+    def test_pp_bubble_stretches_compute(self):
+        spec = autoshard.ProbeSpec(**_TINY)
+        dense = self._score({"dp": 8, "mp": 1, "pp": 1, "batch": 8,
+                             "n_micro": 1}, spec)
+        piped = self._score({"dp": 4, "mp": 1, "pp": 2, "batch": 8,
+                             "n_micro": 2}, spec)
+        # same device count -> same raw compute; pp2/n_micro2 pays the
+        # (1 + (pp-1)/n_micro) = 1.5x fill/drain bubble
+        assert piped["est_compute_ms"] == pytest.approx(
+            dense["est_compute_ms"] * 1.5, rel=1e-3)
+
+    def test_pp_handoff_wire_term_charged_per_device(self):
+        # dp=mp=1 isolates the pipeline term: ticks = n_micro + pp - 1
+        # = 3; per tick each device ships its own [mb, seq, hidden]
+        # slice of the pp-sharded state (NOT the whole stack), fwd+bwd
+        # -> 2*3*mb_bytes on the wire
+        spec = autoshard.ProbeSpec(**_TINY)
+        piped = self._score({"dp": 1, "mp": 1, "pp": 2, "batch": 8,
+                             "n_micro": 2}, spec)
+        mb_bytes = 4.0 * (8 // 2) * spec.seq * spec.hidden
+        expected_ms = 2 * 3 * mb_bytes / (0.01 * 1e9) * 1e3
+        assert piped["est_comms_ms"] == pytest.approx(expected_ms,
+                                                      rel=1e-3)
+
+    def test_moe_probe_costs_expert_all_to_all(self):
+        dense = autoshard.ProbeSpec(**_TINY)
+        moe = autoshard.ProbeSpec(**{**_TINY, "moe_experts": 4})
+        from paddle_tpu.autoshard import cost
+
+        assert cost.probe_param_count(moe) > cost.probe_param_count(dense)
+        cand = {"dp": 8, "mp": 1, "pp": 1, "batch": 8, "n_micro": 1}
+        assert self._score(cand, moe)["est_comms_ms"] > \
+            self._score(cand, dense)["est_comms_ms"]
+
+    def test_moe_experts_flag_reaches_probe_spec(self):
+        import argparse
+
+        from paddle_tpu.autoshard import cli as _cli
+
+        ap = argparse.ArgumentParser()
+        _cli.add_probe_args(ap)
+        args = ap.parse_args(["--moe-experts", "4"])
+        assert autoshard.ProbeSpec.from_args(args).moe_experts == 4
+
+
+# -- the pp axis on the virtual mesh (ISSUE 15) ------------------------------
+
+_TINY_PP = dict(vocab=128, hidden=32, intermediate=0, layers=2, heads=2,
+                seq=16)
+
+
+class TestPlannerPP:
+    @pytest.fixture(scope="class", autouse=True)
+    def _exec_cache(self, tmp_path_factory):
+        from paddle_tpu.jit import exec_cache
+
+        exec_cache.enable(str(tmp_path_factory.mktemp("autoshard_pp")))
+        yield
+        exec_cache.disable()
+        exec_cache.clear()
+
+    @pytest.fixture(scope="class")
+    def sweep(self):
+        spec = autoshard.ProbeSpec(**_TINY_PP)
+        return autoshard.make_plan(8, 16.0, spec=spec,
+                                   configs="dp4xpp2", batches="8")
+
+    def test_pp_candidate_lowers_and_scores(self, sweep):
+        plan, rows = sweep
+        (row,) = rows
+        assert row["pp"] == 2 and row["n_micro"] == 2
+        assert row.get("fits") and row["est_step_ms"] > 0
+
+    def test_pp_row_carries_handoff_wire_bytes(self, sweep):
+        # the compiled GPipe schedule's collective-permutes must show
+        # up in the post-SPMD comms account attributed to the pp axis
+        _plan, rows = sweep
+        per_axis = rows[0]["collectives"]["per_axis_wire_bytes"]
+        pp_bytes = sum(v for ax, v in per_axis.items()
+                       if "pp" in ax.split("+"))
+        assert pp_bytes > 0, per_axis
+
+    def test_plan_records_pipeline_schedule(self, sweep):
+        plan, _rows = sweep
+        assert plan.mesh == {"dp": 4, "mp": 1, "pp": 2}
+        assert plan.devices == 8
+        assert plan.n_micro == 2
+        assert plan.stage_assignment == [0, 1]  # 2 layers over 2 stages
+
+    def test_pp_plan_byte_identical_on_repeat(self, sweep):
+        plan, _rows = sweep
+        spec = autoshard.ProbeSpec(**_TINY_PP)
+        plan2, _ = autoshard.make_plan(8, 16.0, spec=spec,
+                                       configs="dp4xpp2", batches="8")
+        assert plan2.dumps() == plan.dumps()
+
+
 # -- per-axis collective counters --------------------------------------------
 
 class TestPerAxisCollectiveBytes:
@@ -305,6 +499,38 @@ class TestPerAxisCollectiveBytes:
             dist.all_reduce(t, group="dp")
             snap = monitor.snapshot()["counters"]
             assert snap.get("collective/bytes/dp") == 8 * 8 * 4
+        finally:
+            monitor.disable()
+            monitor.reset()
+            env_mod.reset_env()
+
+    def test_pipeline_forward_attributes_pp_bytes(self):
+        # the compiled ppermute handoff never reaches the eager
+        # collective hook — the pipeline container accounts it
+        # analytically (pipeline/* + collective/bytes/pp), ISSUE 15
+        from paddle_tpu import monitor
+        from paddle_tpu.distributed import env as env_mod
+
+        try:
+            plan = _pp_plan(2, 1, 2, n_micro=2)
+            net = _pp_net()
+            autoshard.apply_plan(plan, net)
+            net = autoshard.stage_model(net, plan)
+            monitor.enable()
+            monitor.reset()
+            x = pt.to_tensor(np.random.randn(8, 8).astype(np.float32))
+            net(x)
+            snap = monitor.snapshot()
+            c = snap["counters"]
+            assert c.get("pipeline/forwards") == 1
+            assert c.get("pipeline/microbatches") == 2
+            assert c.get("pipeline/ticks") == 3  # n_micro + pp - 1
+            # per tick: [pp=2, mb=4, 16] fp32 state permuted
+            assert c.get("pipeline/p2p_bytes") == 3 * 2 * 4 * 16 * 4
+            assert c.get("collective/bytes/pp") == \
+                c.get("pipeline/p2p_bytes")
+            assert snap["gauges"].get("pipeline/bubble_frac") == \
+                pytest.approx(1 / 3)
         finally:
             monitor.disable()
             monitor.reset()
@@ -402,6 +628,303 @@ class TestApplyPlan:
             env_mod.reset_env()
 
 
+# -- pp staging + stage-move reshard (ISSUE 15) ------------------------------
+
+_nn = __import__("paddle_tpu.nn", fromlist=["nn"])
+
+
+class _PPBlock(_nn.Layer):
+    """The repeated (stage-able) unit — ONE class, so the pipeline
+    container's repeated-run detection sees identical block types."""
+
+    def __init__(self, width):
+        super().__init__()
+        self.fc = _nn.Linear(width, width)
+
+    def forward(self, x):
+        return pt.tanh(self.fc(x))
+
+
+def _pp_net(out_dim=1):
+    pt.seed(0)
+    return _nn.Sequential(_nn.Linear(8, 16), _PPBlock(16), _PPBlock(16),
+                          _nn.Linear(16, out_dim))
+
+
+def _pp_plan(dp, mp, pp, n_micro=1, batch=8):
+    return autoshard.ShardPlan(mesh={"dp": dp, "mp": mp, "pp": pp},
+                               batch=batch, param_specs={},
+                               n_micro=n_micro)
+
+
+class TestPipelineStaging:
+    def test_stage_model_wraps_block_run(self):
+        from paddle_tpu.distributed import env as env_mod
+        from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers \
+            .pp_layers import PipelineLayer
+
+        try:
+            plan = _pp_plan(2, 1, 2, n_micro=2)
+            net = _pp_net()
+            autoshard.apply_plan(plan, net)
+            staged = autoshard.stage_model(net, plan)
+            assert isinstance(staged, PipelineLayer) and staged._pipelined
+            assert staged._n_blocks == 2
+            names = dict(staged.named_parameters())
+            assert any(n.startswith("stack__") for n in names)
+            # pp=1 plans stage nothing
+            env_mod.reset_env()
+            plan1 = _pp_plan(2, 1, 1)
+            net1 = _pp_net()
+            autoshard.apply_plan(plan1, net1)
+            assert autoshard.stage_model(net1, plan1) is net1
+        finally:
+            env_mod.reset_env()
+
+    def test_canonical_state_dict_covers_block_buffers(self):
+        # the staged container shares ONE buffer across blocks
+        # (blocks[1:]'s copies are discarded at construction); the
+        # canonical checkpoint surface must still write/read it under
+        # every block's flat key so flat↔staged round trips never miss
+        # a tensor
+        from paddle_tpu.distributed import env as env_mod
+
+        class BufBlock(_nn.Layer):
+            def __init__(self, width):
+                super().__init__()
+                self.fc = _nn.Linear(width, width)
+                self.register_buffer("scale",
+                                     pt.to_tensor(np.float32(1.5)))
+
+            def forward(self, x):
+                return pt.tanh(self.fc(x)) * self.scale
+
+        try:
+            plan = _pp_plan(2, 1, 2, n_micro=2)
+            pt.seed(0)
+            net = _nn.Sequential(_nn.Linear(8, 16), BufBlock(16),
+                                 BufBlock(16), _nn.Linear(16, 1))
+            autoshard.apply_plan(plan, net)
+            staged = autoshard.stage_model(net, plan)
+            sd = staged.state_dict()
+            assert "1.scale" in sd and "2.scale" in sd
+            sd2 = {k: (pt.to_tensor(np.float32(2.0))
+                       if k.endswith(".scale") else v)
+                   for k, v in sd.items()}
+            missing, unexpected = staged.set_state_dict(sd2)
+            assert not missing and not unexpected
+            assert float(staged._template.scale.numpy()) == 2.0
+            # the canonical keys equal the flat (pp=1) container's
+            env_mod.reset_env()
+            autoshard.apply_plan(_pp_plan(2, 1, 1))
+            pt.seed(0)
+            flat = _nn.Sequential(_nn.Linear(8, 16), BufBlock(16),
+                                  BufBlock(16), _nn.Linear(16, 1))
+            from paddle_tpu.distributed.fleet.meta_parallel \
+                .parallel_layers.pp_layers import PipelineLayer
+
+            flat_pipe = PipelineLayer(
+                [sub for _, sub in flat.named_children()])
+            assert set(flat_pipe.state_dict()) == set(sd)
+        finally:
+            env_mod.reset_env()
+
+    def test_stage_model_keeps_remat_knobs(self):
+        from paddle_tpu.distributed import env as env_mod
+        from paddle_tpu.distributed.fleet.meta_parallel \
+            .parallel_layers.pp_layers import PipelineLayer
+
+        try:
+            # a pp=1-built container with remat knobs set: re-staging
+            # under a pp2 plan must carry them (the probe the plan
+            # judged ran WITH remat — docs/AUTOSHARD.md)
+            env_mod.init_mesh(dp=8)
+            pre = PipelineLayer(
+                [_PPBlock(16), _PPBlock(16)], recompute_interval=1,
+                remat_ticks=True, loss_fn=lambda o, l: o.mean())
+            assert not pre._pipelined
+            env_mod.reset_env()
+            plan = _pp_plan(4, 1, 2, n_micro=2)
+            autoshard.apply_plan(plan)
+            staged = autoshard.stage_model(pre, plan)
+            assert staged._pipelined
+            assert staged._recompute == 1
+            assert staged._remat_ticks is True
+            assert staged.loss_fn is pre.loss_fn
+        finally:
+            env_mod.reset_env()
+
+    def test_stage_model_unstageable_raises_with_hint(self):
+        from paddle_tpu.distributed import env as env_mod
+        import paddle_tpu.nn as nn
+
+        try:
+            plan = _pp_plan(4, 1, 2, n_micro=2)
+            net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(),
+                                nn.Linear(16, 1))  # no repeated run ≥ 2
+            autoshard.apply_plan(plan, net)
+            with pytest.raises(ValueError, match="PipelineLayer|Pipe"):
+                autoshard.stage_model(net, plan)
+        finally:
+            env_mod.reset_env()
+
+    def test_fit_planned_pp2_matches_pp1_curve(self, tmp_path):
+        """ISSUE 15 acceptance: a planned pp2 fit() on the virtual
+        8-device CPU mesh trains with losses matching the pp=1
+        baseline curve — the 1F1B-in-XLA correctness proof."""
+        from paddle_tpu.distributed import env as env_mod
+        from paddle_tpu.hapi import Model
+
+        rng = np.random.default_rng(3)
+        xs = rng.standard_normal((16, 8)).astype("float32")
+        ys = rng.integers(0, 4, (16, 1))
+        ds = [(xs[i], ys[i]) for i in range(16)]
+
+        def run(plan):
+            losses = []
+
+            class Tap(pt.callbacks.Callback):
+                def on_train_batch_end(self, step, logs=None):
+                    losses.append(float(logs["loss"]))
+
+            try:
+                plan_path = plan.save(
+                    str(tmp_path / f"plan_pp{plan.mesh['pp']}.json"))
+                net = _pp_net(out_dim=4)
+                m = Model(net)
+                m.prepare(pt.optimizer.AdamW(
+                    learning_rate=1e-2, parameters=net.parameters()),
+                    pt.nn.CrossEntropyLoss())
+                m.fit(ds, batch_size=8, epochs=1, verbose=0, log_freq=1,
+                      shuffle=False, shard_plan=plan_path,
+                      callbacks=[Tap()])
+            finally:
+                env_mod.reset_env()
+            return losses
+
+        base = run(_pp_plan(2, 1, 1))
+        pp2 = run(_pp_plan(2, 1, 2, n_micro=2))
+        assert len(base) == len(pp2) == 2
+        for a, b in zip(base, pp2):
+            assert abs(a - b) <= 1e-4 * max(1.0, abs(a)), (base, pp2)
+
+
+class TestStageMoveReshard:
+    """A checkpoint saved at one pp resumes at another ON THE SAME LOSS
+    CURVE — the canonical per-block checkpoint keys + the stacked
+    assemble/split in resilience/resume.py (docs/RESILIENCE.md)."""
+
+    STEPS = 4
+    MOVE_AT = 2
+
+    def _train(self, plan, steps, data, workdir, resume=False,
+               ckpt_at=None):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu import resilience
+        from paddle_tpu.distributed import env as env_mod
+        from paddle_tpu.resilience import resume as rez
+
+        xs, w_true = data
+        try:
+            net = _pp_net()
+            autoshard.apply_plan(plan, net)
+            net = autoshard.stage_model(net, plan)
+            opt = pt.optimizer.AdamW(learning_rate=5e-2,
+                                     parameters=net.parameters())
+            start = 0
+            if resume:
+                scal = rez.restore_latest(net, opt, str(workdir))
+                start = int(scal.get("step", 0))
+            mgr = resilience.CheckpointManager(str(workdir), interval=1,
+                                               keep=3, async_save=False)
+            losses = []
+            for step in range(start, steps):
+                x = autoshard.shard_batch(pt.to_tensor(xs[step]))
+                y = autoshard.shard_batch(pt.to_tensor(xs[step] @ w_true))
+                loss = F.mse_loss(net(x), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(np.asarray(
+                    loss.numpy()).reshape(-1)[0]))
+                if ckpt_at is not None and step + 1 == ckpt_at:
+                    mgr.save(step + 1,
+                             rez.capture(net, opt, step=step + 1))
+            return losses
+        finally:
+            env_mod.reset_env()
+
+    @pytest.fixture(scope="class")
+    def data(self):
+        rng = np.random.default_rng(0)
+        return (rng.standard_normal((self.STEPS, 8, 8)).astype("float32"),
+                rng.standard_normal((8, 1)).astype("float32"))
+
+    @pytest.fixture(scope="class")
+    def reference(self, data, tmp_path_factory):
+        wd = tmp_path_factory.mktemp("ref")
+        return self._train(_pp_plan(2, 1, 1), self.STEPS, data, wd)
+
+    def _assert_on_curve(self, ref, got):
+        assert len(ref) == len(got)
+        for i, (a, b) in enumerate(zip(ref, got)):
+            assert abs(a - b) <= 1e-4 * max(1.0, abs(a)), (i, ref, got)
+
+    def test_pp1_checkpoint_resumes_at_pp2(self, data, reference,
+                                           tmp_path):
+        first = self._train(_pp_plan(2, 1, 1), self.MOVE_AT, data,
+                            tmp_path, ckpt_at=self.MOVE_AT)
+        second = self._train(_pp_plan(2, 1, 2, n_micro=2), self.STEPS,
+                             data, tmp_path, resume=True)
+        self._assert_on_curve(reference, first + second)
+
+    def test_pp2_checkpoint_resumes_at_pp1(self, data, reference,
+                                           tmp_path):
+        first = self._train(_pp_plan(2, 1, 2, n_micro=2), self.MOVE_AT,
+                            data, tmp_path, ckpt_at=self.MOVE_AT)
+        second = self._train(_pp_plan(4, 1, 1), self.STEPS, data,
+                             tmp_path, resume=True)
+        self._assert_on_curve(reference, first + second)
+
+    def test_nested_pipe_checkpoints_round_trip_raw(self, tmp_path):
+        """The canonical per-block layout is scoped to a TOP-LEVEL
+        pipeline network: a pipe nested inside a wrapper model
+        checkpoints its raw stacked tensors through the generic
+        Layer.state_dict and restores in place (same-topology reshard,
+        no stage-move conversion, no crash)."""
+        from paddle_tpu import resilience
+        from paddle_tpu.distributed import env as env_mod
+        from paddle_tpu.resilience import resume as rez
+
+        class Wrapper(_nn.Layer):
+            def __init__(self):
+                super().__init__()
+                plan = _pp_plan(2, 1, 2, n_micro=2)
+                inner = _pp_net()
+                self.pipe = autoshard.stage_model(inner, plan)
+
+            def forward(self, x):
+                return self.pipe(x)
+
+        try:
+            autoshard.apply_plan(_pp_plan(2, 1, 2, n_micro=2))
+            w = Wrapper()
+            assert any(".stack__" in k for k in w.state_dict())
+            flat, scalars = rez.capture(w, None, step=1)
+            mgr = resilience.CheckpointManager(str(tmp_path), interval=1,
+                                               keep=1, async_save=False)
+            mgr.save(1, (flat, scalars))
+            autoshard.apply_plan(_pp_plan(2, 1, 2, n_micro=2))
+            w2 = Wrapper()
+            rez.restore_latest(w2, None, str(tmp_path))
+            for (k, a), (_, b) in zip(w.state_dict().items(),
+                                      w2.state_dict().items()):
+                np.testing.assert_array_equal(
+                    np.asarray(a._data), np.asarray(b._data), err_msg=k)
+        finally:
+            env_mod.reset_env()
+
+
 # -- CLI: the tier-1 pipeline proof ------------------------------------------
 
 def _run_plan_cli(out, cache, extra=()):
@@ -414,13 +937,16 @@ def _run_plan_cli(out, cache, extra=()):
 
 
 def test_cli_smoke_deterministic_and_exec_cache_warm(tmp_path):
-    """Acceptance: `shard_plan.py plan` emits a deterministic plan whose
-    winner fits, and a second invocation with PT_EXEC_CACHE set reports
-    ZERO fresh XLA compiles."""
+    """Acceptance (ISSUE 10 + 15): `shard_plan.py plan --smoke`
+    enumerates and scores a pp>1 candidate next to the dp×mp ones,
+    emits a deterministic plan whose winner fits, and a second
+    invocation with PT_EXEC_CACHE set reports ZERO fresh XLA
+    compiles."""
     cache = tmp_path / "cache"
     cold = _run_plan_cli(tmp_path / "p1.json", cache)
     assert cold.returncode == 0, cold.stderr[-2000:]
     assert "FITS" in cold.stdout and "winner:" in cold.stdout
+    assert "·pp2" in cold.stdout  # the smoke sweep's pipeline candidate
     warm = _run_plan_cli(tmp_path / "p2.json", cache)
     assert warm.returncode == 0, warm.stderr[-2000:]
     line = json.loads([ln for ln in warm.stdout.splitlines()
@@ -431,9 +957,89 @@ def test_cli_smoke_deterministic_and_exec_cache_warm(tmp_path):
     plan = autoshard.load_plan(str(tmp_path / "p1.json"))
     winner_row = next(r for r in plan.rows if r["label"] == plan.winner)
     assert winner_row["fits"]
+    pp_row = next(r for r in plan.rows if r.get("pp", 1) > 1)
+    assert "error" not in pp_row and pp_row.get("fits")
+    assert pp_row["est_step_ms"] > 0 and pp_row["n_micro"] > 1
 
 
-# -- the launcher proof (slow tier) ------------------------------------------
+# -- the launcher proofs (slow tier) -----------------------------------------
+
+_SCRIPT = str(Path(__file__).parent / "autoshard_launch_script.py")
+
+
+def _make_plan_file(configs, path, devices=2):
+    proc = subprocess.run(
+        [sys.executable, "tools/shard_plan.py", "plan",
+         "--devices", str(devices), "--configs", configs,
+         "--out", str(path),
+         "--hidden", "32", "--layers", "2", "--heads", "2",
+         "--seq", "16", "--vocab", "64", "--batches", "8"],
+        cwd=_ROOT, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return str(path)
+
+
+def _launch(workdir, plan, crash_at, resume=False):
+    env = dict(os.environ)
+    env["AUTOSHARD_CRASH_AT"] = str(crash_at)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PADDLE_RESTART_COUNT", None)
+    if resume:
+        env["PT_SHARD_RESUME"] = str(workdir / "ckpt")
+    else:
+        env.pop("PT_SHARD_RESUME", None)
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--max_restart", "0", "--shard_plan", plan,
+         "--log_dir", str(workdir / "log"), _SCRIPT, str(workdir)],
+        cwd=_ROOT, env=env, capture_output=True, text=True,
+        timeout=600)
+
+
+def _losses_of(workdir):
+    out = {}
+    for f in sorted(workdir.glob("losses_r*.json")):
+        data = json.loads(f.read_text())
+        for i, l in enumerate(data["losses"]):
+            out[data["start"] + i] = l
+    return out
+
+
+def _run_launch_proof(tmp_path, plan_a, plan_b, mesh_b, crash_at=3):
+    """Shared plan→launch→kill→replan→resume scaffolding: returns
+    nothing, asserts the stitched curve matches the clean plan-A run."""
+    clean_dir = tmp_path / "clean"
+    clean_dir.mkdir()
+    proc = _launch(clean_dir, plan_a, crash_at=-1)
+    assert proc.returncode == 0, proc.stderr[-2000:] + "".join(
+        p.read_text()[-2000:]
+        for p in (clean_dir / "log").glob("workerlog.*"))
+    clean = _losses_of(clean_dir)
+
+    # crash run: life 0 under plan A dies mid-run (launcher + worker =
+    # the 2-process proof)...
+    crash_dir = tmp_path / "crash"
+    crash_dir.mkdir()
+    proc = _launch(crash_dir, plan_a, crash_at=crash_at)
+    assert proc.returncode == 17, proc.stderr[-2000:]
+    # ...then the REPLANNED topology resumes the same checkpoints
+    proc = _launch(crash_dir, plan_b, crash_at=-1, resume=True)
+    assert proc.returncode == 0, proc.stderr[-2000:] + "".join(
+        p.read_text()[-2000:]
+        for p in (crash_dir / "log").glob("workerlog.*"))
+    crashed = _losses_of(crash_dir)
+
+    assert sorted(clean) == sorted(crashed) == list(range(6))
+    r1 = json.loads((crash_dir / "losses_r1.json").read_text())
+    assert r1["start"] == crash_at       # resumed, not restarted
+    assert r1["mesh"] == mesh_b          # ...under the replanned mesh
+    for step in range(6):
+        # same curve, not bit-identical: the mesh change legitimately
+        # reorders reductions
+        assert abs(clean[step] - crashed[step]) <= 1e-4 * max(
+            1.0, abs(clean[step])), (step, clean[step], crashed[step])
+
 
 @pytest.mark.slow
 def test_plan_launch_kill_replan_resume(tmp_path):
@@ -441,74 +1047,27 @@ def test_plan_launch_kill_replan_resume(tmp_path):
     through the launcher, kill mid-run, REPLAN at dp1×mp2, resume the
     checkpoint through reshard-on-load — losses on the same curve, with
     no hand-written PartitionSpecs anywhere in the test path."""
-    script = str(Path(__file__).parent / "autoshard_launch_script.py")
+    plan_a = _make_plan_file("dp2xmp1", tmp_path / "plan_a.json")
+    plan_b = _make_plan_file("dp1xmp2", tmp_path / "plan_b.json")
+    assert autoshard.load_plan(plan_a).mesh == {"dp": 2, "mp": 1, "pp": 1}
+    assert autoshard.load_plan(plan_b).mesh == {"dp": 1, "mp": 2, "pp": 1}
+    _run_launch_proof(tmp_path, plan_a, plan_b,
+                      mesh_b={"dp": 1, "mp": 2, "pp": 1})
 
-    def make_plan_file(configs, path):
-        proc = subprocess.run(
-            [sys.executable, "tools/shard_plan.py", "plan",
-             "--devices", "2", "--configs", configs, "--out", str(path),
-             "--hidden", "32", "--layers", "1", "--heads", "2",
-             "--seq", "16", "--vocab", "64", "--batches", "8"],
-            cwd=_ROOT, capture_output=True, text=True, timeout=900)
-        assert proc.returncode == 0, proc.stderr[-2000:]
-        return str(path)
 
-    plan_a = make_plan_file("dp2xmp1", tmp_path / "plan_a.json")
-    plan_b = make_plan_file("dp1xmp2", tmp_path / "plan_b.json")
-    assert autoshard.load_plan(plan_a).mesh == {"dp": 2, "mp": 1}
-    assert autoshard.load_plan(plan_b).mesh == {"dp": 1, "mp": 2}
-
-    def launch(workdir, plan, crash_at, resume=False):
-        env = dict(os.environ)
-        env["AUTOSHARD_CRASH_AT"] = str(crash_at)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
-        env.pop("PADDLE_RESTART_COUNT", None)
-        if resume:
-            env["PT_SHARD_RESUME"] = str(workdir / "ckpt")
-        else:
-            env.pop("PT_SHARD_RESUME", None)
-        return subprocess.run(
-            [sys.executable, "-m", "paddle_tpu.distributed.launch",
-             "--max_restart", "0", "--shard_plan", plan,
-             "--log_dir", str(workdir / "log"), script, str(workdir)],
-            cwd=_ROOT, env=env, capture_output=True, text=True,
-            timeout=300)
-
-    def losses_of(workdir):
-        out = {}
-        for f in sorted(workdir.glob("losses_r*.json")):
-            data = json.loads(f.read_text())
-            for i, l in enumerate(data["losses"]):
-                out[data["start"] + i] = l
-        return out
-
-    # clean single-plan run: the reference curve
-    clean_dir = tmp_path / "clean"
-    clean_dir.mkdir()
-    proc = launch(clean_dir, plan_a, crash_at=-1)
-    assert proc.returncode == 0, proc.stderr[-2000:] + "".join(
-        p.read_text()[-2000:] for p in (clean_dir / "log").glob("workerlog.*"))
-    clean = losses_of(clean_dir)
-
-    # crash run: life 0 under plan A dies at step 3 (launcher + worker =
-    # the 2-process proof)...
-    crash_dir = tmp_path / "crash"
-    crash_dir.mkdir()
-    proc = launch(crash_dir, plan_a, crash_at=3)
-    assert proc.returncode == 17, proc.stderr[-2000:]
-    # ...then the REPLANNED topology resumes the same checkpoints
-    proc = launch(crash_dir, plan_b, crash_at=-1, resume=True)
-    assert proc.returncode == 0, proc.stderr[-2000:] + "".join(
-        p.read_text()[-2000:] for p in (crash_dir / "log").glob("workerlog.*"))
-    crashed = losses_of(crash_dir)
-
-    assert sorted(clean) == sorted(crashed) == list(range(6))
-    r1 = json.loads((crash_dir / "losses_r1.json").read_text())
-    assert r1["start"] == 3              # resumed, not restarted
-    assert r1["mesh"] == {"dp": 1, "mp": 2}  # ...under the replanned mesh
-    for step in range(6):
-        # same curve, not bit-identical: the mesh change legitimately
-        # reorders reductions
-        assert abs(clean[step] - crashed[step]) <= 1e-4 * max(
-            1.0, abs(clean[step])), (step, clean[step], crashed[step])
+@pytest.mark.slow
+def test_plan_launch_kill_replan_resume_pp(tmp_path):
+    """ISSUE 15 acceptance: the launcher proof across a stage boundary
+    — plan dp2×pp2 on 4 virtual devices, launch, kill mid-run, replan
+    dp1×mp2×pp2, resume the PIPELINED checkpoints through the
+    canonical per-block reshard — losses on the clean curve."""
+    plan_a = _make_plan_file("dp2xpp2", tmp_path / "plan_a.json",
+                             devices=4)
+    plan_b = _make_plan_file("dp1xmp2xpp2", tmp_path / "plan_b.json",
+                             devices=4)
+    pa = autoshard.load_plan(plan_a)
+    assert pa.mesh == {"dp": 2, "mp": 1, "pp": 2}
+    assert pa.n_micro > 1 and pa.stage_assignment == [0, 1]
+    assert autoshard.load_plan(plan_b).mesh == {"dp": 1, "mp": 2, "pp": 2}
+    _run_launch_proof(tmp_path, plan_a, plan_b,
+                      mesh_b={"dp": 1, "mp": 2, "pp": 2})

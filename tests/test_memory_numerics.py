@@ -217,11 +217,13 @@ class TestMemoryPlanner:
         return planner.build_argparser().parse_args(argv)
 
     def test_mesh_token_parsing(self, planner):
-        assert planner.parse_mesh("dp4xmp2") == {"dp": 4, "mp": 2}
-        assert planner.parse_mesh("dp8") == {"dp": 8, "mp": 1}
-        assert planner.parse_mesh("mp8") == {"dp": 1, "mp": 8}
+        assert planner.parse_mesh("dp4xmp2") == {"dp": 4, "mp": 2,
+                                                 "pp": 1}
+        assert planner.parse_mesh("dp8") == {"dp": 8, "mp": 1, "pp": 1}
+        assert planner.parse_mesh("dp4xpp2") == {"dp": 4, "mp": 1,
+                                                 "pp": 2}
         with pytest.raises(ValueError, match="bad mesh token"):
-            planner.parse_mesh("pp2")
+            planner.parse_mesh("xx2")
 
     def test_bad_factorization_refused(self, planner):
         args = self._args(planner, configs="dp4xmp2")
@@ -246,17 +248,19 @@ class TestMemoryPlanner:
 
     def test_cli_smoke(self):
         """The acceptance-criterion invocation: the CLI on the virtual
-        8-device mesh prints a fits table for ≥ 3 candidates, from
-        lowering-only data, rc 0."""
+        8-device mesh prints a fits table for ≥ 4 candidates (incl. the
+        pp>1 pipeline column — ISSUE 15), from lowering-only data,
+        rc 0."""
         proc = subprocess.run(
             [sys.executable, "tools/memory_planner.py",
              "--hbm-gb", "16", "--smoke"],
             cwd=_ROOT, capture_output=True, text=True, timeout=900)
         assert proc.returncode == 0, proc.stderr[-2000:]
         out = proc.stdout
-        assert out.count("FITS") >= 3
+        assert out.count("FITS") >= 4
+        assert "·pp2" in out  # the pipeline candidate's row
         assert "memory planner: budget 16.00 GiB/device" in out
-        assert "3/3 candidate config(s) fit" in out
+        assert "4/4 candidate config(s) fit" in out
 
 
 # -- numerics sentinel -------------------------------------------------------

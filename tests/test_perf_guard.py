@@ -400,6 +400,27 @@ class TestEvaluate:
         assert bad and not bad[0]["ok"]
         assert "dp 4→8" in bad[0]["detail"]
 
+    def test_flags_pp_drift_with_pre_pp_baseline(self, guard):
+        # a baseline persisted before the planner's pp axis existed
+        # reads as pp=1 (not a wildcard): a fresh pp2 plan for the same
+        # topology is drift, not a pass
+        base = {"metric": "shard_plan_planned_vs_measured", "value": 900.0,
+                "backend": "tpu",
+                "extra": {"shard_plan": {"dp": 8, "mp": 1, "batch": 8,
+                                         "devices": 8}}}
+        fresh = {"metric": "shard_plan_planned_vs_measured", "value": 910.0,
+                 "unit": "tokens/s",
+                 "shard_plan": {"dp": 4, "mp": 1, "pp": 2, "batch": 8,
+                                "devices": 8}}
+        v = guard.evaluate(fresh, base, hardware=True)
+        bad = [c for c in v["checks"] if c["name"] == "plan_drift"]
+        assert bad and not bad[0]["ok"]
+        assert "pp 1→2" in bad[0]["detail"]
+
+    def test_pp_joins_config_keys_with_default_one(self, guard):
+        assert "pp" in guard.CONFIG_KEYS
+        assert guard.CONFIG_KEY_DEFAULTS["pp"] == 1
+
     def test_plan_drift_same_plan_passes(self, guard):
         plan = {"dp": 4, "mp": 2, "batch": 8, "devices": 8}
         base = {"metric": "shard_plan_planned_vs_measured", "value": 900.0,

@@ -245,6 +245,49 @@ def test_fit_resume_of_finished_run_is_noop(tmp_path):
         assert np.array_equal(np.asarray(a._data), np.asarray(b._data)), k
 
 
+def test_restore_reads_legacy_optimizer_keys(tmp_path, monkeypatch):
+    """A checkpoint written before the canonical (model state-dict)
+    optimizer key scheme — keys under ``p.name``/``param_<i>`` — still
+    resumes: the restore probes the legacy names when the canonical
+    ones are absent (a crash-restart across that code change is
+    exactly the resilience use case)."""
+    import paddle_tpu.nn.functional as F
+
+    def build():
+        paddle.seed(7)
+        net = nn.Sequential(nn.Linear(8, 4))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=net.parameters())
+        return net, opt
+
+    net, opt = build()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8).astype("float32"))
+    loss = F.mse_loss(net(x), paddle.zeros([4, 4]))
+    loss.backward()
+    opt.step()
+    # simulate the pre-canonical writer: no model-name map, so capture
+    # falls back to p.name / param_<i> — the legacy key scheme
+    monkeypatch.setattr(rez, "_param_name_map", lambda network: {})
+    flat, scalars = rez.capture(net, opt, step=1)
+    monkeypatch.undo()
+    assert not any(k.startswith("opt.0.weight") for k in flat)
+    ck = str(tmp_path / "ck")
+    mgr = resilience.CheckpointManager(ck, interval=1, async_save=False)
+    mgr.save(1, (flat, scalars))
+
+    net2, opt2 = build()
+    rez.restore_latest(net2, opt2, ck)
+    for p, p2 in zip(opt._parameter_list, opt2._parameter_list):
+        st, st2 = (opt._accumulators.get(id(p)),
+                   opt2._accumulators.get(id(p2)))
+        assert (st is None) == (st2 is None)
+        if st is not None:
+            np.testing.assert_array_equal(np.asarray(st["moment1"]),
+                                          np.asarray(st2["moment1"]))
+    assert opt2._global_step == 1
+
+
 def test_restore_reshards_to_new_mesh(tmp_path):
     """Save with params (and optimizer moments) sharded over a 2-device
     mesh axis, restore into a 4-device layout: values identical, new

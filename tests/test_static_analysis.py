@@ -365,6 +365,29 @@ class TestProgramAuditFixtures:
             jnp.ones((8, 8))).compile()
         assert pa.audit_hlo(good.as_text(), donate_expected=True) == []
 
+    def test_missing_pp_handoff_fixture(self):
+        # PA005 (ISSUE 15): pp>1 train-step with no cross-pp
+        # collective-permute = the stage handoff was compiled out.
+        # Text fixtures (AXIS_ORDER dp,pp,sharding,sep,mp; dp2×pp2:
+        # pp stride 1 → pairs (0,1),(2,3) cross pp only)
+        degrees = {"dp": 2, "pp": 2}
+        bad = ("HloModule step\n"
+               "  %ar = f32[8]{0} all-reduce(f32[8]{0} %x), "
+               "replica_groups={{0,2},{1,3}}, to_apply=%add\n")
+        fs = pa.audit_hlo(bad, degrees=degrees, expect_pp=True)
+        assert [f["rule"] for f in fs] == ["PA005"]
+        assert fs[0]["name"] == "missing_pp_handoff"
+        good = bad + (
+            "  %cp = f32[8]{0} collective-permute(f32[8]{0} %y), "
+            "source_target_pairs={{0,1},{1,0},{2,3},{3,2}}\n")
+        assert pa.audit_hlo(good, degrees=degrees, expect_pp=True) == []
+        # the ZeRO-style head/tail all-gather over pp is NOT a handoff
+        gathered = bad + (
+            "  %ag = f32[16]{0} all-gather(f32[8]{0} %z), "
+            "replica_groups={{0,1},{2,3}}, dimensions={0}\n")
+        fs = pa.audit_hlo(gathered, degrees=degrees, expect_pp=True)
+        assert [f["rule"] for f in fs] == ["PA005"]
+
     def test_host_callback_fixture(self):
         def noisy(x):
             jax.debug.print("s={s}", s=x.sum())

@@ -89,10 +89,12 @@ BENCHES = [
     ("kernel_search", [sys.executable, "tools/kernel_search.py"], 2400,
      None),
     # automatic sharding planner (docs/AUTOSHARD.md): timeboxed candidate
-    # sweep + a short measured run of the winner and runner-up — persists
-    # the planned-vs-measured throughput delta (the cost-model
-    # calibration number) and the plan the guard's --plan-drift gate
-    # pins for this topology
+    # sweep — dp×mp×pp since ISSUE 15, so pipeline candidates are judged
+    # and measured too — + a short measured run of the winner and
+    # runner-up; persists the planned-vs-measured throughput delta (the
+    # cost-model calibration number, incl. the bubble model's first
+    # hardware anchor) and the (dp, mp, pp, batch) plan the guard's
+    # --plan-drift gate pins for this topology
     ("shard_plan", [sys.executable, "tools/shard_plan.py", "bench"],
      2400, None),
     ("profile", [sys.executable, "tools/profile_train_step.py"], 1800,

@@ -7,10 +7,13 @@ per candidate beyond the AOT compile.
     python tools/memory_planner.py --hbm-gb 16 --devices 8 \
         --configs dp8,dp4xmp2,dp2xmp4 --batches 4,8 --hidden 512 --layers 4
 
-For each candidate (dp × mp mesh split, batch size) the planner builds
-the model under that mesh, AOT-compiles the full train step
-(fwd+bwd+optimizer — `jit/train_step.py`), and reads XLA's own
-executable memory accounting (`monitor/memory.py:executable_record`;
+For each candidate (dp × mp × pp mesh split, batch size — the pp
+column rides the planner's shared enumeration, capped by the probe's
+``--layers`` stage depth and ``PT_AUTOSHARD_PP_MAX``) the planner
+builds the model under that mesh, AOT-compiles the full train step
+(fwd+bwd+optimizer — `jit/train_step.py`; pp>1 candidates compile the
+pipeline-staged probe), and reads XLA's own executable memory
+accounting (`monitor/memory.py:executable_record`;
 per-device for SPMD executables) against the ``--hbm-gb`` budget. A
 90 s tunnel compile that would end in an OOM becomes a table row
 instead (PAPERS: *GSPMD*, *Memory-efficient array redistribution* — the
@@ -74,8 +77,13 @@ def default_meshes(n_devices: int) -> list:
 
 
 def candidates(args, n_devices: int) -> list:
-    return _candidates_mod().enumerate_candidates(
-        n_devices, args.configs, str(args.batches))
+    c = _candidates_mod()
+    # the pp column rides the shared enumeration (ISSUE 15): default
+    # sweeps include pipeline candidates up to the probe's stage-able
+    # depth (--layers), bounded by PT_AUTOSHARD_PP_MAX
+    return c.enumerate_candidates(
+        n_devices, args.configs, str(args.batches),
+        pp_max=c.pp_cap(args.layers), stage_depth=args.layers)
 
 
 def plan_one(cand: dict, args) -> dict:

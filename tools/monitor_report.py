@@ -332,6 +332,32 @@ def render_planner(out, totals=None, gauges=None, source=""):
                       if total else ""))
 
 
+def render_pipeline(out, totals=None, gauges=None, source=""):
+    """The pipeline-parallel account (``pipeline/*`` counters from
+    ``fleet/meta_parallel/.../pp_layers.py`` — ISSUE 15): schedule
+    shape (microbatches, ticks), the fill/drain bubble fraction, and
+    the analytically-attributed ppermute handoff bytes (the compiled
+    stage ring is invisible to the eager collective counters)."""
+    totals, gauges = totals or {}, gauges or {}
+    if not any(k.startswith("pipeline/") for k in totals):
+        return
+    out.append("")
+    out.append(f"-- pipeline (pp stages){source} --")
+    fwd = totals.get("pipeline/forwards", 0)
+    micro = totals.get("pipeline/microbatches", 0)
+    ticks = totals.get("pipeline/ticks", 0)
+    out.append(f"pipelined forwards {fwd}   microbatches {micro}   "
+               f"schedule ticks {ticks}")
+    bub = gauges.get("pipeline/bubble_frac")
+    if bub is not None:
+        out.append(f"bubble: {bub * 100:.1f}% of ticks "
+                   f"(fill/drain — shrink with more microbatches)")
+    p2p = totals.get("pipeline/p2p_bytes", 0)
+    if p2p:
+        out.append(f"p2p handoff: {_fmt_bytes(p2p)} "
+                   f"(also attributed to collective/bytes/pp)")
+
+
 def render_resilience(out, totals=None, hists=None, end=None, source=""):
     """The resilience runtime's account (``resilience/*`` counters from
     ``paddle_tpu/resilience`` — docs/RESILIENCE.md): checkpoint traffic
@@ -676,6 +702,10 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
     # -- sharding planner (planner/* + collective/bytes/<axis>) --
     render_planner(out, totals=totals,
                    gauges=(end or {}).get("totals", {}).get("gauges", {}))
+
+    # -- pipeline parallelism (pipeline/* schedule + ppermute account) --
+    render_pipeline(out, totals=totals,
+                    gauges=(end or {}).get("totals", {}).get("gauges", {}))
 
     # -- resilience runtime (resilience/* + run_end last_checkpoint_step) --
     render_resilience(out, totals=totals,

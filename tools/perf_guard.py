@@ -44,8 +44,9 @@ and exits nonzero with a human-readable verdict when the run regressed:
   sweep-config keys, so spec and plain serving rows never cross-judge
 - a changed sharding plan (``--plan-drift``): a fresh hardware line
   whose ``shard_plan`` sub-object (from ``tools/shard_plan.py``) names
-  a different (dp, mp, batch) than the last-good record's
-  ``extra.shard_plan`` for the SAME device count — a silently-changed
+  a different (dp, mp, pp, batch) than the last-good record's
+  ``extra.shard_plan`` for the SAME device count (pre-PP records read
+  as pp=1 baselines) — a silently-changed
   cost model must not flip production sharding without a human reading
   this verdict. Missing baselines, missing plan fields, other
   topologies, and CPU smokes skip the check
@@ -200,7 +201,7 @@ def load_fresh(path: str) -> dict:
 # last_good.
 CONFIG_KEYS = ("batch", "seq", "ce_chunk",
                "requests", "arrival_rate_per_s", "lanes", "block_size",
-               "int8_weights", "devices",
+               "int8_weights", "devices", "pp",
                "shared_prefix_tokens", "prefix_cache", "spec", "spec_k")
 
 # keys whose ABSENCE from an old record means the knob's default, not a
@@ -211,8 +212,11 @@ CONFIG_KEYS = ("batch", "seq", "ce_chunk",
 # before speculative decoding were plain-decode (spec-off) runs: a
 # fresh spec-on line gets no pre-spec baseline, a fresh spec-off line
 # keeps its history
+# ... and pp: records persisted before the planner's pipeline axis
+# existed WERE pp=1 runs, so a fresh pp>1 row never judges itself
+# against them while pp=1 rows keep their pre-PP baselines
 CONFIG_KEY_DEFAULTS = {"shared_prefix_tokens": 0, "prefix_cache": True,
-                       "spec": False, "spec_k": 0}
+                       "spec": False, "spec_k": 0, "pp": 1}
 
 
 def config_match(fresh: dict) -> dict:
@@ -415,15 +419,21 @@ def evaluate(fresh: dict, baseline: dict | None, thresholds: dict | None
         if (th.get("plan_drift") and isinstance(plan, dict)
                 and isinstance(base_plan, dict)
                 and plan.get("devices") == base_plan.get("devices")):
-            drift = [k for k in ("dp", "mp", "batch")
-                     if plan.get(k) != base_plan.get(k)]
+            # pp default 1: records from before the planner's pipeline
+            # axis existed were pp=1 plans, not wildcards
+            def _axis(p, k):
+                return p.get(k, 1 if k == "pp" else None)
+
+            drift = [k for k in ("dp", "mp", "pp", "batch")
+                     if _axis(plan, k) != _axis(base_plan, k)]
             check("plan_drift", not drift,
-                  (f"planned dp{plan.get('dp')}×mp{plan.get('mp')} "
+                  (f"planned dp{plan.get('dp')}×mp{plan.get('mp')}"
+                   f"×pp{_axis(plan, 'pp')} "
                    f"b{plan.get('batch')} matches last-good"
                    if not drift else
                    f"plan changed for the same topology "
                    f"({plan.get('devices')} devices): "
-                   + ", ".join(f"{k} {base_plan.get(k)}→{plan.get(k)}"
+                   + ", ".join(f"{k} {_axis(base_plan, k)}→{_axis(plan, k)}"
                                for k in drift)
                    + " — the cost model flipped production sharding; "
                      "re-measure both configs before trusting it"))

@@ -9,14 +9,19 @@ runs with zero hand-written PartitionSpecs (docs/AUTOSHARD.md).
         --from ckpt_dir train.py args
     python tools/shard_plan.py bench                 # hwbench row
 
-``plan`` enumerates every legal (dp × mp, batch) candidate for the
-device count, AOT-lowers each on a virtual mesh (no execution; with
-``PT_EXEC_CACHE`` a repeat sweep pays ZERO fresh XLA compiles — the
-JSON line's ``fresh_compiles`` proves it), applies the HBM-fit hard
-constraint + the compute/comms roofline (`paddle_tpu/autoshard/cost.py`),
-and writes the winner as a deterministic ``shard_plan.json`` — same
-inputs, byte-identical file. Exit codes mirror memory_planner: 0 a
-winner exists, 3 nothing fits, 2 setup error.
+``plan`` enumerates every legal (dp × mp × pp, batch) candidate for
+the device count (pipeline depth capped by the probe's stage-able
+layer count and ``PT_AUTOSHARD_PP_MAX``), AOT-lowers each on a virtual
+mesh (pp>1 candidates compile the GPipe-in-XLA PipelineLayer schedule;
+no execution; with ``PT_EXEC_CACHE`` a repeat sweep pays ZERO fresh
+XLA compiles — the JSON line's ``fresh_compiles`` proves it), applies
+the HBM-fit hard constraint + the compute/comms roofline
+(`paddle_tpu/autoshard/cost.py` — pipeline candidates carry the
+``(pp−1)/n_micro`` bubble and the ppermute handoff wire term), and
+writes the winner as a deterministic ``shard_plan.json`` — same
+inputs, byte-identical file, now also recording ``pp``/``n_micro``/the
+layer→stage assignment. Exit codes mirror memory_planner: 0 a winner
+exists, 3 nothing fits, 2 setup error.
 
 ``launch`` starts the plan's run through `paddle_tpu.distributed.launch`
 (the launcher stamps ``PT_SHARD_PLAN`` into every worker; scripts call
@@ -67,15 +72,17 @@ def _add_sweep_args(ap) -> None:
                          "v5e chip)")
     ap.add_argument("--configs", default=None,
                     help="comma list of mesh splits, e.g. "
-                         "'dp8,dp4xmp2,dp2xmp4' (default: all power-of-2 "
-                         "dp×mp factorizations of --devices)")
+                         "'dp8,dp4xmp2,dp2xpp2' (default: all power-of-2 "
+                         "dp×mp×pp factorizations of --devices, pp capped "
+                         "by the probe's --layers and PT_AUTOSHARD_PP_MAX)")
     ap.add_argument("--batches", default="8",
                     help="comma list of global batch sizes (default 8)")
     ap.add_argument("--out", default="shard_plan.json",
                     help="plan output path (default ./shard_plan.json)")
     ap.add_argument("--smoke", action="store_true",
-                    help="tiny probe + 3 mesh candidates (the tier-1 CPU "
-                         "pipeline proof, kernel-search convention)")
+                    help="tiny probe + 4 mesh candidates incl. a pp2 "
+                         "pipeline (the tier-1 CPU pipeline proof, "
+                         "kernel-search convention)")
     ap.add_argument("--exec-cache", default=None, metavar="DIR",
                     help="AOT executable cache dir for the candidate "
                          "compiles (default: inherit PT_EXEC_CACHE) — a "
@@ -182,7 +189,8 @@ def cmd_plan(args, argv) -> int:
     spec = autoshard.ProbeSpec(
         vocab=args.vocab, hidden=args.hidden,
         intermediate=args.intermediate, layers=args.layers,
-        heads=args.heads, seq=args.seq)
+        heads=args.heads, seq=args.seq,
+        moe_experts=getattr(args, "moe_experts", 0) or 0)
     try:
         plan, rows = autoshard.make_plan(
             args.devices, args.hbm_gb, spec=spec,
@@ -310,7 +318,8 @@ def cmd_bench(args, argv) -> int:
     spec = autoshard.ProbeSpec(
         vocab=args.vocab, hidden=args.hidden,
         intermediate=args.intermediate, layers=args.layers,
-        heads=args.heads, seq=args.seq)
+        heads=args.heads, seq=args.seq,
+        moe_experts=getattr(args, "moe_experts", 0) or 0)
     plan, rows = autoshard.make_plan(
         args.devices, args.hbm_gb, spec=spec,
         configs=args.configs, batches=args.batches)
