@@ -252,6 +252,42 @@ def main():
     ttft_cold = [(r.t_first - r.t_submit) * 1e3 for r in reqs
                  if r.t_first is not None and not r.ttft_cached_tokens]
 
+    # per-request latency attribution (docs/SERVING.md): the engine's
+    # telescoping clock bills every wall-ms of a request's life to
+    # exactly one of {queue, prefill, decode, preempted}, so the phase
+    # means sum to the measured end-to-end latency — phase_sum_vs_total
+    # self-reports that identity (the acceptance bound is 5%), and
+    # queue_share is what perf_guard --queue-share-growth judges
+    fins = [r for r in reqs if r.t_done is not None]
+    attribution = None
+    if fins:
+        def _mean(xs):
+            return sum(xs) / len(xs)
+
+        q_mean = _mean([r.queue_ms for r in fins])
+        p_mean = _mean([r.prefill_ms for r in fins])
+        d_mean = _mean([r.decode_ms for r in fins])
+        pre_mean = _mean([r.preempted_ms for r in fins])
+        total_mean = _mean([(r.t_done - r.t_submit) * 1e3 for r in fins])
+        phase_sum = q_mean + p_mean + d_mean + pre_mean
+        attribution = {
+            "queue_ms_mean": round(q_mean, 3),
+            "prefill_ms_mean": round(p_mean, 3),
+            "decode_ms_mean": round(d_mean, 3),
+            "preempted_ms_mean": round(pre_mean, 3),
+            "total_ms_mean": round(total_mean, 3),
+            "phase_sum_vs_total": (round(phase_sum / total_mean, 4)
+                                   if total_mean > 0 else None),
+            "queue_share": (round(q_mean / total_mean, 4)
+                            if total_mean > 0 else None),
+            "queue_ms_p99": round(percentile(
+                [r.queue_ms for r in fins], 99), 3),
+            "prefill_refunded_tokens": sum(
+                r.prefill_refunded_tokens for r in fins),
+            "spec_rounds": sum(r.spec_rounds for r in fins),
+            "accepted_tokens": sum(r.accepted_tokens for r in fins),
+        }
+
     # HBM roofline (decode_bench's byte model on the decode phase): per
     # step the chip reads every matmul weight once (lanes share the
     # read) + each live lane's KV prefix, writes one KV token per
@@ -302,6 +338,7 @@ def main():
            "ttft_ms_p99": round(percentile(ttft, 99), 2) if ttft else None,
            "tpot_ms_p50": round(percentile(tpot, 50), 3) if tpot else None,
            "tpot_ms_p99": round(percentile(tpot, 99), 3) if tpot else None,
+           "attribution": attribution,
            "requests": len(reqs),
            "completed": stats["finished"],
            "generated_tokens": tokens,

@@ -59,6 +59,7 @@ INSTRUMENTED_MODULES = (
     "paddle_tpu.io.prefetch",
     "paddle_tpu.hapi.model",
     "paddle_tpu.serving.engine",
+    "paddle_tpu.serving.scheduler",
     "paddle_tpu.serving.speculative",
     "paddle_tpu.ops.pallas.search",
     "paddle_tpu.resilience.checkpoint_manager",

@@ -1,0 +1,126 @@
+"""Black-box postmortem dump: the flight recorder's last spans + the
+registered subsystems' state, serialized on crash (docs/OBSERVABILITY.md).
+
+Aviation-recorder model: while everything is healthy this module costs
+nothing (state providers are weakly-referenced callables, consulted
+only at dump time); when a run dies — an engine raise (double-free,
+pool-invariant break), a ``NonFiniteError`` surfacing through
+``StepLogger.close(error=...)``, or ``tools/soak.py``'s injected
+``PT_SOAK_CRASH_AT`` ``os._exit`` — the last thing written is
+``serving_blackbox.json``: the newest ``PT_BLACKBOX_SPANS`` spans off
+the process-wide ring (:mod:`paddle_tpu.monitor.spans`), plus every
+live provider's snapshot (the serving engine registers its scheduler
+state + per-request journeys; see ``ServingEngine._blackbox_state``).
+
+Crash sites call :func:`maybe_dump`, which writes only when there is a
+postmortem audience — ``PT_SERVE_BLACKBOX`` set (``0`` disables, any
+other value is the artifact path) or the monitor enabled — so unit
+tests that intentionally raise engine errors do not litter artifacts.
+:func:`dump` writes unconditionally (the soak driver's assertion path).
+A dump must never mask the error it is documenting: provider and
+serialization failures are swallowed into the artifact itself.
+"""
+from __future__ import annotations
+
+import json
+import os
+import weakref
+
+__all__ = ["register", "dump", "maybe_dump", "default_path"]
+
+DEFAULT_PATH = "serving_blackbox.json"
+
+# label -> weak callable returning a JSON-able state dict; weakly held
+# so a retired engine never pins itself (dead refs are pruned at dump)
+_providers: list = []
+
+
+def default_path() -> str:
+    env = os.environ.get("PT_SERVE_BLACKBOX")
+    return env if env and env != "0" else DEFAULT_PATH
+
+
+def _spans_cap() -> int:
+    try:
+        return max(16, int(os.environ.get("PT_BLACKBOX_SPANS", "512")))
+    except ValueError:
+        return 512
+
+
+def register(label: str, provider) -> None:
+    """Register a state provider (a bound method is held via
+    ``WeakMethod``; a plain function strongly). Called once per
+    subsystem instance — e.g. every :class:`ServingEngine` on
+    construction."""
+    try:
+        ref = weakref.WeakMethod(provider)
+    except TypeError:
+        ref = (lambda p: (lambda: p))(provider)
+    _providers.append((str(label), ref))
+
+
+def _collect_state() -> dict:
+    state: dict = {}
+    dead = []
+    for i, (label, ref) in enumerate(_providers):
+        fn = ref()
+        if fn is None:
+            dead.append(i)
+            continue
+        key = label if label not in state else f"{label}#{i}"
+        try:
+            state[key] = fn()
+        except Exception as exc:  # a dump never masks the crash
+            state[key] = {"provider_error": repr(exc)}
+    for i in reversed(dead):
+        del _providers[i]
+    return state
+
+
+def dump(path: str | None = None, reason: str = "",
+         error: BaseException | str | None = None) -> str | None:
+    """Serialize the postmortem artifact; returns the path written, or
+    None when even that failed (never raises)."""
+    from . import _span_recorder, enabled
+
+    rec = _span_recorder
+    cap = _spans_cap()
+    try:
+        tail = rec.snapshot()[-cap:]
+        artifact = {
+            "version": 1,
+            "reason": reason or "unspecified",
+            "error": None if error is None else str(error),
+            "monitor_enabled": bool(enabled()),
+            "spans_recorded": rec.count,
+            "spans_dropped": rec.dropped + max(0, rec.count
+                                               - rec.dropped - len(tail)),
+            "spans": [{"name": n, "cat": c, "lane": ln,
+                       "t0": t0, "t1": t1, "args": args}
+                      for (n, c, ln, t0, t1, args) in tail],
+            "state": _collect_state(),
+        }
+        out = path or default_path()
+        tmp = f"{out}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(artifact, f, indent=1, default=repr)
+            f.write("\n")
+        os.replace(tmp, out)  # atomic: never a torn artifact
+        return out
+    except Exception:
+        return None
+
+
+def maybe_dump(reason: str = "",
+               error: BaseException | str | None = None) -> str | None:
+    """Crash-site entry: dump only when someone asked for postmortems
+    (``PT_SERVE_BLACKBOX`` set and not ``0``) or the monitor is live —
+    so intentional error-path unit tests stay artifact-free."""
+    from . import enabled
+
+    env = os.environ.get("PT_SERVE_BLACKBOX")
+    if env == "0":
+        return None
+    if not env and not enabled():
+        return None
+    return dump(reason=reason, error=error)

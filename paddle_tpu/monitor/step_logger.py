@@ -129,6 +129,15 @@ class StepLogger:
         self._write(line)
         self._f.close()
         self._f = None
+        if error is not None:
+            # a run that died mid-loop (NonFiniteError surfacing through
+            # fit, an engine raise crossing the `with`) leaves the
+            # blackbox postmortem next to its run_end line — gated the
+            # same way as every crash site (monitor on or
+            # PT_SERVE_BLACKBOX set), and never masking the error
+            from . import blackbox
+
+            blackbox.maybe_dump(reason="run_error", error=error)
 
     def __enter__(self):
         return self

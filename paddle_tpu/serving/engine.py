@@ -46,16 +46,29 @@ analysis_predictor.h:94` — see ``paddle_tpu/inference``); request-level
 continuous batching + block KV follow the Orca/vLLM iteration-level
 scheduling + PagedAttention memory model (docs/SERVING.md).
 
-Monitor contract: this module carries a ``_monitor`` None-slot
-(``serving/*`` counters, ``monitor.INSTRUMENTED_MODULES``) — when
-monitoring is off no monitor callable is ever invoked; the always-on
-plain-int ``ServingEngine.counters`` feed the serving bench instead.
+Monitor contract: this module carries ``_monitor``/``_spans``
+None-slots (``serving/*`` counters + request-lifecycle spans,
+``monitor.INSTRUMENTED_MODULES``) — when monitoring is off no monitor
+callable is ever invoked; the always-on plain-int
+``ServingEngine.counters`` and per-request latency attribution
+(``Request.queue_ms``/``prefill_ms``/``decode_ms``/``preempted_ms``,
+telescoped at the phase boundaries the engine already timestamps) feed
+the serving bench instead. With ``PT_MONITOR=1`` every request's
+journey lands in the flight recorder on its own ``req/<trace_id>``
+lane — queue/requeue waits (scheduler-side), prefill chunks with their
+prefix-cache hit/miss split, decode/verify rounds with draft/accept
+counts, preemptions, and a whole-journey finish span carrying the
+attribution breakdown (docs/OBSERVABILITY.md). On an engine raise the
+blackbox postmortem (``monitor/blackbox.py``) serializes the last
+spans + scheduler state to ``serving_blackbox.json`` before the error
+propagates.
 
 Greedy decode only for now: per-request sampling params would ride as
 traced lane vectors (same no-retrace discipline); left for a later PR.
 """
 from __future__ import annotations
 
+import collections
 import os
 import sys
 import time
@@ -69,6 +82,7 @@ from ..models.generation import (
     _GenCfg, _collect_params, _mm, _rms, _rope_at,
 )
 from ..monitor import _register as _monitor_register
+from ..monitor import blackbox as _blackbox
 from .kv_cache import BlockPool, blocks_needed
 from .scheduler import RUNNING, FCFSScheduler, Request
 from .speculative import NgramDrafter
@@ -77,9 +91,10 @@ _EMPTY_DRAFT = np.zeros((0,), np.int32)
 
 __all__ = ["ServingConfig", "ServingEngine"]
 
-# telemetry slot (paddle_tpu.monitor None-slot contract): None unless
-# PT_MONITOR wired it
+# telemetry slots (paddle_tpu.monitor None-slot contract): None unless
+# PT_MONITOR wired them
 _monitor = None
+_spans = None
 
 
 def _env_int(name, default):
@@ -365,6 +380,9 @@ class ServingEngine:
         # grow with its request history
         self._requests: dict = {}
         self._finished: dict = {}
+        # newest finished journeys for the blackbox postmortem —
+        # independent of _finished, which pop_finished() clears
+        self._journeys: collections.deque = collections.deque(maxlen=16)
         self._prefill_exec = None
         self._decode_exec = None
         self._verify_exec = None
@@ -398,6 +416,10 @@ class ServingEngine:
             "kv_read_tokens": 0, "kv_dense_read_tokens": 0,
             "decode_wall_s": 0.0,
         }
+        # postmortem hook: on an engine raise (or an external crash
+        # site) the blackbox dump snapshots scheduler + request state
+        # through this weakly-held provider (monitor/blackbox.py)
+        _blackbox.register("serving_engine", self._blackbox_state)
 
     def _resolve_paged(self) -> bool:
         """Decode read-path selection (ServingConfig.paged): forced
@@ -442,6 +464,7 @@ class ServingEngine:
                 f"duplicate request_id {req.request_id!r} (live or "
                 f"finished-but-uncollected — pop_finished() first)")
         req.t_submit = time.perf_counter()
+        req._t_mark = req.t_submit  # attribution clock starts here
         self.scheduler.submit(req)
         self._requests[req.request_id] = req
         return req
@@ -526,7 +549,20 @@ class ServingEngine:
         the shared decode step, emit/reclaim. Returns whether any work
         was done. Admission is one lane at a time with the prefill (and
         its prefix publish) in between, so burst arrivals sharing a
-        prompt hit the cache from the second lane on."""
+        prompt hit the cache from the second lane on.
+
+        On a raise (pool double-free, invariant break, a bad drafter)
+        the blackbox postmortem is written BEFORE the error propagates
+        — the artifact, not the traceback, is what holds the request
+        journeys and scheduler state that explain the crash."""
+        try:
+            return self._step()
+        except Exception as exc:
+            _blackbox.maybe_dump(reason="serving_engine_raise",
+                                 error=exc)
+            raise
+
+    def _step(self) -> bool:
         self._ensure_compiled()
         worked = False
         while True:
@@ -598,9 +634,12 @@ class ServingEngine:
         cached = int(req.cached_len)
         C = self.config.prefill_chunk
         table = jnp.asarray(self._table_row(req))
+        sp = _spans
+        p_t0 = req._t_mark  # admission stamped it just before this call
         nchunks = 0
         tok = None
         for start in range(cached, ctx, C):
+            c_t0 = time.perf_counter() if sp is not None else 0.0
             piece = toks[start:start + C]
             chunk = np.zeros((1, C), np.int32)
             chunk[0, :piece.size] = piece
@@ -610,6 +649,15 @@ class ServingEngine:
                 jnp.asarray(chunk), jnp.int32(start), jnp.int32(ctx),
                 jnp.int32(last_idx))
             nchunks += 1
+            if sp is not None:
+                # enqueue wall only (no per-chunk host sync — the one
+                # sync per admission stays the first-token fetch below)
+                sp.record("serving/prefill_chunk", "serving_prefill",
+                          c_t0, time.perf_counter(),
+                          lane=f"req/{req.trace_id}",
+                          args={"request": req.request_id,
+                                "start": start,
+                                "tokens": min(C, ctx - start)})
         req.pool_len = ctx
         self.scheduler.publish_prefix(req)
         self.counters["prefill_chunks"] += nchunks
@@ -621,9 +669,30 @@ class ServingEngine:
             pool = self.scheduler.pool
             m.on_serving_prefix(cached, ctx - cached,
                                 pool.shared_count, pool.cold_count)
+        # recompute-refund: cached tokens on a re-admission are context
+        # the preemption forced us to rebuild but the prefix cache
+        # served back for free
+        refund = cached if req.output else 0
+        req.prefill_refunded_tokens += refund
+        first_tok = None
+        if not req.output:
+            first_tok = int(np.asarray(tok)[0])  # the TTFT host sync
+        end = time.perf_counter()
+        if p_t0 is not None:
+            req.prefill_ms += (end - p_t0) * 1e3
+            req._t_mark = end
+        if sp is not None:
+            sp.record("serving/prefill", "serving_prefill",
+                      p_t0 if p_t0 is not None else end, end,
+                      lane=f"req/{req.trace_id}",
+                      args={"request": req.request_id, "chunks": nchunks,
+                            "hit_tokens": cached,
+                            "miss_tokens": ctx - cached,
+                            "refunded_tokens": refund,
+                            "recompute": bool(req.output)})
         if req.output:
             return  # recompute path: the pending token is output[-1]
-        self._emit(req, int(np.asarray(tok)[0]), time.perf_counter())
+        self._emit(req, first_tok, end)
 
     def _decode_round(self) -> None:
         sched = self.scheduler
@@ -699,6 +768,11 @@ class ServingEngine:
         c["verify_steps"] += 1
         proposed = accepted = bonus = emitted = 0
         for req in act:
+            # attribution: everything since the lane's last phase
+            # boundary (prefill end / previous round) is decode time
+            if req._t_mark is not None:
+                req.decode_ms += (now - req._t_mark) * 1e3
+                req._t_mark = now
             d = drafts.get(id(req), _EMPTY_DRAFT)
             n = int(d.size)
             row = preds[req.lane]
@@ -707,6 +781,9 @@ class ServingEngine:
                 a += 1
             proposed += n
             accepted += a
+            if n:
+                req.spec_rounds += 1
+                req.accepted_tokens += a
             if n:  # optional feedback hook (Drafter.observe)
                 observe = getattr(self.drafter, "observe", None)
                 if observe is not None:
@@ -750,6 +827,15 @@ class ServingEngine:
             m.on_serving_verify(len(act), self.scheduler.pool.allocatable,
                                 emitted)
             m.on_serving_spec(proposed, accepted, bonus)
+        sp = _spans
+        if sp is not None:
+            # recorded COMPLETE, after rollbacks/releases settled — a
+            # rewound pool_len can never leave an open round span
+            sp.record("serving/verify_round", "serving_decode", t0, now,
+                      lane="serve/rounds",
+                      args={"lanes": len(act), "proposed": proposed,
+                            "accepted": accepted, "bonus": bonus,
+                            "emitted": emitted})
 
     def _plain_decode_round(self, act) -> None:
         L, M = self.config.max_lanes, self.blocks_per_lane
@@ -781,7 +867,15 @@ class ServingEngine:
             # pre-sharing meaning of "free" (cold blocks are spare
             # capacity, not occupancy)
             m.on_serving_decode(len(act), self.scheduler.pool.allocatable)
+        sp = _spans
+        if sp is not None:
+            sp.record("serving/decode_round", "serving_decode", t0, now,
+                      lane="serve/rounds",
+                      args={"lanes": len(act), "emitted": len(act)})
         for req in act:
+            if req._t_mark is not None:
+                req.decode_ms += (now - req._t_mark) * 1e3
+                req._t_mark = now
             req.pool_len += 1
             self._emit(req, int(toks[req.lane]), now)
 
@@ -796,10 +890,38 @@ class ServingEngine:
             self.scheduler.finish(req)
             self._finished[req.request_id] = \
                 self._requests.pop(req.request_id, req)
+            self._journeys.append({
+                "request_id": req.request_id, "trace_id": req.trace_id,
+                "tokens": len(req.output),
+                "preemptions": req.preemptions,
+                "total_ms": round((now - req.t_submit) * 1e3, 3)
+                if req.t_submit is not None else None,
+                **req.attribution()})
             self.counters["finished"] += 1
             m = _monitor
             if m is not None:
                 m.on_serving_evict()
+            sp = _spans
+            if sp is not None and req.t_submit is not None:
+                # the whole journey as ONE span on the request's trace
+                # lane, args carrying the attribution breakdown — what
+                # monitor_report's "requests" section renders and what
+                # survives ring eviction of the per-phase spans
+                sp.record(
+                    "serving/request", "serving_finish",
+                    req.t_submit, now, lane=f"req/{req.trace_id}",
+                    args={"request": req.request_id,
+                          "trace_id": req.trace_id,
+                          "tokens": len(req.output),
+                          "preemptions": req.preemptions,
+                          "total_ms": round(
+                              (now - req.t_submit) * 1e3, 3),
+                          "ttft_ms": round(
+                              (req.t_first - req.t_submit) * 1e3, 3)
+                          if req.t_first is not None else None,
+                          **{k: round(v, 3) if isinstance(v, float)
+                             else v
+                             for k, v in req.attribution().items()}})
 
     def _note_preempt(self, req) -> None:
         self.counters["preemptions"] += 1
@@ -808,6 +930,31 @@ class ServingEngine:
             m.on_serving_preempt()
 
     # -- introspection -------------------------------------------------------
+
+    def _blackbox_state(self) -> dict:
+        """State provider for the blackbox postmortem dump
+        (``monitor/blackbox.py``): geometry, lifetime counters, the
+        scheduler snapshot (queue/lanes/pool/events tail + every LIVE
+        request's partial journey), and the newest finished journeys —
+        enough to reconstruct what the engine was doing when it died.
+        Read-only and exception-tolerant by contract (the dump swallows
+        provider errors), so it never worsens a crash."""
+        return {
+            "config": {
+                "max_lanes": self.config.max_lanes,
+                "block_size": self.config.block_size,
+                "num_blocks": self.scheduler.pool.num_blocks,
+                "prefill_chunk": self.config.prefill_chunk,
+                "max_seq_len": self.max_seq_len,
+                "spec": self.spec_active,
+                "spec_k": self.config.spec_k,
+                "prefix_cache": self.config.prefix_cache,
+                "paged": self.paged_active,
+            },
+            "counters": dict(self.counters),
+            "scheduler": self.scheduler.debug_state(),
+            "finished_tail": list(self._journeys),
+        }
 
     def stats(self) -> dict:
         """Plain-int account of the engine's lifetime (always on)."""

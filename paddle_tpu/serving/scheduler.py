@@ -37,18 +37,37 @@ Every decision lands in ``self.events`` as ``(event, request_id,
 detail)`` — the deterministic-replay audit trail (a bounded ring:
 newest ``events_cap`` decisions, 65536 by default, so the trail never
 grows a long-running server's host memory).
+
+Monitor contract: this module carries ``_monitor``/``_spans``
+None-slots (``monitor.INSTRUMENTED_MODULES``) — with monitoring off no
+monitor callable or span record ever runs here; with ``PT_MONITOR=1``
+admission records each request's queue/requeue wait and preemption as
+flight-recorder spans on the request's trace lane (``req/<trace_id>``;
+docs/OBSERVABILITY.md). The per-request latency attribution
+(``Request.queue_ms``/...) is ALWAYS on, like the engine's plain-int
+counters — it costs one ``perf_counter`` read per admission and per
+preemption, never a monitor call. The event ring stays byte-identical
+either way — spans and attribution are observations, never decisions.
 """
 from __future__ import annotations
 
 import collections
 import itertools
+import sys
+import time
 
 import numpy as np
 
+from ..monitor import _register as _monitor_register
 from .kv_cache import BlockPool, blocks_needed, prefix_keys
 
 __all__ = ["Request", "FCFSScheduler",
            "WAITING", "RUNNING", "FINISHED"]
+
+# telemetry slots (paddle_tpu.monitor None-slot contract): None unless
+# PT_MONITOR wired them
+_monitor = None
+_spans = None
 
 WAITING = "waiting"
 RUNNING = "running"
@@ -66,6 +85,17 @@ class Request:
     is the lane's block table in position order. Timestamps
     (``t_submit``/``t_first``/``t_done``, engine clock seconds) carry
     the TTFT / per-token-latency facts the serving bench reports.
+
+    Attribution (always on, plain float/int arithmetic like the
+    engine's counters): the engine telescopes every request's wall
+    time into ``queue_ms`` (submit -> first admission), ``prefill_ms``,
+    ``decode_ms`` (on-lane time between prefill end and finish, incl.
+    host scheduling between rounds), and ``preempted_ms`` (preempt ->
+    re-admission), advancing ``_t_mark`` at each phase boundary — the
+    four buckets sum to ``t_done - t_submit`` exactly, which is the
+    serving bench's ``attribution`` sub-object contract. ``trace_id``
+    is assigned at first admission and names the request's span lane
+    (``req/<trace_id>``) in the flight recorder.
     """
 
     __slots__ = ("request_id", "prompt", "max_new_tokens", "eos_token_id",
@@ -73,7 +103,10 @@ class Request:
                  "cached_len", "prefix_cached_tokens",
                  "ttft_cached_tokens", "_pkeys",
                  "t_submit", "t_first", "t_done", "preemptions",
-                 "_admit_seq")
+                 "_admit_seq", "trace_id", "_t_mark",
+                 "queue_ms", "prefill_ms", "decode_ms", "preempted_ms",
+                 "prefill_refunded_tokens", "spec_rounds",
+                 "accepted_tokens")
 
     def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
                  request_id=None):
@@ -116,6 +149,37 @@ class Request:
         self.t_done = None
         self.preemptions = 0
         self._admit_seq = -1
+        # per-request latency attribution (see class docstring): the
+        # engine advances _t_mark at every phase boundary so the four
+        # *_ms buckets telescope to exactly t_done - t_submit
+        self.trace_id = None
+        self._t_mark = None
+        self.queue_ms = 0.0
+        self.prefill_ms = 0.0
+        self.decode_ms = 0.0
+        self.preempted_ms = 0.0
+        # recomputed-context tokens a re-admission's prefix-cache hit
+        # refunded (served from shared blocks instead of re-prefilled)
+        self.prefill_refunded_tokens = 0
+        self.spec_rounds = 0
+        self.accepted_tokens = 0
+
+    def attribution(self) -> dict:
+        """The finished request's latency breakdown — the serving
+        bench's per-request record and the blackbox dump's journey
+        entry. Phase buckets are ms on the engine clock; for a FINISHED
+        request they sum to ``t_done - t_submit`` (within float
+        rounding), the property the bench's ``attribution`` sub-object
+        is judged on."""
+        return {
+            "queue_ms": self.queue_ms,
+            "prefill_ms": self.prefill_ms,
+            "decode_ms": self.decode_ms,
+            "preempted_ms": self.preempted_ms,
+            "prefill_refunded_tokens": self.prefill_refunded_tokens,
+            "spec_rounds": self.spec_rounds,
+            "accepted_tokens": self.accepted_tokens,
+        }
 
     @property
     def prefill_tokens(self) -> np.ndarray:
@@ -234,11 +298,36 @@ class FCFSScheduler:
             if req.ttft_cached_tokens is None:  # first admission
                 req.ttft_cached_tokens = req.cached_len
             req._admit_seq = next(self._admit_counter)
+            if req.trace_id is None:  # one trace id per request lifetime
+                req.trace_id = f"r{req.request_id}"
             self.lanes[lane] = req
             self.events.append(("admit", req.request_id, lane))
             if hits:
                 self.events.append(
                     ("prefix_hit", req.request_id, req.cached_len))
+            # latency attribution (always on; engine stamps _t_mark at
+            # submit and preempt): the wait that just ended is queue
+            # time on a first admission, preempted time on a requeue
+            if req._t_mark is not None:
+                now = time.perf_counter()
+                t_wait0 = req._t_mark
+                wait_ms = (now - t_wait0) * 1e3
+                if req.preemptions:
+                    req.preempted_ms += wait_ms
+                else:
+                    req.queue_ms += wait_ms
+                req._t_mark = now
+                sp = _spans
+                if sp is not None:
+                    sp.record(
+                        "serving/requeue_wait" if req.preemptions
+                        else "serving/queue_wait",
+                        "serving_queue", t_wait0, now,
+                        lane=f"req/{req.trace_id}",
+                        args={"request": req.request_id, "lane": lane,
+                              "wait_ms": round(wait_ms, 3),
+                              "preemptions": req.preemptions,
+                              "cached_tokens": req.cached_len})
             admitted.append(req)
         return admitted
 
@@ -354,8 +443,10 @@ class FCFSScheduler:
         because victims are always the newest runners, and multiple
         same-round victims re-enter newest-first, so appendleft restores
         arrival order)."""
+        freed = len(req.blocks)
         self.pool.free(req.blocks, req)
         req.blocks = []
+        lane = req.lane
         self.lanes[req.lane] = None
         req.lane = None
         req.pool_len = 0
@@ -364,6 +455,21 @@ class FCFSScheduler:
         req.preemptions += 1
         self.waiting.appendleft(req)
         self.events.append(("preempt", req.request_id, None))
+        # attribution: on-lane time up to the eviction bills to decode
+        # (the request was holding a lane); the preempt -> re-admission
+        # wait that starts NOW bills to preempted_ms at the next admit
+        if req._t_mark is not None:
+            now = time.perf_counter()
+            req.decode_ms += (now - req._t_mark) * 1e3
+            req._t_mark = now
+            sp = _spans
+            if sp is not None:  # zero-length marker on the trace lane
+                sp.record("serving/preempt", "serving_sched", now, now,
+                          lane=f"req/{req.trace_id}",
+                          args={"request": req.request_id, "lane": lane,
+                                "blocks_freed": freed,
+                                "preemptions": req.preemptions,
+                                "kept_tokens": len(req.output)})
         if on_preempt is not None:
             on_preempt(req)
 
@@ -390,3 +496,36 @@ class FCFSScheduler:
     @property
     def lanes_occupied(self) -> int:
         return sum(1 for r in self.lanes if r is not None)
+
+    def debug_state(self) -> dict:
+        """JSON-able scheduler snapshot for the blackbox postmortem
+        dump (``monitor/blackbox.py``): queue/lane occupancy, pool
+        accounting, the newest audit-trail events, and every live
+        request's (possibly partial) journey. Read-only."""
+        pool = self.pool
+        return {
+            "waiting": [r.request_id for r in self.waiting],
+            "lanes": [None if r is None else r.request_id
+                      for r in self.lanes],
+            "pool": {"capacity": pool.capacity,
+                     "free": pool.free_count, "used": pool.used_count,
+                     "cold": pool.cold_count,
+                     "shared": pool.shared_count,
+                     "indexed": pool.indexed_count},
+            "events_tail": [list(e) for e in
+                            list(self.events)[-64:]],
+            "requests": [{
+                "request_id": r.request_id, "trace_id": r.trace_id,
+                "state": r.state, "lane": r.lane,
+                "pool_len": r.pool_len, "cached_len": r.cached_len,
+                "tokens": len(r.output),
+                "preemptions": r.preemptions,
+                **r.attribution(),
+            } for r in sorted(
+                set(self.waiting)
+                | {r for r in self.lanes if r is not None},
+                key=lambda r: str(r.request_id))],
+        }
+
+
+_monitor_register(sys.modules[__name__])

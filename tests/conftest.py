@@ -34,6 +34,12 @@ enable_compilation_cache("~/.cache/paddle_tpu_test_xla_cache")
 # (overriding env); force CPU-only here so tests never touch the TPU tunnel.
 jax.config.update("jax_platforms", "cpu")
 
+# blackbox postmortems off by default under pytest: many tests raise
+# engine/NaN errors ON PURPOSE (often with the monitor enabled), and each
+# would otherwise litter a serving_blackbox.json into the cwd. Tests that
+# prove the dump path set PT_SERVE_BLACKBOX to a tmp path explicitly.
+os.environ.setdefault("PT_SERVE_BLACKBOX", "0")
+
 # numpy-parity tests need true fp32 contractions; production keeps the fast
 # MXU default (bf16 inputs / fp32 accumulate), tunable via paddle flags.
 jax.config.update("jax_default_matmul_precision", "highest")

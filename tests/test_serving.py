@@ -572,6 +572,9 @@ class TestServingConfig:
         # the None-slot zero-overhead-off audit in test_memory_numerics
         # parametrizes over this list — membership is the contract
         assert "paddle_tpu.serving.engine" in monitor.INSTRUMENTED_MODULES
+        # the scheduler's _spans slot (queue-wait/preempt trace spans)
+        # joined the same contract in ISSUE 16
+        assert "paddle_tpu.serving.scheduler" in monitor.INSTRUMENTED_MODULES
 
 
 # -- bench trace / probe helpers (pure) ---------------------------------------

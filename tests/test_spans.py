@@ -10,6 +10,8 @@ watchpoints (the live retrace-storm warning bench.py arms post-warmup).
 import importlib.util
 import json
 import os
+import subprocess
+import sys
 import threading
 import time
 
@@ -292,6 +294,19 @@ class TestFitTraceExport:
         assert "async pipeline" in report
         assert "prefetch: staged" in report
         assert "hapi host syncs" in report
+
+    def test_report_cli_selftest(self):
+        """`monitor_report.py --selftest` synthesizes its own fixtures
+        (JSONL + spans trace + bench line) and asserts every section —
+        including the ISSUE 16 requests/attribution sections — renders.
+        Run as a subprocess: the tier-1 proof is the CLI contract."""
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(_ROOT, "tools", "monitor_report.py"),
+             "--selftest"],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "selftest ok" in proc.stdout
 
 
 class TestAttributionPass:

@@ -589,6 +589,94 @@ def render_attribution(att, out):
                        f"(dur {w['dur_ms']:.2f}ms: {parts})")
 
 
+# -- per-request serving journeys ---------------------------------------------
+
+def load_request_spans(events_or_path):
+    """``serving/request`` finish spans (cat ``serving_finish``) — each
+    one is a whole request journey with the telescoping latency
+    attribution in its args (docs/SERVING.md). Accepts a chrome-trace
+    event list, a chrome-trace path, or a ``serving_blackbox.json``
+    artifact path (its ``spans`` list uses the raw recorder tuple
+    shape)."""
+    if isinstance(events_or_path, str):
+        with open(events_or_path) as f:
+            data = json.load(f)
+        if isinstance(data, dict) and "spans" in data:  # blackbox artifact
+            return [dict(sp.get("args") or {}) for sp in data["spans"]
+                    if sp.get("cat") == "serving_finish"]
+        events = (data or {}).get("traceEvents", [])
+    else:
+        events = events_or_path or []
+    return [dict(ev.get("args") or {}) for ev in events
+            if ev.get("ph") == "X" and ev.get("cat") == "serving_finish"]
+
+
+def render_requests(journeys, out, top=10, source=""):
+    """Slowest-N request journeys, each decomposed into the phase
+    buckets the engine billed (queue/prefill/decode/preempted — they sum
+    to the request's end-to-end latency)."""
+    if not journeys:
+        return
+
+    def _ms(v):
+        return f"{v:.1f}" if isinstance(v, (int, float)) else "-"
+
+    out.append("")
+    out.append(f"-- requests (slowest {min(top, len(journeys))} of "
+               f"{len(journeys)} journeys, ms){source} --")
+    ordered = sorted(journeys,
+                     key=lambda j: -(j.get("total_ms") or 0.0))
+    rows = [("request", "total", "queue", "prefill", "decode",
+             "preempted", "tokens", "pre", "spec")]
+    for j in ordered[:top]:
+        rows.append((j.get("trace_id") or j.get("request", "?"),
+                     _ms(j.get("total_ms")), _ms(j.get("queue_ms")),
+                     _ms(j.get("prefill_ms")), _ms(j.get("decode_ms")),
+                     _ms(j.get("preempted_ms")), j.get("tokens", "-"),
+                     j.get("preemptions", 0), j.get("spec_rounds", 0)))
+    out.extend(_table(rows, (10, 10, 9, 9, 9, 11, 8, 5, 6)))
+    tot = [j["total_ms"] for j in journeys
+           if isinstance(j.get("total_ms"), (int, float))]
+    qs = [j.get("queue_ms", 0.0) for j in journeys
+          if isinstance(j.get("total_ms"), (int, float))]
+    if tot:
+        mean_t = sum(tot) / len(tot)
+        line = (f"{len(journeys)} finished: total_ms mean "
+                f"{mean_t:.1f}   max {max(tot):.1f}")
+        if mean_t > 0:
+            line += f"   queue share {sum(qs) / sum(tot):.1%}"
+        out.append(line)
+
+
+def render_request_attribution(att, out, source=""):
+    """serving_bench's ``attribution`` sub-object: per-phase latency
+    means that telescope to the measured end-to-end request latency
+    (``phase_sum_vs_total`` ~ 1.0 is the engine's accounting proof)."""
+    if not att:
+        return
+    out.append("")
+    out.append(f"-- request attribution (phase means, ms){source} --")
+    rows = []
+    for key in ("queue_ms_mean", "prefill_ms_mean", "decode_ms_mean",
+                "preempted_ms_mean", "total_ms_mean", "queue_ms_p99"):
+        if att.get(key) is not None:
+            rows.append((key, att[key]))
+    out.extend(_table(rows, (24, 14)))
+    if att.get("queue_share") is not None:
+        out.append(f"queue share: {att['queue_share']:.1%} of request "
+                   f"latency spent waiting for a lane")
+    if att.get("phase_sum_vs_total") is not None:
+        out.append(f"phase sum vs total: {att['phase_sum_vs_total']} "
+                   f"(1.0 = the buckets telescope exactly)")
+    extras = []
+    for key in ("prefill_refunded_tokens", "spec_rounds",
+                "accepted_tokens"):
+        if att.get(key):
+            extras.append(f"{key} {att[key]}")
+    if extras:
+        out.append("   ".join(extras))
+
+
 def render(jsonl_path, trace_path=None, top=10, spans=False,
            bench_path=None):
     steps, begin, end = load_jsonl(jsonl_path)
@@ -756,6 +844,9 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
                     out, totals={f"serving/{k}": v
                                  for k, v in tel_b["serving"].items()},
                     source=" (bench)")
+            if line.get("attribution"):
+                render_request_attribution(line["attribution"], out,
+                                           source=" (bench)")
             if line.get("kernels"):
                 render_kernels(out, bench_kernels=line["kernels"],
                                source=" (bench)")
@@ -830,6 +921,9 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
                 and ev.get("name") == "thread_name"})
             if lanes:
                 out.append("span lanes: " + ", ".join(lanes))
+            # per-request journeys: the engine's serving/request finish
+            # spans carry the whole telescoped attribution per request
+            render_requests(load_request_spans(events), out, top=top)
 
     # -- span attribution --
     if spans:
@@ -849,11 +943,107 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
     return "\n".join(out)
 
 
+def _selftest():
+    """Render a fully synthesized run (StepLogger JSONL + spans chrome
+    trace + bench line) and assert every section the serving-trace stack
+    depends on actually renders — the tier-1 smoke for this tool (pure
+    stdlib: no jax, no engine, no fixture files to go stale)."""
+    import os
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        jsonl = os.path.join(td, "run.jsonl")
+        with open(jsonl, "w") as f:
+            for line in (
+                {"event": "run_begin", "ts": 0.0, "pid": 1,
+                 "monitor_enabled": True, "meta": {"source": "selftest"}},
+                {"step": 1, "ts": 0.1, "dur_ms": 10.0, "loss": 2.5,
+                 "ips": 100.0, "counters": {"jit/retraces": 1}},
+                {"step": 2, "ts": 0.2, "dur_ms": 9.0, "loss": 2.4,
+                 "ips": 110.0},
+                {"event": "run_end", "ts": 0.3, "steps": 2, "wall_s": 0.02,
+                 "totals": {"counters": {
+                     "serving/admits": 2, "serving/evictions": 2,
+                     "serving/prefill_steps": 4, "serving/decode_steps": 9,
+                     "serving/prefix_hit_tokens": 16,
+                     "serving/prefix_miss_tokens": 48},
+                     "histograms": {}, "gauges": {}}},
+            ):
+                f.write(json.dumps(line) + "\n")
+        trace = os.path.join(td, "trace.json")
+
+        def _req(i, total, queue, prefill, decode, preempted, pre=0):
+            return {"ph": "X", "name": "serving/request",
+                    "cat": "serving_finish", "pid": 1,
+                    "tid": f"req/r{i}", "ts": i * 1000.0, "dur": total * 1e3,
+                    "args": {"request": i, "trace_id": f"r{i}",
+                             "tokens": 8, "preemptions": pre,
+                             "total_ms": total, "queue_ms": queue,
+                             "prefill_ms": prefill, "decode_ms": decode,
+                             "preempted_ms": preempted,
+                             "prefill_refunded_tokens": 0,
+                             "spec_rounds": 0, "accepted_tokens": 0}}
+
+        with open(trace, "w") as f:
+            json.dump({"traceEvents": [
+                {"ph": "M", "name": "thread_name", "pid": 1, "tid": "steps",
+                 "args": {"name": "steps"}},
+                {"ph": "X", "name": "step/1", "cat": "step", "pid": 1,
+                 "tid": "steps", "ts": 0.0, "dur": 10000.0},
+                {"ph": "X", "name": "tunnel/sync", "cat": "sync", "pid": 1,
+                 "tid": "host", "ts": 2000.0, "dur": 3000.0},
+                _req(1, 40.0, 5.0, 10.0, 25.0, 0.0),
+                _req(2, 90.0, 20.0, 10.0, 40.0, 20.0, pre=1),
+            ]}, f)
+        bench = os.path.join(td, "bench.log")
+        with open(bench, "w") as f:
+            f.write(json.dumps({
+                "metric": "serving_tokens_per_sec", "value": 123.4,
+                "unit": "tokens/s", "ttft_ms_p50": 12.0,
+                "attribution": {
+                    "queue_ms_mean": 12.5, "prefill_ms_mean": 10.0,
+                    "decode_ms_mean": 32.5, "preempted_ms_mean": 10.0,
+                    "total_ms_mean": 65.0, "phase_sum_vs_total": 1.0,
+                    "queue_share": 0.1923, "queue_ms_p99": 20.0,
+                    "prefill_refunded_tokens": 4, "spec_rounds": 3,
+                    "accepted_tokens": 5},
+                "telemetry": {"serving": {"admits": 2, "evictions": 2,
+                                          "prefill_steps": 4,
+                                          "decode_steps": 9}}}) + "\n")
+        report = render(jsonl, trace_path=trace, top=5, spans=True,
+                        bench_path=bench)
+        needed = (
+            "-- run --",
+            "-- counters (run total) --",
+            "-- serving (continuous batching) --",
+            "-- bench line:",
+            "-- serving (continuous batching) (bench) --",
+            "-- request attribution (phase means, ms) (bench) --",
+            "-- requests (slowest 2 of 2 journeys, ms) --",
+            "-- retrace timeline --",
+            "-- span attribution (host wall decomposition) --",
+        )
+        missing = [m for m in needed if m not in report]
+        # the slowest journey must lead the requests table
+        order_ok = report.find("r2") < report.find("r1") \
+            or "r2" not in report
+        if missing or not order_ok:
+            print(report)
+            print(f"selftest FAILED: missing={missing} "
+                  f"order_ok={order_ok}", file=sys.stderr)
+            return 1
+        print(f"monitor_report selftest ok "
+              f"({len(report.splitlines())} lines, "
+              f"{len(needed)} sections present)")
+        return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Summarize a monitor JSONL run, optionally joined "
                     "with a profiler chrome trace.")
-    ap.add_argument("jsonl", help="StepLogger JSONL file")
+    ap.add_argument("jsonl", nargs="?", default=None,
+                    help="StepLogger JSONL file")
     ap.add_argument("--trace", default=None,
                     help="chrome trace JSON from profiler.export or "
                          "monitor.export_spans")
@@ -868,7 +1058,14 @@ def main(argv=None):
     ap.add_argument("--bench", default=None, metavar="LOG",
                     help="bench log/JSON line: render its guard verdict "
                          "and memory sub-object next to the run")
+    ap.add_argument("--selftest", action="store_true",
+                    help="render a synthesized run and assert every "
+                         "section appears (tier-1 smoke; no jsonl needed)")
     args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if args.jsonl is None:
+        ap.error("jsonl is required (or pass --selftest)")
     report = render(args.jsonl, trace_path=args.trace, top=args.top,
                     spans=args.spans, bench_path=args.bench)
     print(report)
@@ -876,4 +1073,5 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    main()
+    _rc = main()
+    sys.exit(_rc if isinstance(_rc, int) else 0)

@@ -108,6 +108,17 @@ DEFAULT_THRESHOLDS = {
     # ttft_ms_p99; the aggregate tokens/s drop rides the generic
     # throughput check — the metric's value IS tokens/s)
     "ttft_growth": 0.25,
+    # request-attribution gate: fractional growth of serving_bench's
+    # attribution.queue_share (mean queue-wait fraction of end-to-end
+    # request latency) vs the last-good record before the check fails —
+    # a grown queue share means requests wait longer for lanes at the
+    # SAME workload (scheduler regression, slower prefill backing up
+    # admissions, or shrunk effective pool). Only fails past BOTH the
+    # fractional growth and the absolute slack (tiny shares are noisy:
+    # 0.01 → 0.02 is not a regression); skips when either side lacks
+    # the attribution sub-object or the baseline share is 0
+    "queue_share_growth": 0.25,
+    "queue_share_slack": 0.05,
     # prefix-cache gate: fractional drop of serving_bench's
     # prefix_hit_rate vs the last-good record before the check fails —
     # a collapsed hit rate means the shared-prompt workload stopped
@@ -375,6 +386,23 @@ def evaluate(fresh: dict, baseline: dict | None, thresholds: dict | None
                   + (" — tail latency regressed (scheduler queueing or "
                      "prefill got slower)" if tgrowth > th["ttft_growth"]
                      else ""))
+        qs = (fresh.get("attribution") or {}).get("queue_share")
+        base_qs = ((baseline.get("extra") or {}).get("attribution")
+                   or {}).get("queue_share")
+        if qs is not None and base_qs:
+            qgrowth = qs / base_qs - 1.0
+            qover = qs - base_qs
+            qfail = (qgrowth > th["queue_share_growth"]
+                     and qover > th["queue_share_slack"])
+            check("queue_share", not qfail,
+                  f"queue share {qs:.3f} vs last-good {base_qs:.3f} "
+                  f"({'+' if qgrowth > 0 else '-'}"
+                  f"{abs(qgrowth) * 100:.1f}%, max growth "
+                  f"{th['queue_share_growth'] * 100:.0f}% past "
+                  f"{th['queue_share_slack']:.2f} absolute slack)"
+                  + (" — requests wait longer for lanes at the same "
+                     "workload (scheduler regression, slower prefill, "
+                     "or a shrunk effective pool?)" if qfail else ""))
         phr = fresh.get("prefix_hit_rate")
         base_phr = (baseline.get("extra") or {}).get("prefix_hit_rate")
         if phr is not None and base_phr:
@@ -551,6 +579,17 @@ def main(argv=None) -> int:
                     default=DEFAULT_THRESHOLDS["ttft_growth"],
                     help="max fractional p99 TTFT growth vs last-good "
                          "for serving bench lines (default 0.25)")
+    ap.add_argument("--queue-share-growth", type=float,
+                    default=DEFAULT_THRESHOLDS["queue_share_growth"],
+                    help="max fractional growth of the serving bench's "
+                         "attribution.queue_share vs last-good (default "
+                         "0.25; only fails past --queue-share-slack, "
+                         "skipped when either side lacks the "
+                         "attribution sub-object)")
+    ap.add_argument("--queue-share-slack", type=float,
+                    default=DEFAULT_THRESHOLDS["queue_share_slack"],
+                    help="absolute queue-share headroom before the "
+                         "growth gate can fail (default 0.05)")
     ap.add_argument("--prefix-hit-drop", type=float,
                     default=DEFAULT_THRESHOLDS["prefix_hit_drop"],
                     help="max fractional prefix_hit_rate drop vs "
@@ -616,6 +655,8 @@ def main(argv=None) -> int:
                     "compile_growth": args.compile_growth,
                     "compile_slack_ms": args.compile_slack_ms,
                     "ttft_growth": args.ttft_growth,
+                    "queue_share_growth": args.queue_share_growth,
+                    "queue_share_slack": args.queue_share_slack,
                     "prefix_hit_drop": args.prefix_hit_drop,
                     "accept_drop": args.accept_drop,
                     "save_cost_growth": args.save_cost_growth,

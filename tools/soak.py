@@ -105,7 +105,9 @@ def _worker(workdir: str) -> int:
     class CrashAt(paddle.callbacks.Callback):
         """Hard mid-run failure: os._exit skips every flush/join — the
         async checkpoint writer dies mid-write, exactly like a
-        preemption."""
+        preemption. The blackbox postmortem is the ONE thing written
+        first (os._exit skips atexit too, so this is its only chance) —
+        the driver asserts the artifact exists and parses."""
 
         def __init__(self, at):
             self.at = at
@@ -114,6 +116,10 @@ def _worker(workdir: str) -> int:
         def on_train_batch_end(self, step, logs=None):
             self.n += 1
             if self.n == self.at:
+                from paddle_tpu.monitor import blackbox
+
+                blackbox.dump(reason="PT_SOAK_CRASH_AT",
+                              error=f"injected crash at batch {self.n}")
                 os._exit(23)
 
     cbks = []
@@ -253,6 +259,8 @@ def main(argv=None) -> int:
         "PT_MONITOR": "1",
         "PT_MONITOR_SINK": sink,
         "PT_MONITOR_MEM": "1",
+        # crash postmortem lands in the workdir, not the repo cwd
+        "PT_SERVE_BLACKBOX": os.path.join(wd, "serving_blackbox.json"),
         # warm relaunch pays zero fresh XLA compiles (jit/exec_cache.py)
         "PT_EXEC_CACHE": env.get("PT_EXEC_CACHE")
         or os.path.join(wd, "exec_cache"),
@@ -331,6 +339,24 @@ def main(argv=None) -> int:
               f"lives={n_lives} resumed_from={res_from} "
               f"resume_point_complete={untorn} "
               f"complete={complete_ckpts[-3:]} torn={torn_ckpts}")
+        # the injected crash must leave a parseable blackbox postmortem
+        # (monitor/blackbox.py — written before os._exit, atomically)
+        bb_path = env["PT_SERVE_BLACKBOX"]
+        bb_ok, bb_detail = False, f"missing: {bb_path}"
+        try:
+            with open(bb_path) as f:
+                bb = json.load(f)
+            bb_ok = (isinstance(bb.get("spans"), list)
+                     and isinstance(bb.get("state"), dict)
+                     and bb.get("reason") == "PT_SOAK_CRASH_AT")
+            bb_detail = (f"reason={bb.get('reason')} "
+                         f"spans={len(bb.get('spans', []))} "
+                         f"state_keys={sorted(bb.get('state', {}))}")
+        except OSError:
+            pass
+        except ValueError as e:
+            bb_detail = f"unparseable: {e}"
+        check("blackbox", bb_ok, bb_detail)
     skipped = sum(lv.get("skipped_batches", 0) for lv in lives)
     if poison_at >= 0:
         check("nan_skip", skipped >= 1,
