@@ -41,6 +41,18 @@ Knobs (seeded defaults; --smoke pins the small trace explicitly):
                                  (``spec_off`` sub-object: decode
                                  rounds + tokens/s the plain decode
                                  path needed)
+  PT_SERVE_BENCH_REPLICAS (0)    multi-replica router mode (hwbench's
+                                 ``serving_router`` row sets 3): the
+                                 trace replays through a
+                                 ``RouterEngine`` over N in-process
+                                 replicas instead of one engine — the
+                                 line gains ``replicas`` /
+                                 ``affinity_hit_rate`` /
+                                 ``dispatches_per_replica`` /
+                                 ``load_balance_spread`` /
+                                 ``redispatched`` (perf_guard's
+                                 ``--affinity-drop`` gate judges the
+                                 hit rate)
   PT_SERVE_*                     engine geometry (docs/SERVING.md)
   PT_SERVE_PREFIX_CACHE=0        share-nothing pool A/B
   PT_SERVE_SPEC=0                speculation off (plain decode) A/B
@@ -124,7 +136,9 @@ def main():
     import paddle_tpu as pt
     from paddle_tpu import monitor as _mon
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-    from paddle_tpu.serving import ServingConfig, ServingEngine
+    from paddle_tpu.serving import (
+        RouterConfig, RouterEngine, ServingConfig, ServingEngine,
+    )
 
     if os.environ.get("PT_BENCH_MONITOR", "1") != "0":
         # same telemetry ride-along as bench.py: compile wall-time and
@@ -144,6 +158,12 @@ def main():
     spec_k_env = int(os.environ.get("PT_SERVE_BENCH_SPEC_K", "0") or 0)
     spec_kw = {"spec": True, "spec_k": spec_k_env} if spec_k_env else {}
     motif = 4 if spec_k_env else 0
+    # multi-replica router mode (docs/SERVING.md "Replica router"):
+    # PT_SERVE_BENCH_REPLICAS=N replays the SAME trace through a
+    # RouterEngine over N in-process replicas — prefix-affinity dispatch
+    # on, so the shared-prefix trace (PT_SERVE_BENCH_SHARED) measures
+    # what affinity is worth
+    replicas = int(os.environ.get("PT_SERVE_BENCH_REPLICAS", "0") or 0)
     if smoke:
         cfg = LlamaConfig.tiny()
         n_req = int(n_req_env) if n_req_env else 8
@@ -216,7 +236,12 @@ def main():
                 time.sleep(min(trace[i][0] - now, 0.02))
         return reqs, time.perf_counter() - t0
 
-    engine = ServingEngine(model, serve_cfg)
+    if replicas > 1:
+        engine = RouterEngine(
+            model, serve_cfg, RouterConfig(replicas=replicas,
+                                           mode="inproc"))
+    else:
+        engine = ServingEngine(model, serve_cfg)
     engine.warmup()  # compiles (or exec-cache-loads) outside the clock
     reqs, wall = replay(engine)
     # snapshot the monitor AND the exec-cache account NOW: the optional
@@ -377,7 +402,20 @@ def main():
            "hbm_peak_gb_per_s": peak,
            "hbm_util": (round(achieved_gbps / peak, 4) if peak else None),
            "int8_weights": serve_cfg.int8_weights,
-           "paged_attention": bool(stats["paged_attention"])}
+           "paged_attention": bool(stats["paged_attention"]),
+           "replicas": replicas if replicas > 1 else 1}
+    if replicas > 1:
+        # router readout: affinity hit rate is the --affinity-drop
+        # gate's input; load_balance_spread = (max-min)/total dispatches
+        # (0 = perfectly even, 1 = one replica took everything)
+        disp = stats["dispatches_per_replica"]
+        rec["affinity"] = bool(stats["affinity"])
+        rec["affinity_hit_rate"] = round(stats["affinity_hit_rate"], 4)
+        rec["dispatches_per_replica"] = disp
+        rec["load_balance_spread"] = round(
+            (max(disp) - min(disp)) / max(sum(disp), 1), 4)
+        rec["redispatched"] = stats["router"]["redispatches"]
+        rec["dead_replicas"] = stats["router"]["dead_replicas"]
     if stats["spec"]:
         prop = stats["spec_proposed_tokens"]
         rec["accept_rate"] = round(
@@ -442,6 +480,11 @@ def main():
                 if k.startswith("serving/") and v}
         if serv:
             tel["serving"] = serv
+        rout = {k.split("/", 1)[1]: v
+                for k, v in snap["counters"].items()
+                if k.startswith("router/") and v}
+        if rout:
+            tel["router"] = rout
         if _ec.enabled():
             tel["exec_cache"] = ec_snap if ec_snap is not None \
                 else _ec.stats()

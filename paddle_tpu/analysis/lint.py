@@ -83,6 +83,7 @@ DETERMINISM_SCOPE = (
     "paddle_tpu/autoshard/",
     "paddle_tpu/ops/pallas/",
     "paddle_tpu/serving/speculative",
+    "paddle_tpu/serving/router",
     "tools/shard_plan.py",
     "tools/kernel_search.py",
     "tools/flash_autotune.py",

@@ -61,6 +61,7 @@ INSTRUMENTED_MODULES = (
     "paddle_tpu.serving.engine",
     "paddle_tpu.serving.scheduler",
     "paddle_tpu.serving.speculative",
+    "paddle_tpu.serving.router",
     "paddle_tpu.ops.pallas.search",
     "paddle_tpu.resilience.checkpoint_manager",
     "paddle_tpu.resilience.resume",
@@ -157,6 +158,16 @@ _c_spec_accepted = _registry.counter("serving/spec_accepted_tokens")
 _c_spec_bonus = _registry.counter("serving/spec_bonus_tokens")
 _c_spec_draft_calls = _registry.counter("serving/spec_draft_calls")
 _h_spec_accept = _registry.histogram("serving/spec_accept_rate")
+# multi-replica serving router (serving/router.py — docs/SERVING.md):
+# dispatch decisions (with the affinity hit/miss split the bench's
+# affinity_hit_rate reads), drain re-dispatches after a replica death,
+# and the dead-replica count; per-replica dispatch counters and
+# lane/queue gauges land under router/<metric>/<replica>
+_c_router_dispatch = _registry.counter("router/dispatches")
+_c_router_aff_hit = _registry.counter("router/affinity_hits")
+_c_router_aff_miss = _registry.counter("router/affinity_misses")
+_c_router_redispatch = _registry.counter("router/redispatches")
+_c_router_dead = _registry.counter("router/dead_replicas")
 # Pallas kernel engagement + the search harness (ops/pallas/search.py —
 # docs/KERNELS.md): every dispatch-time engagement decision is counted
 # (engaged vs composite fallback, with a per-family breakdown counter),
@@ -589,6 +600,32 @@ def on_serving_prefix(hit_tokens: int, miss_tokens: int,
         _c_serve_prefix_miss.inc(miss_tokens)
     _g_serve_shared_blocks.set(shared_blocks)
     _g_serve_cold_blocks.set(cold_blocks)
+
+
+def on_router_dispatch(replica: int, affinity_hit: bool,
+                       redispatch: bool = False) -> None:
+    """The router routed one request to ``replica`` —
+    ``affinity_hit`` when prefix coverage (not load) chose it,
+    ``redispatch`` when this is a drained request restarting after a
+    replica death."""
+    _c_router_dispatch.inc()
+    (_c_router_aff_hit if affinity_hit else _c_router_aff_miss).inc()
+    _registry.counter(f"router/dispatches/{replica}").inc()
+    if redispatch:
+        _c_router_redispatch.inc()
+
+
+def on_router_dead(replica: int) -> None:
+    """A replica's ``step()`` raised: it is out of rotation and its
+    requests drained back to the router queue."""
+    _c_router_dead.inc()
+
+
+def on_router_lanes(replica: int, occupied: int, queued: int) -> None:
+    """Post-step load census for one replica: occupied lanes + queued
+    (waiting) requests — the least-loaded dispatch rule's inputs."""
+    _registry.gauge(f"router/lanes/{replica}").set(occupied)
+    _registry.gauge(f"router/queued/{replica}").set(queued)
 
 
 def on_pallas_engaged(family: str) -> None:

@@ -12,11 +12,22 @@ from __future__ import annotations
 
 import json
 import os
+import tempfile
 import time
 
 
 def _default_path() -> str:
-    return os.environ.get("PT_MONITOR_SINK") or "monitor_steps.jsonl"
+    """``PT_MONITOR_SINK``, else a run-scoped path under the system
+    tempdir — NEVER the working directory (a bare ``PT_MONITOR=1`` run
+    used to litter a ``monitor_steps.jsonl`` wherever it was launched
+    from). The pid scope keeps concurrent runs from interleaving one
+    file; the ``run_end`` line reports the resolved ``sink`` so the
+    artifact is findable without knowing this rule."""
+    sink = os.environ.get("PT_MONITOR_SINK")
+    if sink:
+        return sink
+    return os.path.join(tempfile.gettempdir(),
+                        f"pt_monitor_steps.{os.getpid()}.jsonl")
 
 
 class StepLogger:
@@ -114,6 +125,7 @@ class StepLogger:
         line = {"event": "run_end", "ts": round(time.time(), 6),
                 "steps": self._step,
                 "wall_s": round(time.perf_counter() - self._t0, 3),
+                "sink": self.path,
                 "totals": self._mon.snapshot()}
         if self._ckpt_step is not None:
             line["last_checkpoint_step"] = self._ckpt_step

@@ -17,6 +17,7 @@ seeded Poisson arrival trace and reports tokens/s + p50/p99 TTFT.
 """
 from .engine import ServingConfig, ServingEngine  # noqa: F401
 from .kv_cache import BlockPool, blocks_needed, prefix_keys  # noqa: F401
+from .router import RouterConfig, RouterEngine  # noqa: F401
 from .scheduler import (  # noqa: F401
     FINISHED, RUNNING, WAITING, FCFSScheduler, Request,
 )
@@ -25,5 +26,6 @@ from .speculative import Drafter, NgramDrafter  # noqa: F401
 __all__ = [
     "ServingConfig", "ServingEngine", "BlockPool", "blocks_needed",
     "prefix_keys", "FCFSScheduler", "Request", "WAITING", "RUNNING",
-    "FINISHED", "Drafter", "NgramDrafter",
+    "FINISHED", "Drafter", "NgramDrafter", "RouterConfig",
+    "RouterEngine",
 ]

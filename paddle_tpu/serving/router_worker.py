@@ -1,0 +1,96 @@
+"""One router replica as a subprocess: the ``mode="worker"`` half of
+:mod:`paddle_tpu.serving.router` (docs/SERVING.md "Replica router").
+
+Protocol: JSON lines on stdin, one JSON reply line per request on the
+ORIGINAL stdout (this process rebinds ``sys.stdout`` to stderr right
+away, so jax/XLA chatter can never corrupt the pipe). Ops:
+
+- ``{"op": "init", "factory": "module:callable", "config": {...}}`` —
+  import ``module``, call ``callable()`` for the model, build a
+  :class:`ServingEngine` with ``ServingConfig(**config)``.
+- ``{"op": "submit", "request_id", "prompt", "max_new_tokens",
+  "eos_token_id"}``
+- ``{"op": "step"}`` -> ``{"ok", "worked", "finished": {rid: [tok]}}``
+- ``{"op": "warmup" | "stats" | "debug_state" | "shutdown"}``
+
+Any op failure replies ``{"ok": false, "error": ...}``; the router
+treats a failed ``step`` (or a dead pipe) as a replica death and
+drains. A warm ``PT_EXEC_CACHE`` directory (inherited env) makes this
+worker's start compile-free — the deployment shape of the router's
+scale-out contract.
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import sys
+
+
+def _build_engine(factory: str, config_kwargs: dict):
+    import numpy as np  # noqa: F401  — model factories usually need it
+
+    from .engine import ServingConfig, ServingEngine
+
+    mod_name, _, fn_name = factory.partition(":")
+    if not mod_name or not fn_name:
+        raise ValueError(
+            f"worker factory must be 'module:callable', got {factory!r}")
+    model = getattr(importlib.import_module(mod_name), fn_name)()
+    return ServingEngine(model, ServingConfig(**config_kwargs))
+
+
+def main(argv=None) -> int:
+    # replies own the real stdout; everything else (jax init banners,
+    # library prints) goes to stderr so the pipe stays pure JSON
+    reply_out = sys.stdout
+    sys.stdout = sys.stderr
+
+    def reply(obj: dict) -> None:
+        reply_out.write(json.dumps(obj) + "\n")
+        reply_out.flush()
+
+    engine = None
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            msg = json.loads(line)
+            op = msg.get("op")
+            if op == "init":
+                engine = _build_engine(msg["factory"],
+                                       msg.get("config") or {})
+                reply({"ok": True, "pid": os.getpid()})
+            elif op == "submit":
+                engine.submit(
+                    msg["prompt"],
+                    max_new_tokens=msg.get("max_new_tokens", 32),
+                    eos_token_id=msg.get("eos_token_id"),
+                    request_id=msg["request_id"])
+                reply({"ok": True})
+            elif op == "step":
+                worked = engine.step() if engine.has_work() else False
+                fins = {str(rid): [int(t) for t in toks]
+                        for rid, toks in engine.pop_finished().items()}
+                reply({"ok": True, "worked": worked, "finished": fins})
+            elif op == "warmup":
+                engine.warmup()
+                reply({"ok": True})
+            elif op == "stats":
+                reply({"ok": True, "stats": engine.stats()})
+            elif op == "debug_state":
+                reply({"ok": True,
+                       "state": engine.scheduler.debug_state()})
+            elif op == "shutdown":
+                reply({"ok": True})
+                return 0
+            else:
+                reply({"ok": False, "error": f"unknown op {op!r}"})
+        except Exception as exc:  # noqa: BLE001 — the router decides
+            reply({"ok": False, "error": f"{type(exc).__name__}: {exc}"})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -258,6 +258,43 @@ def render_serving(out, totals=None, hists=None, gauges=None, source=""):
                    f"max {w['max']} ({w['count']} admit(s))")
 
 
+def render_router(out, totals=None, gauges=None, source=""):
+    """The multi-replica router's account (``router/*`` counters from
+    ``paddle_tpu/serving/router.py`` — docs/SERVING.md "Replica
+    router"): dispatch volume with the affinity hit/miss split,
+    drain traffic (redispatches after a replica death), and the
+    per-replica dispatch + lane-occupancy spread."""
+    totals, gauges = totals or {}, gauges or {}
+    if not any(k.startswith("router/") for k in (*totals, *gauges)):
+        return
+    out.append("")
+    out.append(f"-- serving router (replica dispatch){source} --")
+    disp = totals.get("router/dispatches", 0)
+    hits = totals.get("router/affinity_hits", 0)
+    misses = totals.get("router/affinity_misses", 0)
+    line = f"dispatches {disp}"
+    if hits or misses:
+        line += (f"   affinity hits {hits} / misses {misses} "
+                 f"({hits / (hits + misses):.0%} hit rate)")
+    out.append(line)
+    redisp = totals.get("router/redispatches", 0)
+    dead = totals.get("router/dead_replicas", 0)
+    if redisp or dead:
+        out.append(f"dead replicas {dead}   redispatched (drained) "
+                   f"requests {redisp}")
+    per = sorted((k.rsplit("/", 1)[1], v) for k, v in totals.items()
+                 if k.startswith("router/dispatches/"))
+    for idx, n in per:
+        parts = [f"  replica {idx:<3} dispatches {n}"]
+        lanes = gauges.get(f"router/lanes/{idx}")
+        queued = gauges.get(f"router/queued/{idx}")
+        if lanes is not None:
+            parts.append(f"lanes (last) {lanes:g}")
+        if queued is not None:
+            parts.append(f"queued (last) {queued:g}")
+        out.append("   ".join(parts))
+
+
 def render_kernels(out, totals=None, gauges=None, bench_kernels=None,
                    source=""):
     """The Pallas kernel account (``pallas/*`` engagement counters and
@@ -783,6 +820,10 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
                    hists=(end or {}).get("totals", {}).get("histograms", {}),
                    gauges=(end or {}).get("totals", {}).get("gauges", {}))
 
+    # -- replica router (router/* from the multi-replica dispatcher) --
+    render_router(out, totals=totals,
+                  gauges=(end or {}).get("totals", {}).get("gauges", {}))
+
     # -- pallas kernels (pallas/* + search/* from the search harness) --
     render_kernels(out, totals=totals,
                    gauges=(end or {}).get("totals", {}).get("gauges", {}))
@@ -843,6 +884,12 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
                 render_serving(
                     out, totals={f"serving/{k}": v
                                  for k, v in tel_b["serving"].items()},
+                    source=" (bench)")
+            if tel_b.get("router"):
+                # serving_bench embeds the router counters the same way
+                render_router(
+                    out, totals={f"router/{k}": v
+                                 for k, v in tel_b["router"].items()},
                     source=" (bench)")
             if line.get("attribution"):
                 render_request_attribution(line["attribution"], out,

@@ -42,6 +42,14 @@ and exits nonzero with a human-readable verdict when the run regressed:
   bug) — the tokens-per-decode-step multiplier evaporated. Spec-off
   lines never carry the field, so they skip; ``spec``/``spec_k`` are
   sweep-config keys, so spec and plain serving rows never cross-judge
+- serving router ``affinity_hit_rate`` below last-good by more than
+  ``--affinity-drop`` (25%): the multi-replica router stopped routing
+  same-prefix requests to the replica that already holds their KV
+  blocks (affinity-index churn or a dispatch regression in
+  ``serving/router.py``) — every replica re-prefills the shared prompt
+  and the scale-out win evaporated. Single-engine lines never carry
+  the field, so they skip; ``replicas`` is a sweep-config key, so
+  routed and single-engine rows never cross-judge
 - a changed sharding plan (``--plan-drift``): a fresh hardware line
   whose ``shard_plan`` sub-object (from ``tools/shard_plan.py``) names
   a different (dp, mp, pp, batch) than the last-good record's
@@ -136,6 +144,16 @@ DEFAULT_THRESHOLDS = {
     # side lacks the field (spec-off lines never carry it) or the
     # baseline rate is 0, and on CPU smokes with the rest
     "accept_drop": 0.25,
+    # replica-router gate: fractional drop of serving_bench's
+    # affinity_hit_rate (router dispatches that landed on a replica
+    # already holding the prompt's prefix blocks) vs the last-good
+    # record before the check fails — a collapsed hit rate means every
+    # replica re-prefills the shared prompt (affinity-index churn or a
+    # dispatch regression) and the multi-replica TTFT win silently
+    # evaporated. Skips when either side lacks the field (single-engine
+    # lines never carry it) or the baseline rate is 0, and on CPU
+    # smokes with the rest
+    "affinity_drop": 0.25,
     # resilience gate: fractional growth of the blocking checkpoint-save
     # cost (tools/soak.py lines carry ckpt_save_ms_p50 — the quiesce +
     # host-snapshot time the cadence planner budgets against) vs the
@@ -213,7 +231,8 @@ def load_fresh(path: str) -> dict:
 CONFIG_KEYS = ("batch", "seq", "ce_chunk",
                "requests", "arrival_rate_per_s", "lanes", "block_size",
                "int8_weights", "devices", "pp",
-               "shared_prefix_tokens", "prefix_cache", "spec", "spec_k")
+               "shared_prefix_tokens", "prefix_cache", "spec", "spec_k",
+               "replicas")
 
 # keys whose ABSENCE from an old record means the knob's default, not a
 # wildcard: records persisted before the prefix cache existed WERE
@@ -226,8 +245,13 @@ CONFIG_KEYS = ("batch", "seq", "ce_chunk",
 # ... and pp: records persisted before the planner's pipeline axis
 # existed WERE pp=1 runs, so a fresh pp>1 row never judges itself
 # against them while pp=1 rows keep their pre-PP baselines
+# ... and replicas: records persisted before the multi-replica router
+# existed WERE single-engine (replicas=1) runs, so a fresh routed row
+# never judges itself against them while single-engine rows keep their
+# pre-router baselines
 CONFIG_KEY_DEFAULTS = {"shared_prefix_tokens": 0, "prefix_cache": True,
-                       "spec": False, "spec_k": 0, "pp": 1}
+                       "spec": False, "spec_k": 0, "pp": 1,
+                       "replicas": 1}
 
 
 def config_match(fresh: dict) -> dict:
@@ -426,6 +450,18 @@ def evaluate(fresh: dict, baseline: dict | None, thresholds: dict | None
                      "regression, workload change, or a verify-step "
                      "acceptance bug?)"
                      if adrop > th["accept_drop"] else ""))
+        ahr = fresh.get("affinity_hit_rate")
+        base_ahr = (baseline.get("extra") or {}).get("affinity_hit_rate")
+        if ahr is not None and base_ahr:
+            hdrop = 1.0 - ahr / base_ahr
+            check("affinity_hit", hdrop <= th["affinity_drop"],
+                  f"affinity hit rate {ahr:.3f} vs last-good "
+                  f"{base_ahr:.3f} "
+                  f"({'-' if hdrop > 0 else '+'}{abs(hdrop) * 100:.1f}%,"
+                  f" max drop {th['affinity_drop'] * 100:.0f}%)"
+                  + (" — prefix-affinity dispatch collapsed (affinity-"
+                     "index churn or a router dispatch regression?)"
+                     if hdrop > th["affinity_drop"] else ""))
         sms = fresh.get("ckpt_save_ms_p50")
         base_sms = (baseline.get("extra") or {}).get("ckpt_save_ms_p50")
         if sms is not None and base_sms:
@@ -601,6 +637,12 @@ def main(argv=None) -> int:
                          "vs last-good for serving bench lines (default "
                          "0.25; skipped when either side lacks the "
                          "field or the baseline rate is 0)")
+    ap.add_argument("--affinity-drop", type=float,
+                    default=DEFAULT_THRESHOLDS["affinity_drop"],
+                    help="max fractional router affinity_hit_rate drop "
+                         "vs last-good for serving bench lines (default "
+                         "0.25; skipped when either side lacks the "
+                         "field or the baseline rate is 0)")
     ap.add_argument("--save-cost-growth", type=float,
                     default=DEFAULT_THRESHOLDS["save_cost_growth"],
                     help="max fractional checkpoint-save blocking-cost "
@@ -659,6 +701,7 @@ def main(argv=None) -> int:
                     "queue_share_slack": args.queue_share_slack,
                     "prefix_hit_drop": args.prefix_hit_drop,
                     "accept_drop": args.accept_drop,
+                    "affinity_drop": args.affinity_drop,
                     "save_cost_growth": args.save_cost_growth,
                     "save_cost_slack_ms": args.save_cost_slack_ms,
                     "plan_drift": args.plan_drift,

@@ -35,6 +35,16 @@ Emits ONE JSON verdict line (the bench-line contract: ``metric`` =
 ``soak``) and exits 0 iff every gate passed. Hardware runs persist to
 ``PERF_MEASUREMENTS.json``. ``tools/hwbench.py`` carries a timeboxed soak
 row; ``tests/test_resilience.py`` runs ``--smoke`` in tier-1.
+
+``--router`` is the serving twin: an in-process replica-kill drain
+scenario (docs/SERVING.md "Replica router") — a 3-replica
+``RouterEngine`` serves a shared-prefix trace, one replica's ``step()``
+starts raising mid-flight (``PT_SOAK_ROUTER_KILL`` picks the victim,
+``PT_SOAK_ROUTER_KILL_AT`` the step), and the gate demands every
+request finish on the survivors byte-identical to a no-failure
+single-engine run, with the blackbox postmortem naming the dead
+replica. Same one-JSON-verdict-line contract (``metric`` =
+``soak_router``), exit 0 iff all checks hold.
 """
 from __future__ import annotations
 
@@ -154,6 +164,138 @@ def _worker(workdir: str) -> int:
     return 0
 
 
+# -- router drain leg --------------------------------------------------------
+
+def _router_leg(args) -> int:
+    """``--router``: the serving engine's crash-survival twin of the
+    training soak — kill one of three router replicas mid-trace and
+    gate on the drain contract (finish on survivors, byte-identical
+    tokens, postmortem names the victim)."""
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    import jax
+
+    smoke = args.smoke or not os.environ.get("JAX_PLATFORMS", "").strip()
+    if smoke:
+        # CPU pin the proven way (CLAUDE.md): the env var alone is
+        # overridden by the host sitecustomize
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (
+        RouterConfig, RouterEngine, ServingConfig, ServingEngine,
+    )
+
+    wd = args.out or tempfile.mkdtemp(prefix="pt_soak_router_")
+    os.makedirs(wd, exist_ok=True)
+    bb_path = os.path.join(wd, "router_blackbox.json")
+    os.environ["PT_SERVE_BLACKBOX"] = bb_path
+    victim = int(os.environ.get("PT_SOAK_ROUTER_KILL", "0"))
+    kill_at = int(os.environ.get("PT_SOAK_ROUTER_KILL_AT", "2"))
+
+    pt.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+    model.eval()
+    geom = ServingConfig(max_lanes=3, block_size=4, prefill_chunk=8,
+                         max_seq_len=32)
+    # shared-prefix trace: affinity funnels it onto ONE replica, so
+    # killing that replica drains a full complement of in-flight work
+    rng = np.random.RandomState(0)
+    prefix = rng.randint(0, model.config.vocab_size, (8,)) \
+        .astype(np.int32)
+    work = []
+    for _ in range(12):
+        sfx = rng.randint(0, model.config.vocab_size,
+                          (int(rng.randint(1, 6)),)).astype(np.int32)
+        work.append((np.concatenate([prefix, sfx]),
+                     int(rng.randint(4, 10))))
+
+    print(f"soak --router: smoke={smoke} replicas=3 victim={victim} "
+          f"kill_at={kill_at} workdir={wd}", flush=True)
+    t0 = time.perf_counter()
+    single = ServingEngine(model, geom)
+    for i, (p, n) in enumerate(work):
+        single.submit(p, max_new_tokens=n, request_id=f"r{i}")
+    base = single.run()
+
+    router = RouterEngine(model, geom,
+                          RouterConfig(replicas=3, mode="inproc"))
+    for i, (p, n) in enumerate(work):
+        router.submit(p, max_new_tokens=n, request_id=f"r{i}")
+    eng = router._replicas[victim]._engine
+    real_step = eng.step
+    calls = {"n": 0}
+
+    def flaky_step():
+        calls["n"] += 1
+        if calls["n"] > kill_at:
+            raise RuntimeError(
+                f"soak-injected replica {victim} failure")
+        return real_step()
+
+    eng.step = flaky_step
+    outs = router.run()
+    wall = time.perf_counter() - t0
+
+    checks = []
+
+    def check(name, ok, detail):
+        checks.append({"name": name, "ok": bool(ok), "detail": detail})
+
+    check("finished_all", set(outs) == set(base),
+          f"{len(outs)}/{len(work)} requests finished after the kill")
+    ident = all(np.array_equal(outs[k], base[k])
+                for k in base if k in outs)
+    check("token_identity", ident and set(outs) == set(base),
+          "drained requests byte-identical to the no-failure run"
+          if ident else "token mismatch after drain")
+    c = router.counters
+    check("drain", c["dead_replicas"] == 1 and c["redispatches"] > 0
+          and victim in router._dead,
+          f"dead_replicas={c['dead_replicas']} "
+          f"redispatches={c['redispatches']} dead={router._dead}")
+    bb_ok, bb_detail = False, f"missing: {bb_path}"
+    try:
+        with open(bb_path) as f:
+            bb = json.load(f)
+        srv = bb.get("state", {}).get("serving_router", {})
+        bb_ok = (bb.get("reason") == "router_replica_dead"
+                 and str(victim) in srv.get("dead", {}))
+        bb_detail = (f"reason={bb.get('reason')} "
+                     f"dead={srv.get('dead')}")
+    except OSError:
+        pass
+    except ValueError as e:
+        bb_detail = f"unparseable: {e}"
+    check("blackbox", bb_ok, bb_detail)
+
+    line = {
+        "metric": "soak_router",
+        "value": len(outs),
+        "unit": "requests",
+        "replicas": 3,
+        "victim": victim,
+        "kill_at": kill_at,
+        "redispatched": c["redispatches"],
+        "dispatches_per_replica": list(router.dispatch_counts),
+        "wall_s": round(wall, 3),
+        "checks": [{k: ch[k] for k in ("name", "ok")} for ch in checks],
+    }
+    if smoke:
+        line["note"] = "cpu smoke; replica-kill drain proof"
+    ok = all(ch["ok"] for ch in checks)
+    line["ok"] = ok
+    for ch in checks:
+        mark = "ok  " if ch["ok"] else "FAIL"
+        print(f"  [{mark}] {ch['name']:<16} {ch.get('detail', '')}",
+              flush=True)
+    print(json.dumps(line), flush=True)
+    return 0 if ok else 3
+
+
 # -- driver ------------------------------------------------------------------
 
 def _read_jsonl(path):
@@ -223,11 +365,17 @@ def main(argv=None) -> int:
                     help="total train steps (default: 48 smoke / 2000)")
     ap.add_argument("--out", default=None,
                     help="workdir (default: a fresh temp dir)")
+    ap.add_argument("--router", action="store_true",
+                    help="serving replica-kill drain leg: 3-replica "
+                         "router, one injected step() failure, gated "
+                         "on survivors finishing byte-identical")
     ap.add_argument("--worker", default=None, metavar="WORKDIR",
                     help=argparse.SUPPRESS)  # internal: launcher payload
     args = ap.parse_args(argv)
     if args.worker:
         return _worker(args.worker)
+    if args.router:
+        return _router_leg(args)
 
     smoke = args.smoke
     if not smoke:
