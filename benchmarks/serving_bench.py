@@ -53,9 +53,18 @@ Knobs (seeded defaults; --smoke pins the small trace explicitly):
                                  ``redispatched`` (perf_guard's
                                  ``--affinity-drop`` gate judges the
                                  hit rate)
+  PT_SERVE_BENCH_KV_AB    (0)    =1 (with PT_SERVE_KV_INT8=1, hwbench's
+                                 ``serving_int8kv`` row) replays the
+                                 same trace once more through a fresh
+                                 engine whose pool stores the model
+                                 dtype and embeds the A/B (``kv_bf16``
+                                 sub-object: tokens/s, TTFT p50, pool
+                                 bytes, allocatable_tokens, peak-HBM —
+                                 the capacity line's denominator)
   PT_SERVE_*                     engine geometry (docs/SERVING.md)
   PT_SERVE_PREFIX_CACHE=0        share-nothing pool A/B
   PT_SERVE_SPEC=0                speculation off (plain decode) A/B
+  PT_SERVE_KV_INT8=1             int8 KV block pool (half-HBM KV) A/B
   PT_DECODE_INT8=1               weight-only int8 decode A/B
 """
 from __future__ import annotations
@@ -119,6 +128,32 @@ def build_trace(n, rate, vocab, prompt_rng, new_rng, seed=0,
 def percentile(values, q):
     return float(np.percentile(np.asarray(values, np.float64), q)) \
         if values else None
+
+
+def kv_byte_model(cfg, num_blocks, block_size, kv_el_bytes, scale_bytes):
+    """The serving-KV byte model — ONE place the bench line and the
+    capacity tests (tests/test_serving_kv_int8.py) read the same
+    arithmetic. Per-token KV bytes follow the POOL's storage dtype
+    (``kv_el_bytes`` is the pool array's own itemsize, not an assumed
+    2-byte element) plus ``scale_bytes`` per (position, kv_head) — the
+    fp32 amax scales `quantize_kv` stores alongside an int8 pool.
+
+    ``allocatable_tokens`` divides the UNQUANTIZED pool's byte budget
+    (the configured ``num_blocks`` at the model dtype — "equal
+    PT_SERVE_BLOCKS byte budget") by the actual per-token cost: the
+    bf16 pool lands exactly on ``num_blocks * block_size``, the int8
+    pool on ``2d/(d+4)`` times that (1.94x at head_dim=128 — the
+    capacity claim ISSUE 18 gates at >= 1.9x).
+
+    Returns ``(kv_bytes_per_token, allocatable_tokens)``."""
+    nkv = cfg.num_key_value_heads or cfg.num_attention_heads
+    head_dim = cfg.hidden_size // cfg.num_attention_heads
+    base_el = 2 if cfg.dtype == "bfloat16" else 4
+    per_tok = 2 * cfg.num_hidden_layers * nkv \
+        * (head_dim * kv_el_bytes + scale_bytes)
+    budget = (num_blocks * block_size
+              * 2 * cfg.num_hidden_layers * nkv * head_dim * base_el)
+    return per_tok, budget // per_tok
 
 
 def main():
@@ -335,10 +370,19 @@ def main():
     param_bytes = sum(
         x.nbytes for x in jax.tree_util.tree_leaves(params)
     ) - embed_nbytes + embed_row_bytes
-    kv_el_bytes = 2 if cfg.dtype == "bfloat16" else 4
-    nkv = cfg.num_key_value_heads or cfg.num_attention_heads
-    head_dim = cfg.hidden_size // cfg.num_attention_heads
-    tok_kv_bytes = 2 * cfg.num_hidden_layers * nkv * head_dim * kv_el_bytes
+    # KV bytes from the pool's ACTUAL itemsize (+ scale bytes), not an
+    # assumed 2-byte element — before int8 KV landed this line billed
+    # every pool as bf16; worker-mode routers hold no local pool, so
+    # they derive the itemsize from the config they dispatched
+    kv_int8 = bool(stats.get("kv_int8", False))
+    kpool = getattr(engine, "_kpool", None)
+    kv_el_bytes = (int(kpool.dtype.itemsize) if kpool is not None
+                   else 1 if kv_int8
+                   else 2 if cfg.dtype == "bfloat16" else 4)
+    scale_bytes = 4 if kv_int8 else 0  # one fp32 amax per (pos, kv_head)
+    tok_kv_bytes, allocatable = kv_byte_model(
+        cfg, stats["num_blocks"], stats["block_size"], kv_el_bytes,
+        scale_bytes)
     decode_bytes = (rounds * param_bytes
                     + stats["kv_read_tokens"] * tok_kv_bytes
                     + stats["decoded_tokens"] * tok_kv_bytes)
@@ -402,6 +446,15 @@ def main():
            "hbm_peak_gb_per_s": peak,
            "hbm_util": (round(achieved_gbps / peak, 4) if peak else None),
            "int8_weights": serve_cfg.int8_weights,
+           # int8-KV capacity line (docs/SERVING.md "int8 KV"):
+           # kv_bytes_per_token follows the pool's own itemsize (+ fp32
+           # scale bytes); allocatable_tokens is what the UNQUANTIZED
+           # pool's byte budget buys at that rate — int8 reports ~1.94x
+           # bf16's at head_dim=128 (the >=1.9x acceptance gate)
+           "kv_int8": kv_int8,
+           "kv_bytes_per_token": int(tok_kv_bytes),
+           "allocatable_tokens": int(allocatable),
+           "kv_pool_bytes": stats.get("kv_pool_bytes"),
            "paged_attention": bool(stats["paged_attention"]),
            "replicas": replicas if replicas > 1 else 1}
     if replicas > 1:
@@ -442,6 +495,39 @@ def main():
                 st_off["decoded_tokens"]
                 / (st_off["decode_wall_s"] or 1e-9), 1),
         }
+    if kv_int8 and os.environ.get("PT_SERVE_BENCH_KV_AB", "0") == "1":
+        # int8-vs-bf16 KV A/B (hwbench's serving_int8kv row): the SAME
+        # trace through a fresh engine whose pool stores the model
+        # dtype — the allocatable_tokens delta is the HBM-capacity
+        # claim, the tokens/s + TTFT delta is what quantize-on-write /
+        # dequant-on-read cost end to end on this box
+        eng_bf = ServingEngine(model, make_cfg(kv_int8=False))
+        eng_bf.warmup()
+        reqs_bf, wall_bf = replay(eng_bf)
+        st_bf = eng_bf.stats()
+        toks_bf = sum(len(r.output) for r in reqs_bf)
+        ttft_bf = [(r.t_first - r.t_submit) * 1e3 for r in reqs_bf
+                   if r.t_first is not None]
+        tok_bf, alloc_bf = kv_byte_model(
+            cfg, st_bf["num_blocks"], st_bf["block_size"],
+            int(eng_bf._kpool.dtype.itemsize), 0)
+        rec["kv_bf16"] = {
+            "tokens_per_sec": round(toks_bf / wall_bf, 1)
+            if wall_bf > 0 else 0.0,
+            "ttft_ms_p50": (round(percentile(ttft_bf, 50), 2)
+                            if ttft_bf else None),
+            "kv_bytes_per_token": int(tok_bf),
+            "allocatable_tokens": int(alloc_bf),
+            "kv_pool_bytes": st_bf["kv_pool_bytes"],
+        }
+        try:
+            from paddle_tpu.monitor import memory as _memobs
+
+            pk = _memobs.device_peak_gib()
+            if pk is not None:
+                rec["kv_bf16"]["peak_hbm_gib"] = pk
+        except Exception:  # noqa: BLE001 — a readout must not break the line
+            pass
     if stats["paged_attention"] and peak:
         # the dense read this engine no longer performs, as utilization
         # (docs/KERNELS.md: the paged kernel's measured-win readout)
@@ -455,7 +541,10 @@ def main():
         # the serving engine's ACTUAL read path overrides the
         # table-derived view (forced modes included)
         kernels = _ksearch.engagement_report()
-        kernels["paged_attention"] = bool(stats["paged_attention"])
+        # the engine reads through paged_attention_int8 when kv_int8 —
+        # override the family it ACTUALLY routed, not the bf16 one
+        kernels[stats.get("paged_family", "paged_attention")] = bool(
+            stats["paged_attention"])
         rec["kernels"] = kernels
     except Exception:  # noqa: BLE001 — a readout must not break the line
         pass

@@ -133,9 +133,18 @@ def _attend(q, kc, vc, valid_len, nh, nkv, key_pad=None,
 
 
 def _block(x, layer_p, cache_k, cache_v, li, pos, valid_len, cfg,
-           key_pad=None):
+           key_pad=None, kv_int8=False):
     """One decoder layer over a [b, s] slice, reading/writing the cache at
-    ``pos``. Returns (x_out, new_cache_k, new_cache_v)."""
+    ``pos``. Returns (x_out, new_cache_k, new_cache_v).
+
+    ``kv_int8`` (static) round-trips the freshly-RoPE'd K/V through the
+    shared int8 quant/dequant (`quantization.quantize_kv`) before the
+    cache write — the cache still stores the model dtype, but every
+    cached value is exactly what the serving engine's int8 block pool
+    would reproduce (quantize-on-write there, dequant-on-read here:
+    identical fp32 ops either way), so ``generate(kv_int8=True)`` IS
+    the token-identity reference for `PT_SERVE_KV_INT8` engines
+    (tests/test_serving_kv_int8.py)."""
     nh = cfg.num_attention_heads
     nkv = cfg.num_key_value_heads or nh
     d = cfg.hidden_size // nh
@@ -147,6 +156,11 @@ def _block(x, layer_p, cache_k, cache_v, li, pos, valid_len, cfg,
     k = k.reshape(b, s, nkv, d)
     v = v.reshape(b, s, nkv, d)
     q, k = _rope_at(q, k, pos, cfg.rope_theta)
+    if kv_int8:
+        from ..quantization import dequantize_kv, quantize_kv
+
+        k = dequantize_kv(*quantize_kv(k), k.dtype)
+        v = dequantize_kv(*quantize_kv(v), v.dtype)
     ck = cache_k.at[li].set(
         jax.lax.dynamic_update_slice_in_dim(cache_k[li], k,
                                             valid_len - s, 1))
@@ -166,7 +180,7 @@ def _block(x, layer_p, cache_k, cache_v, li, pos, valid_len, cfg,
 
 
 def _forward(params, ids, cache_k, cache_v, valid_len, cfg,
-             key_pad=None):
+             key_pad=None, kv_int8=False):
     """Forward [b, s] token ids at absolute positions
     [valid_len - s, valid_len), attending over the cache. With left
     padding (``key_pad`` [b]), RoPE positions shift so each row's first
@@ -185,7 +199,7 @@ def _forward(params, ids, cache_k, cache_v, valid_len, cfg,
                    for k in
                    ("ln1", "qkv", "o", "ln2", "gate_up", "down")}
         x, ck, cv = _block(x, layer_p, ck, cv, li, pos, valid_len, cfg,
-                           key_pad=key_pad)
+                           key_pad=key_pad, kv_int8=kv_int8)
         return (x, ck, cv), None
 
     (x, cache_k, cache_v), _ = jax.lax.scan(
@@ -252,10 +266,10 @@ class _GenCfg:
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "do_sample", "top_k",
-                     "use_top_p", "eos_token_id"))
+                     "use_top_p", "eos_token_id", "kv_int8"))
 def _generate_jit(params, ids, key, temperature, top_p, key_pad, *, cfg,
                   max_new_tokens, do_sample, top_k, use_top_p,
-                  eos_token_id):
+                  eos_token_id, kv_int8=False):
     b, prompt_len = ids.shape
     nh = cfg.num_attention_heads
     nkv = cfg.num_key_value_heads or nh
@@ -268,7 +282,7 @@ def _generate_jit(params, ids, key, temperature, top_p, key_pad, *, cfg,
     # prefill: the whole prompt in one batched pass
     logits, cache_k, cache_v = _forward(params, ids, cache_k, cache_v,
                                         jnp.asarray(prompt_len), cfg,
-                                        key_pad=key_pad)
+                                        key_pad=key_pad, kv_int8=kv_int8)
     key, sub = jax.random.split(key)
     next_tok = _sample(logits, sub, do_sample, temperature,
                        top_k, top_p, use_top_p)
@@ -279,7 +293,7 @@ def _generate_jit(params, ids, key, temperature, top_p, key_pad, *, cfg,
         tok, ck, cv, fin, key = carry
         valid = prompt_len + 1 + i
         logits, ck, cv = _forward(params, tok[:, None], ck, cv, valid,
-                                  cfg, key_pad=key_pad)
+                                  cfg, key_pad=key_pad, kv_int8=kv_int8)
         key, sub = jax.random.split(key)
         nxt = _sample(logits, sub, do_sample, temperature,
                       top_k, top_p, use_top_p)
@@ -298,7 +312,8 @@ def _generate_jit(params, ids, key, temperature, top_p, key_pad, *, cfg,
 
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-             seed=0, attention_mask=None, int8_weights=None):
+             seed=0, attention_mask=None, int8_weights=None,
+             kv_int8=None):
     """Generate ``max_new_tokens`` continuations of ``input_ids``
     ([b, prompt_len] int tensor) with the compiled KV-cache decode loop.
     Returns the generated tokens [b, max_new_tokens] (prompt excluded).
@@ -306,7 +321,12 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     Unequal-length prompts batch via LEFT padding + ``attention_mask``
     ([b, prompt_len] 1/0, zeros on the left): pad slots are hidden from
     attention and RoPE positions start at each row's first real token.
-    Without a mask, prompts must be all-real tokens."""
+    Without a mask, prompts must be all-real tokens.
+
+    ``kv_int8`` (default: ``PT_SERVE_KV_INT8``) round-trips cached K/V
+    through the shared symmetric int8 quant/dequant — the reference the
+    int8-pool serving engine is proven token-identical against (see
+    `_block`)."""
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
     if getattr(model.config, "moe_num_experts", 0) > 1:
@@ -316,10 +336,12 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             "generate() does not decode MoE Llama configs yet (the expert "
             "dispatch needs its own cached single-token path); dense "
             "configs are supported")
-    if int8_weights is None:
-        import os
+    import os
 
+    if int8_weights is None:
         int8_weights = os.environ.get("PT_DECODE_INT8") == "1"
+    if kv_int8 is None:
+        kv_int8 = os.environ.get("PT_SERVE_KV_INT8") == "1"
     params = _collect_params(model, int8_weights=int8_weights)
     ids = input_ids._data if isinstance(input_ids, Tensor) \
         else jnp.asarray(np.asarray(input_ids))
@@ -377,5 +399,5 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         cfg=_GenCfg(model.config), max_new_tokens=int(max_new_tokens),
         do_sample=bool(do_sample), top_k=int(top_k),
         use_top_p=float(top_p) < 1.0,
-        eos_token_id=eos_token_id)
+        eos_token_id=eos_token_id, kv_int8=bool(kv_int8))
     return Tensor(out)

@@ -389,7 +389,7 @@ class LlamaForCausalLM(Layer):
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-                 seed=0, attention_mask=None):
+                 seed=0, attention_mask=None, kv_int8=None):
         """Compiled KV-cache autoregressive decoding (see
         models/generation.py). Returns [b, max_new_tokens] new tokens."""
         from .generation import generate as _generate
@@ -398,7 +398,7 @@ class LlamaForCausalLM(Layer):
                          do_sample=do_sample, temperature=temperature,
                          top_k=top_k, top_p=top_p,
                          eos_token_id=eos_token_id, seed=seed,
-                         attention_mask=attention_mask)
+                         attention_mask=attention_mask, kv_int8=kv_int8)
 
     def flops_per_token(self, seq_len):
         """Approximate training FLOPs/token (fwd+bwd) for MFU accounting."""

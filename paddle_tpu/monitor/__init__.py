@@ -145,6 +145,13 @@ _c_serve_prefix_hit = _registry.counter("serving/prefix_hit_tokens")
 _c_serve_prefix_miss = _registry.counter("serving/prefix_miss_tokens")
 _g_serve_shared_blocks = _registry.gauge("serving/shared_blocks")
 _g_serve_cold_blocks = _registry.gauge("serving/cold_blocks")
+# int8 KV block pool (PT_SERVE_KV_INT8 — docs/SERVING.md "int8 KV"):
+# quantize-on-write program launches + the real tokens they quantized,
+# and the device bytes the K/V (+ scale) pools pin — bf16 engines never
+# touch these
+_c_kv_quant_writes = _registry.counter("serving/kv_quant_writes")
+_c_kv_quant_tokens = _registry.counter("serving/kv_quant_tokens")
+_g_kv_pool_bytes = _registry.gauge("serving/kv_pool_bytes")
 # speculative decoding (serving/engine.py verify rounds + the
 # serving/speculative.py drafter — docs/SERVING.md): decoded_tokens
 # accumulates across plain decode AND verify rounds so
@@ -600,6 +607,18 @@ def on_serving_prefix(hit_tokens: int, miss_tokens: int,
         _c_serve_prefix_miss.inc(miss_tokens)
     _g_serve_shared_blocks.set(shared_blocks)
     _g_serve_cold_blocks.set(cold_blocks)
+
+
+def on_serving_kv_quant(writes: int, tokens: int,
+                        pool_bytes: int) -> None:
+    """An int8-pool engine ran ``writes`` quantize-on-write program
+    launches covering ``tokens`` real (non-pad) tokens; ``pool_bytes``
+    is the static K/V + scale pool footprint (docs/SERVING.md
+    "int8 KV")."""
+    _c_kv_quant_writes.inc(writes)
+    if tokens:
+        _c_kv_quant_tokens.inc(tokens)
+    _g_kv_pool_bytes.set(pool_bytes)
 
 
 def on_router_dispatch(replica: int, affinity_hit: bool,

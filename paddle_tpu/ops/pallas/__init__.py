@@ -16,6 +16,7 @@ flash_attention_kernel = _fa.flash_attention_kernel
 register_flash_attention = _fa.register
 hb_flash = _hf.hb_flash
 paged_attend = _pa.paged_attend
+paged_attend_int8 = _pa.paged_attend_int8
 
 
 def check_tpu_lowering():

@@ -41,7 +41,8 @@ from ...framework.jax_compat import export as _jax_export, tpu_compiler_params
 from .. import registry
 from . import search
 
-__all__ = ["paged_attend", "family_key", "check_lowering", "register"]
+__all__ = ["paged_attend", "paged_attend_int8", "family_key",
+           "check_lowering", "check_lowering_int8", "register"]
 
 NEG_INF = -1e30
 _LANES = 128
@@ -164,6 +165,128 @@ def paged_attend(q, kpool, vpool, tables, pos, *, window=0,
     )(tables, pos, q, kpool, vpool)
 
 
+# -- int8 quantized-gather variant (PT_SERVE_KV_INT8 engines) -----------------
+
+def _paged_kernel_int8(tab_ref, pos_ref, q_ref, k_ref, v_ref, ks_ref,
+                       vs_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                       block_size, n_blocks, nkv, g, window=0):
+    """:func:`_paged_kernel` over an int8 block pool: the K/V tiles
+    arrive quantized with their per-position fp32 scale tiles (same
+    scalar-prefetched block-table index maps, so dead-iteration DMA
+    elision is unchanged) and dequantize in-register — the fp32
+    ``int8 * scale`` product feeds the same streaming-softmax math, so
+    outputs match the engine's dense dequant-then-attend read
+    bit-for-bit at fp32 (`quantization.dequantize_kv` is the same two
+    ops)."""
+    l_idx = pl.program_id(0)
+    m_idx = pl.program_id(1)
+    p = pos_ref[l_idx]
+    B = block_size
+    nb = p // B + 1  # live blocks: slots 0..p are visible
+
+    @pl.when(m_idx == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(m_idx < nb)
+    def _step():
+        slots = m_idx * B + jax.lax.broadcasted_iota(jnp.int32, (g, B),
+                                                     1)
+        vis = slots <= p
+        if window > 0:
+            vis &= slots > p - window
+        for j in range(nkv):
+            q = q_ref[0, j * g:(j + 1) * g, :].astype(jnp.float32)
+            # in-tile dequant: [B, d] int8 * [B, 1] fp32 scale
+            k = k_ref[0, :, j, :].astype(jnp.float32) \
+                * ks_ref[0, :, j:j + 1]
+            v = v_ref[0, :, j, :].astype(jnp.float32) \
+                * vs_ref[0, :, j:j + 1]
+            d = q.shape[-1]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [g, B]
+            s = jnp.where(vis, s * (1.0 / math.sqrt(d)), NEG_INF)
+            rows = slice(j * g, (j + 1) * g)
+            m_prev = m_ref[rows, :1]
+            l_prev = l_ref[rows, :1]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(s, axis=1, keepdims=True))
+            pexp = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(pexp, axis=1,
+                                             keepdims=True)
+            m_ref[rows] = jnp.broadcast_to(m_new, (g, m_ref.shape[1]))
+            l_ref[rows] = jnp.broadcast_to(l_new, (g, l_ref.shape[1]))
+            acc_ref[rows] = alpha * acc_ref[rows] + jax.lax.dot_general(
+                pexp, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    @pl.when(m_idx == n_blocks - 1)
+    def _fini():
+        l_safe = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attend_int8(q, kpool, vpool, kscale, vscale, tables, pos, *,
+                      window=0, dead="clamp", interpret=False):
+    """:func:`paged_attend` for an int8 block pool: kpool/vpool are
+    ``[num_blocks, B, nkv, d]`` int8, kscale/vscale their paired
+    ``[num_blocks, B, nkv]`` fp32 amax scales (one per position per KV
+    head — `quantization.quantize_kv`). Scale tiles gather through the
+    SAME block-table index maps as their K/V tiles (one 3-D BlockSpec
+    per scale pool) and dequantize in-tile; everything else — masking,
+    dead-iteration strategies, streaming softmax — is the bf16 kernel
+    unchanged. Returns ``[L, nh, d]`` in ``q.dtype``."""
+    L, nh, d = q.shape
+    B, nkv = kpool.shape[1], kpool.shape[2]
+    M = tables.shape[1]
+    g = nh // nkv
+    if dead == "clamp":
+        def kv_index(l, m, tab, pos):  # noqa: ANN001 — pallas index map
+            return (tab[l, jnp.minimum(m, pos[l] // B)], 0, 0, 0)
+
+        def sc_index(l, m, tab, pos):  # noqa: ANN001
+            return (tab[l, jnp.minimum(m, pos[l] // B)], 0, 0)
+    elif dead == "null":
+        def kv_index(l, m, tab, pos):  # noqa: ANN001
+            return (jnp.where(m <= pos[l] // B, tab[l, m], 0), 0, 0, 0)
+
+        def sc_index(l, m, tab, pos):  # noqa: ANN001
+            return (jnp.where(m <= pos[l] // B, tab[l, m], 0), 0, 0)
+    else:
+        raise ValueError(f"unknown dead-iteration strategy {dead!r}")
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(L, M),
+        in_specs=[
+            pl.BlockSpec((1, nh, d), lambda l, m, tab, pos: (l, 0, 0)),
+            pl.BlockSpec((1, B, nkv, d), kv_index),
+            pl.BlockSpec((1, B, nkv, d), kv_index),
+            pl.BlockSpec((1, B, nkv), sc_index),
+            pl.BlockSpec((1, B, nkv), sc_index),
+        ],
+        out_specs=pl.BlockSpec((1, nh, d),
+                               lambda l, m, tab, pos: (l, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nh, d), jnp.float32),
+            pltpu.VMEM((nh, _LANES), jnp.float32),
+            pltpu.VMEM((nh, _LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_kernel_int8, block_size=B, n_blocks=M,
+                          nkv=nkv, g=g, window=window),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((L, nh, d), q.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(tables, pos, q, kpool, vpool, kscale, vscale)
+
+
 # -- search-harness family ----------------------------------------------------
 
 def family_key(block_size, nkv, g, d, window=0) -> str:
@@ -259,6 +382,64 @@ class PagedAttentionFamily(search.KernelFamily):
 search.register_family(PagedAttentionFamily())
 
 
+class PagedAttentionInt8Family(PagedAttentionFamily):
+    """The quantized-gather variant (`paged_attend_int8`) for int8
+    block pools (``PT_SERVE_KV_INT8`` engines): int8 K/V blocks + fp32
+    scale blocks gather through the same block tables and dequantize
+    in-tile. Same candidate axis (dead-iteration strategy), same
+    geometry keys — but its OWN tune-table family, so an int8 engine
+    never engages on a bf16 measurement or vice versa. Ships
+    disengaged until hwbench's ``kernel_search`` row lands hardware
+    rows (docs/KERNELS.md)."""
+
+    name = "paged_attention_int8"
+
+    def _inputs(self, shape, dtype):
+        from ...quantization import quantize_kv
+
+        q, kpool, vpool, tables, pos = super()._inputs(shape, dtype)
+        # quantize through THE shared helper — the tiles the kernel
+        # dequantizes are exactly what the engine's write path produces
+        kq, ks = quantize_kv(kpool)
+        vq, vs = quantize_kv(vpool)
+        return q, kq, vq, ks, vs, tables, pos
+
+    def build(self, shape, config, interpret):
+        def run(q, kpool, vpool, kscale, vscale, tables, pos):
+            return paged_attend_int8(q, kpool, vpool, kscale, vscale,
+                                     tables, pos,
+                                     dead=config.get("dead", "clamp"),
+                                     interpret=interpret)
+
+        return run
+
+    def build_composite(self, shape):
+        """The engine's int8 dense read (`serving/engine.py:
+        _pool_forward` with ``kv_int8``): gather int8 blocks + scales,
+        `quantization.dequantize_kv`, then `_attend_lanes` — the
+        production fallback this kernel replaces."""
+        L, M, B, nkv, g, d = shape
+        nh = nkv * g
+
+        def composite(q, kpool, vpool, kscale, vscale, tables, pos):
+            from ...quantization import dequantize_kv
+            from ...serving.engine import _attend_lanes
+
+            kc = dequantize_kv(
+                kpool[tables].reshape(L, M * B, nkv, d),
+                kscale[tables].reshape(L, M * B, nkv), q.dtype)
+            vc = dequantize_kv(
+                vpool[tables].reshape(L, M * B, nkv, d),
+                vscale[tables].reshape(L, M * B, nkv), q.dtype)
+            return _attend_lanes(q[:, None], kc, vc, pos[:, None], nh,
+                                 nkv)[:, 0]
+
+        return composite
+
+
+search.register_family(PagedAttentionInt8Family())
+
+
 # -- lowering self-check + registry hookup ------------------------------------
 
 def check_lowering():
@@ -283,11 +464,40 @@ def check_lowering():
             q, pool, pool, tables, pos)
 
 
+def check_lowering_int8():
+    """Mosaic-lower the quantized-gather kernel for platform 'tpu' at
+    the serving geometries (same sweep as :func:`check_lowering` — both
+    dead-iteration strategies, GQA, engine-default and lane-tile block
+    sizes) — any host, no chip."""
+    for (L, M, B, nkv, g, d), dead in (
+            ((8, 32, 16, 12, 1, 128), "clamp"),
+            ((8, 32, 16, 12, 1, 128), "null"),
+            ((4, 8, 128, 4, 2, 128), "clamp")):
+        nh = nkv * g
+        q = jnp.zeros((L, nh, d), jnp.bfloat16)
+        pool = jnp.zeros((L * M + 1, B, nkv, d), jnp.int8)
+        scale = jnp.zeros((L * M + 1, B, nkv), jnp.float32)
+        tables = jnp.zeros((L, M), jnp.int32)
+        pos = jnp.zeros((L,), jnp.int32)
+
+        def run(q, kpool, vpool, kscale, vscale, tables, pos,
+                _dead=dead):
+            return paged_attend_int8(q, kpool, vpool, kscale, vscale,
+                                     tables, pos, dead=_dead)
+
+        _jax_export.export(jax.jit(run), platforms=["tpu"])(
+            q, pool, pool, scale, scale, tables, pos)
+
+
 def register(platform="tpu"):
-    """Registry entry exists for the lowering pre-flight only: the
-    serving engine calls :func:`paged_attend` directly behind its
-    measured-engagement gate, never by op-name dispatch."""
+    """Registry entries exist for the lowering pre-flight only: the
+    serving engine calls :func:`paged_attend` /
+    :func:`paged_attend_int8` directly behind its measured-engagement
+    gate, never by op-name dispatch."""
     fn = paged_attend
     fn.check_lowering = check_lowering
     registry.register_kernel("paged_attention", platform)(fn)
+    fn8 = paged_attend_int8
+    fn8.check_lowering = check_lowering_int8
+    registry.register_kernel("paged_attention_int8", platform)(fn8)
     return fn

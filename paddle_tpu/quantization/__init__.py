@@ -24,7 +24,8 @@ from ..ops.dispatch import apply
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMax",
            "AbsmaxObserver", "quant_dequant", "Int8Linear",
-           "convert_to_int8", "quantize_weight_int8"]
+           "convert_to_int8", "quantize_weight_int8",
+           "quantize_kv", "dequantize_kv"]
 
 
 def _fake_quant(x, scale, bits=8):
@@ -235,6 +236,34 @@ def quantize_weight_int8(w):
     s = jnp.maximum(s, 1e-12)
     q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
     return {"q": q, "s": s}
+
+
+def quantize_kv(x):
+    """Per-position symmetric int8 KV quantization — THE shared helper
+    for the int8 KV-cache path (`PT_SERVE_KV_INT8`): the serving
+    engine's quantize-on-write (`serving/engine.py:_pool_forward`), the
+    reference round-trip (`models/generation.py` ``kv_int8=True``), and
+    the `paged_attention_int8` kernel family's input builder all route
+    through it, so the three paths cannot diverge on scale/clip
+    semantics. Amax is over the trailing head_dim axis: x [..., d] ->
+    (q int8 [..., d], s fp32 [...]) — one scale per (position, kv_head),
+    which is exactly per (layer, block, slot, kv_head) once written into
+    the block pool, so scales are content-derived and shared prefix
+    blocks share their scales."""
+    x32 = x.astype(jnp.float32)
+    s = jnp.max(jnp.abs(x32), axis=-1) / 127.0
+    s = jnp.maximum(s, 1e-12)
+    q = jnp.clip(jnp.round(x32 / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def dequantize_kv(q, s, dtype):
+    """Inverse of :func:`quantize_kv`: q int8 [..., d] and s fp32 [...]
+    back to ``dtype``. fp32 multiply then one cast — bit-identical
+    whether it runs in the engine's dense read, the reference
+    round-trip, or the paged kernel's in-tile dequant (which keeps the
+    fp32 product and lets the attention math consume it)."""
+    return (q.astype(jnp.float32) * s[..., None]).astype(dtype)
 
 
 def _int8_linear_fn(xa, wq, ws, ba=None, *, mode="weight_only",

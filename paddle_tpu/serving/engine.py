@@ -118,6 +118,16 @@ class ServingConfig:
       ceiling; defaults to the model's max_position_embeddings.
     - ``int8_weights`` (``PT_DECODE_INT8``): weight-only int8 matmuls,
       same lever as ``generate()``.
+    - ``kv_int8`` (``PT_SERVE_KV_INT8``, off): int8 block pool — K/V
+      quantize on write (per-position symmetric amax over head_dim,
+      `quantization.quantize_kv`; fp32 scales ride in paired
+      ``[layers, num_blocks, block_size, kv_heads]`` scale pools) and
+      dequantize on read, halving pool HBM at fixed ``num_blocks``.
+      Token-identical to ``generate(kv_int8=True)`` — the quantize-
+      aware reference (tests/test_serving_kv_int8.py); dtype is a
+      static exec-cache key, so churn still never retraces and a fleet
+      still pays exactly 3 fresh compiles. Off = today's engine, byte
+      for byte. docs/SERVING.md "int8 KV".
     - ``paged`` (``PT_SERVE_PAGED``): decode-attention read path —
       ``"auto"`` (default) engages the Pallas paged-attention kernel
       (``ops/pallas/paged_attention.py``) only on a measured-faster
@@ -139,7 +149,8 @@ class ServingConfig:
 
     def __init__(self, max_lanes=None, block_size=None, num_blocks=None,
                  prefill_chunk=None, max_seq_len=None, int8_weights=None,
-                 paged=None, prefix_cache=None, spec=None, spec_k=None):
+                 paged=None, prefix_cache=None, spec=None, spec_k=None,
+                 kv_int8=None):
         self.max_lanes = max_lanes if max_lanes is not None \
             else _env_int("PT_SERVE_LANES", 8)
         self.block_size = block_size if block_size is not None \
@@ -153,6 +164,9 @@ class ServingConfig:
         if int8_weights is None:
             int8_weights = os.environ.get("PT_DECODE_INT8") == "1"
         self.int8_weights = bool(int8_weights)
+        if kv_int8 is None:
+            kv_int8 = os.environ.get("PT_SERVE_KV_INT8") == "1"
+        self.kv_int8 = bool(kv_int8)
         if paged is None:
             paged = os.environ.get("PT_SERVE_PAGED", "auto")
         if paged in (True, 1, "1", "on"):
@@ -210,23 +224,35 @@ def _attend_lanes(q, kc, vc, pos, nh, nkv, sliding_window=0):
     return out.reshape(b, s, nh, d).astype(q.dtype)
 
 
-def _pool_forward(params, kpool, vpool, tables, ids, pos, wlimit, cfg,
-                  paged=False, paged_dead="clamp"):
+def _pool_forward(params, kpool, vpool, kscale, vscale, tables, ids,
+                  pos, wlimit, cfg, paged=False, paged_dead="clamp"):
     """Forward ``ids`` [b, s] at absolute positions ``pos`` [b, s]
     against the block pool: per layer, write each token's K/V into its
     lane's block at ``pos`` (writes at positions >= ``wlimit[b]`` — pad
     tail of a final prefill chunk, idle decode lanes — are redirected to
     null block 0 so they can never clobber live KV), then attend over
     the lane's whole gathered table. Layer math is
-    ``models/generation.py:_block`` on the pooled layout. Returns
-    (x [b, s, hidden], kpool, vpool)."""
+    ``models/generation.py:_block`` on the pooled layout.
+
+    ``kscale``/``vscale`` are the int8 mode's paired fp32 scale pools
+    (``[layers, num_blocks, block_size, kv_heads]``; None in bf16 mode
+    — None is an empty pytree, so the bf16 jaxpr is byte-identical to
+    the pre-int8 program): writes quantize K/V per position through the
+    shared `quantization.quantize_kv` (scale writes ride the same
+    null-redirected ``blk``/``off``, null block included), reads
+    dequantize the gathered blocks before the same fp32 attention —
+    identical ops to ``generate(kv_int8=True)``'s round-trip, so the
+    two paths stay bit-equal. Returns
+    (x [b, s, hidden], kpool, vpool, kscale, vscale)."""
     b, s = ids.shape
     nh = cfg.num_attention_heads
     nkv = cfg.num_key_value_heads or nh
     d = cfg.hidden_size // nh
     B = kpool.shape[2]
     M = tables.shape[1]
-    x = params["embed"][ids].astype(jnp.dtype(cfg.dtype))
+    dt = jnp.dtype(cfg.dtype)
+    quant = kscale is not None
+    x = params["embed"][ids].astype(dt)
     idx = jnp.minimum(pos // B, M - 1)  # pad pos can run past the table
     blk = jnp.take_along_axis(tables, idx, axis=1)
     ok = pos < wlimit[:, None]
@@ -235,7 +261,11 @@ def _pool_forward(params, kpool, vpool, tables, ids, pos, wlimit, cfg,
     n_layers = params["ln1"].shape[0]
 
     def body(carry, li):
-        x, kp, vp = carry
+        if quant:
+            x, kp, vp, ks, vs = carry
+        else:
+            x, kp, vp = carry
+            ks = vs = None
         layer_p = {k: jax.tree_util.tree_map(lambda a: a[li], params[k])
                    for k in
                    ("ln1", "qkv", "o", "ln2", "gate_up", "down")}
@@ -246,23 +276,47 @@ def _pool_forward(params, kpool, vpool, tables, ids, pos, wlimit, cfg,
         k = k.reshape(b, s, nkv, d)
         v = v.reshape(b, s, nkv, d)
         q, k = _rope_at(q, k, pos, cfg.rope_theta)
+        if quant:
+            from ..quantization import quantize_kv
+
+            k, k_s = quantize_kv(k)
+            v, v_s = quantize_kv(v)
+            ks = ks.at[li, blk, off].set(k_s)
+            vs = vs.at[li, blk, off].set(v_s)
         kp = kp.at[li, blk, off].set(k)
         vp = vp.at[li, blk, off].set(v)
         if paged and s == 1:
             # Pallas paged read: gather straight from the pool via the
             # block table, touching only each lane's live prefix — the
             # dense kp[li][tables] gather below reads every table slot
-            from ..ops.pallas.paged_attention import paged_attend
+            interp = jax.default_backend() not in ("tpu", "axon")
+            # (axon = the tunneled TPU plugin, the registry's alias)
+            if quant:
+                from ..ops.pallas.paged_attention import \
+                    paged_attend_int8
 
-            out = paged_attend(
-                q.reshape(b, nh, d), kp[li], vp[li], tables, pos[:, 0],
-                window=cfg.sliding_window, dead=paged_dead,
-                # axon = the tunneled TPU plugin (registry's alias)
-                interpret=jax.default_backend() not in
-                ("tpu", "axon"))[:, None]
+                out = paged_attend_int8(
+                    q.reshape(b, nh, d), kp[li], vp[li], ks[li],
+                    vs[li], tables, pos[:, 0],
+                    window=cfg.sliding_window, dead=paged_dead,
+                    interpret=interp)[:, None]
+            else:
+                from ..ops.pallas.paged_attention import paged_attend
+
+                out = paged_attend(
+                    q.reshape(b, nh, d), kp[li], vp[li], tables,
+                    pos[:, 0], window=cfg.sliding_window,
+                    dead=paged_dead, interpret=interp)[:, None]
         else:
             kc = kp[li][tables].reshape(b, M * B, nkv, d)
             vc = vp[li][tables].reshape(b, M * B, nkv, d)
+            if quant:
+                from ..quantization import dequantize_kv
+
+                kc = dequantize_kv(
+                    kc, ks[li][tables].reshape(b, M * B, nkv), dt)
+                vc = dequantize_kv(
+                    vc, vs[li][tables].reshape(b, M * B, nkv), dt)
             out = _attend_lanes(q, kc, vc, pos, nh, nkv,
                                 sliding_window=cfg.sliding_window)
         x = x + _mm(out.reshape(b, s, nh * d), layer_p["o"])
@@ -271,50 +325,60 @@ def _pool_forward(params, kpool, vpool, tables, ids, pos, wlimit, cfg,
         gate, up = jnp.split(gu, 2, axis=-1)
         x = x + _mm(jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype)
                     * up, layer_p["down"])
+        if quant:
+            return (x, kp, vp, ks, vs), None
         return (x, kp, vp), None
 
-    (x, kpool, vpool), _ = jax.lax.scan(
-        body, (x, kpool, vpool), jnp.arange(n_layers))
-    return x, kpool, vpool
+    if quant:
+        (x, kpool, vpool, kscale, vscale), _ = jax.lax.scan(
+            body, (x, kpool, vpool, kscale, vscale),
+            jnp.arange(n_layers))
+    else:
+        (x, kpool, vpool), _ = jax.lax.scan(
+            body, (x, kpool, vpool), jnp.arange(n_layers))
+    return x, kpool, vpool, kscale, vscale
 
 
-def _prefill_chunk(params, kpool, vpool, table, ids, start, ctx_len,
-                   last_idx, *, cfg):
+def _prefill_chunk(params, kpool, vpool, kscale, vscale, table, ids,
+                   start, ctx_len, last_idx, *, cfg):
     """One lane's prefill chunk: ``ids`` [1, C] at positions
     [start, start+C); greedy-samples from position ``last_idx`` within
     the chunk (the overall last real token on the final chunk; ignored
-    by the caller otherwise). Returns (tok [1], kpool, vpool)."""
+    by the caller otherwise). Returns
+    (tok [1], kpool, vpool, kscale, vscale)."""
     C = ids.shape[1]
     pos = (start + jnp.arange(C, dtype=jnp.int32))[None, :]
-    x, kpool, vpool = _pool_forward(
-        params, kpool, vpool, table, ids, pos,
+    x, kpool, vpool, kscale, vscale = _pool_forward(
+        params, kpool, vpool, kscale, vscale, table, ids, pos,
         jnp.reshape(ctx_len, (1,)), cfg)
     x = _rms(x, params["norm"], cfg.rms_norm_eps)
     h = jax.lax.dynamic_index_in_dim(x, last_idx, axis=1, keepdims=False)
     logits = _mm(h, params["lm_head"]).astype(jnp.float32)
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), kpool, vpool
+    return (jnp.argmax(logits, axis=-1).astype(jnp.int32), kpool, vpool,
+            kscale, vscale)
 
 
-def _decode_step(params, kpool, vpool, tables, cur_len, last_tok, *,
-                 cfg, paged=False, paged_dead="clamp"):
+def _decode_step(params, kpool, vpool, kscale, vscale, tables, cur_len,
+                 last_tok, *, cfg, paged=False, paged_dead="clamp"):
     """The shared decode step: every lane feeds its pending token at
     position ``cur_len`` (write-then-attend, so the token sees itself
     like ``generate()``'s step does) and greedy-samples the next. Idle
     lanes (cur_len 0, table row 0) write to the null block and their
     outputs are ignored host-side. ``paged`` (static) swaps the dense
     gathered KV read for the Pallas paged-attention kernel. Returns
-    (tok [L], kpool, vpool)."""
+    (tok [L], kpool, vpool, kscale, vscale)."""
     pos = cur_len[:, None]
-    x, kpool, vpool = _pool_forward(
-        params, kpool, vpool, tables, last_tok[:, None], pos,
-        cur_len + 1, cfg, paged=paged, paged_dead=paged_dead)
+    x, kpool, vpool, kscale, vscale = _pool_forward(
+        params, kpool, vpool, kscale, vscale, tables, last_tok[:, None],
+        pos, cur_len + 1, cfg, paged=paged, paged_dead=paged_dead)
     x = _rms(x, params["norm"], cfg.rms_norm_eps)
     logits = _mm(x[:, -1], params["lm_head"]).astype(jnp.float32)
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), kpool, vpool
+    return (jnp.argmax(logits, axis=-1).astype(jnp.int32), kpool, vpool,
+            kscale, vscale)
 
 
-def _verify_step(params, kpool, vpool, tables, cur_len, toks, wlimit, *,
-                 cfg):
+def _verify_step(params, kpool, vpool, kscale, vscale, tables, cur_len,
+                 toks, wlimit, *, cfg):
     """The speculative verify step: ``toks`` [L, k+1] holds each lane's
     pending token (column 0) followed by its draft, at absolute
     positions ``cur_len + j``. Writes at positions >= ``wlimit[b]`` (=
@@ -328,11 +392,13 @@ def _verify_step(params, kpool, vpool, tables, cur_len, toks, wlimit, *,
     drafts against them directly."""
     S = toks.shape[1]
     pos = cur_len[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
-    x, kpool, vpool = _pool_forward(
-        params, kpool, vpool, tables, toks, pos, wlimit, cfg)
+    x, kpool, vpool, kscale, vscale = _pool_forward(
+        params, kpool, vpool, kscale, vscale, tables, toks, pos, wlimit,
+        cfg)
     x = _rms(x, params["norm"], cfg.rms_norm_eps)
     logits = _mm(x, params["lm_head"]).astype(jnp.float32)
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), kpool, vpool
+    return (jnp.argmax(logits, axis=-1).astype(jnp.int32), kpool, vpool,
+            kscale, vscale)
 
 
 # -- the engine ---------------------------------------------------------------
@@ -367,10 +433,24 @@ class ServingEngine:
         nkv = self._gcfg.num_key_value_heads or nh
         d = self._gcfg.hidden_size // nh
         layers = self._params["ln1"].shape[0]
-        dt = jnp.dtype(self._gcfg.dtype)
+        dt = jnp.int8 if cfg.kv_int8 else jnp.dtype(self._gcfg.dtype)
         self._kpool = jnp.zeros(
             (layers, num_blocks, cfg.block_size, nkv, d), dt)
         self._vpool = jnp.zeros_like(self._kpool)
+        # int8 mode: paired per-position fp32 amax scales (null block
+        # included — masked writes land there like K/V pad writes do);
+        # None in bf16 mode so the compiled programs stay byte-identical
+        # to the pre-int8 engine (None is an empty pytree operand)
+        if cfg.kv_int8:
+            self._kscale = jnp.zeros(
+                (layers, num_blocks, cfg.block_size, nkv), jnp.float32)
+            self._vscale = jnp.zeros_like(self._kscale)
+        else:
+            self._kscale = self._vscale = None
+        self.kv_pool_bytes = int(
+            self._kpool.nbytes + self._vpool.nbytes
+            + (self._kscale.nbytes + self._vscale.nbytes
+               if cfg.kv_int8 else 0))
         self.scheduler = FCFSScheduler(
             BlockPool(num_blocks, cfg.block_size), cfg.max_lanes,
             self.blocks_per_lane, self.max_seq_len,
@@ -414,6 +494,7 @@ class ServingEngine:
             "spec_bonus_tokens": 0,
             "prefix_hit_tokens": 0, "prefix_miss_tokens": 0,
             "kv_read_tokens": 0, "kv_dense_read_tokens": 0,
+            "kv_quant_writes": 0, "kv_quant_tokens": 0,
             "decode_wall_s": 0.0,
         }
         # postmortem hook: on an engine raise (or an external crash
@@ -424,12 +505,16 @@ class ServingEngine:
     def _resolve_paged(self) -> bool:
         """Decode read-path selection (ServingConfig.paged): forced
         on/off, or ``auto`` = engaged only on a measured-faster
-        ``paged_attention`` tune-table row for this geometry on this
-        device (the measurement-first convention — no row, no flip).
-        Also resolves ``self._paged_dead``: the row's WINNING
-        dead-iteration strategy — engaging the measured configuration,
-        not the default — falling back to ``"clamp"`` when forced on
-        with no row."""
+        tune-table row for this geometry on this device (the
+        measurement-first convention — no row, no flip). Which FAMILY
+        is consulted follows the pool dtype: ``paged_attention`` for
+        bf16 pools, ``paged_attention_int8`` (the quantized-gather
+        variant) when ``kv_int8`` — an int8 engine never engages on a
+        bf16 row or vice versa (``self._paged_family`` is what the
+        bench/guard surface reports). Also resolves
+        ``self._paged_dead``: the row's WINNING dead-iteration strategy
+        — engaging the measured configuration, not the default —
+        falling back to ``"clamp"`` when forced on with no row."""
         from ..ops.pallas import paged_attention as _pa
         from ..ops.pallas import search as _ksearch
 
@@ -438,14 +523,17 @@ class ServingEngine:
         d = self._gcfg.hidden_size // nh
         key = _pa.family_key(self.config.block_size, nkv, nh // nkv, d,
                              window=self._gcfg.sliding_window)
-        cfg_row = _ksearch.best_config("paged_attention", key) or {}
+        self._paged_family = ("paged_attention_int8"
+                              if self.config.kv_int8
+                              else "paged_attention")
+        cfg_row = _ksearch.best_config(self._paged_family, key) or {}
         self._paged_dead = cfg_row.get("dead", "clamp")
         mode = self.config.paged
         if mode == "on":
             return True
         if mode == "off":
             return False
-        return _ksearch.decide("paged_attention", key)
+        return _ksearch.decide(self._paged_family, key)
 
     # -- intake --------------------------------------------------------------
 
@@ -486,23 +574,35 @@ class ServingEngine:
         L, M, C = cfgv.max_lanes, self.blocks_per_lane, cfgv.prefill_chunk
         i32 = jnp.int32
         # donation halves pool HBM traffic; XLA:CPU can't donate these
-        # and would warn per call
+        # and would warn per call. int8 mode donates the scale pools too
+        # — they churn write-for-write with the K/V pools.
         donate = jax.default_backend() != "cpu"
         kw = {"static_argnames": ("cfg",)}
         if donate:
-            kw["donate_argnums"] = (1, 2)
+            kw["donate_argnums"] = (1, 2, 3, 4) if cfgv.kv_int8 \
+                else (1, 2)
         pspec = jax.ShapeDtypeStruct(self._kpool.shape, self._kpool.dtype)
+        sspec = None if self._kscale is None else \
+            jax.ShapeDtypeStruct(self._kscale.shape, self._kscale.dtype)
 
         def key(kind, **extra):
             if not exec_cache.enabled():
                 return None
-            return {"kind": kind, "gen_cfg": self._gcfg._key(),
-                    "params": [exec_cache.array_spec(a) for a in
-                               jax.tree_util.tree_leaves(self._params)],
-                    "pool": (tuple(int(x) for x in self._kpool.shape),
-                             str(self._kpool.dtype)),
-                    "donate": donate,
-                    "mesh": exec_cache.mesh_spec(), **extra}
+            k = {"kind": kind, "gen_cfg": self._gcfg._key(),
+                 "params": [exec_cache.array_spec(a) for a in
+                            jax.tree_util.tree_leaves(self._params)],
+                 "pool": (tuple(int(x) for x in self._kpool.shape),
+                          str(self._kpool.dtype)),
+                 "donate": donate,
+                 "mesh": exec_cache.mesh_spec(), **extra}
+            if cfgv.kv_int8:
+                # the pool dtype above already splits int8 from bf16
+                # entries; the explicit marker + scale spec make the
+                # cache key self-describing (meta sidecar, audits)
+                k["kv_int8"] = True
+                k["scale"] = (tuple(int(x) for x in self._kscale.shape),
+                              str(self._kscale.dtype))
+            return k
 
         dkw = dict(kw)
         dkw["static_argnames"] = ("cfg", "paged", "paged_dead")
@@ -511,7 +611,7 @@ class ServingEngine:
             key("serving_decode", lanes=L, m=M,
                 paged=self.paged_active, paged_dead=self._paged_dead),
             lambda: dec.lower(
-                self._params, pspec, pspec,
+                self._params, pspec, pspec, sspec, sspec,
                 jax.ShapeDtypeStruct((L, M), i32),
                 jax.ShapeDtypeStruct((L,), i32),
                 jax.ShapeDtypeStruct((L,), i32), cfg=self._gcfg,
@@ -523,7 +623,7 @@ class ServingEngine:
         self._prefill_exec = exec_cache.get_or_compile(
             key("serving_prefill", m=M, chunk=C),
             lambda: pre.lower(
-                self._params, pspec, pspec,
+                self._params, pspec, pspec, sspec, sspec,
                 jax.ShapeDtypeStruct((1, M), i32),
                 jax.ShapeDtypeStruct((1, C), i32),
                 scal, scal, scal, cfg=self._gcfg),
@@ -534,7 +634,7 @@ class ServingEngine:
             self._verify_exec = exec_cache.get_or_compile(
                 key("serving_verify", lanes=L, m=M, k=self.config.spec_k),
                 lambda: ver.lower(
-                    self._params, pspec, pspec,
+                    self._params, pspec, pspec, sspec, sspec,
                     jax.ShapeDtypeStruct((L, M), i32),
                     jax.ShapeDtypeStruct((L,), i32),
                     jax.ShapeDtypeStruct((L, S), i32),
@@ -644,10 +744,11 @@ class ServingEngine:
             chunk = np.zeros((1, C), np.int32)
             chunk[0, :piece.size] = piece
             last_idx = ctx - 1 - start if start + C >= ctx else 0
-            tok, self._kpool, self._vpool = self._prefill_exec(
-                self._params, self._kpool, self._vpool, table,
-                jnp.asarray(chunk), jnp.int32(start), jnp.int32(ctx),
-                jnp.int32(last_idx))
+            (tok, self._kpool, self._vpool, self._kscale,
+             self._vscale) = self._prefill_exec(
+                self._params, self._kpool, self._vpool, self._kscale,
+                self._vscale, table, jnp.asarray(chunk),
+                jnp.int32(start), jnp.int32(ctx), jnp.int32(last_idx))
             nchunks += 1
             if sp is not None:
                 # enqueue wall only (no per-chunk host sync — the one
@@ -663,12 +764,20 @@ class ServingEngine:
         self.counters["prefill_chunks"] += nchunks
         self.counters["prefix_hit_tokens"] += cached
         self.counters["prefix_miss_tokens"] += ctx - cached
+        if self.config.kv_int8:
+            # quantize-on-write accounting: program launches that
+            # quantized + the real (non-pad) tokens they wrote
+            self.counters["kv_quant_writes"] += nchunks
+            self.counters["kv_quant_tokens"] += ctx - cached
         m = _monitor
         if m is not None:
             m.on_serving_prefill(nchunks)
             pool = self.scheduler.pool
             m.on_serving_prefix(cached, ctx - cached,
                                 pool.shared_count, pool.cold_count)
+            if self.config.kv_int8:
+                m.on_serving_kv_quant(nchunks, ctx - cached,
+                                      self.kv_pool_bytes)
         # recompute-refund: cached tokens on a re-admission are context
         # the preemption forced us to rebuild but the prefix cache
         # served back for free
@@ -758,9 +867,11 @@ class ServingEngine:
                 toks[req.lane, 1:1 + d.size] = d
             wlim[req.lane] = req.pool_len + 1 + d.size
         t0 = time.perf_counter()
-        pred, self._kpool, self._vpool = self._verify_exec(
-            self._params, self._kpool, self._vpool, jnp.asarray(tables),
-            jnp.asarray(cur), jnp.asarray(toks), jnp.asarray(wlim))
+        (pred, self._kpool, self._vpool, self._kscale,
+         self._vscale) = self._verify_exec(
+            self._params, self._kpool, self._vpool, self._kscale,
+            self._vscale, jnp.asarray(tables), jnp.asarray(cur),
+            jnp.asarray(toks), jnp.asarray(wlim))
         preds = np.asarray(pred)  # the round's ONE host sync
         now = time.perf_counter()
         c = self.counters
@@ -822,11 +933,20 @@ class ServingEngine:
         dense_slots = len(act) * M * self.config.block_size
         c["kv_read_tokens"] += dense_slots
         c["kv_dense_read_tokens"] += dense_slots
+        if self.config.kv_int8:
+            # every non-pad write this round quantized: each lane's
+            # pending token + its (possibly rejected) draft — rejected
+            # positions still wrote int8+scale before the rewind
+            c["kv_quant_writes"] += 1
+            c["kv_quant_tokens"] += len(act) + proposed
         m = _monitor
         if m is not None:
             m.on_serving_verify(len(act), self.scheduler.pool.allocatable,
                                 emitted)
             m.on_serving_spec(proposed, accepted, bonus)
+            if self.config.kv_int8:
+                m.on_serving_kv_quant(1, len(act) + proposed,
+                                      self.kv_pool_bytes)
         sp = _spans
         if sp is not None:
             # recorded COMPLETE, after rollbacks/releases settled — a
@@ -847,9 +967,11 @@ class ServingEngine:
             cur[req.lane] = req.pool_len
             last[req.lane] = req.output[-1]
         t0 = time.perf_counter()
-        tok, self._kpool, self._vpool = self._decode_exec(
-            self._params, self._kpool, self._vpool, jnp.asarray(tables),
-            jnp.asarray(cur), jnp.asarray(last))
+        (tok, self._kpool, self._vpool, self._kscale,
+         self._vscale) = self._decode_exec(
+            self._params, self._kpool, self._vpool, self._kscale,
+            self._vscale, jnp.asarray(tables), jnp.asarray(cur),
+            jnp.asarray(last))
         toks = np.asarray(tok)  # the round's ONE host sync
         now = time.perf_counter()
         c = self.counters
@@ -861,12 +983,17 @@ class ServingEngine:
         # model's inputs (benchmarks/serving_bench.py hbm_util delta)
         c["kv_read_tokens"] += sum(r.pool_len + 1 for r in act)
         c["kv_dense_read_tokens"] += len(act) * M * self.config.block_size
+        if self.config.kv_int8:
+            c["kv_quant_writes"] += 1
+            c["kv_quant_tokens"] += len(act)
         m = _monitor
         if m is not None:
             # allocatable = free list + revivable cold LRU — the
             # pre-sharing meaning of "free" (cold blocks are spare
             # capacity, not occupancy)
             m.on_serving_decode(len(act), self.scheduler.pool.allocatable)
+            if self.config.kv_int8:
+                m.on_serving_kv_quant(1, len(act), self.kv_pool_bytes)
         sp = _spans
         if sp is not None:
             sp.record("serving/decode_round", "serving_decode", t0, now,
@@ -950,6 +1077,7 @@ class ServingEngine:
                 "spec_k": self.config.spec_k,
                 "prefix_cache": self.config.prefix_cache,
                 "paged": self.paged_active,
+                "kv_int8": self.config.kv_int8,
             },
             "counters": dict(self.counters),
             "scheduler": self.scheduler.debug_state(),
@@ -973,7 +1101,10 @@ class ServingEngine:
             max_seq_len=self.max_seq_len,
             prefill_chunk=self.config.prefill_chunk,
             int8_weights=self.config.int8_weights,
+            kv_int8=self.config.kv_int8,
+            kv_pool_bytes=self.kv_pool_bytes,
             paged_attention=self.paged_active,
+            paged_family=self._paged_family,
             paged_dead=self._paged_dead,
             prefix_cache=self.config.prefix_cache,
             shared_blocks=self.scheduler.pool.shared_count,

@@ -43,6 +43,20 @@ also what makes speculative decoding's rollback free: the verify step
 ``pool_len`` — always in the lane's private tail blocks — so rejecting
 a draft is a ``pool_len`` rewind with no copy and no shared-state
 repair (docs/SERVING.md speculative section).
+
+**int8 KV mode** (``ServingConfig(kv_int8=True)`` — docs/SERVING.md
+"int8 KV"): the engine's pools store int8 K/V plus paired fp32 amax
+scale tensors ``[layers, num_blocks, block_size, kv_heads]`` indexed by
+the SAME block ids this ledger hands out — one scale per (position,
+kv_head), null block included. Nothing here changes: a block id means
+"these pool slots AND their scale slots", so sharing shares scales
+(they are content-derived, quantized once at write), preemption frees
+them, cold revival revives them, and every invariant above — refcounts,
+double-free raises, ``free + used + cold == capacity`` — carries over
+to int8 pools untouched (tests/test_serving_kv_int8.py proves it).
+Rollback stays free for the same tail-privacy reason: rejected draft
+scales sit past ``pool_len`` in private tail blocks and are simply
+overwritten next write.
 """
 from __future__ import annotations
 

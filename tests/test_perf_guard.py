@@ -345,6 +345,22 @@ class TestEvaluate:
         assert bad and not bad[0]["ok"]
         assert "paged_attention" in bad[0]["detail"]
 
+    def test_kernel_engagement_gate_covers_paged_attention_int8(
+            self, guard):
+        # the quantized-gather family (ISSUE 18) rides the same
+        # name-agnostic kernels map: engaged-then-composite fails
+        base = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                "backend": "tpu",
+                "extra": {"kernels": {"paged_attention_int8": True}}}
+        fresh = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                 "unit": "tokens/s",
+                 "kernels": {"paged_attention_int8": False}}
+        v = guard.evaluate(fresh, base, hardware=True)
+        assert not v["ok"]
+        bad = [c for c in v["checks"] if c["name"] == "kernel_engagement"]
+        assert bad and not bad[0]["ok"]
+        assert "paged_attention_int8" in bad[0]["detail"]
+
     def test_kernel_engagement_absent_family_is_wildcard(self, guard):
         # a family the fresh line doesn't report wasn't exercised this
         # run — not a regression; newly-engaged families never fail
@@ -420,6 +436,29 @@ class TestEvaluate:
     def test_pp_joins_config_keys_with_default_one(self, guard):
         assert "pp" in guard.CONFIG_KEYS
         assert guard.CONFIG_KEY_DEFAULTS["pp"] == 1
+
+    def test_kv_int8_joins_config_keys_with_default_false(
+            self, guard, tmp_path):
+        # bf16 and int8 serving rows must never cross-judge: kv_int8 is
+        # a config key, and a record persisted before the int8 pool
+        # existed reads as a bf16 run (default False, not a wildcard)
+        assert "kv_int8" in guard.CONFIG_KEYS
+        assert guard.CONFIG_KEY_DEFAULTS["kv_int8"] is False
+        path = str(tmp_path / "store.json")
+        with open(path, "w") as f:
+            json.dump({"records": [
+                {"metric": "serving_tokens_per_sec", "value": 900.0,
+                 "unit": "tokens/s", "backend": "tpu",
+                 "extra": {"requests": 32}}]}, f)
+        bf16 = {"metric": "serving_tokens_per_sec", "value": 880.0,
+                "requests": 32, "kv_int8": False}
+        int8 = dict(bf16, kv_int8=True)
+        assert guard.last_good(
+            path, "serving_tokens_per_sec",
+            match=guard.config_match(bf16)) is not None
+        assert guard.last_good(
+            path, "serving_tokens_per_sec",
+            match=guard.config_match(int8)) is None
 
     def test_plan_drift_same_plan_passes(self, guard):
         plan = {"dp": 4, "mp": 2, "batch": 8, "devices": 8}

@@ -76,6 +76,14 @@ BENCHES = [
      1800, {"PT_SERVE_BENCH_REQUESTS": "32",
             "PT_SERVE_BENCH_SHARED": "64", "PT_SERVE_SPEC": "0",
             "PT_SERVE_BENCH_REPLICAS": "3"}),
+    # int8 KV block pool (docs/SERVING.md "int8 KV"): the plain serving
+    # trace with the pool quantized + the embedded bf16 replay — persists
+    # kv_bytes_per_token / allocatable_tokens (the half-HBM capacity
+    # claim) and the quantize-cost A/B; kv_int8 is a guard config key,
+    # so this row never cross-judges the bf16 serving row
+    ("serving_int8kv", [sys.executable, "benchmarks/serving_bench.py"],
+     1800, {"PT_SERVE_BENCH_REQUESTS": "32", "PT_SERVE_SPEC": "0",
+            "PT_SERVE_KV_INT8": "1", "PT_SERVE_BENCH_KV_AB": "1"}),
     # resilience soak (docs/RESILIENCE.md): fault-injected (crash +
     # poisoned batch) run through launcher relaunch + resume + NaN skip,
     # gated on loss slope / memory growth / the save-cost guard; the
@@ -92,9 +100,10 @@ BENCHES = [
     ("flashtune", [sys.executable, "tools/flash_autotune.py"], 2400, None),
     # kernel search harness (docs/KERNELS.md): enumerate + parity-filter
     # + time the candidate spaces for every registered family (head-
-    # batched flash, paged attention, flash blocks) and persist the
-    # engagement rows the runtime flips on — the timeboxed stage that
-    # settles this PR's two disengaged-by-default kernels next chip-up
+    # batched flash, paged attention, paged_attention_int8, flash
+    # blocks) and persist the engagement rows the runtime flips on —
+    # the timeboxed stage that settles the disengaged-by-default
+    # kernels (now incl. the quantized-gather int8 family) next chip-up
     ("kernel_search", [sys.executable, "tools/kernel_search.py"], 2400,
      None),
     # automatic sharding planner (docs/AUTOSHARD.md): timeboxed candidate

@@ -237,6 +237,19 @@ def render_serving(out, totals=None, hists=None, gauges=None, source=""):
             out.append(f"  accept rate per round: p50 {h['p50']}   "
                        f"p95 {h['p95']}   max {h['max']} "
                        f"({h['count']} round(s))")
+    # int8 KV pool (docs/SERVING.md "int8 KV"): quantize-on-write
+    # totals + the pool's resident bytes — counters only move when
+    # kv_int8 is on, so a bf16 run renders nothing here
+    qw = totals.get("serving/kv_quant_writes", 0)
+    qt = totals.get("serving/kv_quant_tokens", 0)
+    pool_b = gauges.get("serving/kv_pool_bytes")
+    if qw or qt or pool_b:
+        line = "kv pool: int8" if (qw or qt) else "kv pool:"
+        if pool_b is not None:
+            line += f"   {pool_b / 2**20:.1f} MiB resident"
+        line += (f"   {qw} quantizing write(s)   "
+                 f"{qt} token(s) quantized")
+        out.append(line)
     lanes = gauges.get("serving/lanes_occupied")
     blocks = gauges.get("serving/free_blocks")
     shared = gauges.get("serving/shared_blocks")

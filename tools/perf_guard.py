@@ -230,7 +230,7 @@ def load_fresh(path: str) -> dict:
 # last_good.
 CONFIG_KEYS = ("batch", "seq", "ce_chunk",
                "requests", "arrival_rate_per_s", "lanes", "block_size",
-               "int8_weights", "devices", "pp",
+               "int8_weights", "kv_int8", "devices", "pp",
                "shared_prefix_tokens", "prefix_cache", "spec", "spec_k",
                "replicas")
 
@@ -249,9 +249,13 @@ CONFIG_KEYS = ("batch", "seq", "ce_chunk",
 # existed WERE single-engine (replicas=1) runs, so a fresh routed row
 # never judges itself against them while single-engine rows keep their
 # pre-router baselines
+# ... and kv_int8: records persisted before the int8 KV pool existed
+# WERE bf16-pool runs — an int8 line reads half the KV bytes per
+# decode step, so letting it judge (or be judged by) a bf16 baseline
+# would cross-compare different byte models
 CONFIG_KEY_DEFAULTS = {"shared_prefix_tokens": 0, "prefix_cache": True,
                        "spec": False, "spec_k": 0, "pp": 1,
-                       "replicas": 1}
+                       "replicas": 1, "kv_int8": False}
 
 
 def config_match(fresh: dict) -> dict:
