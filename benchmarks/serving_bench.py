@@ -175,10 +175,17 @@ def main():
         RouterConfig, RouterEngine, ServingConfig, ServingEngine,
     )
 
+    from paddle_tpu.monitor import live as _live
+
     if os.environ.get("PT_BENCH_MONITOR", "1") != "0":
         # same telemetry ride-along as bench.py: compile wall-time and
         # the serving/* counters land in the JSON line's telemetry
         _mon.enable()
+        # the live plane rides along too: streaming sketches + the SLO
+        # watchdog (PT_SLO_* targets) feed the line's `slo` sub-object,
+        # and sketch-vs-exact p99 agreement is self-reported
+        _live.enable()
+        _live.reset()
 
     pt.seed(0)
     # documented defaults (module docstring): 64 requests at 4.0/s;
@@ -293,6 +300,14 @@ def main():
                    if _ec_snap_mod.enabled() else None)
     except Exception:  # noqa: BLE001
         ec_snap = None
+    # live-plane snapshot NOW for the same reason: the A/B engines
+    # below would keep feeding the shared sketches/watchdog
+    try:
+        live_snap = _live.snapshot() if _live.enabled() else None
+        live_sketches = (_live.merged_sketches()
+                         if _live.enabled() else {})
+    except Exception:  # noqa: BLE001
+        live_snap, live_sketches = None, {}
 
     stats = engine.stats()
     tokens = sum(len(r.output) for r in reqs)
@@ -469,6 +484,44 @@ def main():
             (max(disp) - min(disp)) / max(sum(disp), 1), 4)
         rec["redispatched"] = stats["router"]["redispatches"]
         rec["dead_replicas"] = stats["router"]["dead_replicas"]
+    # SLO readout (docs/OBSERVABILITY.md "Live telemetry plane"): the
+    # streaming-sketch view of the SAME run next to the exact-numpy
+    # percentiles above — targets + breach count feed perf_guard's
+    # --slo-breach gate, and sketch_err_pct self-reports the sketch's
+    # honesty (must sit within one log-bucket width, ~5%, of exact)
+    rec["slo_ttft_ms_p99"] = (float(os.environ["PT_SLO_TTFT_MS_P99"])
+                              if os.environ.get("PT_SLO_TTFT_MS_P99")
+                              else None)
+    rec["slo_tpot_ms_p99"] = (float(os.environ["PT_SLO_TPOT_MS_P99"])
+                              if os.environ.get("PT_SLO_TPOT_MS_P99")
+                              else None)
+    if live_snap is not None:
+        lslo = live_snap["slo"]
+        worst = lslo["worst_burn"]
+        sk_ttft = live_sketches.get("ttft_ms")
+        sketch_p99 = (round(sk_ttft.quantile(0.99), 3)
+                      if sk_ttft is not None and sk_ttft.count else None)
+        err_pct = None
+        if ttft and sketch_p99 is not None:
+            # nearest-rank exact, matching the sketch's own rank rule —
+            # numpy's interpolated p99 differs by whole samples at
+            # small n, which is not sketch error
+            xs = sorted(ttft)
+            exact_p99 = xs[min(len(xs) - 1,
+                               max(0, -(-99 * len(xs) // 100) - 1))]
+            if exact_p99:
+                err_pct = round(
+                    abs(sketch_p99 - exact_p99) / exact_p99 * 100, 3)
+        rec["slo"] = {
+            "targets": lslo["targets"],
+            "breaches": lslo["breaches"],
+            "worst_burn": (round(max(worst.values()), 3)
+                           if worst else 0.0),
+            "burn_windows": {"fast_steps": lslo["fast_window_steps"],
+                             "slow_steps": lslo["slow_window_steps"]},
+            "sketch_p99_ttft_ms": sketch_p99,
+            "sketch_err_pct": err_pct,
+        }
     if stats["spec"]:
         prop = stats["spec_proposed_tokens"]
         rec["accept_rate"] = round(

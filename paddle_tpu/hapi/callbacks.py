@@ -38,6 +38,16 @@ class Callback:
         relaunch would now resume from."""
         pass
 
+    def on_slo_breach(self, breach=None):
+        """Fired when the live telemetry plane's SLO watchdog declares a
+        burn-rate breach (``monitor/live.py``; docs/OBSERVABILITY.md
+        "Live telemetry plane"). ``breach`` is the structured event dict
+        (metric, target, fast/slow burn rates, window sizes).
+        Observation-only for now — the ROADMAP 3b SLA-aware scheduler is
+        the intended consumer. Only fires while live telemetry is armed
+        (``PT_SLO_*`` targets set)."""
+        pass
+
     def on_eval_begin(self, logs=None):
         pass
 
@@ -265,6 +275,43 @@ class MonitorCallback(Callback):
             self._logger = None
 
 
+class _SLOBridge(Callback):
+    """Bridges live-telemetry SLO breaches (``monitor.live.subscribe``)
+    into the callback chain: every callback's ``on_slo_breach`` fires
+    synchronously with the breach. Subscribes only while a run is
+    active and only when live telemetry is armed — with live off this
+    callback is four no-op method calls per run, zero per step."""
+
+    def __init__(self, cbks):
+        self._cbks = cbks
+        self._armed = False
+
+    def on_train_begin(self, logs=None):
+        from ..monitor import live
+
+        if live.enabled():
+            live.subscribe(self._dispatch)
+            self._armed = True
+
+    def _dispatch(self, breach):
+        for c in self._cbks:
+            if not isinstance(c, _SLOBridge):
+                c.on_slo_breach(breach)
+
+    def _unsubscribe(self):
+        if self._armed:
+            from ..monitor import live
+
+            live.unsubscribe(self._dispatch)
+            self._armed = False
+
+    def on_train_end(self, logs=None):
+        self._unsubscribe()
+
+    def on_train_error(self, error=None):
+        self._unsubscribe()
+
+
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
                      steps=None, verbose=2, save_freq=1, save_dir=None,
                      metrics=None, mode="train", log_freq=1):
@@ -283,6 +330,9 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
 
         if _monitor_enabled():
             cbks.append(MonitorCallback())
+    if mode == "train" and not any(isinstance(c, _SLOBridge)
+                                   for c in cbks):
+        cbks.append(_SLOBridge(cbks))
     lst = CallbackList(cbks)
     lst.set_model(model)
     lst.set_params({

@@ -40,7 +40,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "Registry",
     "SpanRecorder", "spans", "record_span", "span_events", "export_spans",
     "watchpoint", "clear_watchpoints",
-    "memory", "numerics", "INSTRUMENTED_MODULES",
+    "memory", "numerics", "live", "exporter", "INSTRUMENTED_MODULES",
 ]
 
 # The canonical audit list for the zero-overhead contract: every module
@@ -201,6 +201,11 @@ _c_plan_infeasible = _registry.counter("planner/infeasible")
 _c_plan_errors = _registry.counter("planner/errors")
 _c_plan_plans = _registry.counter("planner/plans")
 _g_plan_winner_ms = _registry.gauge("planner/winner_est_step_ms")
+# live telemetry plane (monitor/live.py + monitor/exporter.py —
+# docs/OBSERVABILITY.md "Live telemetry plane"): SLO watchdog breaches.
+# The sketches themselves live in monitor/live.py (they must work with
+# the monitor disabled); only the breach count rides this registry.
+_c_slo_breach = _registry.counter("monitor/slo_breach")
 # compiled-program audit (analysis/program_audit.py, PT_PROGRAM_AUDIT=1
 # — docs/STATIC_ANALYSIS.md): executables judged at the exec-cache
 # chokepoint and invariant findings (per-rule breakdown under
@@ -383,14 +388,18 @@ def disable() -> None:
 
 def _register(mod) -> None:
     """Called by each instrumented module at import: wires its ``_monitor``
-    slot (and its ``_spans`` slot, when the module declares one) to the
-    current enablement state and keeps them in sync with later
-    enable()/disable() calls."""
+    slot (and its ``_spans`` / ``_live`` slots, when the module declares
+    them) to the current enablement state and keeps them in sync with
+    later enable()/disable() calls. The ``_live`` slot is armed by
+    :mod:`paddle_tpu.monitor.live`'s own enablement, independent of the
+    monitor's (live SLO sketches must work with ``PT_MONITOR=0``)."""
     if mod not in _SITES:
         _SITES.append(mod)
     mod._monitor = sys.modules[__name__] if _enabled else None
     if hasattr(mod, "_spans"):
         mod._spans = _span_recorder if _enabled else None
+    if hasattr(mod, "_live"):
+        mod._live = live if live.enabled() else None
 
 
 # -- site callbacks (invoked ONLY while enabled) -----------------------------
@@ -748,6 +757,9 @@ def on_planner_plan(est_step_ms: float) -> None:
 
 from . import memory  # noqa: E402  — device memory observatory
 from . import numerics  # noqa: E402  — first-bad-step NaN isolation
+from . import live  # noqa: E402  — streaming SLO sketches (must precede
+#                                   _register calls so `_live` slots wire)
+from . import exporter  # noqa: E402  — /metrics+/healthz+/statusz endpoint
 from .step_logger import StepLogger  # noqa: E402,F401
 
 # PT_MONITOR=1 enables at import, before any instrumented module registers
@@ -761,3 +773,14 @@ if os.environ.get("PT_MONITOR_MEM", "0") not in ("", "0"):
     memory.enable()
 if os.environ.get("PT_NANCHECK", "0") not in ("", "0"):
     numerics.enable()
+# the live plane arms on any of its own knobs: explicit opt-in, a
+# metrics port (a scraper wants data), or an SLO target (the watchdog
+# needs the sketches). Import-time inert otherwise — no thread, no
+# sketch, no callables in any hot path.
+if (os.environ.get("PT_LIVE_TELEMETRY", "0") not in ("", "0")
+        or os.environ.get("PT_METRICS_PORT")
+        or os.environ.get("PT_SLO_TTFT_MS_P99")
+        or os.environ.get("PT_SLO_TPOT_MS_P99")):
+    live.enable()
+if os.environ.get("PT_METRICS_PORT"):
+    exporter.start()

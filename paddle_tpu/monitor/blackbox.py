@@ -26,9 +26,19 @@ import json
 import os
 import weakref
 
-__all__ = ["register", "dump", "maybe_dump", "default_path"]
+__all__ = ["register", "dump", "maybe_dump", "default_path",
+           "last_dump_path"]
 
 DEFAULT_PATH = "serving_blackbox.json"
+
+# the newest artifact this process wrote — the exporter's /healthz
+# surfaces it so an operator polling liveness learns where the
+# postmortem landed without grepping logs
+_last_dump_path: str | None = None
+
+
+def last_dump_path() -> str | None:
+    return _last_dump_path
 
 # label -> weak callable returning a JSON-able state dict; weakly held
 # so a retired engine never pins itself (dead refs are pruned at dump)
@@ -106,6 +116,8 @@ def dump(path: str | None = None, reason: str = "",
             json.dump(artifact, f, indent=1, default=repr)
             f.write("\n")
         os.replace(tmp, out)  # atomic: never a torn artifact
+        global _last_dump_path
+        _last_dump_path = out
         return out
     except Exception:
         return None

@@ -1,0 +1,294 @@
+"""In-process metrics endpoint: /metrics (OpenMetrics), /healthz, /statusz.
+
+A stdlib ``http.server`` daemon thread (no dependencies, off by
+default) that serves the live telemetry plane
+(:mod:`paddle_tpu.monitor.live`) plus every monitor counter/gauge/
+histogram while the fleet is serving. Arm with ``PT_METRICS_PORT``
+(port ``0`` binds an ephemeral port — :func:`port` reports the bound
+one), bind host from ``PT_METRICS_HOST`` (default ``127.0.0.1``).
+Starting the exporter also arms live collection.
+
+Endpoints:
+
+* ``/metrics`` — OpenMetrics text. Rendered purely from the monitor
+  registry snapshot + the live module's merged fleet state under one
+  serialized render lock; it NEVER calls into engine objects, so a
+  scrape cannot observe (or perturb) an engine mid-step. Counter names
+  sanitize ``serving/queue_wait_ms`` → ``pt_serving_queue_wait_ms``;
+  per-replica tails (``router/dispatches/0``) become
+  ``{replica="0"}`` labels. Fleet totals are local + every worker
+  replica's shipped telemetry, merged exactly (mergeable sketches), so
+  worker-mode output equals in-process output on the same trace.
+* ``/healthz`` — JSON liveness: per-replica dead/alive (from the
+  router's registered status provider), breach count, and the last
+  blackbox postmortem path (the crash artifact an operator should
+  fetch). HTTP 200 while the process serves; a dead replica marks
+  ``"degraded": true`` without failing the probe.
+* ``/statusz`` — human debug page: registered subsystem status
+  providers (engine lanes/pool/prefix-cache occupancy, router queue),
+  live sketch summaries, SLO burn state, exec-cache hit counts. Status
+  providers are read-only plain-int reads; they are called at scrape
+  time, best-effort.
+
+Details: docs/OBSERVABILITY.md "Live telemetry plane".
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import live
+
+__all__ = ["start", "stop", "port", "render_metrics", "render_statusz",
+           "health"]
+
+OPENMETRICS_CTYPE = ("application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8")
+
+_render_lock = threading.Lock()
+_server = None
+_thread = None
+
+
+# -- rendering ---------------------------------------------------------------
+
+def _sanitize(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = round(float(v), 6)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+_REPLICA_TAIL = re.compile(r"^(.*)/(\d+)$")
+
+
+def _group_by_replica(metrics: dict) -> dict:
+    """``{name: value}`` -> ``{base: {replica_label_or_None: value}}`` —
+    trailing integer name segments (``router/lanes/3``) become
+    ``{replica="3"}`` labels on the base family."""
+    grouped: dict = {}
+    for name in sorted(metrics):
+        m = _REPLICA_TAIL.match(name)
+        base, replica = (m.group(1), m.group(2)) if m else (name, None)
+        grouped.setdefault(base, {})[replica] = metrics[name]
+    return grouped
+
+
+def _emit_family(lines, base, kind, cells, suffix=""):
+    pname = "pt_" + _sanitize(base)
+    lines.append(f"# TYPE {pname} {kind}")
+    for replica in sorted(cells, key=lambda r: (r is not None, r)):
+        label = "" if replica is None else f'{{replica="{replica}"}}'
+        lines.append(f"{pname}{suffix}{label} {_fmt(cells[replica])}")
+
+
+def render_metrics() -> str:
+    """The ``/metrics`` body: monitor registry + merged fleet sketches,
+    OpenMetrics text exposition, deterministic ordering throughout."""
+    from . import snapshot as _monitor_snapshot
+
+    with _render_lock:
+        snap = _monitor_snapshot()
+        counters = live.merged_counters(snap.get("counters") or {})
+        sketches = live.merged_sketches()
+        lsnap = live.snapshot()
+
+        lines: list = []
+        for base, cells in sorted(_group_by_replica(counters).items()):
+            _emit_family(lines, base, "counter", cells, suffix="_total")
+        for base, cells in sorted(
+                _group_by_replica(snap.get("gauges") or {}).items()):
+            _emit_family(lines, base, "gauge", cells)
+        for name in sorted(snap.get("histograms") or {}):
+            h = snap["histograms"][name]
+            pname = "pt_" + _sanitize(name)
+            lines.append(f"# TYPE {pname} summary")
+            for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+                lines.append(f'{pname}{{quantile="{q}"}} {_fmt(h[key])}')
+            lines.append(f"{pname}_count {_fmt(h['count'])}")
+            lines.append(f"{pname}_sum {_fmt(h['sum'])}")
+
+        for name in sorted(sketches):
+            sk = sketches[name]
+            pname = "pt_live_" + _sanitize(name)
+            lines.append(f"# TYPE {pname} summary")
+            for q, p in (("0.5", 0.50), ("0.9", 0.90), ("0.99", 0.99)):
+                lines.append(f'{pname}{{quantile="{q}"}} '
+                             f"{_fmt(sk.quantile(p))}")
+            lines.append(f"{pname}_count {_fmt(sk.count)}")
+            lines.append(f"{pname}_sum {_fmt(round(sk.sum, 3))}")
+
+        slo = lsnap.get("slo") or {}
+        lines.append("# TYPE pt_slo_breaches counter")
+        lines.append(f"pt_slo_breaches_total {_fmt(live.fleet_breaches())}")
+        targets = slo.get("targets") or {}
+        if any(t for t in targets.values()):
+            lines.append("# TYPE pt_slo_target_ms gauge")
+            for m in sorted(targets):
+                if targets[m]:
+                    lines.append(f'pt_slo_target_ms{{metric="{m}"}} '
+                                 f"{_fmt(targets[m])}")
+            lines.append("# TYPE pt_slo_burn_rate gauge")
+            for m in sorted(slo.get("last_burn") or {}):
+                for window in ("fast", "slow"):
+                    lines.append(
+                        f'pt_slo_burn_rate{{metric="{m}",window="{window}"}} '
+                        f"{_fmt(slo['last_burn'][m][window])}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+
+def health() -> dict:
+    """The ``/healthz`` body: process liveness, per-replica dead/alive,
+    and the last blackbox postmortem pointer."""
+    from . import enabled as _monitor_enabled
+    from . import blackbox
+
+    replicas = []
+    for label, state in live.collect_status():
+        if isinstance(state, dict) and isinstance(state.get("replicas"),
+                                                  list):
+            replicas.extend(state["replicas"])
+    dead = [r.get("replica") for r in replicas if r.get("dead")]
+    return {
+        "ok": True,
+        "degraded": bool(dead),
+        "monitor_enabled": bool(_monitor_enabled()),
+        "live_enabled": live.enabled(),
+        "slo_breaches": live.fleet_breaches(),
+        "replicas": replicas,
+        "dead_replicas": dead,
+        "last_blackbox": blackbox.last_dump_path(),
+    }
+
+
+def render_statusz() -> str:
+    """The ``/statusz`` body: a plain-text human debug page."""
+    from . import snapshot as _monitor_snapshot
+
+    with _render_lock:
+        out = ["paddle_tpu /statusz", "=" * 40, ""]
+        lsnap = live.snapshot()
+        out.append(f"live steps: {lsnap['steps']}")
+        slo = lsnap["slo"]
+        out.append(f"slo breaches: {slo['breaches']} "
+                   f"(targets {slo['targets']}, "
+                   f"worst burn {slo['worst_burn']})")
+        out.append("")
+        out.append("live sketches (merged fleet):")
+        for name, sk in sorted(live.merged_sketches().items()):
+            s = sk.summary()
+            out.append(f"  {name}: count={s['count']} p50={s['p50']} "
+                       f"p90={s['p90']} p99={s['p99']}")
+        out.append("")
+        snap = _monitor_snapshot()
+        counters = snap.get("counters") or {}
+        interesting = ("jit/exec_cache_hit", "jit/exec_cache_miss",
+                       "jit/retraces", "serving/decoded_tokens",
+                       "serving/preemptions", "monitor/slo_breach")
+        out.append("monitor counters (selected):")
+        for name in interesting:
+            if name in counters:
+                out.append(f"  {name}: {counters[name]}")
+        hists = snap.get("histograms") or {}
+        if "serving/spec_accept_rate" in hists:
+            h = hists["serving/spec_accept_rate"]
+            out.append(f"  spec accept rate: mean={h['mean']} "
+                       f"p50={h['p50']} (n={h['count']})")
+        out.append("")
+        out.append("status providers:")
+        for label, state in live.collect_status():
+            out.append(f"--- {label} ---")
+            try:
+                out.append(json.dumps(state, indent=1, sort_keys=True,
+                                      default=repr))
+            except Exception as exc:  # noqa: BLE001
+                out.append(f"  <unserializable: {exc!r}>")
+        out.append("")
+        return "\n".join(out)
+
+
+# -- the HTTP daemon ---------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "pt-exporter/1"
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                body, ctype = render_metrics(), OPENMETRICS_CTYPE
+            elif path == "/healthz":
+                body = json.dumps(health(), indent=1, default=repr) + "\n"
+                ctype = "application/json"
+            elif path in ("/statusz", "/"):
+                body, ctype = render_statusz(), "text/plain; charset=utf-8"
+            else:
+                self.send_error(404, "unknown endpoint")
+                return
+        except Exception as exc:  # noqa: BLE001 — a scrape never crashes us
+            self.send_error(500, f"render failed: {exc!r}")
+            return
+        payload = body.encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *args):  # scrapes are not stderr events
+        pass
+
+
+def start(port_arg: int | None = None, host: str | None = None):
+    """Start the exporter daemon (idempotent); returns the bound port,
+    or None when the bind failed (a metrics endpoint must never kill
+    the serving process it observes). Arms live collection."""
+    global _server, _thread
+    if _server is not None:
+        return _server.server_address[1]
+    if port_arg is None:
+        raw = os.environ.get("PT_METRICS_PORT", "")
+        try:
+            port_arg = int(raw) if raw else 0
+        except ValueError:
+            port_arg = 0
+    host = host or os.environ.get("PT_METRICS_HOST", "127.0.0.1")
+    try:
+        srv = ThreadingHTTPServer((host, int(port_arg)), _Handler)
+    except OSError as exc:
+        import sys
+        print(f"WARNING: metrics exporter bind failed on "
+              f"{host}:{port_arg}: {exc}", file=sys.stderr, flush=True)
+        return None
+    srv.daemon_threads = True
+    _server = srv
+    live.enable()
+    _thread = threading.Thread(target=srv.serve_forever,
+                               name="pt-metrics-exporter", daemon=True)
+    _thread.start()
+    return srv.server_address[1]
+
+
+def stop() -> None:
+    global _server, _thread
+    if _server is None:
+        return
+    _server.shutdown()
+    _server.server_close()
+    _server = None
+    _thread = None
+
+
+def port():
+    """The bound port while running, else None."""
+    return None if _server is None else _server.server_address[1]

@@ -1,0 +1,600 @@
+"""Live telemetry plane: streaming SLO percentiles + fleet aggregation.
+
+Every other observability layer here ships its verdict after the run
+(StepLogger JSONL, chrome traces, blackbox postmortems). This module is
+the *during*-the-run plane: deterministic streaming quantile sketches
+over rolling step windows for the serving SLO signals (TTFT, TPOT,
+queue-wait, speculative accept-rate), an SLO burn-rate watchdog, and the
+mergeable state the fleet exporter (:mod:`paddle_tpu.monitor.exporter`)
+serves on ``/metrics``.
+
+Sketch design — fixed-boundary log-bucket histogram:
+
+* bucket ``i`` holds values in ``[GAMMA**i, GAMMA**(i+1))`` with
+  ``GAMMA = 1.05`` (5% relative bucket width); ``v <= 0`` lands in a
+  dedicated zero bucket. Boundaries are process-independent constants,
+  so merging two sketches is integer addition of bucket counts —
+  **exact**, associative, commutative. That is what makes worker-mode
+  fleet aggregation equal to in-process aggregation rather than an
+  approximation of it.
+* ``quantile(p)`` is a nearest-rank walk over the sorted bucket keys
+  returning the matched bucket's upper boundary: deterministic, no
+  sampling, no clocks, no randomness (PTL005-clean), with relative
+  error bounded by one bucket width (``GAMMA - 1``).
+
+Zero-overhead-off contract: instrumented modules (``serving/engine``,
+``serving/router``) carry a module-global ``_live`` slot that is
+``None`` unless :func:`enable` installed this module into it — the same
+None-slot discipline as ``_monitor``/``_spans``/``_nancheck`` (audited
+by PTL003 and tests/test_live_telemetry.py). The feeds ride the
+engine's always-on ``Request`` attribution stamps, so live telemetry
+works with ``PT_MONITOR=0`` engines too; enabling the monitor is NOT
+required. Arming: ``PT_LIVE_TELEMETRY=1``, ``PT_METRICS_PORT`` (the
+exporter arms collection), either ``PT_SLO_*`` target, or
+:func:`enable` programmatically.
+
+SLO watchdog: ``PT_SLO_TTFT_MS_P99`` / ``PT_SLO_TPOT_MS_P99`` (ms
+targets, unset = no watchdog) judged with the SRE multiwindow
+burn-rate rule — the violation fraction over a fast window
+(``PT_SLO_FAST_WINDOW`` steps, 12) AND a slow window
+(``PT_SLO_SLOW_WINDOW`` steps, 120), each divided by the 1% error
+budget a p99 target implies; a breach fires on the step where the fast
+burn ≥ ``PT_SLO_BURN_FAST`` (14.0) while the slow burn ≥
+``PT_SLO_BURN_SLOW`` (6.0), and re-arms only after the fast window
+recovers. Each breach increments ``monitor/slo_breach``, records a
+span marker, queues a structured event for StepLogger, and notifies
+:func:`subscribe` subscribers (``Callback.on_slo_breach`` rides this).
+
+Details: docs/OBSERVABILITY.md "Live telemetry plane".
+"""
+from __future__ import annotations
+
+import math
+import os
+import sys
+import threading
+import weakref
+
+__all__ = [
+    "QuantileSketch", "LiveMetrics",
+    "enable", "disable", "enabled", "reset",
+    "observe", "on_request_finished", "on_accept_rate", "on_engine_step",
+    "set_remote", "export_local", "merged_sketches", "merged_counters",
+    "register_status", "collect_status",
+    "subscribe", "unsubscribe", "pop_breach_events", "breach_count",
+    "snapshot", "slo_targets",
+]
+
+# 5% relative bucket width: sketch p99 agrees with an exact sort within
+# one bucket (the serving_bench `sketch_err_pct` self-check rides this)
+GAMMA = 1.05
+_LOG_GAMMA = math.log(GAMMA)
+
+# p99 targets imply a 1% error budget; burn rate = violation_fraction / this
+ERROR_BUDGET = 0.01
+
+# the sketch streams live.py maintains; SLO targets exist for the first two
+METRICS = ("ttft_ms", "tpot_ms", "queue_wait_ms", "accept_rate")
+
+
+def _env_float(name: str):
+    raw = os.environ.get(name)
+    if raw in (None, ""):
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, "") or default))
+    except ValueError:
+        return default
+
+
+# -- the sketch --------------------------------------------------------------
+
+class QuantileSketch:
+    """Mergeable fixed-boundary log-bucket histogram (stdlib-only).
+
+    State is ``{bucket_index: count}`` plus a zero bucket, a total
+    count, and a running sum — all of which merge by addition, so any
+    grouping of observations over any number of processes yields the
+    same bucket counts (merge-associativity is property-tested in
+    tests/test_live_telemetry.py against numpy percentiles).
+    """
+
+    __slots__ = ("buckets", "zero", "count", "sum")
+
+    def __init__(self):
+        self.buckets: dict = {}
+        self.zero = 0
+        self.count = 0
+        self.sum = 0.0
+
+    @staticmethod
+    def bucket_index(value: float) -> int:
+        return int(math.floor(math.log(value) / _LOG_GAMMA))
+
+    @staticmethod
+    def bucket_upper(index: int) -> float:
+        return GAMMA ** (index + 1)
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        if v <= 0.0 or not math.isfinite(v):
+            self.zero += 1
+        else:
+            i = int(math.floor(math.log(v) / _LOG_GAMMA))
+            self.buckets[i] = self.buckets.get(i, 0) + 1
+            self.sum += v
+        self.count += 1
+
+    def merge(self, other: "QuantileSketch") -> "QuantileSketch":
+        """Fold ``other`` into this sketch (exact: integer addition)."""
+        for i, n in other.buckets.items():
+            self.buckets[i] = self.buckets.get(i, 0) + n
+        self.zero += other.zero
+        self.count += other.count
+        self.sum += other.sum
+        return self
+
+    def copy(self) -> "QuantileSketch":
+        out = QuantileSketch()
+        out.buckets = dict(self.buckets)
+        out.zero = self.zero
+        out.count = self.count
+        out.sum = self.sum
+        return out
+
+    def quantile(self, p: float) -> float:
+        """Nearest-rank quantile (``p`` in [0, 1]); returns the matched
+        bucket's upper boundary — deterministic, error ≤ one bucket."""
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"quantile p must be in [0, 1], got {p!r}")
+        if self.count <= 0:
+            return 0.0
+        rank = min(self.count, max(1, math.ceil(p * self.count)))
+        cum = self.zero
+        if cum >= rank:
+            return 0.0
+        for i in sorted(self.buckets):
+            cum += self.buckets[i]
+            if cum >= rank:
+                return GAMMA ** (i + 1)
+        return GAMMA ** (max(self.buckets) + 1) if self.buckets else 0.0
+
+    def count_over(self, threshold: float) -> int:
+        """Observations in buckets at/above ``threshold``'s bucket —
+        the deterministic violation count the burn rate divides (values
+        sharing the threshold's bucket count as violations, so the
+        watchdog alarms at most one bucket width early, never late)."""
+        if threshold <= 0.0:
+            return self.count - self.zero
+        t = int(math.floor(math.log(threshold) / _LOG_GAMMA))
+        return sum(n for i, n in self.buckets.items() if i >= t)
+
+    def to_dict(self) -> dict:
+        return {
+            "buckets": {str(i): n for i, n in sorted(self.buckets.items())},
+            "zero": self.zero,
+            "count": self.count,
+            "sum": round(self.sum, 6),
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "QuantileSketch":
+        out = cls()
+        for k, n in (data.get("buckets") or {}).items():
+            out.buckets[int(k)] = int(n)
+        out.zero = int(data.get("zero", 0))
+        out.count = int(data.get("count", 0))
+        out.sum = float(data.get("sum", 0.0))
+        return out
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": round(self.sum, 6),
+            "p50": round(self.quantile(0.50), 6),
+            "p90": round(self.quantile(0.90), 6),
+            "p99": round(self.quantile(0.99), 6),
+        }
+
+
+def _merge_all(windows, out=None) -> dict:
+    merged: dict = out if out is not None else {}
+    for w in windows:
+        for name, sk in w.items():
+            tgt = merged.get(name)
+            if tgt is None:
+                merged[name] = sk.copy()
+            else:
+                tgt.merge(sk)
+    return merged
+
+
+# -- the collector -----------------------------------------------------------
+
+class LiveMetrics:
+    """Per-process live collector: cumulative sketches + rolling
+    per-engine-step windows + the SLO burn-rate watchdog. One instance
+    (:data:`_local`) backs the module-level site callbacks; in-process
+    router replicas therefore share it naturally, while worker-mode
+    replicas ship their own via :func:`export_local` /
+    :func:`set_remote`."""
+
+    def __init__(self, fast_steps: int | None = None,
+                 slow_steps: int | None = None):
+        self._lock = threading.RLock()
+        self.fast_steps = fast_steps or _env_int("PT_SLO_FAST_WINDOW", 12)
+        self.slow_steps = slow_steps or _env_int("PT_SLO_SLOW_WINDOW", 120)
+        self.targets = {
+            "ttft_ms": _env_float("PT_SLO_TTFT_MS_P99"),
+            "tpot_ms": _env_float("PT_SLO_TPOT_MS_P99"),
+        }
+        self.burn_fast_threshold = _env_float("PT_SLO_BURN_FAST") or 14.0
+        self.burn_slow_threshold = _env_float("PT_SLO_BURN_SLOW") or 6.0
+        self._total: dict = {}        # name -> cumulative QuantileSketch
+        self._cur: dict = {}          # name -> current-window sketch
+        self._closed: list = []       # rolling closed windows (<= slow_steps)
+        self.steps = 0
+        self.breaches = 0
+        self._in_breach: dict = {}    # metric -> latched (re-arm on recovery)
+        self.worst_burn: dict = {}    # metric -> max fast-window burn seen
+        self.last_burn: dict = {}     # metric -> {"fast": x, "slow": y}
+        self._pending: list = []      # breach events awaiting StepLogger
+        self.breach_log: list = []    # bounded history for /statusz
+
+    # -- feeds ---------------------------------------------------------------
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            for store in (self._total, self._cur):
+                sk = store.get(name)
+                if sk is None:
+                    store[name] = sk = QuantileSketch()
+                sk.observe(value)
+
+    def step(self) -> None:
+        """Close the current window (one engine step), roll the
+        retained-window ring, and run the watchdog."""
+        with self._lock:
+            self.steps += 1
+            self._closed.append(self._cur)
+            if len(self._closed) > self.slow_steps:
+                del self._closed[0]
+            self._cur = {}
+            self._watchdog()
+
+    # -- watchdog ------------------------------------------------------------
+
+    def _burn(self, merged: dict, metric: str, target: float):
+        sk = merged.get(metric)
+        if sk is None or sk.count == 0:
+            return None
+        return (sk.count_over(target) / sk.count) / ERROR_BUDGET
+
+    def _watchdog(self) -> None:
+        armed = [(m, t) for m, t in self.targets.items() if t]
+        if not armed:
+            return
+        fast = _merge_all(self._closed[-self.fast_steps:])
+        slow = _merge_all(self._closed)
+        for metric, target in armed:
+            burn_fast = self._burn(fast, metric, target)
+            burn_slow = self._burn(slow, metric, target)
+            if burn_fast is None or burn_slow is None:
+                continue
+            self.last_burn[metric] = {"fast": round(burn_fast, 3),
+                                      "slow": round(burn_slow, 3)}
+            prev = self.worst_burn.get(metric, 0.0)
+            if burn_fast > prev:
+                self.worst_burn[metric] = round(burn_fast, 3)
+            firing = (burn_fast >= self.burn_fast_threshold
+                      and burn_slow >= self.burn_slow_threshold)
+            if firing and not self._in_breach.get(metric):
+                self._in_breach[metric] = True
+                self._fire(metric, target, burn_fast, burn_slow, fast)
+            elif not firing and burn_fast < self.burn_fast_threshold:
+                self._in_breach[metric] = False
+
+    def _fire(self, metric, target, burn_fast, burn_slow, fast_merged):
+        sk = fast_merged.get(metric)
+        breach = {
+            "metric": metric,
+            "target_ms": target,
+            "burn_fast": round(burn_fast, 3),
+            "burn_slow": round(burn_slow, 3),
+            "fast_window_steps": self.fast_steps,
+            "slow_window_steps": self.slow_steps,
+            "observed_p99": round(sk.quantile(0.99), 3) if sk else None,
+            "step": self.steps,
+        }
+        self.breaches += 1
+        self._pending.append(breach)
+        self.breach_log.append(breach)
+        if len(self.breach_log) > 64:
+            del self.breach_log[0]
+        _emit_breach(breach)
+
+    # -- reads ---------------------------------------------------------------
+
+    def sketches(self) -> dict:
+        with self._lock:
+            return {name: sk.copy() for name, sk in sorted(self._total.items())}
+
+    def pop_pending(self) -> list:
+        with self._lock:
+            out, self._pending = self._pending, []
+            return out
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "steps": self.steps,
+                "sketches": {n: sk.summary()
+                             for n, sk in sorted(self._total.items())},
+                "slo": {
+                    "targets": {f"{m}_p99": t
+                                for m, t in self.targets.items()},
+                    "breaches": self.breaches,
+                    "worst_burn": dict(self.worst_burn),
+                    "last_burn": {m: dict(v)
+                                  for m, v in self.last_burn.items()},
+                    "fast_window_steps": self.fast_steps,
+                    "slow_window_steps": self.slow_steps,
+                    "burn_fast_threshold": self.burn_fast_threshold,
+                    "burn_slow_threshold": self.burn_slow_threshold,
+                },
+            }
+
+
+# -- breach emission (counter + span + subscribers) --------------------------
+
+_subscribers: list = []
+
+
+def subscribe(fn) -> None:
+    """Register ``fn(breach_dict)`` to be called synchronously on every
+    SLO breach (``hapi.callbacks`` bridges this to
+    ``Callback.on_slo_breach``; ROADMAP 3b's scheduler consumes it
+    later). Subscriber exceptions are swallowed — observation must
+    never kill the serving loop."""
+    if fn not in _subscribers:
+        _subscribers.append(fn)
+
+
+def unsubscribe(fn) -> None:
+    try:
+        _subscribers.remove(fn)
+    except ValueError:
+        pass
+
+
+def _emit_breach(breach: dict) -> None:
+    from . import _c_slo_breach, record_span
+
+    _c_slo_breach.inc()
+    try:
+        import time
+        now = time.perf_counter()
+        record_span("slo_breach", "slo", now, now,
+                    args={k: breach[k] for k in ("metric", "burn_fast")})
+    except Exception:  # noqa: BLE001 — a marker must not kill serving
+        pass
+    for fn in list(_subscribers):
+        try:
+            fn(breach)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+# -- process-local state + fleet remotes -------------------------------------
+
+_enabled = False
+_local = LiveMetrics()
+_remotes: dict = {}  # replica key -> {"counters": {...}, "sketches": {...}}
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    """Arm live collection (idempotent): installs this module into every
+    registered instrumentation site's ``_live`` slot. Same effect as
+    starting the process with ``PT_LIVE_TELEMETRY=1``. Re-reads the
+    ``PT_SLO_*`` knobs so tests can re-arm under fresh targets."""
+    global _enabled, _local
+    if _enabled:
+        return
+    _enabled = True
+    _local = LiveMetrics()
+    from . import _SITES
+    this = sys.modules[__name__]
+    for mod in _SITES:
+        if hasattr(mod, "_live"):
+            mod._live = this
+
+
+def disable() -> None:
+    global _enabled
+    if not _enabled:
+        return
+    _enabled = False
+    from . import _SITES
+    for mod in _SITES:
+        if hasattr(mod, "_live"):
+            mod._live = None
+
+
+def reset() -> None:
+    """Drop all collected state (sketches, windows, breaches, remote
+    replica payloads); enablement and registered providers survive."""
+    global _local
+    _local = LiveMetrics()
+    _remotes.clear()
+    if _enabled:
+        from . import _SITES
+        this = sys.modules[__name__]
+        for mod in _SITES:
+            if hasattr(mod, "_live"):
+                mod._live = this
+
+
+# -- site callbacks (invoked through the `_live` slot ONLY while armed) ------
+
+def observe(name: str, value: float) -> None:
+    _local.observe(name, value)
+
+
+def on_request_finished(ttft_ms, tpot_ms, queue_wait_ms) -> None:
+    """One request left the engine (`ServingEngine._emit` finish branch,
+    computed from the always-on `Request` attribution stamps)."""
+    if ttft_ms is not None:
+        _local.observe("ttft_ms", ttft_ms)
+    if tpot_ms is not None:
+        _local.observe("tpot_ms", tpot_ms)
+    if queue_wait_ms is not None:
+        _local.observe("queue_wait_ms", queue_wait_ms)
+
+
+def on_accept_rate(proposed: int, accepted: int) -> None:
+    """One speculative verify round's post-trim account."""
+    if proposed:
+        _local.observe("accept_rate", accepted / proposed)
+
+
+def on_engine_step() -> None:
+    """One engine scheduling step completed: roll the live windows and
+    evaluate the SLO watchdog."""
+    _local.step()
+
+
+# -- fleet aggregation -------------------------------------------------------
+
+def set_remote(key: str, payload: dict) -> None:
+    """Install replica ``key``'s latest cumulative telemetry payload
+    (the router's per-step `telemetry` op pull). Cumulative replacement
+    — not deltas — so a lost pull is self-healing and merge stays
+    exact."""
+    if isinstance(payload, dict):
+        _remotes[str(key)] = payload
+
+
+def export_local() -> dict:
+    """This process's cumulative telemetry, shaped for the worker
+    protocol: monitor counter totals + raw sketch state + breach
+    account. Everything in it merges by addition on the router side."""
+    from . import snapshot as _monitor_snapshot
+
+    snap = _local.snapshot()
+    return {
+        "counters": dict(_monitor_snapshot().get("counters") or {}),
+        "sketches": {name: sk.to_dict()
+                     for name, sk in _local.sketches().items()},
+        "breaches": _local.breaches,
+        "worst_burn": dict(_local.worst_burn),
+        "steps": snap["steps"],
+    }
+
+
+def merged_sketches() -> dict:
+    """Local sketches + every remote replica's, merged exactly (remote
+    keys iterated sorted — deterministic)."""
+    merged = _local.sketches()
+    for key in sorted(_remotes):
+        remote = _remotes[key].get("sketches") or {}
+        for name in sorted(remote):
+            sk = QuantileSketch.from_dict(remote[name])
+            tgt = merged.get(name)
+            if tgt is None:
+                merged[name] = sk
+            else:
+                tgt.merge(sk)
+    return merged
+
+
+def merged_counters(local_counters: dict) -> dict:
+    """Fleet counter totals: the local registry's counters plus every
+    remote replica's shipped totals (integer addition, sorted replica
+    order)."""
+    merged = dict(local_counters)
+    for key in sorted(_remotes):
+        for name, value in sorted(
+                (_remotes[key].get("counters") or {}).items()):
+            merged[name] = merged.get(name, 0) + value
+    return merged
+
+
+def fleet_breaches() -> int:
+    total = _local.breaches
+    for key in sorted(_remotes):
+        total += int(_remotes[key].get("breaches") or 0)
+    return total
+
+
+def pop_breach_events() -> list:
+    """Drain breach events queued since the last call (StepLogger writes
+    each as a structured ``{"event": "slo_breach"}`` JSONL line)."""
+    return _local.pop_pending()
+
+
+def breach_count() -> int:
+    return _local.breaches
+
+
+def snapshot() -> dict:
+    """Run-end / bench snapshot of the local collector (plus the fleet
+    breach total when remotes are attached)."""
+    snap = _local.snapshot()
+    if _remotes:
+        snap["slo"]["fleet_breaches"] = fleet_breaches()
+        snap["replicas_remote"] = sorted(_remotes)
+    return snap
+
+
+def slo_targets() -> dict:
+    return {f"{m}_p99": t for m, t in _local.targets.items()}
+
+
+# -- status providers (the /statusz + /healthz surface) ----------------------
+
+# label -> weak callable returning a JSON-able dict; same aviation-recorder
+# pattern as monitor/blackbox.py — a retired engine never pins itself
+_status_providers: list = []
+
+
+def register_status(label: str, provider) -> None:
+    """Register a read-only status provider (e.g. ``ServingEngine.stats``,
+    ``RouterEngine._health_state``) for the exporter's ``/statusz`` and
+    ``/healthz`` pages. Bound methods are weakly held."""
+    try:
+        ref = weakref.WeakMethod(provider)
+    except TypeError:
+        ref = (lambda p: (lambda: p))(provider)
+    _status_providers.append((str(label), ref))
+
+
+def collect_status() -> list:
+    """Every live provider's ``(label, state)`` — provider errors are
+    reported in-band, never raised (a debug page must not crash the
+    process it is debugging)."""
+    out = []
+    dead = []
+    for i, (label, ref) in enumerate(_status_providers):
+        fn = ref()
+        if fn is None:
+            dead.append(i)
+            continue
+        key = label if all(label != k for k, _ in out) else f"{label}#{i}"
+        try:
+            out.append((key, fn()))
+        except Exception as exc:  # noqa: BLE001
+            out.append((key, {"provider_error": repr(exc)}))
+    for i in reversed(dead):
+        del _status_providers[i]
+    return out

@@ -107,7 +107,20 @@ class StepLogger:
             line["memory"] = led.step_census()
         line.update(delta)
         self._write(line)
+        self._drain_breaches()
         return line
+
+    def _drain_breaches(self) -> None:
+        """SLO watchdog breaches queued since the last line land as
+        structured ``{"event": "slo_breach"}`` lines — the live plane's
+        durable record (monitor/live.py; zero-cost while live is off)."""
+        from . import live
+
+        if not live.enabled():
+            return
+        for breach in live.pop_breach_events():
+            self._write({"event": "slo_breach", "step": self._step,
+                         "ts": round(time.time(), 6), **breach})
 
     def note_checkpoint(self, step) -> None:
         """Record the last COMPLETE checkpoint's step: the ``run_end``
@@ -138,6 +151,14 @@ class StepLogger:
         for k, v in fields.items():
             if v is not None:
                 line[k] = v
+        from . import live
+
+        if live.enabled():
+            # undrained breaches still land, and the run_end carries
+            # the live-window snapshot monitor_report's SLO section
+            # renders (sketch quantiles + burn state)
+            self._drain_breaches()
+            line.setdefault("live", live.snapshot())
         self._write(line)
         self._f.close()
         self._f = None

@@ -83,6 +83,7 @@ from ..models.generation import (
 )
 from ..monitor import _register as _monitor_register
 from ..monitor import blackbox as _blackbox
+from ..monitor import live as _live_telemetry
 from .kv_cache import BlockPool, blocks_needed
 from .scheduler import RUNNING, FCFSScheduler, Request
 from .speculative import NgramDrafter
@@ -92,9 +93,14 @@ _EMPTY_DRAFT = np.zeros((0,), np.int32)
 __all__ = ["ServingConfig", "ServingEngine"]
 
 # telemetry slots (paddle_tpu.monitor None-slot contract): None unless
-# PT_MONITOR wired them
+# PT_MONITOR wired them. `_live` is the streaming-SLO sibling
+# (monitor/live.py): armed by PT_LIVE_TELEMETRY / PT_METRICS_PORT /
+# PT_SLO_* independently of PT_MONITOR — its feeds ride the always-on
+# Request attribution stamps, so arming it costs three guarded calls
+# per step and nothing when off.
 _monitor = None
 _spans = None
+_live = None
 
 
 def _env_int(name, default):
@@ -501,6 +507,9 @@ class ServingEngine:
         # site) the blackbox dump snapshots scheduler + request state
         # through this weakly-held provider (monitor/blackbox.py)
         _blackbox.register("serving_engine", self._blackbox_state)
+        # /statusz hook: same weak-provider pattern for the live
+        # exporter's debug page (stats() is plain-int and read-only)
+        _live_telemetry.register_status("serving_engine", self.stats)
 
     def _resolve_paged(self) -> bool:
         """Decode read-path selection (ServingConfig.paged): forced
@@ -681,6 +690,10 @@ class ServingEngine:
         if self.scheduler.has_running():
             self._decode_round()
             worked = True
+        lv = _live
+        if lv is not None:
+            # one engine step = one live window: roll + SLO watchdog
+            lv.on_engine_step()
         return worked
 
     def run(self) -> dict:
@@ -947,6 +960,9 @@ class ServingEngine:
             if self.config.kv_int8:
                 m.on_serving_kv_quant(1, len(act) + proposed,
                                       self.kv_pool_bytes)
+        lv = _live
+        if lv is not None and proposed:
+            lv.on_accept_rate(proposed, accepted)
         sp = _spans
         if sp is not None:
             # recorded COMPLETE, after rollbacks/releases settled — a
@@ -1028,6 +1044,17 @@ class ServingEngine:
             m = _monitor
             if m is not None:
                 m.on_serving_evict()
+            lv = _live
+            if lv is not None:
+                # the always-on attribution stamps ARE the SLO feed —
+                # no PT_MONITOR needed for live percentiles
+                lv.on_request_finished(
+                    (req.t_first - req.t_submit) * 1e3
+                    if req.t_submit is not None else None,
+                    (req.t_done - req.t_first) * 1e3
+                    / (len(req.output) - 1)
+                    if len(req.output) > 1 else None,
+                    req.queue_ms)
             sp = _spans
             if sp is not None and req.t_submit is not None:
                 # the whole journey as ONE span on the request's trace

@@ -65,13 +65,18 @@ import numpy as np
 
 from ..monitor import _register as _monitor_register
 from ..monitor import blackbox as _blackbox
+from ..monitor import live as _live_telemetry
 from .kv_cache import prefix_keys
 
 __all__ = ["RouterConfig", "RouterEngine"]
 
 # telemetry slots (paddle_tpu.monitor None-slot contract): None unless
-# PT_MONITOR wired them
+# PT_MONITOR wired them. `_live` (monitor/live.py) additionally drives
+# the per-step worker telemetry pull that closes the fleet-aggregation
+# gap: worker-mode replica counters/sketches ship over the pipe and
+# merge router-side, so /metrics reads the same totals either mode.
 _monitor = None
+_live = None
 
 _auto_id = itertools.count()
 
@@ -177,6 +182,11 @@ class _InprocReplica:
     def stats(self) -> dict:
         return self._engine.stats()
 
+    def telemetry(self):
+        # in-process engines feed the process-local live collector
+        # directly through their own `_live` slot — nothing to ship
+        return None
+
     def debug_state(self) -> dict:
         return self._engine.scheduler.debug_state()
 
@@ -201,6 +211,12 @@ class _WorkerReplica:
             os.path.abspath(__file__))))
         env = dict(os.environ)
         env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        # one exporter per fleet: the router process owns the metrics
+        # port; workers collect (PT_LIVE_TELEMETRY) and ship their
+        # telemetry over the pipe instead of binding their own server
+        env.pop("PT_METRICS_PORT", None)
+        if _live_telemetry.enabled():
+            env["PT_LIVE_TELEMETRY"] = "1"
         self._proc = subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.serving.router_worker"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env,
@@ -258,6 +274,17 @@ class _WorkerReplica:
             return self._call({"op": "stats"}).get("stats", {})
         except RuntimeError as exc:
             return {"worker_error": str(exc)}
+
+    def telemetry(self):
+        """The worker's cumulative monitor counters + live sketches
+        (`live.export_local` shape) — cumulative, not deltas, so a
+        missed pull self-heals and the router-side merge stays exact.
+        None when the worker is unreachable (its last shipped payload
+        stays merged)."""
+        try:
+            return self._call({"op": "telemetry"}).get("telemetry")
+        except RuntimeError:
+            return None
 
     def debug_state(self) -> dict:
         try:
@@ -331,6 +358,10 @@ class RouterEngine:
         }
         self.dispatch_counts = [0] * rc.replicas
         _blackbox.register("serving_router", self._blackbox_state)
+        # /healthz hook: the exporter reads per-replica dead/alive from
+        # this weakly-held provider (monitor/live.py status registry)
+        _live_telemetry.register_status("serving_router",
+                                        self._health_state)
 
     @staticmethod
     def _as_kwargs(config) -> dict:
@@ -469,6 +500,15 @@ class RouterEngine:
             if m is not None:
                 occ, queued = rep.load()
                 m.on_router_lanes(i, occ, queued)
+            lv = _live
+            if lv is not None:
+                # fleet aggregation: pull the worker's cumulative
+                # telemetry after its step so this round's finishes are
+                # already in the payload (in-process replicas return
+                # None — they feed the local collector directly)
+                tel = rep.telemetry()
+                if tel is not None:
+                    lv.set_remote(str(i), tel)
         return worked
 
     def run(self) -> dict:
@@ -577,6 +617,20 @@ class RouterEngine:
             queued=len(self._queue),
         )
         return out
+
+    def _health_state(self) -> dict:
+        """/healthz provider: the light per-replica dead/alive ledger —
+        plain ints and strings only, safe to read at scrape time (the
+        heavyweight scheduler snapshots stay in `_blackbox_state`)."""
+        return {
+            "mode": self.router_config.mode,
+            "queued": len(self._queue),
+            "counters": dict(self.counters),
+            "replicas": [
+                {"replica": i, "dead": i in self._dead,
+                 "reason": self._dead.get(i)}
+                for i in range(len(self._replicas))],
+        }
 
     def _blackbox_state(self) -> dict:
         """Blackbox provider (``monitor/blackbox.py``): router config +

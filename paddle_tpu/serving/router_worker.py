@@ -11,6 +11,12 @@ away, so jax/XLA chatter can never corrupt the pipe). Ops:
 - ``{"op": "submit", "request_id", "prompt", "max_new_tokens",
   "eos_token_id"}``
 - ``{"op": "step"}`` -> ``{"ok", "worked", "finished": {rid: [tok]}}``
+- ``{"op": "telemetry"}`` -> ``{"ok", "telemetry": {...}}`` — this
+  process's CUMULATIVE monitor counter totals + live sketch state
+  (``monitor.live.export_local``; the router installs
+  ``PT_LIVE_TELEMETRY=1`` in the worker env when its own live plane is
+  armed). Cumulative so the router's merge is idempotent and the
+  fleet's ``/metrics`` equals in-process mode exactly.
 - ``{"op": "warmup" | "stats" | "debug_state" | "shutdown"}``
 
 Any op failure replies ``{"ok": false, "error": ...}``; the router
@@ -77,6 +83,11 @@ def main(argv=None) -> int:
             elif op == "warmup":
                 engine.warmup()
                 reply({"ok": True})
+            elif op == "telemetry":
+                from ..monitor import live as _live_telemetry
+
+                reply({"ok": True,
+                       "telemetry": _live_telemetry.export_local()})
             elif op == "stats":
                 reply({"ok": True, "stats": engine.stats()})
             elif op == "debug_state":

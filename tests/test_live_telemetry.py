@@ -1,0 +1,494 @@
+"""Live telemetry plane tests (ISSUE 19 — monitor/live + monitor/exporter).
+
+The acceptance spine:
+
+- **Sketch honesty** — the fixed-boundary log-bucket quantile sketch
+  agrees with exact numpy percentiles within one bucket width (5%)
+  across distributions, and merging is EXACT (associative, order-free:
+  any split of a stream merges back to byte-identical bucket state) —
+  the property that makes fleet aggregation equality, not approximation.
+- **Endpoint smoke** — a live engine scraped over real HTTP: /metrics
+  parses as OpenMetrics (TYPE-declared families, # EOF), /healthz
+  reports per-replica dead/alive through an injected replica death,
+  /statusz renders.
+- **SLO watchdog** — fast+slow burn-rate windows fire a breach on a
+  sustained violation: monitor/slo_breach counter, StepLogger
+  `slo_breach` event lines, `Callback.on_slo_breach` via the hapi
+  bridge, run_end live snapshot.
+- **Worker-mode parity** — the same seeded trace through an in-process
+  fleet and a worker (subprocess) fleet yields byte-equal /metrics
+  serving+router counter totals and live sketch counts (mergeable
+  sketches + the router's per-step telemetry pulls), identical tokens,
+  and the in-process fleet still compiles exactly 3 programs with the
+  live plane armed.
+- **Zero-overhead off** — `_live` slots are None in the tier-1 default
+  environment (the parametrized audit in test_memory_numerics.py
+  covers every INSTRUMENTED_MODULES entry), the exporter starts no
+  thread at import, and enable/disable round-trips the slots.
+"""
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import monitor
+from paddle_tpu.monitor import StepLogger
+from paddle_tpu.monitor import exporter
+from paddle_tpu.monitor import live
+from paddle_tpu.monitor.live import GAMMA, QuantileSketch
+
+GEOM = dict(max_lanes=3, block_size=4, prefill_chunk=8, max_seq_len=32)
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    pt.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+    m.eval()
+    return m
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    """Live plane enabled with clean state; restores disabled-off."""
+    was = live.enabled()
+    live.enable()
+    live.reset()
+    yield live
+    live.reset()
+    if not was:
+        live.disable()
+
+
+def _mixed_workload(vocab, rng, n):
+    out = []
+    for _ in range(n):
+        plen, new = int(rng.randint(3, 13)), int(rng.randint(4, 10))
+        out.append((rng.randint(0, vocab, (plen,)).astype(np.int32), new))
+    return out
+
+
+# -- the sketch ---------------------------------------------------------------
+
+class TestQuantileSketch:
+    @pytest.mark.parametrize("dist", ["uniform", "lognormal", "expo"])
+    def test_quantiles_within_one_bucket_of_numpy(self, dist):
+        rng = np.random.RandomState(0)
+        vals = {
+            "uniform": rng.uniform(0.5, 400.0, 4000),
+            "lognormal": rng.lognormal(3.0, 1.2, 4000),
+            "expo": rng.exponential(25.0, 4000),
+        }[dist]
+        sk = QuantileSketch()
+        for v in vals:
+            sk.observe(float(v))
+        for p in (0.50, 0.90, 0.99):
+            exact = float(np.percentile(vals, p * 100))
+            approx = sk.quantile(p)
+            # upper-boundary nearest-rank: within one bucket width of
+            # the exact rank value (+ slack for numpy's interpolation)
+            assert abs(approx - exact) / exact <= (GAMMA - 1) + 0.01, \
+                f"{dist} p{p}: exact={exact} sketch={approx}"
+
+    def test_merge_is_exact_and_associative(self):
+        rng = np.random.RandomState(1)
+        vals = rng.lognormal(2.0, 1.0, 3000)
+        whole = QuantileSketch()
+        parts = [QuantileSketch() for _ in range(3)]
+        for i, v in enumerate(vals):
+            whole.observe(float(v))
+            parts[i % 3].observe(float(v))
+        ab_c = parts[0].copy().merge(parts[1]).merge(parts[2])
+        c_ab = parts[2].copy().merge(parts[0]).merge(parts[1])
+        assert ab_c.to_dict() == c_ab.to_dict() == whole.to_dict()
+
+    def test_json_roundtrip(self):
+        sk = QuantileSketch()
+        for v in (0.01, 1.0, 5.5, 1e6, 0.0, -3.0):
+            sk.observe(v)
+        rt = QuantileSketch.from_dict(json.loads(json.dumps(sk.to_dict())))
+        assert rt.to_dict() == sk.to_dict()
+        assert rt.quantile(0.5) == sk.quantile(0.5)
+
+    def test_zero_and_empty(self):
+        sk = QuantileSketch()
+        assert sk.quantile(0.99) == 0.0
+        sk.observe(0.0)
+        sk.observe(-1.0)
+        assert sk.count == 2 and sk.zero == 2
+        assert sk.quantile(0.99) == 0.0
+
+    def test_count_over_never_undercounts(self):
+        sk = QuantileSketch()
+        vals = [1.0, 5.0, 10.0, 50.0, 100.0, 200.0]
+        for v in vals:
+            sk.observe(v)
+        for t in (4.0, 40.0, 99.0):
+            exact = sum(1 for v in vals if v > t)
+            assert sk.count_over(t) >= exact
+            # ...and overshoots by at most the threshold's own bucket
+            assert sk.count_over(t) <= sum(
+                1 for v in vals if v > t / GAMMA)
+
+
+# -- zero-overhead-off + enable/disable wiring --------------------------------
+
+class TestZeroOverheadOff:
+    def test_import_time_inert(self):
+        """Tier-1 default env: live disabled, no exporter thread, and
+        the serving slots are None (no live callable reachable)."""
+        import paddle_tpu.serving.engine as eng
+        import paddle_tpu.serving.router as rtr
+
+        assert not live.enabled()
+        assert exporter.port() is None
+        assert eng._live is None
+        assert rtr._live is None
+
+    def test_enable_wires_slots_and_disable_clears(self):
+        import paddle_tpu.serving.engine as eng
+        import paddle_tpu.serving.router as rtr
+
+        live.enable()
+        try:
+            assert eng._live is live and rtr._live is live
+            # arming live must NOT arm the monitor (independent planes)
+            assert not monitor.enabled()
+            assert eng._monitor is None
+        finally:
+            live.disable()
+        assert eng._live is None and rtr._live is None
+
+    def test_live_slot_in_lint_contract(self):
+        from paddle_tpu.analysis import lint
+
+        assert "_live" in lint._SLOT_NAMES
+
+    def test_slot_modules_in_audit_list(self):
+        assert "paddle_tpu.serving.engine" in monitor.INSTRUMENTED_MODULES
+        assert "paddle_tpu.serving.router" in monitor.INSTRUMENTED_MODULES
+
+
+# -- the watchdog + breach plumbing -------------------------------------------
+
+class TestSLOWatchdog:
+    def _arm(self, monkeypatch, target="10"):
+        monkeypatch.setenv("PT_SLO_TTFT_MS_P99", target)
+        monkeypatch.setenv("PT_SLO_FAST_WINDOW", "2")
+        monkeypatch.setenv("PT_SLO_SLOW_WINDOW", "4")
+        live.enable()
+        live.reset()  # re-reads the PT_SLO_* knobs
+
+    def test_sustained_violation_fires_once_and_relatches(
+            self, monkeypatch, armed):
+        self._arm(monkeypatch)
+        seen = []
+        live.subscribe(seen.append)
+        try:
+            monitor.counter("monitor/slo_breach").reset()
+            for _ in range(6):
+                live.on_request_finished(50.0, 5.0, 1.0)  # 50ms >> 10ms
+                live.on_engine_step()
+            assert live.breach_count() == 1, "breach must latch, not spam"
+            assert monitor.counter("monitor/slo_breach").value == 1
+            assert seen and seen[0]["metric"] == "ttft_ms"
+            assert seen[0]["burn_fast"] >= 14.0
+            # recovery re-arms: healthy windows, then violations again
+            for _ in range(6):
+                live.on_request_finished(1.0, 1.0, 1.0)
+                live.on_engine_step()
+            for _ in range(6):
+                live.on_request_finished(50.0, 5.0, 1.0)
+                live.on_engine_step()
+            assert live.breach_count() == 2
+        finally:
+            live.unsubscribe(seen.append)
+
+    def test_no_target_no_breach(self, monkeypatch, armed):
+        monkeypatch.delenv("PT_SLO_TTFT_MS_P99", raising=False)
+        monkeypatch.delenv("PT_SLO_TPOT_MS_P99", raising=False)
+        live.reset()
+        for _ in range(20):
+            live.on_request_finished(1e6, 1e6, 1.0)
+            live.on_engine_step()
+        assert live.breach_count() == 0
+
+    def test_steplogger_writes_breach_events_and_run_end_snapshot(
+            self, monkeypatch, armed, tmp_path):
+        self._arm(monkeypatch)
+        path = tmp_path / "steps.jsonl"
+        log = StepLogger(str(path), meta={"source": "test"})
+        for _ in range(4):
+            live.on_request_finished(50.0, 5.0, 1.0)
+            live.on_engine_step()
+        log.log_step(loss=1.0)
+        log.close()
+        lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+        events = [ln for ln in lines if ln.get("event") == "slo_breach"]
+        assert len(events) == 1
+        assert events[0]["metric"] == "ttft_ms"
+        assert events[0]["target_ms"] == 10.0
+        end = lines[-1]
+        assert end["event"] == "run_end"
+        assert end["live"]["slo"]["breaches"] == 1
+        assert end["live"]["sketches"]["ttft_ms"]["count"] == 4
+        assert end["totals"]["counters"].get("monitor/slo_breach", 0) >= 1
+
+    def test_callback_bridge_dispatches_on_slo_breach(
+            self, monkeypatch, armed):
+        from paddle_tpu.hapi.callbacks import (
+            Callback, _SLOBridge, config_callbacks,
+        )
+
+        self._arm(monkeypatch)
+
+        class Recorder(Callback):
+            def __init__(self):
+                self.breaches = []
+
+            def on_slo_breach(self, breach=None):
+                self.breaches.append(breach)
+
+        rec = Recorder()
+        bridge = _SLOBridge([rec])
+        bridge.on_train_begin()
+        try:
+            for _ in range(4):
+                live.on_request_finished(50.0, 5.0, 1.0)
+                live.on_engine_step()
+        finally:
+            bridge.on_train_end()
+        assert len(rec.breaches) == 1
+        assert rec.breaches[0]["metric"] == "ttft_ms"
+        # after unsubscribe the chain goes quiet
+        for _ in range(8):
+            live.on_request_finished(1.0, 1.0, 1.0)
+            live.on_engine_step()
+        for _ in range(4):
+            live.on_request_finished(50.0, 5.0, 1.0)
+            live.on_engine_step()
+        assert len(rec.breaches) == 1
+        # config_callbacks wires the bridge into every train chain
+        lst = config_callbacks(callbacks=[rec], verbose=0)
+        assert any(isinstance(c, _SLOBridge) for c in lst.callbacks)
+        # the base class carries the hook (observation-only default)
+        assert Callback().on_slo_breach({"metric": "x"}) is None
+
+
+# -- live engine + endpoint smoke ---------------------------------------------
+
+def _scrape(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.read().decode()
+
+
+def _parse_openmetrics(body):
+    """Minimal OpenMetrics check: returns {family: [sample lines]};
+    asserts every sample rides a TYPE-declared family and the
+    exposition terminates with # EOF."""
+    lines = body.splitlines()
+    assert lines[-1] == "# EOF"
+    families, cur = {}, None
+    for ln in lines[:-1]:
+        if ln.startswith("# TYPE "):
+            cur = ln.split()[2]
+            families[cur] = []
+            continue
+        assert cur is not None and ln.startswith(cur), ln
+        name = ln.split("{")[0].split()[0]
+        base = name
+        for suffix in ("_total", "_count", "_sum"):
+            if base.endswith(suffix):
+                base = base[:-len(suffix)]
+        assert base == cur or name == cur, ln
+        float(ln.rsplit(" ", 1)[1])  # every value parses
+        families[cur].append(ln)
+    return families
+
+
+def test_engine_endpoint_smoke(model, armed):
+    """Scrape a live engine over real HTTP: sketches fed from the
+    always-on attribution handoffs (PT_MONITOR stays OFF), OpenMetrics
+    parses, statusz renders the engine's registered provider."""
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    assert not monitor.enabled()
+    monitor.reset()  # stale registry state from earlier tests is noise here
+    engine = ServingEngine(model, ServingConfig(**GEOM))
+    work = _mixed_workload(model.config.vocab_size,
+                           np.random.RandomState(4), 5)
+    for i, (p, n) in enumerate(work):
+        engine.submit(p, max_new_tokens=n, request_id=f"r{i}")
+    outs = engine.run()
+    assert len(outs) == len(work)
+
+    port = exporter.start(0)
+    assert port
+    try:
+        fams = _parse_openmetrics(_scrape(port, "/metrics"))
+        assert "pt_live_ttft_ms" in fams
+        count_line = [ln for ln in fams["pt_live_ttft_ms"]
+                      if ln.startswith("pt_live_ttft_ms_count")][0]
+        assert int(count_line.split()[-1]) == len(work)
+        # monitor off -> no monitor counters in the exposition, but the
+        # live plane is fully populated (the PT_MONITOR=0 contract)
+        assert "pt_serving_admits" not in fams
+
+        h = json.loads(_scrape(port, "/healthz"))
+        assert h["ok"] and h["live_enabled"]
+        assert h["slo_breaches"] == 0
+
+        sz = _scrape(port, "/statusz")
+        assert "paddle_tpu /statusz" in sz
+        assert "serving_engine" in sz
+        assert "ttft_ms" in sz
+    finally:
+        exporter.stop()
+    assert exporter.port() is None
+
+
+def test_healthz_reports_replica_death(model, armed, monkeypatch):
+    """The liveness endpoint's first adversarial proof, in-test: a
+    replica killed mid-trace shows up dead in /healthz (the soak
+    driver's --router leg polls the same surface through a real kill)."""
+    from paddle_tpu.serving import (
+        RouterConfig, RouterEngine, ServingConfig,
+    )
+
+    router = RouterEngine(
+        model, ServingConfig(**GEOM),
+        RouterConfig(replicas=2, mode="inproc"))
+    work = _mixed_workload(model.config.vocab_size,
+                           np.random.RandomState(6), 4)
+    for i, (p, n) in enumerate(work):
+        router.submit(p, max_new_tokens=n, request_id=f"r{i}")
+    router.step()
+
+    h = exporter.health()
+    assert [r for r in h["replicas"] if r["dead"]] == []
+
+    def boom():
+        raise RuntimeError("injected replica failure")
+
+    monkeypatch.setattr(router._replicas[0]._engine, "step", boom)
+    router.step()  # the killing step: dead must be visible right after
+    h = exporter.health()
+    assert h["dead_replicas"] == [0]
+    dead = [r for r in h["replicas"] if r["dead"]]
+    assert dead and "injected replica failure" in dead[0]["reason"]
+    router.run()  # survivors finish the drained work
+    assert router.counters["finished"] == len(work)
+
+
+# -- worker-mode fleet parity -------------------------------------------------
+
+def _parity_lines(body):
+    """The mode-invariant subset of /metrics: serving+router counter
+    totals and live sketch observation counts. Quantile/sum lines carry
+    wall-clock latencies that legitimately differ between process
+    shapes, and monitor HISTOGRAM counts (ring-percentile state) stay
+    per-process — the live sketches are the fleet-mergeable replacement
+    and ARE held to parity here."""
+    keep = []
+    for ln in body.splitlines():
+        name = ln.split("{")[0].split()[0]
+        if name.startswith(("pt_serving", "pt_router")) \
+                and name.endswith("_total"):
+            keep.append(ln)
+        elif name.startswith("pt_live") and name.endswith("_count"):
+            keep.append(ln)
+    return keep
+
+
+@pytest.mark.slow
+def test_worker_fleet_metrics_parity(model, armed, tmp_path, monkeypatch):
+    """THE fleet-aggregation proof: the same seeded trace through an
+    in-process 2-replica fleet and a worker (subprocess) 2-replica
+    fleet produces byte-equal /metrics counter totals + sketch counts,
+    identical tokens — worker-mode replica telemetry is no longer lost.
+    The in-process fleet still pays exactly 3 fresh compiles with the
+    live plane armed."""
+    from paddle_tpu.jit import exec_cache as ec
+    from paddle_tpu.serving import (
+        RouterConfig, RouterEngine, ServingConfig,
+    )
+
+    factory = tmp_path / "lt_factory.py"
+    factory.write_text(
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu.models.llama import LlamaConfig, "
+        "LlamaForCausalLM\n"
+        "def build():\n"
+        "    pt.seed(0)\n"
+        "    m = LlamaForCausalLM(LlamaConfig.tiny("
+        "num_hidden_layers=2))\n"
+        "    m.eval()\n"
+        "    return m\n")
+    monkeypatch.setenv("PYTHONPATH", str(tmp_path) + os.pathsep
+                       + os.environ.get("PYTHONPATH", ""))
+    # counters need the monitor in BOTH shapes: here and in the workers
+    monkeypatch.setenv("PT_MONITOR", "1")
+    was = monitor.enabled()
+    monitor.enable()
+    work = _mixed_workload(model.config.vocab_size,
+                           np.random.RandomState(2), 6)
+
+    def run_fleet(router):
+        for i, (p, n) in enumerate(work):
+            router.submit(p, max_new_tokens=n, request_id=f"r{i}")
+        outs = router.run()
+        body = exporter.render_metrics()
+        return outs, _parity_lines(body)
+
+    try:
+        monitor.reset()
+        live.reset()
+        ec.enable(str(tmp_path / "cache"))
+        ec.clear()
+        try:
+            inproc = RouterEngine(
+                model, ServingConfig(**GEOM),
+                RouterConfig(replicas=2, mode="inproc"))
+            inproc.warmup()
+            assert ec.stats()["misses"] == 3, \
+                "live plane must not add compiles"
+            outs_in, lines_in = run_fleet(inproc)
+            assert ec.stats()["misses"] == 3, "live plane retraced!"
+        finally:
+            ec.disable()
+            ec.clear()
+
+        monitor.reset()
+        live.reset()
+        worker = RouterEngine(
+            config=GEOM,
+            router_config=RouterConfig(
+                replicas=2, mode="worker",
+                worker_factory="lt_factory:build"))
+        try:
+            outs_wk, lines_wk = run_fleet(worker)
+        finally:
+            worker.close()
+    finally:
+        monitor.reset()
+        if not was:
+            monitor.disable()
+
+    assert set(outs_in) == set(outs_wk)
+    for rid in outs_in:
+        np.testing.assert_array_equal(outs_in[rid], outs_wk[rid])
+    assert lines_in, "parity subset must not be empty"
+    assert any(ln.startswith("pt_live_ttft_ms_count") for ln in lines_in)
+    assert any(ln.startswith("pt_serving_decoded_tokens_total")
+               for ln in lines_in)
+    assert lines_in == lines_wk, (
+        "worker-mode fleet /metrics diverged from in-process:\n"
+        + "\n".join(sorted(set(lines_in) ^ set(lines_wk))))

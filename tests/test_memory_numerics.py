@@ -451,8 +451,11 @@ def test_zero_overhead_audit_import_time_inert(modname):
     monitor/sentinel callable is reachable from any hot path. New
     instrumentation sites must join INSTRUMENTED_MODULES, so this audit
     covers them without edits here."""
+    from paddle_tpu.monitor import live as live_telemetry
+
     assert not monitor.enabled()
     assert not numerics.enabled()
+    assert not live_telemetry.enabled()
     assert memobs._ledger is None
     mod = importlib.import_module(modname)
     assert mod._monitor is None, f"{modname}._monitor"
@@ -460,6 +463,8 @@ def test_zero_overhead_audit_import_time_inert(modname):
         assert mod._spans is None, f"{modname}._spans"
     if hasattr(mod, "_nancheck"):
         assert mod._nancheck is None, f"{modname}._nancheck"
+    if hasattr(mod, "_live"):
+        assert mod._live is None, f"{modname}._live"
 
 
 def test_audit_list_covers_all_registered_sites():

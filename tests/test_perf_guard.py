@@ -511,6 +511,78 @@ class TestEvaluate:
                             thresholds={"plan_drift": False})
         assert not any(c["name"] == "plan_drift" for c in v4["checks"])
 
+    def test_flags_fresh_slo_breach(self, guard):
+        # SLO-breach gate (ISSUE 19): the burn-rate watchdog fired on a
+        # trace that breached zero times in the last-good record
+        base = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                "backend": "tpu",
+                "extra": {"slo": {"breaches": 0, "worst_burn": 2.0}}}
+        fresh = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                 "unit": "tokens/s",
+                 "slo": {"breaches": 2, "worst_burn": 40.0}}
+        v = guard.evaluate(fresh, base, hardware=True)
+        assert not v["ok"]
+        assert any(c["name"] == "slo_breach" and not c["ok"]
+                   for c in v["checks"])
+        # the gate can be disabled explicitly (--no-slo-breach)
+        v2 = guard.evaluate(fresh, base, hardware=True,
+                            thresholds={"slo_breach": False})
+        assert not any(c["name"] == "slo_breach" for c in v2["checks"])
+
+    def test_slo_breach_gate_skips_and_rides_baseline(self, guard):
+        # zero fresh breaches pass; a baseline that already breached
+        # rides forward; either side missing the sub-object skips
+        base_b = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                  "backend": "tpu", "extra": {"slo": {"breaches": 3}}}
+        fresh_b = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                   "unit": "tokens/s", "slo": {"breaches": 5}}
+        v = guard.evaluate(fresh_b, base_b, hardware=True)
+        assert any(c["name"] == "slo_breach" and c["ok"]
+                   for c in v["checks"])
+        base_0 = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                  "backend": "tpu", "extra": {"slo": {"breaches": 0}}}
+        fresh_0 = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                   "unit": "tokens/s", "slo": {"breaches": 0}}
+        v = guard.evaluate(fresh_0, base_0, hardware=True)
+        assert any(c["name"] == "slo_breach" and c["ok"]
+                   for c in v["checks"])
+        no_sub = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                  "unit": "tokens/s"}
+        v = guard.evaluate(no_sub, base_0, hardware=True)
+        assert not any(c["name"] == "slo_breach" for c in v["checks"])
+        base_no = {"metric": "serving_tokens_per_sec", "value": 1000.0,
+                   "backend": "tpu", "extra": {}}
+        v = guard.evaluate(fresh_b, base_no, hardware=True)
+        assert not any(c["name"] == "slo_breach" for c in v["checks"])
+
+    def test_slo_targets_join_config_keys(self, guard, tmp_path):
+        # a record judged at PT_SLO_TTFT_MS_P99=200 never baselines a
+        # fresh line judged at 100 (tighter target, different line in
+        # the sand); pre-SLO records (no key) read as target-off
+        path = str(tmp_path / "store.json")
+        with open(path, "w") as f:
+            json.dump({"records": [
+                {"metric": "serving_tokens_per_sec", "value": 900.0,
+                 "unit": "tokens/s", "backend": "tpu",
+                 "extra": {"slo_ttft_ms_p99": 200.0}}]}, f)
+        same = {"metric": "serving_tokens_per_sec", "value": 880.0,
+                "slo_ttft_ms_p99": 200.0}
+        tighter = {"metric": "serving_tokens_per_sec", "value": 880.0,
+                   "slo_ttft_ms_p99": 100.0}
+        off = {"metric": "serving_tokens_per_sec", "value": 880.0,
+               "slo_ttft_ms_p99": None}
+        assert guard.last_good(
+            path, "serving_tokens_per_sec",
+            match=guard.config_match(same)) is not None
+        assert guard.last_good(
+            path, "serving_tokens_per_sec",
+            match=guard.config_match(tighter)) is None
+        assert guard.last_good(
+            path, "serving_tokens_per_sec",
+            match=guard.config_match(off)) is None
+        assert "slo_ttft_ms_p99" in guard.CONFIG_KEYS
+        assert guard.CONFIG_KEY_DEFAULTS["slo_ttft_ms_p99"] is None
+
     def test_flags_save_cost_growth(self, guard):
         base = {"metric": "soak", "value": 900.0, "backend": "tpu",
                 "extra": {"ckpt_save_ms_p50": 300.0}}

@@ -5,6 +5,7 @@ one summary table.
     python tools/monitor_report.py run.jsonl [--trace trace.json] [--top 10]
     python tools/monitor_report.py run.jsonl --trace trace.json --spans
     python tools/monitor_report.py run.jsonl --bench bench.log
+    python tools/monitor_report.py run.jsonl --metrics metrics.txt
 
 Sections: run overview (steps, wall, loss, ips), counter totals, the async
 pipeline (prefetch staging/starvation, AsyncStepper bound waits, hapi host
@@ -22,6 +23,15 @@ percentiles, and — when a chrome trace from
 `paddle_tpu.profiler.Profiler.export` (or `monitor.export_spans`) is
 given — the top dispatched ops and the monitor counter tracks found on
 the timeline, so one report correlates the JSONL run with the trace.
+
+The "SLO / live windows" section renders the live telemetry plane
+(``paddle_tpu/monitor/live.py`` — docs/OBSERVABILITY.md): streaming
+sketch percentiles (TTFT/TPOT/queue-wait/accept-rate), the armed
+``PT_SLO_*`` targets, fast/slow burn-rate state, and the breach count —
+from the run_end line's ``live`` snapshot, or from a SAVED ``/metrics``
+exposition (``--metrics FILE``, e.g. ``curl :9100/metrics > f``) where
+it also derives the per-replica dispatch share the router's
+``{replica=}`` labels carry.
 
 `--spans` adds the host-blocked-time attribution pass: the flight
 recorder's `ph:"X"` spans (`paddle_tpu/monitor/spans.py`) are decomposed
@@ -306,6 +316,131 @@ def render_router(out, totals=None, gauges=None, source=""):
         if queued is not None:
             parts.append(f"queued (last) {queued:g}")
         out.append("   ".join(parts))
+
+
+def render_slo(out, live=None, source=""):
+    """The live telemetry plane's account (the run_end line's ``live``
+    sub-object — ``monitor/live.py:snapshot()``): streaming sketch
+    percentiles per metric, the armed SLO targets, burn-rate state
+    (fast/slow windows), and the breach count."""
+    if not live:
+        return
+    out.append("")
+    out.append(f"-- SLO / live windows{source} --")
+    slo = live.get("slo") or {}
+    out.append(f"engine steps {live.get('steps', 0)}   windows: fast "
+               f"{slo.get('fast_window_steps', '?')} / slow "
+               f"{slo.get('slow_window_steps', '?')} steps")
+    targets = {k: v for k, v in (slo.get("targets") or {}).items() if v}
+    if targets:
+        out.append("targets: " + "   ".join(
+            f"{k} {v:g} ms" for k, v in sorted(targets.items())))
+    else:
+        out.append("targets: none armed (PT_SLO_TTFT_MS_P99 / "
+                   "PT_SLO_TPOT_MS_P99)")
+    line = f"breaches: {slo.get('breaches', 0)}"
+    if slo.get("fleet_breaches") is not None:
+        line += f"   fleet total: {slo['fleet_breaches']}"
+    out.append(line)
+    last = slo.get("last_burn") or {}
+    worst = slo.get("worst_burn") or {}
+    for metric in sorted(set(last) | set(worst)):
+        lb = last.get(metric) or {}
+        out.append(f"  {metric}: burn fast {lb.get('fast', '-')} / "
+                   f"slow {lb.get('slow', '-')}   worst "
+                   f"{worst.get(metric, '-')} "
+                   f"(fires at {slo.get('burn_fast_threshold', 14)}/"
+                   f"{slo.get('burn_slow_threshold', 6)})")
+    sketches = live.get("sketches") or {}
+    if sketches:
+        rows = [("metric", "count", "p50", "p90", "p99")]
+        for name, s in sorted(sketches.items()):
+            rows.append((name, s.get("count", 0), s.get("p50", "-"),
+                         s.get("p90", "-"), s.get("p99", "-")))
+        out.extend(_table(rows, (18, 8, 12, 12, 12)))
+    if live.get("replicas_remote"):
+        out.append("remote replicas merged: "
+                   + ", ".join(str(r) for r in live["replicas_remote"]))
+
+
+def parse_openmetrics(text):
+    """``{name: [(labels_dict, value)]}`` from a saved ``/metrics``
+    exposition (``monitor/exporter.py`` format). Comment/TYPE/EOF lines
+    are skipped; unparseable lines are tolerated (a truncated scrape
+    must still be reportable)."""
+    series = {}
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln or ln.startswith("#"):
+            continue
+        head, _, val = ln.rpartition(" ")
+        try:
+            value = float(val)
+        except ValueError:
+            continue
+        name, labels = head, {}
+        if "{" in head and head.endswith("}"):
+            name, _, lab = head.partition("{")
+            for part in lab[:-1].split(","):
+                k, eq, v = part.partition("=")
+                if eq:
+                    labels[k.strip()] = v.strip().strip('"')
+        series.setdefault(name, []).append((labels, value))
+    return series
+
+
+def render_metrics_file(series, out, source=""):
+    """The SLO/live view of a saved ``/metrics`` exposition: live sketch
+    summaries, targets + burn state, breach total, and the per-replica
+    dispatch share from the router's ``{replica=}`` labels."""
+    out.append("")
+    out.append(f"-- SLO / live windows (/metrics){source} --")
+
+    def _one(name, default=None):
+        samples = series.get(name) or []
+        return samples[0][1] if samples else default
+
+    breaches = _one("pt_slo_breaches_total")
+    if breaches is not None:
+        out.append(f"breaches: {breaches:g}")
+    targets = series.get("pt_slo_target_ms") or []
+    if targets:
+        out.append("targets: " + "   ".join(
+            f"{lb.get('metric', '?')} {v:g} ms"
+            for lb, v in sorted(targets,
+                                key=lambda s: s[0].get("metric", ""))))
+    burns = series.get("pt_slo_burn_rate") or []
+    if burns:
+        by_metric = {}
+        for lb, v in burns:
+            by_metric.setdefault(lb.get("metric", "?"), {})[
+                lb.get("window", "?")] = v
+        for metric in sorted(by_metric):
+            w = by_metric[metric]
+            out.append(f"  {metric}: burn fast {w.get('fast', '-')} / "
+                       f"slow {w.get('slow', '-')}")
+    live_names = sorted(
+        n[:-len("_count")] for n in series
+        if n.startswith("pt_live_") and n.endswith("_count"))
+    if live_names:
+        rows = [("metric", "count", "p50", "p90", "p99")]
+        for base in live_names:
+            q = {lb.get("quantile"): v
+                 for lb, v in series.get(base, [])}
+            rows.append((base[len("pt_live_"):],
+                         f"{_one(base + '_count', 0):g}",
+                         q.get("0.5", "-"), q.get("0.9", "-"),
+                         q.get("0.99", "-")))
+        out.extend(_table(rows, (18, 8, 12, 12, 12)))
+    disp = [(lb.get("replica", "?"), v) for lb, v in
+            series.get("pt_router_dispatches_total", [])
+            if lb.get("replica") is not None]
+    total_disp = sum(v for _, v in disp)
+    if disp and total_disp:
+        out.append("dispatch share:")
+        for idx, v in sorted(disp):
+            out.append(f"  replica {idx:<3} {v:g} "
+                       f"({v / total_disp:.0%})")
 
 
 def render_kernels(out, totals=None, gauges=None, bench_kernels=None,
@@ -728,7 +863,7 @@ def render_request_attribution(att, out, source=""):
 
 
 def render(jsonl_path, trace_path=None, top=10, spans=False,
-           bench_path=None):
+           bench_path=None, metrics_path=None):
     steps, begin, end = load_jsonl(jsonl_path)
     out = [f"== monitor run: {jsonl_path} =="]
     if begin:
@@ -836,6 +971,20 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
     # -- replica router (router/* from the multi-replica dispatcher) --
     render_router(out, totals=totals,
                   gauges=(end or {}).get("totals", {}).get("gauges", {}))
+
+    # -- SLO / live windows (the run_end line's live snapshot) --
+    render_slo(out, live=(end or {}).get("live"))
+
+    # -- SLO / live windows from a saved /metrics exposition --
+    if metrics_path:
+        try:
+            series = parse_openmetrics(open(metrics_path).read())
+        except OSError as e:
+            out.append("")
+            out.append(f"unreadable metrics file: {e}")
+        else:
+            render_metrics_file(series, out,
+                                source=f" {metrics_path}")
 
     # -- pallas kernels (pallas/* + search/* from the search harness) --
     render_kernels(out, totals=totals,
@@ -1027,7 +1176,20 @@ def _selftest():
                      "serving/prefill_steps": 4, "serving/decode_steps": 9,
                      "serving/prefix_hit_tokens": 16,
                      "serving/prefix_miss_tokens": 48},
-                     "histograms": {}, "gauges": {}}},
+                     "histograms": {}, "gauges": {}},
+                 "live": {"steps": 9, "sketches": {
+                     "ttft_ms": {"count": 2, "sum": 52.0, "p50": 12.3,
+                                 "p90": 40.1, "p99": 40.1}},
+                     "slo": {"targets": {"ttft_ms_p99": 25.0,
+                                         "tpot_ms_p99": None},
+                             "breaches": 1,
+                             "worst_burn": {"ttft_ms": 50.0},
+                             "last_burn": {"ttft_ms": {"fast": 50.0,
+                                                       "slow": 11.1}},
+                             "fast_window_steps": 12,
+                             "slow_window_steps": 120,
+                             "burn_fast_threshold": 14.0,
+                             "burn_slow_threshold": 6.0}}},
             ):
                 f.write(json.dumps(line) + "\n")
         trace = os.path.join(td, "trace.json")
@@ -1070,12 +1232,34 @@ def _selftest():
                 "telemetry": {"serving": {"admits": 2, "evictions": 2,
                                           "prefill_steps": 4,
                                           "decode_steps": 9}}}) + "\n")
+        metrics_file = os.path.join(td, "metrics.txt")
+        with open(metrics_file, "w") as f:
+            f.write("\n".join((
+                "# TYPE pt_router_dispatches counter",
+                'pt_router_dispatches_total{replica="0"} 6',
+                'pt_router_dispatches_total{replica="1"} 2',
+                "# TYPE pt_live_ttft_ms summary",
+                'pt_live_ttft_ms{quantile="0.5"} 12.3',
+                'pt_live_ttft_ms{quantile="0.9"} 40.1',
+                'pt_live_ttft_ms{quantile="0.99"} 40.1',
+                "pt_live_ttft_ms_count 2",
+                "pt_live_ttft_ms_sum 52.0",
+                "# TYPE pt_slo_breaches counter",
+                "pt_slo_breaches_total 1",
+                "# TYPE pt_slo_target_ms gauge",
+                'pt_slo_target_ms{metric="ttft_ms"} 25.0',
+                "# TYPE pt_slo_burn_rate gauge",
+                'pt_slo_burn_rate{metric="ttft_ms",window="fast"} 50.0',
+                'pt_slo_burn_rate{metric="ttft_ms",window="slow"} 11.1',
+                "# EOF", "")))
         report = render(jsonl, trace_path=trace, top=5, spans=True,
-                        bench_path=bench)
+                        bench_path=bench, metrics_path=metrics_file)
         needed = (
             "-- run --",
             "-- counters (run total) --",
             "-- serving (continuous batching) --",
+            "-- SLO / live windows --",
+            "-- SLO / live windows (/metrics)",
             "-- bench line:",
             "-- serving (continuous batching) (bench) --",
             "-- request attribution (phase means, ms) (bench) --",
@@ -1084,13 +1268,18 @@ def _selftest():
             "-- span attribution (host wall decomposition) --",
         )
         missing = [m for m in needed if m not in report]
+        # the run_end live snapshot's SLO state must land in the text
+        slo_ok = ("breaches: 1" in report
+                  and "ttft_ms 25 ms" in report
+                  and "replica 0" in report and "(75%)" in report)
         # the slowest journey must lead the requests table
         order_ok = report.find("r2") < report.find("r1") \
             or "r2" not in report
-        if missing or not order_ok:
+        if missing or not order_ok or not slo_ok:
             print(report)
             print(f"selftest FAILED: missing={missing} "
-                  f"order_ok={order_ok}", file=sys.stderr)
+                  f"order_ok={order_ok} slo_ok={slo_ok}",
+                  file=sys.stderr)
             return 1
         print(f"monitor_report selftest ok "
               f"({len(report.splitlines())} lines, "
@@ -1118,6 +1307,10 @@ def main(argv=None):
     ap.add_argument("--bench", default=None, metavar="LOG",
                     help="bench log/JSON line: render its guard verdict "
                          "and memory sub-object next to the run")
+    ap.add_argument("--metrics", default=None, metavar="FILE",
+                    help="saved /metrics OpenMetrics exposition "
+                         "(monitor/exporter.py): render its SLO/live "
+                         "view incl. per-replica dispatch share")
     ap.add_argument("--selftest", action="store_true",
                     help="render a synthesized run and assert every "
                          "section appears (tier-1 smoke; no jsonl needed)")
@@ -1127,7 +1320,8 @@ def main(argv=None):
     if args.jsonl is None:
         ap.error("jsonl is required (or pass --selftest)")
     report = render(args.jsonl, trace_path=args.trace, top=args.top,
-                    spans=args.spans, bench_path=args.bench)
+                    spans=args.spans, bench_path=args.bench,
+                    metrics_path=args.metrics)
     print(report)
     return report
 

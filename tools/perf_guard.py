@@ -58,6 +58,15 @@ and exits nonzero with a human-readable verdict when the run regressed:
   cost model must not flip production sharding without a human reading
   this verdict. Missing baselines, missing plan fields, other
   topologies, and CPU smokes skip the check
+- a fresh SLO breach (``--slo-breach``): a fresh hardware line whose
+  ``slo`` sub-object (``serving_bench`` with the live telemetry plane
+  armed — docs/OBSERVABILITY.md) reports ``breaches > 0`` when the
+  last-good record's ``extra.slo`` had zero — the burn-rate watchdog
+  fired on a trace that used to meet its ``PT_SLO_*`` targets. The
+  target values are sweep-config keys, so lines judged against
+  different targets never cross-compare; lines or baselines without
+  the sub-object (live plane off, pre-SLO records) skip, CPU smokes
+  skip with the rest
 - a new compiled-program audit finding (``--audit``): a fresh hardware
   line whose ``program_audit`` sub-object (``analysis/program_audit.py``,
   armed by ``PT_PROGRAM_AUDIT=1``) reports a (rule, label) finding
@@ -171,6 +180,15 @@ DEFAULT_THRESHOLDS = {
     # CPU smokes and baselines without the sub-object skip, matching the
     # --ttft-growth convention
     "audit": True,
+    # SLO-breach gate (--slo-breach / --no-slo-breach): a fresh
+    # hardware line whose slo sub-object (serving_bench with the live
+    # plane armed) counts breaches > 0 fails when the last-good record
+    # breached zero times at the SAME PT_SLO_* targets — a latency
+    # regression crossed the burn-rate watchdog's line, not just a
+    # percentile wiggle. Both-sides-have-the-sub-object required;
+    # baselines that already breached ride forward (fixing the SLO is
+    # a separate act from regressing it)
+    "slo_breach": True,
 }
 
 
@@ -232,7 +250,7 @@ CONFIG_KEYS = ("batch", "seq", "ce_chunk",
                "requests", "arrival_rate_per_s", "lanes", "block_size",
                "int8_weights", "kv_int8", "devices", "pp",
                "shared_prefix_tokens", "prefix_cache", "spec", "spec_k",
-               "replicas")
+               "replicas", "slo_ttft_ms_p99", "slo_tpot_ms_p99")
 
 # keys whose ABSENCE from an old record means the knob's default, not a
 # wildcard: records persisted before the prefix cache existed WERE
@@ -255,7 +273,10 @@ CONFIG_KEYS = ("batch", "seq", "ce_chunk",
 # would cross-compare different byte models
 CONFIG_KEY_DEFAULTS = {"shared_prefix_tokens": 0, "prefix_cache": True,
                        "spec": False, "spec_k": 0, "pp": 1,
-                       "replicas": 1, "kv_int8": False}
+                       "replicas": 1, "kv_int8": False,
+                       # absent = no SLO target armed (pre-live-plane
+                       # records and target-off runs are the same config)
+                       "slo_ttft_ms_p99": None, "slo_tpot_ms_p99": None}
 
 
 def config_match(fresh: dict) -> dict:
@@ -528,6 +549,24 @@ def evaluate(fresh: dict, baseline: dict | None, thresholds: dict | None
                        f"[{f.get('label')}]" for f in new)
                    + " — a program invariant broke since the baseline "
                      "(see analysis/program_audit.py)"))
+        slo = fresh.get("slo")
+        base_slo = (baseline.get("extra") or {}).get("slo")
+        if (th.get("slo_breach") and isinstance(slo, dict)
+                and isinstance(base_slo, dict)):
+            # config-key matching already pinned the PT_SLO_* targets,
+            # so both sides judged the same line in the sand; a
+            # baseline that already breached rides forward (fixing an
+            # SLO is a separate act from regressing into one)
+            breaches = int(slo.get("breaches") or 0)
+            base_breaches = int(base_slo.get("breaches") or 0)
+            regressed = breaches > 0 and base_breaches == 0
+            check("slo_breach", not regressed,
+                  (f"{breaches} breach(es), last-good had "
+                   f"{base_breaches}"
+                   + (" — the burn-rate watchdog fired on a trace that "
+                      "used to meet its SLO targets (worst burn "
+                      f"{slo.get('worst_burn')}; see "
+                      "docs/OBSERVABILITY.md)" if regressed else "")))
         kern = fresh.get("kernels")
         base_kern = (baseline.get("extra") or {}).get("kernels")
         if kern is not None and base_kern:
@@ -672,6 +711,16 @@ def main(argv=None) -> int:
                          "either side lacks the sub-object)")
     ap.add_argument("--no-audit", dest="audit", action="store_false",
                     help="disable the program-audit gate")
+    ap.add_argument("--slo-breach", dest="slo_breach",
+                    action="store_true", default=True,
+                    help="fail a hardware line whose slo sub-object "
+                         "counts breaches when the last-good record "
+                         "(same PT_SLO_* targets) breached zero times "
+                         "(default on; skips when either side lacks "
+                         "the sub-object)")
+    ap.add_argument("--no-slo-breach", dest="slo_breach",
+                    action="store_false",
+                    help="disable the SLO-breach gate")
     ap.add_argument("--require-baseline", action="store_true",
                     help="fail when the store has no last-good hardware "
                          "record for the metric")
@@ -709,7 +758,8 @@ def main(argv=None) -> int:
                     "save_cost_growth": args.save_cost_growth,
                     "save_cost_slack_ms": args.save_cost_slack_ms,
                     "plan_drift": args.plan_drift,
-                    "audit": args.audit},
+                    "audit": args.audit,
+                    "slo_breach": args.slo_breach},
         hardware=hardware)
     if args.require_baseline and baseline is None:
         verdict["ok"] = False

@@ -237,7 +237,44 @@ def _router_leg(args) -> int:
         return real_step()
 
     eng.step = flaky_step
-    outs = router.run()
+
+    # live telemetry plane over the drain (docs/OBSERVABILITY.md): an
+    # in-process /metrics endpoint on an ephemeral port, polled through
+    # the kill — the healthz gate below requires the dead replica to be
+    # visible in /healthz within the same driving step that killed it
+    import urllib.request
+
+    from paddle_tpu.monitor import exporter as _exporter
+
+    port = _exporter.start(0)
+
+    def _healthz():
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            return json.loads(r.read().decode())
+
+    healthz_ok = port is not None
+    healthz_err = None if port else "exporter failed to start"
+    killed_step = dead_reported_step = None
+    steps_driven = 0
+    last_health = None
+    while router.has_work():
+        router.step()
+        steps_driven += 1
+        if killed_step is None and calls["n"] > kill_at:
+            killed_step = steps_driven
+        if port:
+            try:
+                last_health = _healthz()
+            except (OSError, ValueError) as e:
+                healthz_ok, healthz_err = False, f"scrape failed: {e}"
+                port = None
+                continue
+            if (last_health.get("dead_replicas")
+                    and dead_reported_step is None):
+                dead_reported_step = steps_driven
+    outs = router.pop_finished()
+    _exporter.stop()
     wall = time.perf_counter() - t0
 
     checks = []
@@ -271,6 +308,12 @@ def _router_leg(args) -> int:
     except ValueError as e:
         bb_detail = f"unparseable: {e}"
     check("blackbox", bb_ok, bb_detail)
+    check("healthz", healthz_ok and killed_step is not None
+          and dead_reported_step == killed_step,
+          healthz_err or (f"dead replica visible in /healthz at step "
+                          f"{dead_reported_step} (killed at step "
+                          f"{killed_step}); degraded="
+                          f"{(last_health or {}).get('degraded')}"))
 
     line = {
         "metric": "soak_router",
@@ -281,6 +324,8 @@ def _router_leg(args) -> int:
         "kill_at": kill_at,
         "redispatched": c["redispatches"],
         "dispatches_per_replica": list(router.dispatch_counts),
+        "killed_step": killed_step,
+        "dead_reported_step": dead_reported_step,
         "wall_s": round(wall, 3),
         "checks": [{k: ch[k] for k in ("name", "ok")} for ch in checks],
     }
