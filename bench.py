@@ -275,8 +275,26 @@ def main():
         stepper = AsyncStepper(step, max_in_flight=int(
             os.environ.get("PT_BENCH_ASYNC_DEPTH", "2")))
 
+    # goodput ledger over the whole bench (warmup + timed loop): the
+    # line then says where the wall went — XLA compiles land in the
+    # `compile` bucket via the TrainStep slot, everything outside the
+    # bracketed step calls is `other` (monitor/goodput.py)
+    from paddle_tpu.monitor import goodput as _gp
+
+    gled = None
+    if os.environ.get("PT_GOODPUT", "1") not in ("", "0"):
+        _gp.reset_run()
+        gled = _gp.Ledger()
+        _gp.activate(gled)
+
     for _ in range(warmup):
-        float(step(ids, labels).numpy())  # host transfer = real sync
+        if gled is not None:
+            gled.enter("productive_step")
+        try:
+            float(step(ids, labels).numpy())  # host transfer = real sync
+        finally:
+            if gled is not None:
+                gled.exit()
     # post-warmup retrace baseline + live watchpoint: a retrace INSIDE the
     # timed loop means the throughput number includes an XLA compile — the
     # warning fires mid-run (tools/perf_guard.py re-checks it post-hoc)
@@ -298,16 +316,25 @@ def main():
     t0 = time.perf_counter()
     for _ in range(steps):
         t_h = time.perf_counter()
+        if gled is not None:
+            gled.enter("productive_step")
         loss = stepper(ids, labels)
         if _ASYNC_MODE == "sync":
             float(loss.numpy())  # per-step host round-trip (the baseline)
+        if gled is not None:
+            gled.exit()
         host_blocked += time.perf_counter() - t_h
         if slog is not None:
             slog.log_step(num_samples=batch * seq)
     if _ASYNC_MODE == "async":
         t_h = time.perf_counter()
         stepper.drain()
-        host_blocked += time.perf_counter() - t_h
+        dt_drain = time.perf_counter() - t_h
+        host_blocked += dt_drain
+        if gled is not None:
+            # the drain finishes dispatched steps: productive wall,
+            # charged without bumping the ledger's step count
+            gled.charge("productive_step", dt_drain)
     final_loss = float(loss.numpy())  # chained through params: syncs all
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss)
@@ -326,6 +353,12 @@ def main():
         "ce_chunk": model.config.ce_chunk_size}
     if _ASYNC_MODE == "async":
         extra["async_depth"] = stepper.max_in_flight
+    if gled is not None:
+        # wall-clock classification for the whole bench run (exact
+        # telescoping; tools/perf_guard.py --goodput-drop gates the frac)
+        gsnap = gled.snapshot()
+        extra["goodput"] = gsnap
+        extra["goodput_frac"] = round(gsnap["goodput_frac"], 4)
     if tpu_note:
         extra["note"] = tpu_note
         extra["see"] = "PERF.md records any TPU numbers measured earlier"
@@ -413,6 +446,8 @@ def main():
             rec_extra["peak_hbm_gib"] = mem_obj["peak_hbm_gib"]
         if program_audit is not None:
             rec_extra["program_audit"] = program_audit
+        if extra.get("goodput_frac") is not None:
+            rec_extra["goodput_frac"] = extra["goodput_frac"]
         try:
             _meas.record(_METRIC, round(tokens_per_sec, 2), "tokens/s",
                          extra=rec_extra)
@@ -519,6 +554,9 @@ def main():
                    tokens_per_sec=round(tokens_per_sec, 2),
                    host_blocked_ms_per_step=extra["host_blocked_ms_per_step"],
                    memory=mem_obj, guard=extra.get("guard"))
+    if gled is not None:
+        # after slog.close: the run_end line reads the active ledger
+        _gp.deactivate(gled)
     _emit(round(tokens_per_sec, 2), round(mfu / 0.45, 4), **extra)
 
 

@@ -89,7 +89,8 @@ DETERMINISM_SCOPE = (
     "tools/flash_autotune.py",
 )
 
-_SLOT_NAMES = ("_monitor", "_spans", "_nancheck", "_audit", "_live")
+_SLOT_NAMES = ("_monitor", "_spans", "_nancheck", "_audit", "_live",
+               "_goodput")
 
 _DISABLE_RE = re.compile(r"#\s*ptlint:\s*disable(?:=([A-Z0-9, ]+))?")
 _SKIP_FILE_RE = re.compile(r"#\s*ptlint:\s*skip-file")

@@ -66,6 +66,15 @@ def _spawn(args, rank, nprocs, master, restarts=0):
         env["TPU_VISIBLE_DEVICES"] = args.devices
     if args.shard_plan is not None:
         env["PT_SHARD_PLAN"] = os.path.abspath(args.shard_plan)
+    # fleet telemetry (docs/OBSERVABILITY.md "Training goodput plane"):
+    # every worker heartbeats into one launcher-owned directory the
+    # babysit loop tails; a launcher that holds PT_METRICS_PORT moves
+    # workers to ephemeral ports (each reports its bound port in the
+    # heartbeat line — the launcher serves the aggregate)
+    env.setdefault("PT_HEARTBEAT_DIR", os.path.join(
+        os.path.abspath(args.log_dir), "heartbeats"))
+    if os.environ.get("PT_METRICS_PORT"):
+        env["PT_METRICS_PORT"] = "0"
     os.makedirs(args.log_dir, exist_ok=True)
     log = open(os.path.join(args.log_dir,
                             f"workerlog.{rank}"), "ab", buffering=0)
@@ -73,6 +82,33 @@ def _spawn(args, rank, nprocs, master, restarts=0):
            + args.training_script_args)
     proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=subprocess.STDOUT)
     return proc, log
+
+
+def _arm_fleet(args, nprocs):
+    """Launcher-side fleet telemetry: a FleetMonitor tailing every
+    worker's heartbeat JSONL (straggler / dp-desync / silent-worker
+    detectors, exact sketch merges) plus — when the launcher holds
+    PT_METRICS_PORT — an aggregated /metrics + /statusz endpoint, its
+    bound port written to ``{log_dir}/metrics_port``. Soft-fails:
+    babysitting must survive any telemetry error."""
+    try:
+        from ...monitor import exporter
+        from ...monitor import heartbeat as _hb
+
+        hb_dir = os.environ.get("PT_HEARTBEAT_DIR") or os.path.join(
+            os.path.abspath(args.log_dir), "heartbeats")
+        fleet = _hb.FleetMonitor(hb_dir, nprocs, log_dir=args.log_dir)
+        fleet.attach()
+        if os.environ.get("PT_METRICS_PORT"):
+            port = exporter.start()
+            if port:
+                with open(os.path.join(args.log_dir,
+                                       "metrics_port"), "w") as f:
+                    f.write(f"{port}\n")
+        return fleet
+    except Exception as e:  # noqa: BLE001 — telemetry never kills launch
+        print(f"launch: fleet telemetry unavailable: {e}", file=sys.stderr)
+        return None
 
 
 def main():
@@ -115,11 +151,18 @@ def main():
         procs = [_spawn(args, r, nprocs, master, restarts)
                  for r in range(nprocs)]
 
+    fleet = None
     try:
         for r in range(nprocs):
             procs.append(_spawn(args, r, nprocs, master))
+        fleet = _arm_fleet(args, nprocs)
         members = set(manager.alive_nodes()) if manager else None
         while True:
+            if fleet is not None:
+                try:
+                    fleet.poll()
+                except Exception:  # noqa: BLE001 — babysit loop wins
+                    pass
             states = [p.poll() for p, _ in procs]
             if all(s is not None for s in states):
                 bad = [s for s in states if s != 0]
@@ -151,6 +194,13 @@ def main():
                 _relaunch_pod()
             time.sleep(0.5)
     finally:
+        if fleet is not None:
+            # terminal poll: the final fleet.json snapshot (and any
+            # just-landed verdict) survives the launcher's exit
+            try:
+                fleet.poll()
+            except Exception:  # noqa: BLE001
+                pass
         if manager is not None:
             manager.exit()
         for p, log in procs:

@@ -25,7 +25,11 @@ from ..framework.io import load as _load, save as _save
 from ..io.reader import DataLoader
 from ..jit.train_step import AsyncStepper, TrainStep
 from ..monitor import _register as _monitor_register
+from ..monitor import blackbox as _blackbox
+from ..monitor import goodput as _gp
+from ..monitor import heartbeat as _heartbeat
 from ..monitor import memory as _memory
+from ..monitor import watchdog as _watchdog
 from ..monitor.numerics import NonFiniteError as _NonFiniteError
 
 # Telemetry slots (see paddle_tpu.monitor): None unless PT_MONITOR wired
@@ -158,6 +162,45 @@ def _materialize_logs(logs):
         for k, f in zip(todo, vals):
             out[k] = f
     return out
+
+
+class _TrainState:
+    """fit's blackbox state provider: what a crash/hang postmortem sees
+    of the training loop — step, last materialized loss, the goodput
+    ledger snapshot, and the async pipeline's in-flight depth. Registered
+    per-fit as a bound method so the recorder's WeakMethod lets it die
+    with the run (monitor/blackbox.py)."""
+
+    __slots__ = ("_stepper", "_ledger", "step", "loss", "__weakref__")
+
+    def __init__(self, stepper, ledger):
+        self._stepper = stepper
+        self._ledger = ledger
+        self.step = 0
+        self.loss = None
+
+    def state(self):
+        out = {"step": self.step, "last_loss": self.loss,
+               "in_flight": self._stepper.in_flight}
+        if self._ledger is not None:
+            out["goodput"] = self._ledger.snapshot()
+        return out
+
+
+def _input_wait_iter(ledger, it):
+    """Bracket each batch fetch as goodput ``input_wait``: blocking in
+    the data iterator (loader compute, prefetch starvation) lands in its
+    own bucket instead of inflating the step or ``other`` residual."""
+    it = iter(it)
+    while True:
+        ledger.enter("input_wait")
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        finally:
+            ledger.exit()
+        yield item
 
 
 class Model:
@@ -304,6 +347,16 @@ class Model:
         reshards into the NEW plan's placements on load, so the saved
         (dp×mp) need not match."""
         assert self._train_step is not None, "call prepare() first"
+        # training goodput plane (docs/OBSERVABILITY.md): one wall-clock
+        # ledger per run, created before any setup so plan-apply/restore
+        # time is inside the wall. PT_GOODPUT=0 opts out entirely (and
+        # stands down the hang watchdog, whose deadline has no EMA
+        # source without fit feeding it). Armed — slots wired, watchdog
+        # started — only after setup can no longer raise outside the
+        # teardown paths below.
+        ledger = (_gp.Ledger()
+                  if os.environ.get("PT_GOODPUT", "1") not in ("", "0")
+                  else None)
         if shard_plan is None:
             shard_plan = os.environ.get("PT_SHARD_PLAN") or None
         if resume_from is None:
@@ -375,9 +428,15 @@ class Model:
 
             crash = int(os.environ.get("PADDLE_RESTART_COUNT", "0")
                         or 0) > 0
-            scalars = _resume.restore_latest(
-                self.network, self._optimizer, resume_from,
-                train_step=self._train_step, crash_resume=crash)
+            if ledger is not None:
+                ledger.enter("restore_resume")
+            try:
+                scalars = _resume.restore_latest(
+                    self.network, self._optimizer, resume_from,
+                    train_step=self._train_step, crash_resume=crash)
+            finally:
+                if ledger is not None:
+                    ledger.exit()
             if scalars is not None:
                 start_epoch = int(scalars.get("epoch", 0))
                 skip_batches = int(scalars.get("batch_in_epoch", 0))
@@ -443,6 +502,34 @@ class Model:
         # loop position for the terminal checkpoint: (next epoch, next
         # batch) a resume of this run would execute
         pos = (start_epoch, skip_batches)
+        # arm the goodput plane: activate the ledger (wiring the
+        # module `_goodput` slots), start the hang watchdog, open the
+        # fleet heartbeat when a launcher stamped PT_HEARTBEAT_DIR, and
+        # join the blackbox as the training state provider. Teardown
+        # runs on BOTH exits, after the MonitorCallback's run_end line
+        # (which reads the still-active ledger).
+        _gp.reset_run()
+        tstate = _TrainState(stepper, ledger)
+        _blackbox.register("training", tstate.state)
+        wdog = None
+        hb = None
+        if ledger is not None:
+            _gp.activate(ledger)
+            wdog = _watchdog.Watchdog().start()
+        hb_dir = os.environ.get("PT_HEARTBEAT_DIR")
+        if hb_dir:
+            try:
+                hb = _heartbeat.HeartbeatWriter(hb_dir)
+            except OSError:
+                hb = None  # telemetry must never kill training
+
+        def _goodput_teardown():
+            if wdog is not None:
+                wdog.stop()
+            if hb is not None:
+                hb.close()
+            if ledger is not None:
+                _gp.deactivate(ledger)
         try:
             for epoch in range(start_epoch, epochs):
                 cbks.on_epoch_begin(epoch)
@@ -468,6 +555,8 @@ class Model:
                     prefetch = DevicePrefetchIterator(
                         data_src, depth=device_prefetch)
                     epoch_iter = enumerate(prefetch, start=skip_now)
+                if ledger is not None:
+                    epoch_iter = _input_wait_iter(ledger, epoch_iter)
                 try:
                     for step, batch in epoch_iter:
                         cbks.on_train_batch_begin(step)
@@ -476,9 +565,16 @@ class Model:
                         tensors = _to_tensor_list(batch)
                         if shard_batch is not None:
                             tensors = [shard_batch(t) for t in tensors]
+                        t_step = time.perf_counter()
+                        if ledger is not None:
+                            ledger.enter("productive_step")
                         try:
                             loss = stepper(*tensors)
                         except _NonFiniteError as e:
+                            if ledger is not None:
+                                # dispatch + sentinel replay that ended
+                                # in a drop: not productive wall-clock
+                                ledger.exit("nan_replay_or_skip")
                             if policy is None:
                                 raise
                             # skip-and-continue: the sentinel raised
@@ -497,9 +593,18 @@ class Model:
                             if num_iters is not None and it >= num_iters:
                                 break
                             continue
+                        if ledger is not None:
+                            ledger.exit()
                         if policy is not None:
                             policy.record_success()
                         global_step += 1
+                        step_ms = (time.perf_counter() - t_step) * 1e3
+                        tstate.step = global_step
+                        if ledger is not None:
+                            # the shared step-time EMA (watchdog deadline,
+                            # ckpt cadence, monitor/step_ms_ema gauge);
+                            # StepLogger feeds it when no ledger is active
+                            _gp.observe_step_ms(step_ms, step=global_step)
                         # lazy between windows; number-like (counted,
                         # sync-on-read) if a user callback touches it
                         logs = {"loss": _LazyLoss(loss)}
@@ -507,6 +612,22 @@ class Model:
                             # the window's one host sync — aligned with
                             # ProgBarLogger's print cadence
                             logs = _materialize_logs(logs)
+                        lv = logs.get("loss")
+                        cur_loss = (float(lv)
+                                    if isinstance(lv, (int, float))
+                                    else None)
+                        if cur_loss is not None:
+                            tstate.loss = cur_loss
+                        if hb is not None:
+                            # fleet heartbeat: loss only on materialized
+                            # windows (never force a host sync for
+                            # telemetry) — windows align across ranks,
+                            # so the launcher's desync detector compares
+                            # same-step losses
+                            hb.beat(global_step, loss=cur_loss,
+                                    step_ms=step_ms,
+                                    buckets=ledger.snapshot()["buckets"]
+                                    if ledger is not None else None)
                         cbks.on_train_batch_end(step, logs)
                         pos = (epoch, step + 1)
                         if mgr is not None:
@@ -528,7 +649,14 @@ class Model:
                     if prefetch is not None:
                         prefetch.close()
                 # exact final metrics: fence the pipeline, then one sync
+                t_drain = time.perf_counter()
                 stepper.drain()
+                if ledger is not None:
+                    # the drain wait finishes already-dispatched steps —
+                    # productive wall, charged without bumping the step
+                    # count (charge() never increments `steps`)
+                    ledger.charge("productive_step",
+                                  time.perf_counter() - t_drain)
                 logs = _materialize_logs(logs)
                 led = _memory._ledger
                 if led is not None:
@@ -576,12 +704,18 @@ class Model:
                 except Exception:  # noqa: BLE001 — original error wins
                     pass
             cbks.on_train_error(f"{type(e).__name__}: {e}")
+            # after on_train_error: the crashed run's run_end line (and
+            # its blackbox dump) read the still-active ledger above
+            _goodput_teardown()
             raise
         finally:
             # per-fit override only: later fits follow the global state
             # again unless they pass their own nan_check
             self._train_step._nan_check = prev_nan_check
         cbks.on_train_end()
+        # after on_train_end: MonitorCallback's run_end carries
+        # `goodput` only while the ledger is still active
+        _goodput_teardown()
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None):

@@ -37,10 +37,14 @@ from ..monitor import numerics as _numerics
 # dispatch vs trace+compile, donation rebinds, AsyncStepper fence waits.
 # `_nancheck` is the numerics sentinel's slot (monitor/numerics.py):
 # None unless PT_NANCHECK armed it — per-instance `nan_check=True`
-# overrides it without touching the global slot.
+# overrides it without touching the global slot. `_goodput` is armed
+# only while a fit() goodput ledger is active (monitor/goodput.py):
+# it retro-charges fresh-signature compile time out of the enclosing
+# productive_step bucket.
 _monitor = None
 _spans = None
 _nancheck = None
+_goodput = None
 
 
 class TrainStep:
@@ -408,11 +412,16 @@ class TrainStep:
     def __call__(self, *batch):
         m = _monitor
         sp = _spans
+        g = _goodput
         # span clock starts BEFORE _get_compiled: a fresh signature pays
         # trace + XLA compile (or a cache-tier load) inside it, and that
-        # cost belongs to this call's compile span, not "other"
-        t_dispatch = time.perf_counter() if sp is not None else None
+        # cost belongs to this call's compile span (and the goodput
+        # ledger's compile bucket), not "other"
+        t_dispatch = (time.perf_counter()
+                      if sp is not None or g is not None else None)
         fn, arrays, nan_check = self._get_compiled(batch)
+        if g is not None and self._retraced:
+            g.charge("compile", time.perf_counter() - t_dispatch)
         lr = self._opt.get_lr()
         self._step_count += 1
         place = self._place

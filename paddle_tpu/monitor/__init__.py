@@ -41,6 +41,7 @@ __all__ = [
     "SpanRecorder", "spans", "record_span", "span_events", "export_spans",
     "watchpoint", "clear_watchpoints",
     "memory", "numerics", "live", "exporter", "INSTRUMENTED_MODULES",
+    "goodput", "watchdog", "heartbeat",
 ]
 
 # The canonical audit list for the zero-overhead contract: every module
@@ -69,6 +70,9 @@ INSTRUMENTED_MODULES = (
     "paddle_tpu.autoshard.planner",
     "paddle_tpu.analysis.program_audit",
     "paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.pp_layers",
+    "paddle_tpu.monitor.goodput",
+    "paddle_tpu.monitor.watchdog",
+    "paddle_tpu.monitor.heartbeat",
 )
 
 _registry = Registry()
@@ -400,6 +404,10 @@ def _register(mod) -> None:
         mod._spans = _span_recorder if _enabled else None
     if hasattr(mod, "_live"):
         mod._live = live if live.enabled() else None
+    if hasattr(mod, "_goodput"):
+        from . import goodput
+
+        mod._goodput = goodput._slot_value()
 
 
 # -- site callbacks (invoked ONLY while enabled) -----------------------------
@@ -760,6 +768,9 @@ from . import numerics  # noqa: E402  — first-bad-step NaN isolation
 from . import live  # noqa: E402  — streaming SLO sketches (must precede
 #                                   _register calls so `_live` slots wire)
 from . import exporter  # noqa: E402  — /metrics+/healthz+/statusz endpoint
+from . import goodput  # noqa: E402  — wall-clock goodput ledger
+from . import watchdog  # noqa: E402  — hang watchdog (step-deadline)
+from . import heartbeat  # noqa: E402  — launcher fleet heartbeat plane
 from .step_logger import StepLogger  # noqa: E402,F401
 
 # PT_MONITOR=1 enables at import, before any instrumented module registers

@@ -143,6 +143,19 @@ def render_metrics() -> str:
                     lines.append(
                         f'pt_slo_burn_rate{{metric="{m}",window="{window}"}} '
                         f"{_fmt(slo['last_burn'][m][window])}")
+
+        # training goodput plane (monitor/goodput.py): the active
+        # ledger's bucket account, rendered only while a run is live
+        from . import goodput
+
+        gsnap = goodput.active_snapshot()
+        if gsnap is not None:
+            lines.append("# TYPE pt_goodput_seconds gauge")
+            for b in goodput.BUCKETS:
+                lines.append(f'pt_goodput_seconds{{bucket="{b}"}} '
+                             f"{_fmt(gsnap['buckets'][b])}")
+            lines.append("# TYPE pt_goodput_frac gauge")
+            lines.append(f"pt_goodput_frac {_fmt(gsnap['goodput_frac'])}")
         lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
@@ -152,6 +165,7 @@ def health() -> dict:
     and the last blackbox postmortem pointer."""
     from . import enabled as _monitor_enabled
     from . import blackbox
+    from . import watchdog
 
     replicas = []
     for label, state in live.collect_status():
@@ -159,7 +173,7 @@ def health() -> dict:
                                                   list):
             replicas.extend(state["replicas"])
     dead = [r.get("replica") for r in replicas if r.get("dead")]
-    return {
+    out = {
         "ok": True,
         "degraded": bool(dead),
         "monitor_enabled": bool(_monitor_enabled()),
@@ -169,6 +183,16 @@ def health() -> dict:
         "dead_replicas": dead,
         "last_blackbox": blackbox.last_dump_path(),
     }
+    # training liveness (monitor/watchdog.py): lets a soak gate poll
+    # training health the way --router polls replica health
+    wd = watchdog.state()
+    if wd:
+        out["last_step_age_s"] = wd.get("last_step_age_s")
+        out["hung"] = bool(wd.get("hung"))
+        out["training"] = wd
+        if out["hung"]:
+            out["degraded"] = True
+    return out
 
 
 def render_statusz() -> str:
@@ -190,11 +214,27 @@ def render_statusz() -> str:
             out.append(f"  {name}: count={s['count']} p50={s['p50']} "
                        f"p90={s['p90']} p99={s['p99']}")
         out.append("")
+        from . import goodput
+
+        ema = goodput.step_ms_ema()
+        if ema is not None:
+            out.append(f"step_ms_ema: {round(ema, 3)} ms")
+        gsnap = goodput.active_snapshot()
+        if gsnap is not None:
+            out.append(f"goodput: frac={round(gsnap['goodput_frac'], 4)} "
+                       f"wall_s={round(gsnap['wall_s'], 3)} "
+                       f"steps={gsnap['steps']}")
+            out.append("  " + " ".join(
+                f"{b}={round(gsnap['buckets'][b], 3)}"
+                for b in goodput.BUCKETS))
+        if ema is not None or gsnap is not None:
+            out.append("")
         snap = _monitor_snapshot()
         counters = snap.get("counters") or {}
         interesting = ("jit/exec_cache_hit", "jit/exec_cache_miss",
                        "jit/retraces", "serving/decoded_tokens",
-                       "serving/preemptions", "monitor/slo_breach")
+                       "serving/preemptions", "monitor/slo_breach",
+                       "monitor/hang_trips")
         out.append("monitor counters (selected):")
         for name in interesting:
             if name in counters:

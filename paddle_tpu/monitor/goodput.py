@@ -1,0 +1,303 @@
+"""Wall-clock goodput ledger: where did this training run's time go?
+
+The training-side sibling of the serving attribution plane
+(docs/OBSERVABILITY.md "Training goodput plane"). Every `fit()` run
+owns one :class:`Ledger` that classifies the run's wall-clock into
+telescoping buckets:
+
+    productive_step          the stepper call itself (dispatch + any
+                             bound-wait the async window forced)
+    compile                  trace + XLA compile on fresh signatures
+                             (retro-charged out of the enclosing step
+                             by the TrainStep `_goodput` bracket)
+    checkpoint_save_blocking the CheckpointManager's measured quiesce +
+                             snapshot cost (async file I/O excluded —
+                             it overlaps training)
+    nan_replay_or_skip       a sentinel-failed step: the replay that
+                             isolated the bad leaf plus the discarded
+                             dispatch (the step never happened)
+    restore_resume           `resume_from` restore + reshard-on-load
+    input_wait               blocking in the data iterator (prefetch
+                             starvation surfaces here)
+    other                    the residual — callbacks, logging, host
+                             bookkeeping
+
+Invariant (the PR 16 convention): ``sum(buckets.values()) == wall_s``
+EXACTLY — ``other`` is computed as the residual against measured wall
+and ``wall_s`` is re-derived as the canonical-order sum, so the
+equality is exact in float, not approximate. Negative float dust is
+folded into the largest named bucket.
+
+Always-on under the standing None-slot contract: the ledger itself is
+plain clock arithmetic (no monitor callables, no device syncs — proven
+byte-identical to an unledgered run by tests/test_goodput.py), while
+remote brackets (TrainStep compile, CheckpointManager save) ride
+``_goodput`` module slots that are ``None`` unless a ledger is active
+— :func:`activate` arms them, :func:`deactivate` disarms. ``PT_GOODPUT=0``
+keeps `fit()` from creating a ledger at all.
+
+This module also owns the ONE shared step-time EMA (satellite: the
+hang watchdog and the checkpoint cadence planner both used to compute
+it privately): :func:`observe_step_ms` feeds it (and the
+``monitor/step_ms_ema`` gauge while the monitor is enabled);
+:func:`step_ms_ema` / :func:`last_step_info` read it.
+"""
+from __future__ import annotations
+
+import math
+import sys
+import threading
+import time
+
+__all__ = [
+    "BUCKETS", "Ledger", "activate", "deactivate", "active",
+    "active_snapshot", "enter", "exit", "charge",
+    "observe_step_ms", "step_ms_ema", "last_step_info", "reset_run",
+]
+
+BUCKETS = (
+    "productive_step",
+    "compile",
+    "checkpoint_save_blocking",
+    "nan_replay_or_skip",
+    "restore_resume",
+    "input_wait",
+    "other",
+)
+
+# None-slot contract: the gauge emission below is the only monitor
+# callable this module ever invokes, and only while enabled.
+_monitor = None
+
+_EMA_ALPHA = 0.2  # matches the ckpt cadence planner's historical EMA
+
+
+class _Frame:
+    __slots__ = ("bucket", "mark", "displaced")
+
+    def __init__(self, bucket: str, mark: float):
+        self.bucket = bucket
+        self.mark = mark
+        # seconds retro-charged to OTHER buckets while this frame was
+        # open (TrainStep's compile bracket) — subtracted at exit so
+        # the telescoping stays exact
+        self.displaced = 0.0
+
+
+class Ledger:
+    """One run's wall-clock account. Thread-safe: the hang watchdog
+    reads :meth:`current_bucket` / :meth:`snapshot` from its daemon
+    thread while the fit loop charges."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._seconds = {b: 0.0 for b in BUCKETS[:-1]}
+        self._stack: list[_Frame] = []
+        self._steps = 0
+        self._nan_steps = 0
+
+    # -- charging -----------------------------------------------------
+
+    def enter(self, bucket: str) -> None:
+        """Open ``bucket``; time accrues to it until :meth:`exit`."""
+        if bucket not in self._seconds:
+            raise ValueError(f"unknown goodput bucket {bucket!r} "
+                             f"(named buckets: {BUCKETS[:-1]})")
+        with self._lock:
+            self._stack.append(_Frame(bucket, time.perf_counter()))
+
+    def exit(self, bucket: str | None = None) -> float:
+        """Close the innermost open bucket and charge its exclusive
+        elapsed; ``bucket`` reclassifies the charge (the NaN-skip path
+        re-labels a failed productive_step). Returns the seconds
+        charged."""
+        with self._lock:
+            if not self._stack:
+                return 0.0
+            now = time.perf_counter()
+            f = self._stack.pop()
+            dt = max(0.0, now - f.mark - f.displaced)
+            b = bucket if bucket is not None else f.bucket
+            self._seconds[b] += dt
+            if b == "productive_step":
+                self._steps += 1
+            elif b == "nan_replay_or_skip":
+                self._nan_steps += 1
+            if self._stack:
+                # the parent only keeps its exclusive time
+                self._stack[-1].displaced += now - f.mark
+            return dt
+
+    def charge(self, bucket: str, dt: float) -> None:
+        """Retro-charge ``dt`` seconds to ``bucket``, displacing the
+        currently open frame (the TrainStep compile bracket: the
+        compile happened *inside* the step's frame)."""
+        if dt <= 0.0 or bucket not in self._seconds:
+            return
+        with self._lock:
+            self._seconds[bucket] += dt
+            if self._stack:
+                self._stack[-1].displaced += dt
+
+    def current_bucket(self) -> str | None:
+        with self._lock:
+            return self._stack[-1].bucket if self._stack else None
+
+    # -- reading ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Bucket account at this instant. Open frames contribute their
+        exclusive elapsed-so-far; the invariant
+        ``sum(buckets.values()) == wall_s`` holds exactly."""
+        with self._lock:
+            now = time.perf_counter()
+            live = dict(self._seconds)
+            upper = now
+            for f in reversed(self._stack):  # innermost first
+                live[f.bucket] += max(0.0, upper - f.mark - f.displaced)
+                upper = f.mark
+            wall = now - self._t0
+            other = wall - math.fsum(live.values())
+            if other < 0.0:  # float dust: fold into the largest bucket
+                widest = max(live, key=live.get)
+                live[widest] += other
+                other = 0.0
+            buckets = {b: live[b] for b in BUCKETS[:-1]}
+            buckets["other"] = other
+            # wall_s is the canonical-order sum of the exact values we
+            # report, so the telescoping equality is exact in float
+            wall_s = 0.0
+            for b in BUCKETS:
+                wall_s += buckets[b]
+            return {
+                "wall_s": wall_s,
+                "buckets": buckets,
+                "goodput_frac": (buckets["productive_step"] / wall_s
+                                 if wall_s > 0.0 else 0.0),
+                "steps": self._steps,
+                "nan_steps": self._nan_steps,
+            }
+
+
+# -- active-ledger plumbing (the `_goodput` slot lifecycle) ----------------
+
+_lock = threading.Lock()
+_active: list = []  # stack of Ledgers; the top is the charge target
+
+
+def _slot_value():
+    """What a registering module's ``_goodput`` slot should hold right
+    now (consulted by ``monitor._register`` for late importers)."""
+    return sys.modules[__name__] if _active else None
+
+
+def _wire(on: bool) -> None:
+    import paddle_tpu.monitor as _m
+
+    val = sys.modules[__name__] if on else None
+    for mod in list(_m._SITES):
+        if hasattr(mod, "_goodput"):
+            mod._goodput = val
+
+
+def activate(ledger: Ledger) -> Ledger:
+    """Make ``ledger`` the charge target and arm every ``_goodput``
+    slot (sibling of ``live.enable()``'s arming walk)."""
+    with _lock:
+        _active.append(ledger)
+        _wire(True)
+    return ledger
+
+
+def deactivate(ledger: Ledger) -> None:
+    """Retire ``ledger``; the last deactivation disarms all slots back
+    to ``None`` (zero-overhead outside a run)."""
+    with _lock:
+        if ledger in _active:
+            _active.remove(ledger)
+        if not _active:
+            _wire(False)
+
+
+def active() -> Ledger | None:
+    return _active[-1] if _active else None
+
+
+def active_snapshot() -> dict | None:
+    led = active()
+    return led.snapshot() if led is not None else None
+
+
+# -- slot-facing module API (callers hold `_goodput`, already None-guarded)
+
+def enter(bucket: str) -> None:
+    led = active()
+    if led is not None:
+        led.enter(bucket)
+
+
+def exit(bucket: str | None = None) -> float:  # noqa: A001 — slot verb
+    led = active()
+    return led.exit(bucket) if led is not None else 0.0
+
+
+def charge(bucket: str, dt: float) -> None:
+    led = active()
+    if led is not None:
+        led.charge(bucket, dt)
+
+
+# -- shared step-time EMA (one source for watchdog + ckpt cadence) ---------
+
+_step_ema_ms: float | None = None
+_last_step_t: float | None = None
+_last_step_idx: int = 0
+_g_ema = None  # lazily created monitor/step_ms_ema gauge
+
+
+def reset_run() -> None:
+    """Forget the previous run's EMA / last-step markers (fit calls
+    this at run start so a fresh watchdog never judges stale age)."""
+    global _step_ema_ms, _last_step_t, _last_step_idx
+    with _lock:
+        _step_ema_ms = None
+        _last_step_t = None
+        _last_step_idx = 0
+
+
+def observe_step_ms(ms: float, step: int | None = None) -> None:
+    """One completed training step took ``ms`` wall milliseconds."""
+    global _step_ema_ms, _last_step_t, _last_step_idx, _g_ema
+    with _lock:
+        _step_ema_ms = (ms if _step_ema_ms is None
+                        else (1.0 - _EMA_ALPHA) * _step_ema_ms
+                        + _EMA_ALPHA * ms)
+        _last_step_t = time.perf_counter()
+        _last_step_idx = int(step) if step is not None else _last_step_idx + 1
+        ema = _step_ema_ms
+    m = _monitor
+    if m is not None:
+        if _g_ema is None:
+            _g_ema = m.gauge("monitor/step_ms_ema")
+        _g_ema.set(ema)
+
+
+def step_ms_ema() -> float | None:
+    return _step_ema_ms
+
+
+def last_step_info() -> dict:
+    """{"step": last completed step index, "age_s": seconds since it
+    landed (None before the first step)} — the watchdog's liveness
+    signal and /healthz's ``last_step_age_s``."""
+    t = _last_step_t
+    return {
+        "step": _last_step_idx,
+        "age_s": (time.perf_counter() - t) if t is not None else None,
+    }
+
+
+from . import _register as _monitor_register  # noqa: E402
+
+_monitor_register(sys.modules[__name__])

@@ -1,0 +1,483 @@
+"""Fleet heartbeat plane: cross-worker visibility for `distributed.launch`.
+
+Training-side workers (``fit()`` under a launcher that stamped
+``PT_HEARTBEAT_DIR``) append one JSONL line per completed step via
+:class:`HeartbeatWriter`:
+
+    {"rank": 0, "step": 12, "ts": <epoch s>, "loss": 2.31,
+     "step_ms": 4.8, "step_ms_sketch": {...cumulative QuantileSketch...},
+     "goodput": {...bucket seconds...}, "metrics_port": 43117}
+
+``loss`` appears only on steps where fit already materialized it (the
+deferred-sync contract — a heartbeat never forces a host round-trip);
+``step_ms_sketch`` is cumulative, so the newest line per rank replaces
+all older ones and the launcher's fleet merge is EXACT (the
+``monitor/live.QuantileSketch`` merge property).
+
+The launcher tails every worker's file through :class:`FleetMonitor`
+inside its babysit loop: per-rank ``fleet/...`` gauges (the exporter's
+replica-label convention renders them as ``{replica="<rank>"}``), an
+aggregated ``/statusz`` status provider, a ``fleet.json`` snapshot in
+the log dir, and three latched detectors —
+
+* **straggler**: at a step reported by ≥2 ranks, a rank whose
+  ``step_ms`` exceeds ``PT_STRAGGLER_FACTOR`` (3.0) × the fleet
+  median — the named rank latches (first offending step wins, ties by
+  rank: deterministic).
+* **dp desync**: same-step loss divergence across dp replicas beyond
+  ``PT_DESYNC_TOL`` (1e-3, relative) — the runtime sibling of PA001's
+  replicated-dp tripwire; names the extreme ranks.
+* **silent worker**: a rank whose newest heartbeat is older than
+  ``PT_HEARTBEAT_TIMEOUT`` (60 s) while a sibling still beats — the
+  launcher writes ``fleet_postmortem.rank<R>.json`` naming the victim.
+
+This file is loadable standalone (``tools/monitor_report.py`` loads it
+by path with no package context), so module-level imports are
+stdlib-only and in-package collaborators (live sketches, the metrics
+registry, the exporter) import lazily inside methods.
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+__all__ = [
+    "HeartbeatWriter", "FleetMonitor", "heartbeat_path",
+    "read_heartbeats", "detect_straggler", "detect_desync",
+    "detect_silent",
+]
+
+_monitor = None
+
+DEFAULT_STRAGGLER_FACTOR = 3.0
+DEFAULT_DESYNC_TOL = 1e-3
+DEFAULT_TIMEOUT_S = 60.0
+
+# detector step-history bound: ancient steps can never latch a fresh
+# verdict once this many newer ones exist, so memory stays flat on
+# long runs
+MAX_TRACKED_STEPS = 4096
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def heartbeat_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"heartbeat.{int(rank)}.jsonl")
+
+
+# -- worker side -------------------------------------------------------------
+
+class HeartbeatWriter:
+    """One per training worker; ``beat()`` is a single JSONL append
+    (line-buffered, no fsync — a torn tail is tolerated by every
+    reader)."""
+
+    def __init__(self, directory: str, rank: int | None = None):
+        self.rank = int(rank if rank is not None
+                        else os.environ.get("PADDLE_TRAINER_ID", "0"))
+        os.makedirs(directory, exist_ok=True)
+        self.path = heartbeat_path(directory, self.rank)
+        self._f = open(self.path, "a", buffering=1)
+        try:
+            from .live import QuantileSketch
+
+            self._sketch = QuantileSketch()
+        except ImportError:  # path-loaded (package-free) context
+            self._sketch = None
+        self._port = None
+        try:
+            from . import exporter
+
+            self._port = exporter.port()
+        except ImportError:
+            pass
+
+    def beat(self, step: int, loss=None, step_ms: float | None = None,
+             buckets: dict | None = None) -> None:
+        line: dict = {"rank": self.rank, "step": int(step),
+                      "ts": time.time()}
+        if loss is not None:
+            line["loss"] = float(loss)
+        if step_ms is not None:
+            line["step_ms"] = round(float(step_ms), 4)
+            if self._sketch is not None:
+                self._sketch.observe(float(step_ms))
+                line["step_ms_sketch"] = self._sketch.to_dict()
+        if buckets:
+            line["goodput"] = buckets
+        if self._port:
+            line["metrics_port"] = self._port
+        try:
+            self._f.write(json.dumps(line) + "\n")
+        except ValueError:  # closed file: a late beat never kills fit
+            return
+        m = _monitor
+        if m is not None:
+            m.counter("fleet/heartbeats").inc()
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+# -- parsing + detectors (pure, stdlib-only) ---------------------------------
+
+def read_heartbeats(directory: str) -> dict:
+    """``{rank: [heartbeat dicts in file order]}`` — tolerant of torn
+    tails and foreign files."""
+    out: dict = {}
+    if not directory or not os.path.isdir(directory):
+        return out
+    for fn in sorted(os.listdir(directory)):
+        if not (fn.startswith("heartbeat.") and fn.endswith(".jsonl")):
+            continue
+        try:
+            rank = int(fn.split(".")[1])
+        except (IndexError, ValueError):
+            continue
+        lines = []
+        try:
+            with open(os.path.join(directory, fn)) as f:
+                for raw in f:
+                    try:
+                        lines.append(json.loads(raw))
+                    except ValueError:
+                        continue
+        except OSError:
+            continue
+        out[rank] = lines
+    return out
+
+
+def _per_step(by_rank: dict, field: str) -> dict:
+    per: dict = {}
+    for rank, lines in by_rank.items():
+        for ln in lines:
+            v = ln.get(field)
+            if v is None or "step" not in ln:
+                continue
+            per.setdefault(int(ln["step"]), {})[int(rank)] = float(v)
+    return per
+
+
+def _straggler_from_steps(per_step_ms: dict, factor: float) -> dict | None:
+    for step in sorted(per_step_ms):
+        ranks = per_step_ms[step]
+        if len(ranks) < 2:
+            continue
+        med = statistics.median(ranks.values())
+        if med <= 0.0:
+            continue
+        for rank in sorted(ranks):
+            if ranks[rank] > factor * med:
+                return {"rank": rank, "step": step,
+                        "step_ms": round(ranks[rank], 3),
+                        "fleet_median_ms": round(med, 3),
+                        "factor": factor}
+    return None
+
+
+def _desync_from_steps(per_step_loss: dict, tol: float) -> dict | None:
+    for step in sorted(per_step_loss):
+        ranks = per_step_loss[step]
+        if len(ranks) < 2:
+            continue
+        lo, hi = min(ranks.values()), max(ranks.values())
+        scale = max(abs(lo), abs(hi), 1e-12)
+        if (hi - lo) / scale > tol:
+            lo_rank = min(r for r in sorted(ranks) if ranks[r] == lo)
+            hi_rank = min(r for r in sorted(ranks) if ranks[r] == hi)
+            return {"ranks": sorted({lo_rank, hi_rank}), "step": step,
+                    "spread": hi - lo, "rel_spread": (hi - lo) / scale,
+                    "tol": tol,
+                    "losses": {str(r): ranks[r] for r in sorted(ranks)}}
+    return None
+
+
+def _silent_from_last(last: dict, timeout_s: float,
+                      now: float) -> dict | None:
+    if len(last) < 2:
+        return None
+    fresh = [r for r in last if now - last[r]["ts"] <= timeout_s]
+    stale = sorted(r for r in last if now - last[r]["ts"] > timeout_s)
+    if not (fresh and stale):
+        return None
+    victim = stale[0]
+    return {"rank": victim,
+            "silent_s": round(now - last[victim]["ts"], 3),
+            "timeout_s": timeout_s,
+            "last_step": last[victim].get("step")}
+
+
+def detect_straggler(by_rank: dict, factor: float | None = None):
+    """First (step, rank) whose step_ms exceeds ``factor`` × the fleet
+    median at that step; None when the fleet is balanced."""
+    f = factor if factor is not None else _env_float(
+        "PT_STRAGGLER_FACTOR", DEFAULT_STRAGGLER_FACTOR)
+    return _straggler_from_steps(_per_step(by_rank, "step_ms"), f)
+
+
+def detect_desync(by_rank: dict, tol: float | None = None):
+    """First step where same-step losses across dp replicas diverge
+    beyond relative ``tol`` — names the extreme ranks."""
+    t = tol if tol is not None else _env_float(
+        "PT_DESYNC_TOL", DEFAULT_DESYNC_TOL)
+    return _desync_from_steps(_per_step(by_rank, "loss"), t)
+
+
+def detect_silent(by_rank: dict, timeout_s: float | None = None,
+                  now: float | None = None):
+    """A rank silent past ``timeout_s`` while a sibling still beats."""
+    t = timeout_s if timeout_s is not None else _env_float(
+        "PT_HEARTBEAT_TIMEOUT", DEFAULT_TIMEOUT_S)
+    last = {int(r): {"ts": lines[-1].get("ts", 0.0),
+                     "step": lines[-1].get("step")}
+            for r, lines in by_rank.items() if lines}
+    return _silent_from_last(last, t,
+                             time.time() if now is None else now)
+
+
+# -- launcher side -----------------------------------------------------------
+
+class FleetMonitor:
+    """Launcher-side aggregator: incremental tail-reads of every
+    worker's heartbeat file, latched detector verdicts, exact sketch
+    merges, per-rank gauges, a ``/statusz`` provider and a
+    ``fleet.json`` snapshot."""
+
+    def __init__(self, directory: str, nprocs: int | None = None,
+                 log_dir: str | None = None,
+                 straggler_factor: float | None = None,
+                 desync_tol: float | None = None,
+                 heartbeat_timeout_s: float | None = None):
+        self.dir = directory
+        self.nprocs = nprocs
+        self.log_dir = log_dir or directory
+        self.straggler_factor = (
+            straggler_factor if straggler_factor is not None
+            else _env_float("PT_STRAGGLER_FACTOR",
+                            DEFAULT_STRAGGLER_FACTOR))
+        self.desync_tol = (desync_tol if desync_tol is not None
+                           else _env_float("PT_DESYNC_TOL",
+                                           DEFAULT_DESYNC_TOL))
+        self.heartbeat_timeout_s = (
+            heartbeat_timeout_s if heartbeat_timeout_s is not None
+            else _env_float("PT_HEARTBEAT_TIMEOUT", DEFAULT_TIMEOUT_S))
+        self._offsets: dict = {}       # rank -> consumed byte offset
+        self._buffers: dict = {}       # rank -> undecoded tail fragment
+        self._last: dict = {}          # rank -> newest heartbeat fields
+        self._sketches: dict = {}      # rank -> newest cumulative sketch
+        self._per_step_ms: dict = {}
+        self._per_step_loss: dict = {}
+        self.verdicts: dict = {"straggler": None, "desync": None,
+                               "silent": None}
+        self._postmortem_path: str | None = None
+
+    # -- ingestion ----------------------------------------------------
+
+    def _ranks_on_disk(self):
+        if not os.path.isdir(self.dir):
+            return []
+        ranks = []
+        for fn in os.listdir(self.dir):
+            if fn.startswith("heartbeat.") and fn.endswith(".jsonl"):
+                try:
+                    ranks.append(int(fn.split(".")[1]))
+                except (IndexError, ValueError):
+                    continue
+        return sorted(ranks)
+
+    def poll(self) -> dict:
+        """One babysit-loop tick: consume new heartbeat lines, run the
+        detectors, refresh gauges + snapshot. Returns the verdicts."""
+        for rank in self._ranks_on_disk():
+            self._consume(rank)
+        self._detect()
+        self._set_gauges()
+        self.write_snapshot()
+        return self.verdicts
+
+    def _consume(self, rank: int) -> None:
+        path = heartbeat_path(self.dir, rank)
+        try:
+            with open(path, "rb") as f:
+                f.seek(self._offsets.get(rank, 0))
+                chunk = f.read()
+        except OSError:
+            return
+        if not chunk:
+            return
+        self._offsets[rank] = self._offsets.get(rank, 0) + len(chunk)
+        data = self._buffers.pop(rank, b"") + chunk
+        lines = data.split(b"\n")
+        if lines and lines[-1]:  # torn tail: keep for the next poll
+            self._buffers[rank] = lines[-1]
+        for raw in lines[:-1]:
+            if not raw.strip():
+                continue
+            try:
+                ln = json.loads(raw)
+            except ValueError:
+                continue
+            self._ingest(rank, ln)
+
+    def _ingest(self, rank: int, ln: dict) -> None:
+        step = ln.get("step")
+        self._last[rank] = {k: ln.get(k) for k in
+                            ("step", "ts", "loss", "step_ms",
+                             "goodput", "metrics_port")}
+        sk = ln.get("step_ms_sketch")
+        if sk is not None:
+            self._sketches[rank] = sk  # cumulative: newest replaces
+        if step is None:
+            return
+        step = int(step)
+        if ln.get("step_ms") is not None:
+            self._per_step_ms.setdefault(step, {})[rank] = \
+                float(ln["step_ms"])
+        if ln.get("loss") is not None:
+            self._per_step_loss.setdefault(step, {})[rank] = \
+                float(ln["loss"])
+        for per in (self._per_step_ms, self._per_step_loss):
+            while len(per) > MAX_TRACKED_STEPS:
+                per.pop(min(per))
+
+    # -- detectors (latched: the first verdict survives) --------------
+
+    def _detect(self) -> None:
+        if self.verdicts["straggler"] is None:
+            self.verdicts["straggler"] = _straggler_from_steps(
+                self._per_step_ms, self.straggler_factor)
+        if self.verdicts["desync"] is None:
+            self.verdicts["desync"] = _desync_from_steps(
+                self._per_step_loss, self.desync_tol)
+        if self.verdicts["silent"] is None and self._last:
+            last = {r: {"ts": info.get("ts") or 0.0,
+                        "step": info.get("step")}
+                    for r, info in self._last.items()}
+            verdict = _silent_from_last(last, self.heartbeat_timeout_s,
+                                        time.time())
+            if verdict is not None:
+                self.verdicts["silent"] = verdict
+                self._write_postmortem(verdict)
+
+    def _write_postmortem(self, verdict: dict) -> None:
+        path = os.path.join(self.log_dir,
+                            f"fleet_postmortem.rank{verdict['rank']}.json")
+        try:
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"reason": "heartbeat_timeout",
+                           "victim_rank": verdict["rank"],
+                           "verdict": verdict,
+                           "workers": self._last}, f, indent=1,
+                          default=repr)
+                f.write("\n")
+            os.replace(tmp, path)
+            self._postmortem_path = path
+            print(f"WARNING: fleet: worker rank {verdict['rank']} silent "
+                  f"for {verdict['silent_s']}s (timeout "
+                  f"{verdict['timeout_s']}s); postmortem: {path}",
+                  file=sys.stderr, flush=True)
+        except OSError:
+            pass
+
+    # -- surfaces -----------------------------------------------------
+
+    def _set_gauges(self) -> None:
+        try:
+            import paddle_tpu.monitor as m
+        except ImportError:
+            return
+        for rank in sorted(self._last):
+            info = self._last[rank]
+            if info.get("step") is not None:
+                m.gauge(f"fleet/step/{rank}").set(int(info["step"]))
+            if info.get("step_ms") is not None:
+                m.gauge(f"fleet/step_ms/{rank}").set(float(info["step_ms"]))
+            if info.get("loss") is not None:
+                m.gauge(f"fleet/loss/{rank}").set(float(info["loss"]))
+
+    def merged_step_sketch(self):
+        """Exact fleet-wide step_ms sketch (QuantileSketch merge), or
+        None outside package context / before any beat."""
+        try:
+            from .live import QuantileSketch
+        except ImportError:
+            return None
+        merged = None
+        for rank in sorted(self._sketches):
+            try:
+                sk = QuantileSketch.from_dict(self._sketches[rank])
+            except Exception:  # noqa: BLE001 — a torn sketch never kills
+                continue
+            if merged is None:
+                merged = sk
+            else:
+                merged.merge(sk)
+        return merged
+
+    def status(self) -> dict:
+        """The aggregated fleet view (/statusz provider + fleet.json)."""
+        workers = {}
+        for rank in sorted(self._last):
+            info = dict(self._last[rank])
+            ts = info.pop("ts", None)
+            if ts:
+                info["age_s"] = round(time.time() - ts, 3)
+            workers[str(rank)] = info
+        merged = self.merged_step_sketch()
+        steps = [i["step"] for i in self._last.values()
+                 if i.get("step") is not None]
+        return {
+            "nprocs": self.nprocs,
+            "workers": workers,
+            "fleet": {
+                "min_step": min(steps) if steps else None,
+                "max_step": max(steps) if steps else None,
+                "step_ms": merged.summary() if merged is not None
+                and merged.count else None,
+            },
+            "verdicts": self.verdicts,
+            "postmortem": self._postmortem_path,
+        }
+
+    def attach(self) -> None:
+        """Register the aggregated view as a ``/statusz`` status
+        provider (kept out of ``__init__`` so path-loaded use never
+        imports the live plane)."""
+        try:
+            from .live import register_status
+        except ImportError:
+            return
+        register_status("fleet", self.status)
+
+    def write_snapshot(self) -> str | None:
+        """Atomic ``fleet.json`` in the log dir — the scraped-snapshot
+        input ``tools/monitor_report.py --fleet`` accepts."""
+        path = os.path.join(self.log_dir, "fleet.json")
+        try:
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(self.status(), f, indent=1, default=repr)
+                f.write("\n")
+            os.replace(tmp, path)
+            return path
+        except OSError:
+            return None
+
+
+if __package__:  # skipped when tools load this file by path
+    from . import _register as _monitor_register
+
+    _monitor_register(sys.modules[__name__])

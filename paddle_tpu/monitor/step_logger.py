@@ -106,6 +106,14 @@ class StepLogger:
             # profiler's ph:"C" counter tracks)
             line["memory"] = led.step_census()
         line.update(delta)
+        from . import goodput
+
+        if goodput.active() is None:
+            # the shared step-time EMA (monitor/step_ms_ema gauge; the
+            # hang watchdog + ckpt cadence planner both read it). When
+            # a goodput ledger is active the fit loop feeds it with
+            # the true stepper wall-time instead.
+            goodput.observe_step_ms(dur * 1e3)
         self._write(line)
         self._drain_breaches()
         return line
@@ -151,6 +159,13 @@ class StepLogger:
         for k, v in fields.items():
             if v is not None:
                 line[k] = v
+        from . import goodput
+
+        gsnap = goodput.active_snapshot()
+        if gsnap is not None:
+            # where did the run's wall-clock go (exact telescoping;
+            # monitor_report renders the verdict from this)
+            line.setdefault("goodput", gsnap)
         from . import live
 
         if live.enabled():

@@ -1,0 +1,238 @@
+"""Hang watchdog: a daemon-thread step-deadline monitor for `fit()`.
+
+A hung collective, a deadlocked host callback, or a wedged input
+pipeline all look identical from the outside: the step counter stops.
+The watchdog turns "stopped" into a diagnosable event — it polls the
+shared step-time EMA (:func:`paddle_tpu.monitor.goodput.step_ms_ema`,
+the same source the checkpoint cadence planner reads) and, once at
+least one step has completed, judges the age of the last completed
+step against
+
+    deadline = max(PT_HANG_MIN_S, PT_HANG_FACTOR * ema_step_s)
+
+(`PT_HANG_FACTOR` 8, `PT_HANG_MIN_S` 5 s). Legitimately slow phases
+never trip it: while the goodput ledger's open bucket is ``compile``,
+``checkpoint_save_blocking`` or ``restore_resume`` the judge stands
+down (a first-signature XLA compile can dwarf any EMA).
+
+On a trip the watchdog latches (re-armed by the next completed step),
+captures an all-thread stack dump via ``sys._current_frames``, and
+writes a blackbox artifact through the PR 16 recorder
+(:mod:`paddle_tpu.monitor.blackbox`) — training's registered state
+provider contributes step, last loss, ledger snapshot and in-flight
+async depth, and the watchdog's own provider contributes the verdict
++ stacks. Artifact path: ``PT_HANG_BLACKBOX`` (falls back to the
+recorder's default). Then ``PT_HANG_POLICY`` decides: ``warn``
+(default) logs and keeps running, ``abort`` exits the process with
+status 124, ``off`` never starts the thread.
+
+``tools/soak.py`` injects a hang (``PT_SOAK_HANG_AT``: a sleep inside
+a host callback boundary) and gates on the artifact naming the hung
+step; the exporter's ``/healthz`` surfaces :func:`state` as training
+liveness (``last_step_age_s`` + ``hung``).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+
+__all__ = ["Watchdog", "state"]
+
+_monitor = None
+
+DEFAULT_FACTOR = 8.0
+DEFAULT_MIN_S = 5.0
+
+# ledger buckets during which the judge stands down: these phases
+# legitimately dwarf the step EMA
+QUIET_BUCKETS = frozenset(
+    {"compile", "checkpoint_save_blocking", "restore_resume"})
+
+_active: "Watchdog | None" = None
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _thread_stacks(limit: int = 24) -> dict:
+    """Formatted stacks of every live thread, keyed by thread name —
+    the payload that distinguishes a hung collective from a wedged
+    data loader."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for tid, frame in sys._current_frames().items():
+        key = f"{names.get(tid, 'unknown')}#{tid}"
+        out[key] = [ln.rstrip("\n") for ln
+                    in traceback.format_stack(frame, limit=limit)]
+    return out
+
+
+class Watchdog:
+    """One per `fit()` run. ``start()`` spawns the daemon thread (a
+    no-op under ``PT_HANG_POLICY=off``); ``stop()`` joins it."""
+
+    def __init__(self, factor: float | None = None,
+                 min_s: float | None = None,
+                 policy: str | None = None,
+                 poll_s: float | None = None):
+        self.factor = (factor if factor is not None
+                       else _env_float("PT_HANG_FACTOR", DEFAULT_FACTOR))
+        self.min_s = (min_s if min_s is not None
+                      else _env_float("PT_HANG_MIN_S", DEFAULT_MIN_S))
+        self.policy = (policy if policy is not None
+                       else os.environ.get("PT_HANG_POLICY", "warn")).lower()
+        if self.factor <= 0:
+            raise ValueError(
+                f"hang watchdog factor must be > 0, got {self.factor} "
+                "(PT_HANG_FACTOR)")
+        if self.min_s <= 0:
+            raise ValueError(
+                f"hang watchdog min_s must be > 0, got {self.min_s} "
+                "(PT_HANG_MIN_S)")
+        if self.policy not in ("warn", "abort", "off"):
+            raise ValueError(
+                f"unknown hang watchdog policy {self.policy!r} "
+                "(PT_HANG_POLICY: warn|abort|off)")
+        self._poll_s = poll_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._tripped = False     # latched until a newer step completes
+        self._trips = 0
+        self._seen_step: int | None = None
+        self._last_trip: dict | None = None
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> "Watchdog":
+        global _active
+        if self.policy == "off" or self._thread is not None:
+            return self
+        from . import blackbox
+
+        blackbox.register("training_watchdog", self._blackbox_state)
+        self._thread = threading.Thread(
+            target=self._run, name="pt-hang-watchdog", daemon=True)
+        self._thread.start()
+        _active = self
+        return self
+
+    def stop(self) -> None:
+        global _active
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if _active is self:
+            _active = None
+
+    # -- judging ------------------------------------------------------
+
+    def deadline_s(self) -> float | None:
+        from . import goodput
+
+        ema_ms = goodput.step_ms_ema()
+        if ema_ms is None:
+            return None  # no completed step yet: nothing to judge against
+        return max(self.min_s, self.factor * ema_ms / 1e3)
+
+    def _run(self) -> None:
+        from . import goodput
+
+        while not self._stop.is_set():
+            deadline = self.deadline_s()
+            tick = (self._poll_s if self._poll_s is not None
+                    else 0.25 if deadline is None
+                    else min(1.0, max(0.05, deadline / 4.0)))
+            if self._stop.wait(tick):
+                return
+            deadline = self.deadline_s()
+            if deadline is None:
+                continue
+            info = goodput.last_step_info()
+            age = info.get("age_s")
+            if age is None:
+                continue
+            step = info.get("step")
+            if self._tripped and step != self._seen_step:
+                self._tripped = False  # progress resumed: re-arm
+            led = goodput.active()
+            bucket = led.current_bucket() if led is not None else None
+            if bucket in QUIET_BUCKETS:
+                continue
+            if not self._tripped and age > deadline:
+                self._trip(step, age, deadline, bucket)
+
+    def _trip(self, step: int, age: float, deadline: float,
+              bucket: str | None) -> None:
+        from . import blackbox
+
+        self._tripped = True
+        self._trips += 1
+        self._seen_step = step
+        self._last_trip = {
+            "hung_step": step + 1,
+            "last_completed_step": step,
+            "age_s": round(age, 3),
+            "deadline_s": round(deadline, 3),
+            "open_bucket": bucket,
+            "policy": self.policy,
+            "stacks": _thread_stacks(),
+        }
+        m = _monitor
+        if m is not None:
+            m.counter("monitor/hang_trips").inc()
+        path = os.environ.get("PT_HANG_BLACKBOX") or None
+        written = blackbox.dump(
+            path=path, reason="hang_watchdog",
+            error=(f"step {step + 1} exceeded hang deadline: no step "
+                   f"completed for {age:.1f}s (deadline {deadline:.1f}s)"))
+        print(f"WARNING: hang watchdog: no step completed for {age:.1f}s "
+              f"(deadline {deadline:.1f}s, last completed step {step}); "
+              f"blackbox: {written}", file=sys.stderr, flush=True)
+        if self.policy == "abort":
+            os._exit(124)
+
+    # -- reporting ----------------------------------------------------
+
+    def _blackbox_state(self) -> dict:
+        return {
+            "factor": self.factor,
+            "min_s": self.min_s,
+            "policy": self.policy,
+            "trips": self._trips,
+            "last_trip": self._last_trip,
+        }
+
+    def state(self) -> dict:
+        """Training-liveness verdict for ``/healthz``."""
+        from . import goodput
+
+        info = goodput.last_step_info()
+        age = info.get("age_s")
+        deadline = self.deadline_s()
+        return {
+            "last_step": info.get("step"),
+            "last_step_age_s": round(age, 3) if age is not None else None,
+            "hung": self._tripped,
+            "deadline_s": round(deadline, 3) if deadline is not None else None,
+            "trips": self._trips,
+        }
+
+
+def state() -> dict:
+    """The active watchdog's liveness verdict, ``{}`` when none runs
+    (the exporter's ``/healthz`` consumes this)."""
+    w = _active
+    return w.state() if w is not None else {}
+
+
+from . import _register as _monitor_register  # noqa: E402
+
+_monitor_register(sys.modules[__name__])

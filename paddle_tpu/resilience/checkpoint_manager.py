@@ -47,7 +47,12 @@ import time
 from ..monitor import _register as _monitor_register
 
 # Telemetry slot (see paddle_tpu.monitor): None unless PT_MONITOR wired it.
+# `_goodput` (monitor/goodput.py) is armed only while a fit() goodput
+# ledger is active: save() charges its measured blocking cost to the
+# checkpoint_save_blocking bucket, and _tick prefers the ledger's shared
+# step-time EMA over the private one.
 _monitor = None
+_goodput = None
 
 _MANIFEST = "MANIFEST.json"
 _STEP_DIR = re.compile(r"^step-(\d{8})$")
@@ -220,6 +225,15 @@ class CheckpointManager:
                                                   - self._last_tick[0])
             self._ema_step_s = dt if self._ema_step_s is None else (
                 0.8 * self._ema_step_s + 0.2 * dt)
+        g = _goodput
+        if g is not None:
+            # one shared step-time source (satellite of the goodput
+            # plane): the ledger's EMA is fed with the true stepper
+            # wall-time, so the cadence plan and the hang watchdog
+            # judge against the same number
+            ema_ms = g.step_ms_ema()
+            if ema_ms is not None:
+                self._ema_step_s = ema_ms / 1e3
         self._last_tick = (step, now)
         if self._start_step is None:
             self._start_step = step
@@ -291,6 +305,9 @@ class CheckpointManager:
         m = _monitor
         if m is not None:
             m.on_ckpt_save(blocked * 1e3)
+        g = _goodput
+        if g is not None:
+            g.charge("checkpoint_save_blocking", blocked)
         if writer is None:  # sync save: finalize inline
             self._publish(step, path, manifest)
         else:

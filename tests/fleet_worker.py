@@ -1,0 +1,44 @@
+"""Synthetic fleet worker for the 2-process launcher e2e
+(tests/test_fleet.py): heartbeats like a training worker would —
+rank-conditional step_ms (an injected straggler) and a rank-conditional
+loss (an injected dp desync) — without importing jax: the heartbeat
+module is loaded by path (its module-level imports are stdlib-only by
+contract, the same property tools/monitor_report.py relies on)."""
+import importlib.util
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_heartbeat():
+    path = os.path.join(ROOT, "paddle_tpu", "monitor", "heartbeat.py")
+    spec = importlib.util.spec_from_file_location("fleet_worker_hb", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main():
+    hb = _load_heartbeat()
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    writer = hb.HeartbeatWriter(os.environ["PT_HEARTBEAT_DIR"])
+    for step in range(1, 9):
+        step_ms = 5.0
+        loss = 2.5 - 0.05 * step
+        if rank == 1 and step == 4:
+            step_ms = 40.0  # straggler: > 1.5x the 2-rank median 22.5
+        if rank == 1 and step == 6:
+            loss = 9.9      # dp desync: same-step loss divergence
+        writer.beat(step, loss=loss, step_ms=step_ms)
+        # slow enough that the launcher's 0.5 s babysit poll observes
+        # the fleet mid-run, fast enough for tier-1
+        time.sleep(0.15)
+    writer.close()
+    print(f"WORKER_DONE rank={rank}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

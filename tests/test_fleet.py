@@ -1,0 +1,299 @@
+"""Fleet telemetry tests (ISSUE 20 — monitor/heartbeat + the launcher's
+FleetMonitor, docs/OBSERVABILITY.md "Training goodput plane").
+
+Tier-1 proof of the fleet half of the goodput plane: the three
+detectors each latch a worker-NAMED verdict (straggler / dp desync /
+silent worker), the launcher-side FleetMonitor surfaces them through
+``fleet.json`` + the aggregated ``/statusz``, a real `fit()` under
+``PT_HEARTBEAT_DIR`` heartbeats, and a genuine 2-process
+`distributed.launch` run with injected faults lands both verdicts in
+the launcher's artifacts (+ ``tools/monitor_report.py --fleet`` renders
+them offline)."""
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.monitor import exporter, heartbeat, live
+
+REPO = str(Path(__file__).parent.parent)
+
+
+def _write_beats(directory, rank, rows, mode="w"):
+    """rows: [(step, ts, step_ms, loss)] — loss/step_ms may be None."""
+    os.makedirs(directory, exist_ok=True)
+    with open(heartbeat.heartbeat_path(directory, rank), mode) as f:
+        for step, ts, step_ms, loss in rows:
+            line = {"rank": rank, "step": step, "ts": ts}
+            if step_ms is not None:
+                line["step_ms"] = step_ms
+            if loss is not None:
+                line["loss"] = loss
+            f.write(json.dumps(line) + "\n")
+
+
+# -- detectors (pure, synthetic by_rank dicts) -------------------------------
+
+def test_straggler_detector_names_rank_and_step():
+    by_rank = {
+        0: [{"step": 1, "step_ms": 5.0}, {"step": 2, "step_ms": 5.0}],
+        1: [{"step": 1, "step_ms": 5.0}, {"step": 2, "step_ms": 5.0}],
+        2: [{"step": 1, "step_ms": 5.0}, {"step": 2, "step_ms": 50.0}],
+    }
+    v = heartbeat.detect_straggler(by_rank, factor=3.0)
+    assert v is not None
+    assert v["rank"] == 2 and v["step"] == 2
+    assert v["step_ms"] == 50.0 and v["fleet_median_ms"] == 5.0
+    # balanced fleet: no verdict
+    assert heartbeat.detect_straggler(
+        {0: by_rank[0], 1: by_rank[1]}, factor=3.0) is None
+
+
+def test_straggler_needs_two_reporting_ranks():
+    # one rank at a step can never be its own straggler
+    assert heartbeat.detect_straggler(
+        {0: [{"step": 1, "step_ms": 500.0}]}, factor=3.0) is None
+
+
+def test_desync_detector_names_extreme_ranks():
+    by_rank = {
+        0: [{"step": 1, "loss": 2.5}, {"step": 2, "loss": 2.4}],
+        1: [{"step": 1, "loss": 2.5}, {"step": 2, "loss": 9.9}],
+    }
+    v = heartbeat.detect_desync(by_rank, tol=1e-3)
+    assert v is not None
+    assert v["ranks"] == [0, 1] and v["step"] == 2
+    assert v["rel_spread"] > 1e-3
+    # within tolerance: no verdict (dp replicas agree)
+    same = {0: [{"step": 1, "loss": 2.5}], 1: [{"step": 1, "loss": 2.5}]}
+    assert heartbeat.detect_desync(same, tol=1e-3) is None
+
+
+def test_silent_detector_names_victim():
+    now = 1000.0
+    by_rank = {
+        0: [{"step": 5, "ts": now}],
+        1: [{"step": 3, "ts": now - 120.0}],
+    }
+    v = heartbeat.detect_silent(by_rank, timeout_s=60.0, now=now)
+    assert v is not None
+    assert v["rank"] == 1 and v["last_step"] == 3
+    assert v["silent_s"] == 120.0
+    # a lone rank is never "silent" (nothing to compare against)
+    assert heartbeat.detect_silent(
+        {1: by_rank[1]}, timeout_s=60.0, now=now) is None
+
+
+# -- FleetMonitor over synthetic heartbeat files -----------------------------
+
+def test_fleet_monitor_latches_and_snapshots(tmp_path):
+    hb_dir = str(tmp_path / "hb")
+    now = time.time()
+    _write_beats(hb_dir, 0, [(s, now, 5.0, 2.5 - 0.1 * s)
+                             for s in (1, 2, 3)])
+    _write_beats(hb_dir, 1, [(1, now, 5.0, 2.4), (2, now, 5.0, 2.3),
+                             (3, now, 5.0, 2.2)])
+    _write_beats(hb_dir, 2, [(1, now, 5.0, 2.4), (2, now, 50.0, 2.3),
+                             (3, now, 5.0, 8.8)])
+    fleet = heartbeat.FleetMonitor(hb_dir, 3, log_dir=str(tmp_path),
+                                   straggler_factor=3.0, desync_tol=1e-3,
+                                   heartbeat_timeout_s=3600.0)
+    verdicts = fleet.poll()
+    assert verdicts["straggler"]["rank"] == 2
+    assert verdicts["straggler"]["step"] == 2
+    # first offending step wins; the divergent rank is named
+    assert verdicts["desync"]["step"] == 3
+    assert verdicts["desync"]["ranks"] == [0, 2]
+    assert verdicts["silent"] is None
+    # latched: a later balanced poll never clears the verdicts
+    _write_beats(hb_dir, 0, [(4, now, 5.0, 2.1)], mode="a")
+    _write_beats(hb_dir, 1, [(4, now, 5.0, 2.1)], mode="a")
+    _write_beats(hb_dir, 2, [(4, now, 5.0, 2.1)], mode="a")
+    v2 = fleet.poll()
+    assert v2["straggler"] == verdicts["straggler"]
+    # fleet.json snapshot in the log dir, worker-keyed
+    snap = json.loads((tmp_path / "fleet.json").read_text())
+    assert set(snap["workers"]) >= {"0", "1", "2"}
+    assert snap["verdicts"]["straggler"]["rank"] == 2
+    st = fleet.status()
+    assert st["fleet"]["min_step"] is not None
+
+
+def test_fleet_monitor_silent_worker_postmortem(tmp_path):
+    hb_dir = str(tmp_path / "hb")
+    now = time.time()
+    _write_beats(hb_dir, 0, [(5, now, 5.0, 2.0)])
+    _write_beats(hb_dir, 1, [(2, now - 300.0, 5.0, 2.1)])
+    fleet = heartbeat.FleetMonitor(hb_dir, 2, log_dir=str(tmp_path),
+                                   heartbeat_timeout_s=60.0)
+    verdicts = fleet.poll()
+    assert verdicts["silent"]["rank"] == 1
+    pm_path = tmp_path / "fleet_postmortem.rank1.json"
+    assert pm_path.exists()
+    pm = json.loads(pm_path.read_text())
+    assert pm["reason"] == "heartbeat_timeout"
+    assert pm["victim_rank"] == 1
+    assert fleet.status()["postmortem"] == str(pm_path)
+
+
+def test_fleet_monitor_tolerates_torn_tail(tmp_path):
+    hb_dir = str(tmp_path / "hb")
+    now = time.time()
+    _write_beats(hb_dir, 0, [(1, now, 5.0, 2.5)])
+    # rank 1's file ends mid-line (a worker mid-write): consumed later
+    with open(heartbeat.heartbeat_path(hb_dir, 1), "w") as f:
+        f.write(json.dumps({"rank": 1, "step": 1, "ts": now,
+                            "step_ms": 5.0}) + "\n")
+        f.write('{"rank": 1, "step": 2, "ts"')
+    fleet = heartbeat.FleetMonitor(hb_dir, 2, log_dir=str(tmp_path),
+                                   heartbeat_timeout_s=3600.0)
+    fleet.poll()
+    assert fleet._last[1]["step"] == 1
+    # the torn tail completes: the buffered fragment + completion parse
+    with open(heartbeat.heartbeat_path(hb_dir, 1), "a") as f:
+        f.write(f': {now}, "step_ms": 6.0}}\n')
+    fleet.poll()
+    assert fleet._last[1]["step"] == 2
+
+
+def test_statusz_aggregates_fleet_verdicts(tmp_path):
+    """The launcher's aggregated /statusz carries the fleet provider's
+    worker-named verdicts (acceptance: verdicts visible in /statusz)."""
+    hb_dir = str(tmp_path / "hb")
+    now = time.time()
+    _write_beats(hb_dir, 0, [(1, now, 5.0, 2.5), (2, now, 5.0, 2.4)])
+    _write_beats(hb_dir, 1, [(1, now, 5.0, 2.5), (2, now, 5.0, 7.7)])
+    _write_beats(hb_dir, 2, [(1, now, 5.0, 2.5), (2, now, 60.0, 2.4)])
+    fleet = heartbeat.FleetMonitor(hb_dir, 3, log_dir=str(tmp_path),
+                                   straggler_factor=3.0, desync_tol=1e-3,
+                                   heartbeat_timeout_s=3600.0)
+    fleet.poll()
+    fleet.attach()
+    port = exporter.start(0)
+    assert port
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/statusz", timeout=10) as r:
+            body = r.read().decode()
+        assert "--- fleet ---" in body
+        assert '"straggler"' in body and '"rank": 2' in body
+        assert '"desync"' in body
+    finally:
+        # exporter.start() armed the live plane; restore the tier-1
+        # import-inert default for later test files
+        exporter.stop()
+        live.disable()
+        live.reset()
+
+
+# -- fit() integration: workers heartbeat under PT_HEARTBEAT_DIR -------------
+
+def test_fit_writes_heartbeats(tmp_path, monkeypatch):
+    hb_dir = str(tmp_path / "hb")
+    monkeypatch.setenv("PT_HEARTBEAT_DIR", hb_dir)
+    monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = paddle.optimizer.AdamW(learning_rate=5e-2,
+                                 parameters=net.parameters())
+    model = paddle.Model(net)
+    model.prepare(opt, nn.MSELoss())
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((32, 8)).astype("float32")
+    ys = xs @ rng.standard_normal((8, 1)).astype("float32")
+    model.fit([(xs[i], ys[i]) for i in range(32)], batch_size=8,
+              epochs=2, shuffle=False, verbose=0, log_freq=1)
+    by_rank = heartbeat.read_heartbeats(hb_dir)
+    assert list(by_rank) == [0]
+    beats = by_rank[0]
+    assert [b["step"] for b in beats] == list(range(1, 9))
+    assert all(b.get("step_ms", 0) > 0 for b in beats)
+    # log_freq=1 materializes every loss -> every beat carries it
+    assert all(isinstance(b.get("loss"), float) for b in beats)
+    # the cumulative sketch merges exactly: newest line carries them all
+    assert beats[-1]["step_ms_sketch"]["count"] == 8
+    # goodput buckets ride along for the fleet "gp%" column
+    assert "productive_step" in beats[-1]["goodput"]
+
+
+# -- the 2-process launcher e2e ----------------------------------------------
+
+@pytest.mark.slow
+def test_two_worker_launch_latches_fleet_verdicts(tmp_path):
+    """Acceptance: a real `distributed.launch` pod of 2 fault-injected
+    workers (rank 1 straggles at step 4 and desyncs at step 6) ends
+    with both worker-named verdicts latched in the launcher's
+    fleet.json, and `monitor_report --fleet` re-derives them offline
+    from the raw heartbeat directory."""
+    log_dir = tmp_path / "log"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PADDLE_TRAINER_ID", None)
+    env.pop("PADDLE_TRAINERS_NUM", None)
+    env.pop("PT_HEARTBEAT_DIR", None)
+    # 2 ranks: max/median is bounded by 2, so the injected 40ms-vs-5ms
+    # straggler is judged at 1.5x (the knob exists for exactly this
+    # fleet-width effect)
+    env["PT_STRAGGLER_FACTOR"] = "1.5"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(log_dir),
+         "--max_restart", "0",
+         os.path.join(REPO, "tests", "fleet_worker.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:])
+    snap = json.loads((log_dir / "fleet.json").read_text())
+    strag = snap["verdicts"]["straggler"]
+    assert strag is not None and strag["rank"] == 1 and strag["step"] == 4
+    desync = snap["verdicts"]["desync"]
+    assert desync is not None and desync["step"] == 6
+    assert desync["ranks"] == [0, 1]
+    assert set(snap["workers"]) == {"0", "1"}
+    assert snap["verdicts"]["silent"] is None
+
+    # monitor_report --fleet over the raw heartbeat dir: the offline
+    # detectors re-derive + render the same worker-named verdicts
+    run_jsonl = tmp_path / "empty_run.jsonl"
+    run_jsonl.write_text("")
+    rep = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "monitor_report.py"),
+         str(run_jsonl), "--fleet", str(log_dir / "heartbeats")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    assert "STRAGGLER: rank 1 at step 4" in rep.stdout
+    assert "DP DESYNC: ranks [0, 1] at step 6" in rep.stdout
+
+
+def test_monitor_report_fleet_json_input(tmp_path):
+    """--fleet also accepts the launcher's fleet.json snapshot."""
+    snap = {
+        "nprocs": 2,
+        "workers": {"0": {"step": 8, "step_ms": 5.0, "loss": 2.1},
+                    "1": {"step": 8, "step_ms": 5.0, "loss": 2.1}},
+        "fleet": {"min_step": 8, "max_step": 8, "step_ms": None},
+        "verdicts": {"straggler": {"rank": 1, "step": 4, "step_ms": 40.0,
+                                   "fleet_median_ms": 22.5, "factor": 1.5},
+                     "desync": None, "silent": None},
+        "postmortem": None,
+    }
+    fj = tmp_path / "fleet.json"
+    fj.write_text(json.dumps(snap))
+    run_jsonl = tmp_path / "empty_run.jsonl"
+    run_jsonl.write_text("")
+    rep = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "monitor_report.py"),
+         str(run_jsonl), "--fleet", str(fj)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    assert "STRAGGLER: rank 1 at step 4" in rep.stdout
+    assert "workers reporting: 2 / 2" in rep.stdout

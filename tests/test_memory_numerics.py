@@ -465,6 +465,10 @@ def test_zero_overhead_audit_import_time_inert(modname):
         assert mod._nancheck is None, f"{modname}._nancheck"
     if hasattr(mod, "_live"):
         assert mod._live is None, f"{modname}._live"
+    if hasattr(mod, "_goodput"):
+        # the goodput slot is ledger-scoped, not PT_MONITOR-scoped: it
+        # must be None whenever no fit() ledger is active (ISSUE 20)
+        assert mod._goodput is None, f"{modname}._goodput"
 
 
 def test_audit_list_covers_all_registered_sites():

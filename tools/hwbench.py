@@ -163,11 +163,13 @@ def _guard_check(name: str, stdout: str):
 
 
 def _memory_status(name: str, stdout: str):
-    """Peak-HBM + numerics-sentinel status from a finished bench's JSON
-    line — printed per bench and returned for the summary, so memory
-    regressions get the same while-the-chip-is-up visibility as
-    throughput. (The benches themselves persist these fields into their
-    PERF_MEASUREMENTS.json records; this is the live readout.)"""
+    """Peak-HBM + numerics-sentinel + goodput status from a finished
+    bench's JSON line — printed per bench and returned for the summary,
+    so memory and goodput regressions get the same while-the-chip-is-up
+    visibility as throughput. (The benches themselves persist these
+    fields into their PERF_MEASUREMENTS.json records — bench.py and
+    soak.py carry ``goodput_frac`` in their extras, which anchors
+    perf_guard --goodput-drop; this is the live readout.)"""
     try:
         if ROOT not in sys.path:
             sys.path.insert(0, ROOT)
@@ -186,6 +188,8 @@ def _memory_status(name: str, stdout: str):
             out["nan_check"] = mem["nan_check"]
         elif "nan_check" in line:
             out["nan_check"] = line["nan_check"]
+        if line.get("goodput_frac") is not None:
+            out["goodput_frac"] = line["goodput_frac"]
         if out:
             parts = []
             if "peak_hbm_gib" in out:
@@ -193,6 +197,8 @@ def _memory_status(name: str, stdout: str):
             if "nan_check" in out:
                 parts.append("nan-check "
                              + ("armed" if out["nan_check"] else "off"))
+            if "goodput_frac" in out:
+                parts.append(f"goodput {out['goodput_frac']:.1%}")
             print(f"hwbench: {name} memory: {', '.join(parts)}", flush=True)
         return out or None
     except Exception as e:  # noqa: BLE001 — a readout, never a gate

@@ -576,6 +576,204 @@ def render_resilience(out, totals=None, hists=None, end=None, source=""):
                       if end.get("error") else ""))
 
 
+GOODPUT_BUCKETS = (
+    "productive_step", "compile", "checkpoint_save_blocking",
+    "nan_replay_or_skip", "restore_resume", "input_wait", "other",
+)
+
+_GOODPUT_VERDICTS = {
+    "productive_step": "healthy: productive stepping dominates the wall",
+    "compile": "compile-bound: XLA compiles ate the wall — warm the "
+               "exec cache (PT_EXEC_CACHE) or check for retrace churn",
+    "checkpoint_save_blocking": "checkpoint-bound: blocking save cost "
+                                "dominates — raise PT_CKPT_OVERHEAD_PCT "
+                                "or check the save path's throughput",
+    "nan_replay_or_skip": "numerics-bound: NaN replay/skip cycles ate "
+                          "the wall — the data or LR is poisoning steps",
+    "restore_resume": "restore-bound: checkpoint restore dominates "
+                      "(expected only on short relaunched runs)",
+    "input_wait": "input-bound: the loader starved fit — raise prefetch "
+                  "depth / loader workers",
+    "other": "mostly unclassified wall (host bookkeeping between "
+             "ledgered regions)",
+}
+
+
+def render_goodput(out, gp, source=""):
+    """The goodput ledger's "where did the time go" account
+    (``monitor/goodput.py`` — docs/OBSERVABILITY.md "Training goodput
+    plane"): every wall-clock second of the run classified into the
+    telescoping buckets, the goodput fraction, and a verdict naming
+    the dominant non-productive bucket."""
+    if not gp or not isinstance(gp, dict):
+        return
+    buckets = gp.get("buckets") or {}
+    wall = gp.get("wall_s")
+    if wall is None:
+        wall = sum(v for v in buckets.values()
+                   if isinstance(v, (int, float)))
+    out.append("")
+    out.append(f"-- goodput (where did the time go){source} --")
+    line = f"wall: {wall:.3f} s"
+    if gp.get("steps") is not None:
+        line += f"   steps: {gp['steps']}"
+    if gp.get("nan_steps"):
+        line += f"   nan steps: {gp['nan_steps']}"
+    out.append(line)
+    if buckets and wall > 0:
+        rows = []
+        for name in GOODPUT_BUCKETS:
+            if name not in buckets:
+                continue
+            s = buckets[name]
+            rows.append((name, f"{s:.3f} s", f"{s / wall * 100:5.1f}%"))
+        for name in sorted(set(buckets) - set(GOODPUT_BUCKETS)):
+            s = buckets[name]
+            rows.append((name, f"{s:.3f} s", f"{s / wall * 100:5.1f}%"))
+        out.extend(_table(rows, (26, 14, 10)))
+        ssum = sum(buckets.values())
+        out.append(f"buckets sum: {ssum:.3f} s "
+                   + ("(telescopes exactly)" if ssum == wall
+                      else f"vs wall {wall:.3f} s — LEDGER BROKEN"))
+    frac = gp.get("goodput_frac")
+    if frac is None and wall and buckets.get("productive_step") is not None:
+        frac = buckets["productive_step"] / wall
+    if frac is not None:
+        out.append(f"goodput_frac: {frac:.4f} "
+                   f"({frac * 100:.1f}% of wall was productive stepping)")
+    if buckets and wall > 0:
+        dom = max(buckets, key=lambda b: buckets[b])
+        if buckets[dom] > 0.2 * wall and dom in _GOODPUT_VERDICTS:
+            out.append(f"verdict: {_GOODPUT_VERDICTS[dom]}")
+
+
+def _load_heartbeat_mod():
+    """``paddle_tpu/monitor/heartbeat.py`` loaded by path (its
+    module-level imports are stdlib-only by contract) so the fleet
+    section's parsing + detectors cannot drift from the launcher's —
+    and this tool stays importable with no jax on the box."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "paddle_tpu", "monitor", "heartbeat.py")
+    spec = importlib.util.spec_from_file_location("pt_heartbeat", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_fleet(path):
+    """A fleet view from either a ``fleet.json`` snapshot (the
+    launcher's scraped artifact) or a heartbeat DIRECTORY (re-run the
+    detectors offline over the raw JSONL — a postmortem needs no live
+    launcher). Returns the ``FleetMonitor.status()`` dict shape."""
+    import os
+
+    if os.path.isdir(path):
+        hb = _load_heartbeat_mod()
+        by_rank = hb.read_heartbeats(path)
+        workers = {}
+        last_ts = {}
+        for rank, lines in sorted(by_rank.items()):
+            if not lines:
+                continue
+            newest = lines[-1]
+            workers[str(rank)] = {
+                k: newest.get(k) for k in
+                ("step", "loss", "step_ms", "goodput", "metrics_port")}
+            last_ts[rank] = newest.get("ts") or 0.0
+        steps = [w["step"] for w in workers.values()
+                 if w.get("step") is not None]
+        now = max(last_ts.values()) if last_ts else 0.0
+        return {
+            "nprocs": len(by_rank) or None,
+            "workers": workers,
+            "fleet": {"min_step": min(steps) if steps else None,
+                      "max_step": max(steps) if steps else None,
+                      "step_ms": None},
+            "verdicts": {
+                "straggler": hb.detect_straggler(by_rank),
+                "desync": hb.detect_desync(by_rank),
+                # offline: judge silence against the newest beat anywhere
+                # in the fleet, not this tool's wall clock
+                "silent": hb.detect_silent(by_rank, now=now),
+            },
+            "postmortem": None,
+            "offline": True,
+        }
+    with open(path) as f:
+        return json.load(f)
+
+
+def render_fleet(out, fleet, source=""):
+    """The launcher fleet view (``FleetMonitor.status()`` — per-worker
+    table, merged step_ms, and the three latched detector verdicts:
+    straggler / dp desync / silent worker, each naming its rank)."""
+    if not fleet:
+        return
+    out.append("")
+    off = " [offline re-detect]" if fleet.get("offline") else ""
+    out.append(f"-- fleet (launcher workers){source}{off} --")
+    workers = fleet.get("workers") or {}
+    fl = fleet.get("fleet") or {}
+    head = f"workers reporting: {len(workers)}"
+    if fleet.get("nprocs"):
+        head += f" / {fleet['nprocs']}"
+    if fl.get("min_step") is not None:
+        head += (f"   step span: {fl['min_step']}..{fl['max_step']}"
+                 + (f" (skew {fl['max_step'] - fl['min_step']})"
+                    if fl["max_step"] != fl["min_step"] else ""))
+    out.append(head)
+    if workers:
+        rows = [("rank", "step", "step_ms", "loss", "age_s", "gp%")]
+        for rank in sorted(workers, key=lambda r: int(r)):
+            w = workers[rank] or {}
+            gp = w.get("goodput") or {}
+            tot = sum(v for v in gp.values()
+                      if isinstance(v, (int, float))) if gp else 0.0
+            gpp = (f"{gp.get('productive_step', 0.0) / tot * 100:.0f}"
+                   if tot > 0 else "-")
+            rows.append((rank, w.get("step", "-"),
+                         w.get("step_ms", "-"),
+                         (f"{w['loss']:.4f}"
+                          if isinstance(w.get("loss"), (int, float))
+                          else "-"),
+                         w.get("age_s", "-"), gpp))
+        out.extend(_table(rows, (6, 8, 10, 12, 9, 6)))
+    sk = fl.get("step_ms")
+    if sk:
+        out.append(f"fleet step_ms (merged sketch): p50 {sk.get('p50')}   "
+                   f"p90 {sk.get('p90')}   p99 {sk.get('p99')} "
+                   f"({sk.get('count')} step(s))")
+    verdicts = fleet.get("verdicts") or {}
+    strag = verdicts.get("straggler")
+    if strag:
+        out.append(f"STRAGGLER: rank {strag.get('rank')} at step "
+                   f"{strag.get('step')} — {strag.get('step_ms')} ms vs "
+                   f"fleet median {strag.get('fleet_median_ms')} ms "
+                   f"(threshold {strag.get('factor')}x)")
+    desync = verdicts.get("desync")
+    if desync:
+        out.append(f"DP DESYNC: ranks {desync.get('ranks')} at step "
+                   f"{desync.get('step')} — loss spread "
+                   f"{desync.get('spread'):.6g} (rel "
+                   f"{desync.get('rel_spread'):.3g} > tol "
+                   f"{desync.get('tol'):.3g}); same-step losses must "
+                   f"match across dp replicas")
+    silent = verdicts.get("silent")
+    if silent:
+        out.append(f"SILENT WORKER: rank {silent.get('rank')} — no "
+                   f"heartbeat for {silent.get('silent_s')}s (timeout "
+                   f"{silent.get('timeout_s')}s, last step "
+                   f"{silent.get('last_step')})")
+    if fleet.get("postmortem"):
+        out.append(f"postmortem: {fleet['postmortem']}")
+    if not (strag or desync or silent):
+        out.append("verdicts: none latched (fleet healthy)")
+
+
 def render_memory(mem, out, steps=(), source=""):
     """The memory observatory's account: run-level peaks (+ sentinel
     state) and the per-step live-census trajectory when step lines
@@ -863,7 +1061,7 @@ def render_request_attribution(att, out, source=""):
 
 
 def render(jsonl_path, trace_path=None, top=10, spans=False,
-           bench_path=None, metrics_path=None):
+           bench_path=None, metrics_path=None, fleet_path=None):
     steps, begin, end = load_jsonl(jsonl_path)
     out = [f"== monitor run: {jsonl_path} =="]
     if begin:
@@ -1004,6 +1202,21 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
                       .get("histograms", {}),
                       end=end)
 
+    # -- goodput ledger (run_end's goodput sub-object — where did the
+    #    wall-clock go) --
+    render_goodput(out, (end or {}).get("goodput"))
+
+    # -- fleet (--fleet: a launcher fleet.json snapshot or the raw
+    #    heartbeat directory, detectors re-run offline) --
+    if fleet_path:
+        try:
+            fleet = load_fleet(fleet_path)
+        except (OSError, ValueError) as e:
+            out.append("")
+            out.append(f"unreadable fleet source: {e}")
+        else:
+            render_fleet(out, fleet, source=f" {fleet_path}")
+
     # -- device memory (observatory run_end sub-object and/or per-step
     #    censuses) --
     mem = (end or {}).get("memory")
@@ -1056,6 +1269,8 @@ def render(jsonl_path, trace_path=None, top=10, spans=False,
             if line.get("attribution"):
                 render_request_attribution(line["attribution"], out,
                                            source=" (bench)")
+            if line.get("goodput"):
+                render_goodput(out, line["goodput"], source=" (bench)")
             if line.get("kernels"):
                 render_kernels(out, bench_kernels=line["kernels"],
                                source=" (bench)")
@@ -1171,6 +1386,16 @@ def _selftest():
                 {"step": 2, "ts": 0.2, "dur_ms": 9.0, "loss": 2.4,
                  "ips": 110.0},
                 {"event": "run_end", "ts": 0.3, "steps": 2, "wall_s": 0.02,
+                 "goodput": {"wall_s": 10.0,
+                             "buckets": {"productive_step": 8.0,
+                                         "compile": 1.5,
+                                         "checkpoint_save_blocking": 0.25,
+                                         "nan_replay_or_skip": 0.0,
+                                         "restore_resume": 0.0,
+                                         "input_wait": 0.25,
+                                         "other": 0.0},
+                             "goodput_frac": 0.8, "steps": 2,
+                             "nan_steps": 0},
                  "totals": {"counters": {
                      "serving/admits": 2, "serving/evictions": 2,
                      "serving/prefill_steps": 4, "serving/decode_steps": 9,
@@ -1229,6 +1454,10 @@ def _selftest():
                     "queue_share": 0.1923, "queue_ms_p99": 20.0,
                     "prefill_refunded_tokens": 4, "spec_rounds": 3,
                     "accepted_tokens": 5},
+                "goodput": {"wall_s": 5.0,
+                            "buckets": {"productive_step": 4.0,
+                                        "compile": 1.0},
+                            "goodput_frac": 0.8, "steps": 4},
                 "telemetry": {"serving": {"admits": 2, "evictions": 2,
                                           "prefill_steps": 4,
                                           "decode_steps": 9}}}) + "\n")
@@ -1252,17 +1481,41 @@ def _selftest():
                 'pt_slo_burn_rate{metric="ttft_ms",window="fast"} 50.0',
                 'pt_slo_burn_rate{metric="ttft_ms",window="slow"} 11.1',
                 "# EOF", "")))
+        # fleet fixture: 3 workers' heartbeat JSONL with an injected
+        # straggler (rank 2 at step 2: 50ms vs fleet median 5ms) and a
+        # dp desync (rank 2's step-3 loss diverges) — the offline
+        # detectors in load_fleet() must latch + name both
+        hb_dir = os.path.join(td, "heartbeats")
+        os.makedirs(hb_dir)
+        beats = {
+            0: [(1, 5.0, 2.50), (2, 5.0, 2.40), (3, 5.0, 2.30)],
+            1: [(1, 5.0, 2.50), (2, 5.0, 2.40), (3, 5.0, 2.30)],
+            2: [(1, 5.0, 2.50), (2, 50.0, 2.40), (3, 5.0, 9.99)],
+        }
+        for rank, rows in beats.items():
+            with open(os.path.join(hb_dir,
+                                   f"heartbeat.{rank}.jsonl"), "w") as f:
+                for step, ms, loss in rows:
+                    f.write(json.dumps(
+                        {"rank": rank, "step": step, "ts": 100.0 + step,
+                         "step_ms": ms, "loss": loss,
+                         "goodput": {"productive_step": 4.0,
+                                     "compile": 1.0}}) + "\n")
         report = render(jsonl, trace_path=trace, top=5, spans=True,
-                        bench_path=bench, metrics_path=metrics_file)
+                        bench_path=bench, metrics_path=metrics_file,
+                        fleet_path=hb_dir)
         needed = (
             "-- run --",
             "-- counters (run total) --",
             "-- serving (continuous batching) --",
             "-- SLO / live windows --",
             "-- SLO / live windows (/metrics)",
+            "-- goodput (where did the time go) --",
+            "-- fleet (launcher workers)",
             "-- bench line:",
             "-- serving (continuous batching) (bench) --",
             "-- request attribution (phase means, ms) (bench) --",
+            "-- goodput (where did the time go) (bench) --",
             "-- requests (slowest 2 of 2 journeys, ms) --",
             "-- retrace timeline --",
             "-- span attribution (host wall decomposition) --",
@@ -1275,10 +1528,18 @@ def _selftest():
         # the slowest journey must lead the requests table
         order_ok = report.find("r2") < report.find("r1") \
             or "r2" not in report
-        if missing or not order_ok or not slo_ok:
+        # goodput: the exact-telescope proof + fraction must render
+        gp_ok = ("goodput_frac: 0.8000" in report
+                 and "(telescopes exactly)" in report)
+        # fleet: both injected verdicts must latch and name rank 2
+        fleet_ok = ("STRAGGLER: rank 2 at step 2" in report
+                    and "DP DESYNC: ranks [0, 2] at step 3" in report)
+        if missing or not order_ok or not slo_ok or not gp_ok \
+                or not fleet_ok:
             print(report)
             print(f"selftest FAILED: missing={missing} "
-                  f"order_ok={order_ok} slo_ok={slo_ok}",
+                  f"order_ok={order_ok} slo_ok={slo_ok} "
+                  f"gp_ok={gp_ok} fleet_ok={fleet_ok}",
                   file=sys.stderr)
             return 1
         print(f"monitor_report selftest ok "
@@ -1311,6 +1572,11 @@ def main(argv=None):
                     help="saved /metrics OpenMetrics exposition "
                          "(monitor/exporter.py): render its SLO/live "
                          "view incl. per-replica dispatch share")
+    ap.add_argument("--fleet", default=None, metavar="DIR-or-JSON",
+                    help="launcher fleet view: a fleet.json snapshot, "
+                         "or the PT_HEARTBEAT_DIR itself (straggler / "
+                         "dp-desync / silent-worker detectors re-run "
+                         "offline over the raw heartbeat JSONL)")
     ap.add_argument("--selftest", action="store_true",
                     help="render a synthesized run and assert every "
                          "section appears (tier-1 smoke; no jsonl needed)")
@@ -1321,7 +1587,7 @@ def main(argv=None):
         ap.error("jsonl is required (or pass --selftest)")
     report = render(args.jsonl, trace_path=args.trace, top=args.top,
                     spans=args.spans, bench_path=args.bench,
-                    metrics_path=args.metrics)
+                    metrics_path=args.metrics, fleet_path=args.fleet)
     print(report)
     return report
 

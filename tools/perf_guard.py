@@ -50,6 +50,13 @@ and exits nonzero with a human-readable verdict when the run regressed:
   and the scale-out win evaporated. Single-engine lines never carry
   the field, so they skip; ``replicas`` is a sweep-config key, so
   routed and single-engine rows never cross-judge
+- ``goodput_frac`` below last-good by more than ``--goodput-drop``
+  (10%): the run's goodput ledger (``monitor/goodput.py`` — the
+  wall-clock share spent in ``productive_step``; ``bench.py`` and
+  ``tools/soak.py`` lines carry it) says the same workload now burns
+  its wall somewhere unproductive — compile storm, checkpoint stalls,
+  or input waits; the line's ``goodput`` buckets name which. Skipped
+  when either side lacks the field or the baseline is 0
 - a changed sharding plan (``--plan-drift``): a fresh hardware line
   whose ``shard_plan`` sub-object (from ``tools/shard_plan.py``) names
   a different (dp, mp, pp, batch) than the last-good record's
@@ -170,6 +177,16 @@ DEFAULT_THRESHOLDS = {
     # noisy at single-digit ms)
     "save_cost_growth": 0.50,
     "save_cost_slack_ms": 250.0,
+    # goodput gate (--goodput-drop): fractional drop of the line's
+    # goodput_frac (wall-clock share spent in productive_step — the
+    # run's goodput ledger, monitor/goodput.py; bench.py and
+    # tools/soak.py lines carry it) vs the last-good record before the
+    # check fails — a collapsed goodput fraction means the same
+    # workload now burns its wall somewhere unproductive (compile
+    # storm, checkpoint stalls, input waits). Skips when either side
+    # lacks the field or the baseline is 0, and on CPU smokes with
+    # the rest
+    "goodput_drop": 0.10,
     # sharding-plan drift gate: on by default; --no-plan-drift disables
     "plan_drift": True,
     # program-audit gate (--audit / --no-audit): a fresh hardware line
@@ -503,6 +520,18 @@ def evaluate(fresh: dict, baseline: dict | None, thresholds: dict | None
                   + (" — checkpointing got more expensive (the cadence "
                      "planner will save less often for the same "
                      "overhead budget)" if sfail else ""))
+        gf = fresh.get("goodput_frac")
+        base_gf = (baseline.get("extra") or {}).get("goodput_frac")
+        if gf is not None and base_gf:
+            gdrop = 1.0 - gf / base_gf
+            check("goodput_frac", gdrop <= th["goodput_drop"],
+                  f"goodput {gf:.3f} vs last-good {base_gf:.3f} "
+                  f"({'-' if gdrop > 0 else '+'}{abs(gdrop) * 100:.1f}%,"
+                  f" max drop {th['goodput_drop'] * 100:.0f}%)"
+                  + (" — the run's wall-clock went unproductive "
+                     "(compile storm, checkpoint stalls, or input "
+                     "waits — read the goodput buckets in the line)"
+                     if gdrop > th["goodput_drop"] else ""))
         plan = fresh.get("shard_plan")
         base_plan = (baseline.get("extra") or {}).get("shard_plan")
         if (th.get("plan_drift") and isinstance(plan, dict)
@@ -695,6 +724,12 @@ def main(argv=None) -> int:
                     default=DEFAULT_THRESHOLDS["save_cost_slack_ms"],
                     help="absolute save-cost headroom before the growth "
                          "gate can fail (default 250)")
+    ap.add_argument("--goodput-drop", type=float,
+                    default=DEFAULT_THRESHOLDS["goodput_drop"],
+                    help="max fractional goodput_frac drop vs last-good "
+                         "for lines carrying the goodput ledger "
+                         "(default 0.10; skipped when either side lacks "
+                         "the field)")
     ap.add_argument("--plan-drift", dest="plan_drift",
                     action="store_true", default=True,
                     help="fail a hardware line whose shard_plan differs "
@@ -757,6 +792,7 @@ def main(argv=None) -> int:
                     "affinity_drop": args.affinity_drop,
                     "save_cost_growth": args.save_cost_growth,
                     "save_cost_slack_ms": args.save_cost_slack_ms,
+                    "goodput_drop": args.goodput_drop,
                     "plan_drift": args.plan_drift,
                     "audit": args.audit,
                     "slo_breach": args.slo_breach},

@@ -18,6 +18,11 @@ then injects the two faults that kill real long runs:
   checkpoint, never a torn one.
 - ``PT_SOAK_POISON_AT=<batch>``: one batch of NaNs; the numerics
   sentinel + skip policy must drop it and continue.
+- ``PT_SOAK_HANG_AT=<batch>``: a sleep inside a host callback boundary
+  (``PT_SOAK_HANG_S``, 2.5 s) freezes the step counter; the hang
+  watchdog (``monitor/watchdog.py``, ``PT_HANG_MIN_S=1`` in the soak
+  env) must trip mid-hang and write a blackbox artifact NAMING the hung
+  step (``PT_HANG_BLACKBOX``) while policy ``warn`` lets the run go on.
 
 The run's FINAL STATE is then gated — not just "no stack trace":
 
@@ -84,6 +89,8 @@ def _worker(workdir: str) -> int:
     batch = int(os.environ.get("PT_SOAK_BATCH", str(SMOKE_BATCH)))
     crash_at = int(os.environ.get("PT_SOAK_CRASH_AT", "-1"))
     poison_at = int(os.environ.get("PT_SOAK_POISON_AT", "-1"))
+    hang_at = int(os.environ.get("PT_SOAK_HANG_AT", "-1"))
+    hang_s = float(os.environ.get("PT_SOAK_HANG_S", "2.5"))
     ckpt_dir = os.path.join(workdir, "ckpt")
 
     paddle.seed(0)
@@ -132,9 +139,30 @@ def _worker(workdir: str) -> int:
                               error=f"injected crash at batch {self.n}")
                 os._exit(23)
 
+    class HangAt(paddle.callbacks.Callback):
+        """Injected hang: a sleep inside a host callback boundary — the
+        step counter stops, exactly like a wedged collective from the
+        watchdog's viewpoint. PT_HANG_MIN_S is short in the soak env, so
+        the hang watchdog (monitor/watchdog.py) must trip mid-sleep,
+        write its blackbox artifact naming the hung step, and (policy
+        ``warn``) let the run continue — the driver gates on the
+        artifact."""
+
+        def __init__(self, at, hold_s):
+            self.at = at
+            self.hold_s = hold_s
+            self.n = 0
+
+        def on_train_batch_end(self, step, logs=None):
+            self.n += 1
+            if self.n == self.at:
+                time.sleep(self.hold_s)
+
     cbks = []
     if restart == 0 and crash_at >= 0:
         cbks.append(CrashAt(crash_at))
+    if restart == 0 and hang_at >= 0:
+        cbks.append(HangAt(hang_at, hang_s))
 
     t0 = time.perf_counter()
     model.fit(ds, batch_size=batch, epochs=1, shuffle=False, verbose=0,
@@ -155,6 +183,7 @@ def _worker(workdir: str) -> int:
         "skipped_batches": counters.get("resilience/skipped_batches", 0),
         "saves": counters.get("resilience/saves", 0),
         "crash_resumes": counters.get("resilience/crash_resumes", 0),
+        "hang_trips": counters.get("monitor/hang_trips", 0),
         "params_finite": bool(np.isfinite(params).all()),
         "params_sum": float(params.sum()),
     }
@@ -439,6 +468,9 @@ def main(argv=None) -> int:
                                   str(max(2, steps // 3))))
     poison_at = int(os.environ.get("PT_SOAK_POISON_AT",
                                    str(max(3, (2 * steps) // 3))))
+    # hang BEFORE the crash: injected once, on the first life
+    hang_at = int(os.environ.get("PT_SOAK_HANG_AT",
+                                 str(max(1, steps // 6))))
 
     wd = args.out or tempfile.mkdtemp(prefix="pt_soak_")
     os.makedirs(wd, exist_ok=True)
@@ -449,11 +481,16 @@ def main(argv=None) -> int:
         "PT_SOAK_BATCH": str(batch),
         "PT_SOAK_CRASH_AT": str(crash_at),
         "PT_SOAK_POISON_AT": str(poison_at),
+        "PT_SOAK_HANG_AT": str(hang_at),
         "PT_MONITOR": "1",
         "PT_MONITOR_SINK": sink,
         "PT_MONITOR_MEM": "1",
         # crash postmortem lands in the workdir, not the repo cwd
         "PT_SERVE_BLACKBOX": os.path.join(wd, "serving_blackbox.json"),
+        # hang watchdog: short deadline floor so the injected sleep
+        # trips it; its artifact lands separately from the crash one
+        "PT_HANG_MIN_S": env.get("PT_HANG_MIN_S") or "1",
+        "PT_HANG_BLACKBOX": os.path.join(wd, "hang_blackbox.json"),
         # warm relaunch pays zero fresh XLA compiles (jit/exec_cache.py)
         "PT_EXEC_CACHE": env.get("PT_EXEC_CACHE")
         or os.path.join(wd, "exec_cache"),
@@ -469,7 +506,8 @@ def main(argv=None) -> int:
         env.setdefault("PT_CKPT_OVERHEAD_PCT", "40")
         env.setdefault("PT_CKPT_MAX_INTERVAL", "4")
     print(f"soak: smoke={smoke} steps={steps} crash_at={crash_at} "
-          f"poison_at={poison_at} workdir={wd}", flush=True)
+          f"poison_at={poison_at} hang_at={hang_at} workdir={wd}",
+          flush=True)
 
     t0 = time.perf_counter()
     proc = subprocess.run(
@@ -554,6 +592,31 @@ def main(argv=None) -> int:
     if poison_at >= 0:
         check("nan_skip", skipped >= 1,
               f"{skipped} batch(es) skipped (poison at {poison_at})")
+    if hang_at >= 0:
+        # the injected hang must leave a parseable watchdog artifact
+        # NAMING the hung step (the first life hangs after batch
+        # `hang_at`, so step hang_at+1 is the one that never landed
+        # within deadline)
+        hb_path = env["PT_HANG_BLACKBOX"]
+        hang_ok, hang_detail = False, f"missing: {hb_path}"
+        try:
+            with open(hb_path) as f:
+                hb = json.load(f)
+            trip = (hb.get("state", {}).get("training_watchdog", {})
+                    or {}).get("last_trip") or {}
+            hang_ok = (hb.get("reason") == "hang_watchdog"
+                       and trip.get("hung_step") == hang_at + 1
+                       and bool(trip.get("stacks")))
+            hang_detail = (f"reason={hb.get('reason')} "
+                           f"hung_step={trip.get('hung_step')} "
+                           f"(expected {hang_at + 1}) "
+                           f"stacks={len(trip.get('stacks') or {})} "
+                           f"thread(s)")
+        except OSError:
+            pass
+        except ValueError as e:
+            hang_detail = f"unparseable: {e}"
+        check("hang_watchdog", hang_ok, hang_detail)
 
     losses = [(s["step"], s["loss"]) for s in step_lines if "loss" in s]
     if len(losses) >= 8:
@@ -608,6 +671,20 @@ def main(argv=None) -> int:
     if save_h:
         line["ckpt_save_ms_p50"] = save_h.get("p50")
         line["ckpt_save_ms_max"] = save_h.get("max")
+    gp = final_end.get("goodput") or {}
+    if gp.get("goodput_frac") is not None:
+        # the final life's wall-clock classification (run_end.goodput)
+        line["goodput_frac"] = round(gp["goodput_frac"], 4)
+    if hang_at >= 0:
+        # from the artifact, not the life summaries: the hanging life is
+        # the one the injected crash kills before it writes its summary
+        try:
+            with open(env["PT_HANG_BLACKBOX"]) as f:
+                line["hang_trips"] = (json.load(f).get("state", {})
+                                      .get("training_watchdog", {})
+                                      or {}).get("trips", 0)
+        except (OSError, ValueError):
+            line["hang_trips"] = 0
     if losses:
         line["loss_first"] = losses[0][1]
         line["loss_last"] = losses[-1][1]
@@ -633,7 +710,8 @@ def main(argv=None) -> int:
 
             extra = {k: line[k] for k in (
                 "steps", "batch", "lives", "skipped_batches",
-                "ckpt_saves", "ckpt_save_ms_p50", "wall_s") if k in line}
+                "ckpt_saves", "ckpt_save_ms_p50", "goodput_frac",
+                "wall_s") if k in line}
             meas.record("soak", value, "samples/s", extra=extra)
         except Exception as e:  # noqa: BLE001 — persist must not gate
             print(f"soak: measurement persist failed: {e}",
